@@ -1,0 +1,25 @@
+"""TPU-native network-aware Kubernetes scheduling framework.
+
+A brand-new implementation of the capabilities of the reference
+``pablojara/kubernetesNetAwareScheduler`` (a Go custom scheduler,
+``scheduler/scheduler.go``), re-designed TPU-first:
+
+- Cluster telemetry (the reference's per-pod node_exporter scrapes,
+  scheduler.go:275-279) lives as columnar matrices in TPU HBM
+  (:mod:`~kubernetesnetawarescheduler_tpu.core.state`).
+- Node scoring (the reference's min/max weighted vote,
+  scheduler.go:334-365) is a batched, vmap'd pod x node x peer cost
+  reduction on the MXU (:mod:`~kubernetesnetawarescheduler_tpu.core.score`),
+  with feasibility (capacity, taints, affinity) fused in as ``-inf`` masks.
+- Assignment (the reference's nondeterministic map-argmax,
+  scheduler.go:384-394) is a deterministic argmax with batch-internal
+  conflict resolution (:mod:`~kubernetesnetawarescheduler_tpu.core.assign`).
+- Scale comes from ``shard_map`` over a device mesh
+  (:mod:`~kubernetesnetawarescheduler_tpu.parallel`) and tiled Pallas
+  kernels (:mod:`~kubernetesnetawarescheduler_tpu.ops`), not from
+  serial HTTP round-trips.
+"""
+
+__version__ = "0.1.0"
+
+SCHEDULER_NAME = "netAwareScheduler"  # parity: scheduler.go:119

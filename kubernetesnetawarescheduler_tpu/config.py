@@ -1,0 +1,229 @@
+"""Configuration layer.
+
+The reference hardcodes everything: scheduler name (scheduler.go:119), queue
+size (scheduler.go:129), node names (scheduler.go:252-256), node IPs
+(scheduler.go:275-279), NIC/disk device names (scheduler.go:466-471,
+:535-540), iperf file paths (scheduler.go:507-510) and the metric vote
+weights 3/2/1/1/3/1 (scheduler.go:360-365).  Here all of that is a real
+config surface: dataclasses, loadable from JSON/YAML
+(:func:`load_config`), consumed by the JAX scoring service, the benchmark
+harness and the native extender shim alike.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Any, Mapping, Sequence
+
+# ---------------------------------------------------------------------------
+# Metric channel layout of the NodeMetrics[N, M] matrix.
+#
+# The first six channels are exactly the per-node signals the reference
+# scrapes and votes on (PrometheusNodeMetrics, scheduler.go:24-32):
+#   cpu scaling frequency (getCurrentCPUUsage, scheduler.go:409-442),
+#   occupied memory %     (getOccupiedMemoryPercentage, :444-461),
+#   tx / rx packet totals (getNetworkPacketsSent/Received, :463-500),
+#   iperf3 bandwidth      (getNetworkBandwith, :503-530),
+#   disk io in flight     (getDiskIONow, :532-549).
+# ---------------------------------------------------------------------------
+
+
+class Metric:
+    """Indices into the metric axis of ``NodeMetrics[N, M]``."""
+
+    CPU_FREQ = 0
+    MEM_PCT = 1
+    NET_TX = 2
+    NET_RX = 3
+    BANDWIDTH = 4
+    DISK_IO = 5
+
+    COUNT = 6
+
+    NAMES = ("cpu_freq", "mem_pct", "net_tx", "net_rx", "bandwidth", "disk_io")
+
+
+# Goodness direction per metric: +1 means "higher raw value is better",
+# -1 means "lower raw value is better".  Mirrors the reference's sweep
+# directions (min for cpu/mem/tx/rx/disk, max for bandwidth;
+# scheduler.go:334-359).
+GOODNESS = (-1.0, -1.0, -1.0, -1.0, +1.0, -1.0)
+
+
+class Resource:
+    """Indices into the resource axis of capacity/usage/request vectors."""
+
+    CPU = 0
+    MEM = 1
+    NET_BW = 2
+
+    COUNT = 3
+
+    NAMES = ("cpu", "mem", "net_bw")
+
+
+@dataclasses.dataclass(frozen=True)
+class ScoreWeights:
+    """Weights of the scoring policy.
+
+    ``cpu..disk`` reproduce the reference's vote weights (+3 best CPU,
+    +2 best memory, +1 best tx, +1 best rx, +3 best bandwidth, +1 best
+    disk; scheduler.go:360-365) but applied to *normalized continuous*
+    metrics instead of a winner-takes-all vote, so that close seconds
+    are not scored identically to the worst node.
+
+    ``peer_bw`` / ``peer_lat`` weight the pod-aware network-cost term —
+    the capability the reference's per-pair iperf3 files
+    (scheduler.go:503-530) gesture at, generalized to full node x node
+    bandwidth / latency matrices.
+
+    ``balance`` is the soft bin-packing penalty (worst-fit resource
+    fraction after placement); the reference never consults pod resource
+    requests at all (``pod`` is an unused argument of ``prioritize``,
+    scheduler.go:248).
+    """
+
+    cpu: float = 3.0
+    mem: float = 2.0
+    net_tx: float = 1.0
+    net_rx: float = 1.0
+    bandwidth: float = 3.0
+    disk: float = 1.0
+
+    peer_bw: float = 2.0
+    peer_lat: float = 2.0
+    balance: float = 1.0
+
+    def metric_vector(self) -> tuple[float, ...]:
+        """Per-channel weights aligned with :class:`Metric` order."""
+        return (self.cpu, self.mem, self.net_tx, self.net_rx,
+                self.bandwidth, self.disk)
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshConfig:
+    """Device-mesh layout for sharded scoring.
+
+    ``dp`` shards the pending-pod axis (batch data-parallelism), ``tp``
+    shards the node axis (so the ``N x N`` latency/bandwidth matrices and
+    the per-node capacity state split across devices).  ``dp * tp`` must
+    equal the number of participating devices.
+    """
+
+    dp: int = 1
+    tp: int = 1
+
+    @property
+    def num_devices(self) -> int:
+        return self.dp * self.tp
+
+
+@dataclasses.dataclass(frozen=True)
+class SchedulerConfig:
+    """Static shapes + policy for one compiled scheduler instance.
+
+    Shapes are compile-time constants (XLA requirement): real clusters
+    are padded up to ``max_nodes`` / batches padded to ``max_pods`` with
+    validity masks, so metric updates never trigger recompilation.
+    """
+
+    max_nodes: int = 128
+    max_pods: int = 64
+    max_peers: int = 8
+
+    num_metrics: int = Metric.COUNT
+    num_resources: int = Resource.COUNT
+
+    weights: ScoreWeights = dataclasses.field(default_factory=ScoreWeights)
+    mesh: MeshConfig = dataclasses.field(default_factory=MeshConfig)
+
+    # Metric staleness: scores decay toward neutral with age
+    # (exp(-age/tau)).  The reference instead re-scrapes every node
+    # synchronously per pod (scheduler.go:275-279) and trusts whatever
+    # iperf JSON was last dropped into /home (scheduler.go:512).
+    staleness_tau_s: float = 60.0
+
+    # Pending-pod queue capacity; parity with the reference's
+    # ``make(chan *v1.Pod, 300)`` (scheduler.go:129).
+    queue_capacity: int = 300
+
+    # Pods addressed to this scheduler name are ours (scheduler.go:119,
+    # :170).
+    scheduler_name: str = "netAwareScheduler"
+
+    # Compute dtype for the score matmuls (MXU-friendly).
+    use_bfloat16: bool = True
+
+    def __post_init__(self) -> None:
+        if self.max_nodes <= 0 or self.max_pods <= 0 or self.max_peers <= 0:
+            raise ValueError("shape limits must be positive")
+        if self.num_metrics < Metric.COUNT:
+            raise ValueError(
+                f"need at least {Metric.COUNT} metric channels for parity")
+
+
+# ---------------------------------------------------------------------------
+# (De)serialization — config files for the service / shim / benchmarks.
+# ---------------------------------------------------------------------------
+
+
+# Nested dataclass fields of SchedulerConfig, by field name.
+_NESTED = {"weights": ScoreWeights, "mesh": MeshConfig}
+
+
+def _from_mapping(cls: Any, data: Mapping[str, Any]) -> Any:
+    known = {f.name for f in dataclasses.fields(cls)}
+    unknown = set(data) - known
+    if unknown:
+        raise ValueError(
+            f"unknown {cls.__name__} config keys: {sorted(unknown)}; "
+            f"valid keys: {sorted(known)}")
+    kwargs: dict[str, Any] = {}
+    for name, value in data.items():
+        nested = _NESTED.get(name)
+        if nested is not None and isinstance(value, Mapping):
+            value = _from_mapping(nested, value)
+        kwargs[name] = value
+    return cls(**kwargs)
+
+
+def config_from_dict(data: Mapping[str, Any]) -> SchedulerConfig:
+    return _from_mapping(SchedulerConfig, data)
+
+
+def config_to_dict(cfg: SchedulerConfig) -> dict[str, Any]:
+    return dataclasses.asdict(cfg)
+
+
+def load_config(path: str) -> SchedulerConfig:
+    """Load a :class:`SchedulerConfig` from a JSON or YAML file."""
+    with open(path, "r", encoding="utf-8") as fh:
+        text = fh.read()
+    if path.endswith((".yaml", ".yml")):
+        import yaml
+
+        data = yaml.safe_load(text)
+    else:
+        data = json.loads(text)
+    return config_from_dict(data or {})
+
+
+def save_config(cfg: SchedulerConfig, path: str) -> None:
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(config_to_dict(cfg), fh, indent=2)
+        fh.write("\n")
+
+
+__all__: Sequence[str] = (
+    "Metric",
+    "Resource",
+    "GOODNESS",
+    "ScoreWeights",
+    "MeshConfig",
+    "SchedulerConfig",
+    "config_from_dict",
+    "config_to_dict",
+    "load_config",
+    "save_config",
+)

@@ -1,0 +1,18 @@
+"""Core scheduling engine: device-resident state, scoring, assignment."""
+
+from kubernetesnetawarescheduler_tpu.core.state import (  # noqa: F401
+    ClusterState,
+    PodBatch,
+    init_cluster_state,
+    init_pod_batch,
+)
+from kubernetesnetawarescheduler_tpu.core.score import (  # noqa: F401
+    score_pods,
+    feasibility_mask,
+    NEG_INF,
+)
+from kubernetesnetawarescheduler_tpu.core.assign import (  # noqa: F401
+    assign_greedy,
+    assign_parallel,
+    schedule_batch,
+)

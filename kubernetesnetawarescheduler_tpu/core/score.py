@@ -1,0 +1,187 @@
+"""The score/filter kernel: batched pod x node scoring on the MXU.
+
+This replaces the reference's ``prioritize()`` (scheduler.go:248-368),
+which per scheduled pod performed 5 serial HTTP scrapes, ~25 substring
+scans and a winner-takes-all vote (+3 best CPU, +2 best mem, +1 best
+tx/rx, +3 best bandwidth, +1 best disk; scheduler.go:360-365) over a
+hardcoded 5-node set, ignoring the pod entirely (its ``pod`` argument is
+never read).  Known reference bugs intentionally NOT reproduced: the
+bandwidth winner overwrote ``bestNetSentNode`` so the +3 bandwidth vote
+went to a dead key (scheduler.go:351-354, :364), and the map-iteration
+argmax tie-break was nondeterministic (scheduler.go:384-394).
+
+Here, for a batch of ``P`` pods against ``N`` nodes with up to ``K``
+peers each:
+
+    score[p, n] = metric_score[n]                    (continuous vote)
+                + (T @ C)[p, n]                      (network cost, MXU)
+                - w_balance * worst_fit[p, n]        (soft bin packing)
+                + (-inf if infeasible)               (batched masks)
+
+where ``T[P, N]`` is the per-pod traffic-to-node matrix (scatter-added
+from the peer lists) and ``C[N, N] = w_bw * bw_norm - w_lat * lat_norm``
+is the pairwise network desirability matrix.  Expressing the peer
+reduction as a dense ``[P, N] @ [N, N]`` matmul is the TPU-first move:
+the gather/reduce the reference does with files and loops rides the
+128x128 systolic array in bfloat16.
+
+Everything is shape-static and jit-compatible; no data-dependent Python
+control flow.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from kubernetesnetawarescheduler_tpu.config import (
+    GOODNESS,
+    SchedulerConfig,
+)
+from kubernetesnetawarescheduler_tpu.core.state import ClusterState, PodBatch
+
+NEG_INF = jnp.float32(-1e30)
+_EPS = 1e-9
+
+
+def normalize_metrics(metrics: jax.Array, node_valid: jax.Array,
+                      goodness: jax.Array) -> jax.Array:
+    """Min-max normalize each metric channel over valid nodes to [0, 1],
+    flipped so that 1.0 is always "best".
+
+    The reference's analog is the sentinel-initialized min/max sweep
+    (scheduler.go:258-265, :334-359): only the single winner per metric
+    got credit.  Continuous normalization keeps the same ordering while
+    making scores informative for every node.
+    """
+    valid = node_valid[:, None]
+    big = jnp.float32(3.4e38)
+    lo = jnp.min(jnp.where(valid, metrics, big), axis=0)
+    hi = jnp.max(jnp.where(valid, metrics, -big), axis=0)
+    span = jnp.maximum(hi - lo, _EPS)
+    unit = (metrics - lo[None, :]) / span[None, :]
+    unit = jnp.clip(unit, 0.0, 1.0)
+    # goodness=+1 -> keep; goodness=-1 -> 1 - unit.
+    flipped = jnp.where(goodness[None, :] > 0, unit, 1.0 - unit)
+    return jnp.where(valid, flipped, 0.0)
+
+
+def metric_scores(state: ClusterState, cfg: SchedulerConfig) -> jax.Array:
+    """Pod-independent per-node score ``f32[N]``: the weighted continuous
+    vote over normalized metrics, decayed by staleness.
+
+    Staleness decay replaces the reference's synchronous re-scrape per
+    pod (scheduler.go:275-279): a node whose telemetry is old drifts
+    toward a neutral 0.5 per channel instead of being trusted blindly.
+    """
+    goodness = jnp.asarray(GOODNESS + (0.0,) * (cfg.num_metrics - len(GOODNESS)),
+                           jnp.float32)
+    w = jnp.asarray(cfg.weights.metric_vector() +
+                    (0.0,) * (cfg.num_metrics - len(GOODNESS)), jnp.float32)
+    norm = normalize_metrics(state.metrics, state.node_valid, goodness)
+    conf = jnp.exp(-state.metrics_age / cfg.staleness_tau_s)
+    blended = conf[:, None] * norm + (1.0 - conf[:, None]) * 0.5
+    score = blended @ w
+    return jnp.where(state.node_valid, score, 0.0)
+
+
+def peer_traffic_matrix(pods: PodBatch, num_nodes: int) -> jax.Array:
+    """Scatter the ragged peer lists into a dense ``T[P, N]`` traffic
+    matrix (CSR -> padded dense; peers with index -1 are dropped).
+
+    This is the densification step that turns the per-peer gather into
+    an MXU matmul.  The reference's counterpart is one iperf3 JSON file
+    read per node per pod (scheduler.go:503-530).
+    """
+    p, k = pods.peers.shape
+    valid = (pods.peers >= 0) & pods.pod_valid[:, None]
+    safe = jnp.where(valid, pods.peers, 0)
+    traffic = jnp.where(valid, pods.peer_traffic, 0.0)
+    t = jnp.zeros((p, num_nodes), jnp.float32)
+    return t.at[jnp.arange(p)[:, None], safe].add(traffic, mode="drop")
+
+
+def net_cost_matrix(state: ClusterState, cfg: SchedulerConfig) -> jax.Array:
+    """``C[N, N] = w_bw * bw_norm - w_lat * lat_norm``, the desirability
+    of placing one end of a flow on row-node given the other end on
+    column-node.  Normalized by the max over valid pairs so weights are
+    scale-free.
+
+    The diagonal is pinned to the best possible value (``w_bw``):
+    co-located endpoints talk over loopback, which no physical link
+    beats — regardless of what the probe pipeline wrote into
+    ``bw[i, i]`` (iperf never measures a node against itself;
+    run.sh:12 probes client->server pairs only)."""
+    pair_valid = state.node_valid[:, None] & state.node_valid[None, :]
+    bw_max = jnp.maximum(jnp.max(jnp.where(pair_valid, state.bw, 0.0)), _EPS)
+    lat_max = jnp.maximum(jnp.max(jnp.where(pair_valid, state.lat, 0.0)), _EPS)
+    c = (cfg.weights.peer_bw * state.bw / bw_max
+         - cfg.weights.peer_lat * state.lat / lat_max)
+    n = state.num_nodes
+    eye = jnp.eye(n, dtype=bool)
+    c = jnp.where(eye, cfg.weights.peer_bw, c)
+    return jnp.where(pair_valid, c, 0.0)
+
+
+def network_scores(state: ClusterState, pods: PodBatch,
+                   cfg: SchedulerConfig) -> jax.Array:
+    """Pod-aware network term ``f32[P, N]`` as a single MXU matmul."""
+    t = peer_traffic_matrix(pods, state.num_nodes)
+    c = net_cost_matrix(state, cfg)
+    if cfg.use_bfloat16:
+        # bf16 inputs, f32 accumulation: standard MXU recipe.
+        return jnp.dot(t.astype(jnp.bfloat16), c.T.astype(jnp.bfloat16),
+                       preferred_element_type=jnp.float32)
+    # Full f32: on TPU the default matmul precision is bf16 passes, so
+    # ask for HIGHEST explicitly when exactness is requested.
+    return jnp.dot(t, c.T, precision=jax.lax.Precision.HIGHEST)
+
+
+def balance_penalty(state: ClusterState, pods: PodBatch) -> jax.Array:
+    """Worst-fit fractional utilization after placement, ``f32[P, N]``:
+    ``max_r (used[n,r] + req[p,r]) / cap[n,r]``.  Soft bin-packing
+    pressure; the reference has no notion of this (pod requests unused,
+    scheduler.go:248)."""
+    cap = jnp.maximum(state.cap, _EPS)
+    frac = (state.used[None, :, :] + pods.req[:, None, :]) / cap[None, :, :]
+    return jnp.max(frac, axis=-1)
+
+
+def feasibility_mask(state: ClusterState, pods: PodBatch) -> jax.Array:
+    """Hard constraints as a batched ``bool[P, N]`` mask.
+
+    Covers what the reference delegated to stock Kubernetes for its own
+    probe pods (nodeAffinity deployment.yaml:17-26, tolerations
+    deployment.yaml:27-31) plus capacity fit, fused so filtering and
+    scoring are one kernel:
+
+    - fit:       req <= cap - used for every resource
+    - taints:    node taints ⊆ pod tolerations
+    - selector:  required node labels all present
+    - pod affinity:      some required group present on node (if any)
+    - pod anti-affinity: no forbidden group present on node, and
+      symmetrically no resident pod forbids this pod's group (k8s's
+      existing-pod-anti-affinity symmetry)
+    """
+    free = state.cap - state.used
+    fits = jnp.all(pods.req[:, None, :] <= free[None, :, :] + _EPS, axis=-1)
+    tol = (state.taint_bits[None, :] & ~pods.tol_bits[:, None]) == 0
+    sel = (state.label_bits[None, :] & pods.sel_bits[:, None]) \
+        == pods.sel_bits[:, None]
+    aff_req = pods.affinity_bits[:, None]
+    affinity = (aff_req == 0) | ((state.group_bits[None, :] & aff_req) != 0)
+    anti = (state.group_bits[None, :] & pods.anti_bits[:, None]) == 0
+    sym = (state.resident_anti[None, :] & pods.group_bit[:, None]) == 0
+    ok = fits & tol & sel & affinity & anti & sym
+    return ok & state.node_valid[None, :] & pods.pod_valid[:, None]
+
+
+def score_pods(state: ClusterState, pods: PodBatch,
+               cfg: SchedulerConfig) -> jax.Array:
+    """Full masked score matrix ``f32[P, N]``; -inf marks infeasible."""
+    base = metric_scores(state, cfg)[None, :]
+    net = network_scores(state, pods, cfg)
+    bal = cfg.weights.balance * balance_penalty(state, pods)
+    raw = base + net - bal
+    ok = feasibility_mask(state, pods)
+    return jnp.where(ok, raw, NEG_INF)

@@ -1,0 +1,214 @@
+"""Device-resident columnar cluster state.
+
+The reference keeps no state at all: every scheduling cycle re-scrapes all
+five node_exporters synchronously (scheduler.go:275-279) and re-reads the
+iperf3 JSON files from ``/home`` (scheduler.go:503-530), i.e. its "state"
+is the network.  Here the cluster lives in TPU HBM as fixed-shape arrays,
+updated asynchronously by the ingest layer, and scoring is pure compute:
+
+- ``metrics[N, M]``          — generalized ``PrometheusNodeMetrics``
+                               (struct at scheduler.go:24-32).
+- ``lat[N, N]`` / ``bw[N, N]`` — the netperf-derived pairwise matrices
+                               replacing per-node iperf3 files
+                               (scheduler.go:503-530, run.sh:12-14).
+- ``cap/used[N, R]``          — capacities & usage; the reference never
+                               consults these (``pod`` unused in
+                               ``prioritize``, scheduler.go:248).
+- label/taint/group bitmasks  — batched feasibility, replacing the stock
+                               k8s mechanisms the reference leaned on
+                               (nodeAffinity/toleration in its probe
+                               manifests, deployment.yaml:17-31).
+
+All shapes are static (padded to ``cfg.max_nodes`` / ``cfg.max_pods``)
+with validity masks so that live updates never recompile.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from kubernetesnetawarescheduler_tpu.config import SchedulerConfig
+
+
+@struct.dataclass
+class ClusterState:
+    """Columnar cluster telemetry + allocation state (a JAX pytree).
+
+    Shapes (``N = cfg.max_nodes``, ``M = cfg.num_metrics``,
+    ``R = cfg.num_resources``):
+
+    - ``metrics``      f32[N, M]   raw metric values per node
+    - ``metrics_age``  f32[N]      seconds since each node's last update
+    - ``lat``          f32[N, N]   pairwise latency (ms); 0 on diagonal
+    - ``bw``           f32[N, N]   pairwise bandwidth (bits/s)
+    - ``cap``          f32[N, R]   allocatable capacity
+    - ``used``         f32[N, R]   currently allocated
+    - ``node_valid``   bool[N]     padding/health mask
+    - ``label_bits``   u32[N]      interned node-label set (bitmask)
+    - ``taint_bits``   u32[N]      interned taint set (bitmask)
+    - ``group_bits``   u32[N]      pod-groups present on the node
+                                   (inter-pod affinity at hostname
+                                   topology, as batched masks)
+    - ``resident_anti`` u32[N]     OR of the anti-affinity selectors of
+                                   pods already on the node — enforces
+                                   k8s's *symmetric* required
+                                   anti-affinity (a group-G pod may not
+                                   join a node hosting a pod that
+                                   declared anti-affinity to G)
+    """
+
+    metrics: jax.Array
+    metrics_age: jax.Array
+    lat: jax.Array
+    bw: jax.Array
+    cap: jax.Array
+    used: jax.Array
+    node_valid: jax.Array
+    label_bits: jax.Array
+    taint_bits: jax.Array
+    group_bits: jax.Array
+    resident_anti: jax.Array
+
+    @property
+    def num_nodes(self) -> int:
+        return self.metrics.shape[0]
+
+    @property
+    def num_metrics(self) -> int:
+        return self.metrics.shape[1]
+
+    @property
+    def num_resources(self) -> int:
+        return self.cap.shape[1]
+
+
+@struct.dataclass
+class PodBatch:
+    """A batch of pending pods to place (a JAX pytree).
+
+    Shapes (``P = cfg.max_pods``, ``K = cfg.max_peers``,
+    ``R = cfg.num_resources``):
+
+    - ``req``            f32[P, R]  resource requests
+    - ``peers``          i32[P, K]  node index of each already-placed peer
+                                    the pod exchanges traffic with
+                                    (-1 = padding)
+    - ``peer_traffic``   f32[P, K]  relative traffic volume per peer
+    - ``tol_bits``       u32[P]     tolerated taints (bitmask)
+    - ``sel_bits``       u32[P]     required node labels (bitmask; node
+                                    must have ALL of these)
+    - ``affinity_bits``  u32[P]     required co-located pod groups (node
+                                    must host at least one if nonzero)
+    - ``anti_bits``      u32[P]     anti-affinity pod groups (node must
+                                    host NONE)
+    - ``group_bit``      u32[P]     the pod's own group bit (0 = none),
+                                    committed to ``group_bits`` on bind
+    - ``priority``       f32[P]     scheduling priority (higher first)
+    - ``pod_valid``      bool[P]    padding mask
+    """
+
+    req: jax.Array
+    peers: jax.Array
+    peer_traffic: jax.Array
+    tol_bits: jax.Array
+    sel_bits: jax.Array
+    affinity_bits: jax.Array
+    anti_bits: jax.Array
+    group_bit: jax.Array
+    priority: jax.Array
+    pod_valid: jax.Array
+
+    @property
+    def num_pods(self) -> int:
+        return self.req.shape[0]
+
+    @property
+    def max_peers(self) -> int:
+        return self.peers.shape[1]
+
+
+def init_cluster_state(cfg: SchedulerConfig, **overrides: Any) -> ClusterState:
+    """An empty, all-padding cluster of static shape."""
+    n, m, r = cfg.max_nodes, cfg.num_metrics, cfg.num_resources
+    fields = dict(
+        metrics=jnp.zeros((n, m), jnp.float32),
+        metrics_age=jnp.zeros((n,), jnp.float32),
+        lat=jnp.zeros((n, n), jnp.float32),
+        bw=jnp.zeros((n, n), jnp.float32),
+        cap=jnp.zeros((n, r), jnp.float32),
+        used=jnp.zeros((n, r), jnp.float32),
+        node_valid=jnp.zeros((n,), jnp.bool_),
+        label_bits=jnp.zeros((n,), jnp.uint32),
+        taint_bits=jnp.zeros((n,), jnp.uint32),
+        group_bits=jnp.zeros((n,), jnp.uint32),
+        resident_anti=jnp.zeros((n,), jnp.uint32),
+    )
+    fields.update(overrides)
+    return ClusterState(**fields)
+
+
+def init_pod_batch(cfg: SchedulerConfig, **overrides: Any) -> PodBatch:
+    """An empty, all-padding pod batch of static shape."""
+    p, k, r = cfg.max_pods, cfg.max_peers, cfg.num_resources
+    fields = dict(
+        req=jnp.zeros((p, r), jnp.float32),
+        peers=jnp.full((p, k), -1, jnp.int32),
+        peer_traffic=jnp.zeros((p, k), jnp.float32),
+        tol_bits=jnp.zeros((p,), jnp.uint32),
+        sel_bits=jnp.zeros((p,), jnp.uint32),
+        affinity_bits=jnp.zeros((p,), jnp.uint32),
+        anti_bits=jnp.zeros((p,), jnp.uint32),
+        group_bit=jnp.zeros((p,), jnp.uint32),
+        priority=jnp.zeros((p,), jnp.float32),
+        pod_valid=jnp.zeros((p,), jnp.bool_),
+    )
+    fields.update(overrides)
+    return PodBatch(**fields)
+
+
+def commit_assignments(state: ClusterState, pods: PodBatch,
+                       assignment: jax.Array) -> ClusterState:
+    """Apply a batch assignment to the allocation state.
+
+    ``assignment`` is i32[P] with the chosen node per pod (-1 =
+    unschedulable).  Adds each placed pod's requests to ``used`` and ORs
+    its group bit into the node's ``group_bits`` — the device-side
+    counterpart of the reference's ``Bind`` POST (scheduler.go:196-206),
+    which is emitted host-side by the binder.
+    """
+    placed = (assignment >= 0) & pods.pod_valid
+    safe_idx = jnp.where(placed, assignment, 0)
+    add = jnp.where(placed[:, None], pods.req, 0.0)
+    used = state.used.at[safe_idx].add(add, mode="drop")
+    # Per-node OR of the placed pods' group bits.  A scatter-add would
+    # double-count two same-group pods landing on one node, so reduce a
+    # one-hot [P, N] mask with bitwise-or instead.
+    onehot = placed[:, None] & (
+        assignment[:, None] == jnp.arange(state.num_nodes)[None, :])
+
+    def scatter_or(bits):
+        contrib = jnp.where(onehot, bits[:, None], jnp.uint32(0))
+        return jax.lax.reduce(contrib, jnp.uint32(0),
+                              jax.lax.bitwise_or, dimensions=[0])
+
+    return state.replace(
+        used=used,
+        group_bits=state.group_bits | scatter_or(pods.group_bit),
+        resident_anti=state.resident_anti | scatter_or(pods.anti_bits))
+
+
+def pad_axis(x: jax.Array, size: int, axis: int = 0,
+             fill: float = 0.0) -> jax.Array:
+    """Pad ``x`` along ``axis`` up to ``size`` with ``fill``."""
+    cur = x.shape[axis]
+    if cur > size:
+        raise ValueError(f"axis {axis} has {cur} > max {size}")
+    if cur == size:
+        return x
+    widths = [(0, 0)] * x.ndim
+    widths[axis] = (0, size - cur)
+    return jnp.pad(x, widths, constant_values=fill)

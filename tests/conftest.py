@@ -1,0 +1,11 @@
+"""Test env: force the CPU backend with 8 virtual devices so sharding
+tests run without TPU hardware (mirrors the driver's dryrun harness).
+Must run before anything imports jax."""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()

@@ -1,0 +1,87 @@
+"""Random cluster/pod-batch instance generator for tests (NumPy side).
+
+This is the seed of the "fake cluster state generator" SURVEY.md 4 calls
+for — the replacement for testing against a live 5-node cluster.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from kubernetesnetawarescheduler_tpu.config import SchedulerConfig
+from kubernetesnetawarescheduler_tpu.core.state import (
+    ClusterState,
+    PodBatch,
+    init_cluster_state,
+    init_pod_batch,
+)
+
+
+def random_instance(rng: np.random.Generator, cfg: SchedulerConfig,
+                    n_nodes: int | None = None, n_pods: int | None = None,
+                    with_constraints: bool = True):
+    """Random (state, pods) as plain numpy dicts matching the pytrees."""
+    n_total, m, r = cfg.max_nodes, cfg.num_metrics, cfg.num_resources
+    p_total, k = cfg.max_pods, cfg.max_peers
+    n = n_nodes if n_nodes is not None else n_total
+    p = n_pods if n_pods is not None else p_total
+
+    node_valid = np.zeros((n_total,), bool)
+    node_valid[:n] = True
+    lat = rng.uniform(0.1, 20.0, (n_total, n_total)).astype(np.float32)
+    lat = (lat + lat.T) / 2
+    np.fill_diagonal(lat, 0.0)
+    bw = rng.uniform(1e8, 1e10, (n_total, n_total)).astype(np.float32)
+    bw = (bw + bw.T) / 2
+
+    cap = rng.uniform(4.0, 32.0, (n_total, r)).astype(np.float32)
+    used = (cap * rng.uniform(0.0, 0.6, (n_total, r))).astype(np.float32)
+
+    state = dict(
+        metrics=rng.uniform(0.0, 100.0, (n_total, m)).astype(np.float32),
+        metrics_age=rng.uniform(0.0, 120.0, (n_total,)).astype(np.float32),
+        lat=lat,
+        bw=bw,
+        cap=cap,
+        used=used,
+        node_valid=node_valid,
+        label_bits=rng.integers(0, 8, (n_total,)).astype(np.uint32),
+        taint_bits=(rng.random((n_total,)) < 0.2).astype(np.uint32)
+        * np.uint32(1 if with_constraints else 0),
+        group_bits=rng.integers(0, 4, (n_total,)).astype(np.uint32),
+        resident_anti=(rng.integers(0, 4, (n_total,)).astype(np.uint32)
+                       * np.uint32(1 if with_constraints else 0)),
+    )
+
+    pod_valid = np.zeros((p_total,), bool)
+    pod_valid[:p] = True
+    peers = rng.integers(-1, n, (p_total, k)).astype(np.int32)
+    pods = dict(
+        req=rng.uniform(0.1, 4.0, (p_total, r)).astype(np.float32),
+        peers=peers,
+        peer_traffic=rng.uniform(0.0, 5.0, (p_total, k)).astype(np.float32),
+        tol_bits=(rng.random((p_total,)) < 0.5).astype(np.uint32),
+        sel_bits=(rng.integers(0, 4, (p_total,)).astype(np.uint32)
+                  * np.uint32(1 if with_constraints else 0)),
+        affinity_bits=(rng.random((p_total,)) < 0.15).astype(np.uint32)
+        * np.uint32(2 if with_constraints else 0),
+        anti_bits=(rng.random((p_total,)) < 0.15).astype(np.uint32)
+        * np.uint32(1 if with_constraints else 0),
+        group_bit=np.uint32(1) << rng.integers(0, 2, (p_total,)).astype(np.uint32),
+        priority=rng.uniform(0.0, 10.0, (p_total,)).astype(np.float32),
+        pod_valid=pod_valid,
+    )
+    return state, pods
+
+
+def to_pytrees(cfg: SchedulerConfig, state_np: dict, pods_np: dict):
+    import jax.numpy as jnp
+
+    state = init_cluster_state(cfg, **{
+        key: jnp.asarray(val) for key, val in state_np.items()})
+    pods = init_pod_batch(cfg, **{
+        key: jnp.asarray(val) for key, val in pods_np.items()})
+    return state, pods
+
+
+__all__ = ["random_instance", "to_pytrees", "ClusterState", "PodBatch"]

@@ -1,0 +1,165 @@
+"""Pure-NumPy oracle implementations of the scoring/assignment semantics.
+
+Deliberately written with explicit Python loops and no JAX, so that the
+vectorized device kernels in ``core/`` are tested against an independent
+reimplementation (SURVEY.md 4's test plan item (a)).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from kubernetesnetawarescheduler_tpu.config import GOODNESS, SchedulerConfig
+
+NEG_INF = -1e30
+EPS = 1e-9
+
+
+def oracle_normalize(metrics, node_valid, goodness):
+    n, m = metrics.shape
+    out = np.zeros((n, m), np.float32)
+    for j in range(m):
+        vals = [metrics[i, j] for i in range(n) if node_valid[i]]
+        if not vals:
+            continue
+        lo, hi = min(vals), max(vals)
+        span = max(hi - lo, EPS)
+        for i in range(n):
+            if not node_valid[i]:
+                continue
+            unit = min(max((metrics[i, j] - lo) / span, 0.0), 1.0)
+            out[i, j] = unit if goodness[j] > 0 else 1.0 - unit
+    return out
+
+
+def oracle_metric_scores(state, cfg: SchedulerConfig):
+    n, m = state["metrics"].shape
+    goodness = list(GOODNESS) + [0.0] * (m - len(GOODNESS))
+    w = list(cfg.weights.metric_vector()) + [0.0] * (m - len(GOODNESS))
+    norm = oracle_normalize(state["metrics"], state["node_valid"], goodness)
+    out = np.zeros((n,), np.float32)
+    for i in range(n):
+        if not state["node_valid"][i]:
+            continue
+        conf = np.exp(-state["metrics_age"][i] / cfg.staleness_tau_s)
+        s = 0.0
+        for j in range(m):
+            blended = conf * norm[i, j] + (1.0 - conf) * 0.5
+            s += w[j] * blended
+        out[i] = s
+    return out
+
+
+def oracle_traffic_matrix(pods, num_nodes):
+    p, k = pods["peers"].shape
+    t = np.zeros((p, num_nodes), np.float32)
+    for i in range(p):
+        if not pods["pod_valid"][i]:
+            continue
+        for kk in range(k):
+            j = pods["peers"][i, kk]
+            if j >= 0:
+                t[i, j] += pods["peer_traffic"][i, kk]
+    return t
+
+
+def oracle_net_cost(state, cfg: SchedulerConfig):
+    n = state["lat"].shape[0]
+    valid = state["node_valid"]
+    bw_max = max(
+        (state["bw"][i, j] for i in range(n) for j in range(n)
+         if valid[i] and valid[j]), default=0.0)
+    lat_max = max(
+        (state["lat"][i, j] for i in range(n) for j in range(n)
+         if valid[i] and valid[j]), default=0.0)
+    bw_max = max(bw_max, EPS)
+    lat_max = max(lat_max, EPS)
+    c = np.zeros((n, n), np.float32)
+    for i in range(n):
+        for j in range(n):
+            if valid[i] and valid[j]:
+                if i == j:  # loopback: best possible link
+                    c[i, j] = cfg.weights.peer_bw
+                else:
+                    c[i, j] = (cfg.weights.peer_bw * state["bw"][i, j] / bw_max
+                               - cfg.weights.peer_lat * state["lat"][i, j] / lat_max)
+    return c
+
+
+def oracle_feasible(state, pods, used=None, group_bits=None,
+                    resident_anti=None):
+    used = state["used"] if used is None else used
+    group_bits = state["group_bits"] if group_bits is None else group_bits
+    resident_anti = (state["resident_anti"] if resident_anti is None
+                     else resident_anti)
+    p = pods["req"].shape[0]
+    n = state["cap"].shape[0]
+    ok = np.zeros((p, n), bool)
+    for i in range(p):
+        for j in range(n):
+            if not (pods["pod_valid"][i] and state["node_valid"][j]):
+                continue
+            fits = all(pods["req"][i, r] <= state["cap"][j, r] - used[j, r] + EPS
+                       for r in range(state["cap"].shape[1]))
+            tol = (int(state["taint_bits"][j]) & ~int(pods["tol_bits"][i])) == 0
+            sel = (int(state["label_bits"][j]) & int(pods["sel_bits"][i])) \
+                == int(pods["sel_bits"][i])
+            aff = (int(pods["affinity_bits"][i]) == 0
+                   or (int(group_bits[j]) & int(pods["affinity_bits"][i])) != 0)
+            anti = (int(group_bits[j]) & int(pods["anti_bits"][i])) == 0
+            sym = (int(resident_anti[j]) & int(pods["group_bit"][i])) == 0
+            ok[i, j] = fits and tol and sel and aff and anti and sym
+    return ok
+
+
+def oracle_balance(state, pods, used=None):
+    used = state["used"] if used is None else used
+    p = pods["req"].shape[0]
+    n, r = state["cap"].shape
+    out = np.zeros((p, n), np.float32)
+    for i in range(p):
+        for j in range(n):
+            out[i, j] = max(
+                (used[j, rr] + pods["req"][i, rr]) / max(state["cap"][j, rr], EPS)
+                for rr in range(r))
+    return out
+
+
+def oracle_scores(state, pods, cfg: SchedulerConfig):
+    base = oracle_metric_scores(state, cfg)
+    t = oracle_traffic_matrix(pods, state["cap"].shape[0])
+    c = oracle_net_cost(state, cfg)
+    net = t @ c.T
+    bal = cfg.weights.balance * oracle_balance(state, pods)
+    ok = oracle_feasible(state, pods)
+    raw = base[None, :] + net - bal
+    return np.where(ok, raw, NEG_INF).astype(np.float32)
+
+
+def oracle_assign_greedy(state, pods, cfg: SchedulerConfig):
+    """Sequential greedy assignment with capacity/group updates."""
+    p = pods["req"].shape[0]
+    base = oracle_metric_scores(state, cfg)
+    t = oracle_traffic_matrix(pods, state["cap"].shape[0])
+    c = oracle_net_cost(state, cfg)
+    net = t @ c.T
+    used = state["used"].copy()
+    group = state["group_bits"].copy()
+    res_anti = state["resident_anti"].copy()
+    # priority desc, index asc
+    order = sorted(range(p), key=lambda i: (-pods["priority"][i], i))
+    out = np.full((p,), -1, np.int32)
+    for i in order:
+        if not pods["pod_valid"][i]:
+            continue
+        ok = oracle_feasible(state, pods, used, group, res_anti)[i]
+        bal = cfg.weights.balance * oracle_balance(state, pods, used)[i]
+        row = np.where(ok, base + net[i] - bal, NEG_INF)
+        j = int(np.argmax(row))
+        if row[j] <= NEG_INF * 0.5:
+            continue
+        out[i] = j
+        used[j] += pods["req"][i]
+        group[j] |= pods["group_bit"][i]
+        res_anti[j] |= pods["anti_bits"][i]
+    return out

@@ -1,0 +1,158 @@
+"""Assignment semantics: greedy vs oracle, parallel safety properties.
+
+SURVEY.md 4 plan item (e): constraint masks (capacity, taints, node
+selectors, affinity/anti-affinity) must never be violated by the argmax,
+including *within* a batch (the stateful-capacity hard part).
+"""
+
+import numpy as np
+import pytest
+
+from kubernetesnetawarescheduler_tpu.config import SchedulerConfig
+from kubernetesnetawarescheduler_tpu.core import assign as assign_lib
+from kubernetesnetawarescheduler_tpu.core.state import commit_assignments
+
+from tests import gen, oracle
+
+CFG = SchedulerConfig(max_nodes=16, max_pods=12, max_peers=4,
+                      use_bfloat16=False)
+
+
+def make(seed, n_nodes=12, n_pods=10, cfg=CFG, **kw):
+    rng = np.random.default_rng(seed)
+    state_np, pods_np = gen.random_instance(rng, cfg, n_nodes=n_nodes,
+                                            n_pods=n_pods, **kw)
+    state, pods = gen.to_pytrees(cfg, state_np, pods_np)
+    return state_np, pods_np, state, pods
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2, 3, 4])
+def test_greedy_matches_oracle(seed):
+    state_np, pods_np, state, pods = make(seed)
+    got = np.asarray(assign_lib.assign_greedy(state, pods, CFG))
+    want = oracle.oracle_assign_greedy(state_np, pods_np, CFG)
+    np.testing.assert_array_equal(got, want)
+
+
+def check_assignment_safety(state_np, pods_np, assignment, cfg):
+    """The batch placement must be *serializable*: there exists an order
+    in which each pod's constraints hold at its own placement time
+    (capacity and symmetric anti-affinity are order-independent;
+    positive affinity created within the batch makes order matter)."""
+    remaining = [i for i, j in enumerate(assignment) if j >= 0]
+    for i in remaining:
+        assert pods_np["pod_valid"][i]
+        assert state_np["node_valid"][assignment[i]]
+    used = state_np["used"].copy()
+    group = state_np["group_bits"].copy()
+    res_anti = state_np["resident_anti"].copy()
+    while remaining:
+        ok = oracle.oracle_feasible(state_np, pods_np, used, group, res_anti)
+        placeable = [i for i in remaining if ok[i, assignment[i]]]
+        assert placeable, (
+            f"no valid serialization: pods {remaining} stuck "
+            f"(assignment {assignment})")
+        for i in placeable:
+            j = assignment[i]
+            used[j] += pods_np["req"][i]
+            group[j] |= pods_np["group_bit"][i]
+            res_anti[j] |= pods_np["anti_bits"][i]
+            remaining.remove(i)
+    assert np.all(used <= state_np["cap"] + 1e-4)
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2, 3, 4, 5, 6, 7])
+def test_parallel_never_violates_constraints(seed):
+    state_np, pods_np, state, pods = make(seed)
+    assignment = np.asarray(assign_lib.assign_parallel(state, pods, CFG))
+    check_assignment_safety(state_np, pods_np, assignment, CFG)
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_greedy_never_violates_constraints(seed):
+    state_np, pods_np, state, pods = make(seed)
+    assignment = np.asarray(assign_lib.assign_greedy(state, pods, CFG))
+    check_assignment_safety(state_np, pods_np, assignment, CFG)
+
+
+def test_deterministic():
+    _, _, state, pods = make(42)
+    a1 = np.asarray(assign_lib.assign_parallel(state, pods, CFG))
+    a2 = np.asarray(assign_lib.assign_parallel(state, pods, CFG))
+    g1 = np.asarray(assign_lib.assign_greedy(state, pods, CFG))
+    g2 = np.asarray(assign_lib.assign_greedy(state, pods, CFG))
+    np.testing.assert_array_equal(a1, a2)
+    np.testing.assert_array_equal(g1, g2)
+
+
+def test_capacity_contention_spreads_pods():
+    """P identical pods, each node only fits one: every pod must land on
+    a distinct node (the two-pods-one-slot conflict the reference could
+    never hit because it scheduled strictly one pod at a time,
+    scheduler.go:191)."""
+    cfg = SchedulerConfig(max_nodes=8, max_pods=8, max_peers=2,
+                          use_bfloat16=False)
+    state_np, pods_np, state, pods = make(0, n_nodes=8, n_pods=8, cfg=cfg,
+                                          with_constraints=False)
+    state_np["cap"][:] = 1.0
+    state_np["used"][:] = 0.0
+    pods_np["req"][:] = 0.6  # two pods never fit together
+    pods_np["peers"][:] = -1
+    state, pods = gen.to_pytrees(cfg, state_np, pods_np)
+    for fn in (assign_lib.assign_parallel, assign_lib.assign_greedy):
+        a = np.asarray(fn(state, pods, cfg))
+        placed = a[a >= 0]
+        assert len(placed) == 8, f"{fn.__name__} left pods unplaced: {a}"
+        assert len(set(placed.tolist())) == 8, f"{fn.__name__} collided: {a}"
+
+
+def test_unschedulable_pod_gets_minus_one():
+    cfg = SchedulerConfig(max_nodes=4, max_pods=2, max_peers=2,
+                          use_bfloat16=False)
+    state_np, pods_np, state, pods = make(1, n_nodes=4, n_pods=2, cfg=cfg,
+                                          with_constraints=False)
+    pods_np["req"][0] = 1e6  # impossible request
+    state, pods = gen.to_pytrees(cfg, state_np, pods_np)
+    for fn in (assign_lib.assign_parallel, assign_lib.assign_greedy):
+        a = np.asarray(fn(state, pods, cfg))
+        assert a[0] == -1
+        assert a[1] >= 0
+
+
+def test_batch_internal_affinity():
+    """Pod B requires co-location with pod A's group: B can only place
+    after A's placement publishes the group bit — both assigners must
+    satisfy it within one batch."""
+    cfg = SchedulerConfig(max_nodes=6, max_pods=2, max_peers=2,
+                          use_bfloat16=False)
+    state_np, pods_np, state, pods = make(2, n_nodes=6, n_pods=2, cfg=cfg,
+                                          with_constraints=False)
+    state_np["group_bits"][:] = 0
+    pods_np["group_bit"][:] = 0
+    pods_np["affinity_bits"][:] = 0
+    pods_np["anti_bits"][:] = 0
+    pods_np["req"][:] = 0.1
+    pods_np["priority"][0] = 10.0  # A first
+    pods_np["priority"][1] = 1.0
+    pods_np["group_bit"][0] = np.uint32(4)
+    pods_np["affinity_bits"][1] = np.uint32(4)  # B needs A's group
+    state, pods = gen.to_pytrees(cfg, state_np, pods_np)
+    for fn in (assign_lib.assign_parallel, assign_lib.assign_greedy):
+        a = np.asarray(fn(state, pods, cfg))
+        assert a[0] >= 0
+        assert a[1] == a[0], f"{fn.__name__}: affinity not honored: {a}"
+
+
+def test_commit_updates_usage_and_groups():
+    state_np, pods_np, state, pods = make(3)
+    assignment = assign_lib.assign_parallel(state, pods, CFG)
+    new_state = commit_assignments(state, pods, assignment)
+    a = np.asarray(assignment)
+    used = state_np["used"].copy()
+    group = state_np["group_bits"].copy()
+    for i, j in enumerate(a):
+        if j >= 0:
+            used[j] += pods_np["req"][i]
+            group[j] |= pods_np["group_bit"][i]
+    np.testing.assert_allclose(np.asarray(new_state.used), used, atol=1e-5)
+    np.testing.assert_array_equal(np.asarray(new_state.group_bits), group)

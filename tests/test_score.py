@@ -1,0 +1,160 @@
+"""Score kernel vs the NumPy oracle (SURVEY.md 4 plan item (a))."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from kubernetesnetawarescheduler_tpu.config import (
+    GOODNESS,
+    Metric,
+    SchedulerConfig,
+    ScoreWeights,
+)
+from kubernetesnetawarescheduler_tpu.core import score as score_lib
+from kubernetesnetawarescheduler_tpu.core.state import (
+    init_cluster_state,
+    init_pod_batch,
+)
+
+from tests import gen, oracle
+
+
+CFG = SchedulerConfig(max_nodes=16, max_pods=8, max_peers=4,
+                      use_bfloat16=False)
+
+
+@pytest.fixture(params=[0, 1, 2])
+def instance(request):
+    rng = np.random.default_rng(request.param)
+    state_np, pods_np = gen.random_instance(rng, CFG, n_nodes=12, n_pods=6)
+    state, pods = gen.to_pytrees(CFG, state_np, pods_np)
+    return state_np, pods_np, state, pods
+
+
+def test_normalize_matches_oracle(instance):
+    state_np, _, state, _ = instance
+    goodness = np.asarray(GOODNESS, np.float32)
+    got = score_lib.normalize_metrics(
+        state.metrics, state.node_valid, jnp.asarray(goodness))
+    want = oracle.oracle_normalize(
+        state_np["metrics"], state_np["node_valid"], goodness)
+    np.testing.assert_allclose(np.asarray(got), want, atol=1e-5)
+
+
+def test_metric_scores_match_oracle(instance):
+    state_np, _, state, _ = instance
+    got = score_lib.metric_scores(state, CFG)
+    want = oracle.oracle_metric_scores(state_np, CFG)
+    np.testing.assert_allclose(np.asarray(got), want, atol=1e-4)
+
+
+def test_traffic_matrix_matches_oracle(instance):
+    _, pods_np, _, pods = instance
+    got = score_lib.peer_traffic_matrix(pods, CFG.max_nodes)
+    want = oracle.oracle_traffic_matrix(pods_np, CFG.max_nodes)
+    np.testing.assert_allclose(np.asarray(got), want, atol=1e-5)
+
+
+def test_net_cost_matches_oracle(instance):
+    state_np, _, state, _ = instance
+    got = score_lib.net_cost_matrix(state, CFG)
+    want = oracle.oracle_net_cost(state_np, CFG)
+    np.testing.assert_allclose(np.asarray(got), want, atol=1e-5)
+
+
+def test_feasibility_matches_oracle(instance):
+    state_np, pods_np, state, pods = instance
+    got = score_lib.feasibility_mask(state, pods)
+    want = oracle.oracle_feasible(state_np, pods_np)
+    np.testing.assert_array_equal(np.asarray(got), want)
+
+
+def test_scores_match_oracle(instance):
+    state_np, pods_np, state, pods = instance
+    got = np.asarray(score_lib.score_pods(state, pods, CFG))
+    want = oracle.oracle_scores(state_np, pods_np, CFG)
+    feasible = want > oracle.NEG_INF / 2
+    np.testing.assert_array_equal(got > oracle.NEG_INF / 2, feasible)
+    np.testing.assert_allclose(got[feasible], want[feasible],
+                               rtol=1e-4, atol=1e-3)
+
+
+def test_reference_vote_parity():
+    """A 5-node scenario shaped like the reference's weighted vote
+    (scheduler.go:334-365): each node is the extreme winner of specific
+    metric channels, everything else pinned to the losing extreme, so
+    our continuous scores reduce exactly to the reference vote totals
+    +3 cpu / +2 mem / +1 tx / +1 rx / +3 bandwidth / +1 disk."""
+    cfg = SchedulerConfig(max_nodes=5, max_pods=1, max_peers=1,
+                          use_bfloat16=False,
+                          weights=ScoreWeights(balance=0.0))
+    hi, lo = 100.0, 1.0
+    # Winner per channel (lower better except BANDWIDTH): node0 cpu,
+    # node1 mem, node2 tx+rx, node3 bandwidth, node4 disk.
+    metrics = np.full((5, Metric.COUNT), hi, np.float32)
+    metrics[:, Metric.BANDWIDTH] = lo
+    metrics[0, Metric.CPU_FREQ] = lo
+    metrics[1, Metric.MEM_PCT] = lo
+    metrics[2, Metric.NET_TX] = lo
+    metrics[2, Metric.NET_RX] = lo
+    metrics[3, Metric.BANDWIDTH] = hi
+    metrics[4, Metric.DISK_IO] = lo
+    state = init_cluster_state(
+        cfg,
+        metrics=jnp.asarray(metrics),
+        node_valid=jnp.ones((5,), jnp.bool_),
+        cap=jnp.ones((5, 3)) * 100,
+    )
+    base = np.asarray(score_lib.metric_scores(state, cfg))
+    # Vote totals: node0=3 (cpu), node1=2 (mem), node2=1+1, node3=3 (bw),
+    # node4=1 (disk).
+    np.testing.assert_allclose(base, [3.0, 2.0, 2.0, 3.0, 1.0], atol=1e-5)
+    # Deterministic argmax: tie 3.0 between node0/node3 -> node0 (first
+    # index), unlike the reference's random Go map iteration
+    # (scheduler.go:384-394).
+    assert int(np.argmax(base)) == 0
+
+
+def test_colocation_beats_any_remote_link():
+    """The net-cost diagonal is pinned to the loopback optimum: placing
+    a pod on its peer's own node must score at least as well as any
+    remote link, even though the probe pipeline never measures a node
+    against itself (run.sh:12 probes pairs only)."""
+    import jax.numpy as jnp
+    from kubernetesnetawarescheduler_tpu.core.state import init_cluster_state
+    cfg = SchedulerConfig(max_nodes=4, max_pods=1, max_peers=1,
+                          use_bfloat16=False)
+    state = init_cluster_state(
+        cfg,
+        node_valid=jnp.ones((4,), jnp.bool_),
+        bw=jnp.full((4, 4), 1e10) * (1 - jnp.eye(4)),  # zero diagonal
+        lat=jnp.full((4, 4), 1.0) * (1 - jnp.eye(4)),
+    )
+    c = np.asarray(score_lib.net_cost_matrix(state, cfg))
+    assert np.all(np.diag(c) >= c.max(axis=1) - 1e-6)
+
+
+def test_unknown_config_key_rejected():
+    from kubernetesnetawarescheduler_tpu.config import config_from_dict
+    with pytest.raises(ValueError, match="unknown"):
+        config_from_dict({"max_node": 256})
+    with pytest.raises(ValueError, match="unknown"):
+        config_from_dict({"weights": {"cpus": 1.0}})
+
+
+def test_staleness_decays_toward_neutral():
+    cfg = SchedulerConfig(max_nodes=4, max_pods=1, max_peers=1,
+                          staleness_tau_s=10.0, use_bfloat16=False)
+    metrics = np.tile(np.linspace(0, 100, 4)[:, None],
+                      (1, Metric.COUNT)).astype(np.float32)
+    fresh = init_cluster_state(
+        cfg, metrics=jnp.asarray(metrics),
+        node_valid=jnp.ones((4,), jnp.bool_))
+    stale = fresh.replace(metrics_age=jnp.full((4,), 1e6, jnp.float32))
+    s_fresh = np.asarray(score_lib.metric_scores(fresh, cfg))
+    s_stale = np.asarray(score_lib.metric_scores(stale, cfg))
+    # Stale nodes all collapse to the neutral 0.5-per-channel score.
+    neutral = 0.5 * sum(cfg.weights.metric_vector())
+    np.testing.assert_allclose(s_stale, neutral, atol=1e-4)
+    assert np.std(s_fresh) > np.std(s_stale)
