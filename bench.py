@@ -1,0 +1,53 @@
+"""Headline benchmark: clusterloader2-style density replay throughput.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Baseline semantics: the reference scheduler's cycle performs 5 serial
+node_exporter scrapes plus 4 iperf-file reads per pod
+(scheduler/scheduler.go:191, :275-279, :503-530) before picking a node.
+On its 192.168.1.x LAN that bounds effective throughput at ~10 pods/sec
+(>=10 ms per scrape round-trip, 5 in series, plus parsing ~100 KB
+bodies ~25 times) — a deliberately generous ceiling used as
+``vs_baseline`` denominator.  The north-star target is 10k pods/sec at
+5k nodes (BASELINE.json).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+
+REFERENCE_PODS_PER_SEC = 10.0
+
+
+def main() -> None:
+    num_nodes = int(os.environ.get("BENCH_NODES", "1024"))
+    num_pods = int(os.environ.get("BENCH_PODS", "4096"))
+    batch = int(os.environ.get("BENCH_BATCH", "256"))
+    method = os.environ.get("BENCH_METHOD", "parallel")
+
+    from kubernetesnetawarescheduler_tpu.bench.density import run_density
+
+    res = run_density(num_nodes=num_nodes, num_pods=num_pods,
+                      batch_size=batch, method=method)
+    print(json.dumps({
+        "metric": f"density_pods_per_sec_n{num_nodes}",
+        "value": round(res.pods_per_sec, 1),
+        "unit": "pods/s",
+        "vs_baseline": round(res.pods_per_sec / REFERENCE_PODS_PER_SEC, 2),
+        "detail": {
+            "pods_bound": res.pods_bound,
+            "pods_unschedulable": res.pods_unschedulable,
+            "score_p50_ms": round(res.score_p50_ms, 2),
+            "score_p99_ms": round(res.score_p99_ms, 2),
+            "encode_p99_ms": round(res.encode_p99_ms, 2),
+            "bind_p99_ms": round(res.bind_p99_ms, 2),
+            "batch_size": batch,
+            "method": method,
+        },
+    }))
+
+
+if __name__ == "__main__":
+    main()

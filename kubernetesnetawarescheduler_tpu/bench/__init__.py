@@ -1,0 +1,16 @@
+"""Benchmark & evaluation harness.
+
+The reference's evaluation was entirely offline and external: a manual
+N-pods x 100 MB transfer workload (datasets/customNetworkBenchmark) and
+clusterloader2 runs (datasets/clusterloader2), with only the result
+artifacts committed.  This package recreates that harness *as code*:
+fake-cluster generation, workload replay for the five BASELINE.json
+configs, and emitters for the same artifact shapes.
+"""
+
+from kubernetesnetawarescheduler_tpu.bench.fakecluster import (  # noqa: F401
+    ClusterSpec,
+    WorkloadSpec,
+    build_fake_cluster,
+    generate_workload,
+)

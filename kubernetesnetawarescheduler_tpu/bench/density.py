@@ -1,0 +1,110 @@
+"""clusterloader2-style density replay: pods/sec + Score() latency.
+
+The reference's density evidence is committed clusterloader2 output at
+90/110/130 containers on the 5-node cluster
+(datasets/clusterloader2/*/ResourceUsageSummary_load_*.json).  This
+harness replays the same *kind* of experiment as code against the fake
+cluster: N nodes, a stream of pending pods, measuring scheduling
+throughput and per-cycle score/assign latency percentiles — the
+BASELINE.json north-star metrics (>=10k pods/sec, p99 Score() < 5 ms at
+5k nodes).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+
+import numpy as np
+
+from kubernetesnetawarescheduler_tpu.config import SchedulerConfig
+from kubernetesnetawarescheduler_tpu.core.loop import SchedulerLoop
+from kubernetesnetawarescheduler_tpu.bench.fakecluster import (
+    ClusterSpec,
+    WorkloadSpec,
+    build_fake_cluster,
+    feed_metrics,
+    generate_workload,
+)
+
+
+@dataclasses.dataclass
+class DensityResult:
+    num_nodes: int
+    pods_submitted: int
+    pods_bound: int
+    pods_unschedulable: int
+    wall_s: float
+    pods_per_sec: float
+    score_p50_ms: float
+    score_p99_ms: float
+    encode_p99_ms: float
+    bind_p99_ms: float
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+def _round_up(x: int, mult: int) -> int:
+    return ((x + mult - 1) // mult) * mult
+
+
+def run_density(num_nodes: int = 100, num_pods: int = 300,
+                batch_size: int = 64, method: str = "parallel",
+                seed: int = 0, cfg: SchedulerConfig | None = None,
+                warmup: bool = True,
+                metric_drop_fraction: float = 0.0) -> DensityResult:
+    """Schedule ``num_pods`` generated pods onto a ``num_nodes`` fake
+    cluster; returns throughput/latency stats (compile excluded via a
+    warmup cycle)."""
+    if cfg is None:
+        cfg = SchedulerConfig(
+            max_nodes=_round_up(num_nodes, 128),
+            max_pods=batch_size,
+            max_peers=4,
+            queue_capacity=max(300, num_pods + batch_size),
+        )
+    cluster, lat, bw = build_fake_cluster(ClusterSpec(num_nodes=num_nodes,
+                                                      seed=seed))
+    loop = SchedulerLoop(cluster, cfg, method=method)
+    loop.encoder.set_network(lat, bw)
+    rng = np.random.default_rng(seed + 1)
+    feed_metrics(cluster, loop.encoder, rng,
+                 drop_fraction=metric_drop_fraction)
+
+    if warmup:
+        # Trigger jit compilation on a throwaway cluster with identical
+        # compile shapes, so the measured run neither pays compile time
+        # nor loses capacity to warmup pods.
+        wcluster, wlat, wbw = build_fake_cluster(
+            ClusterSpec(num_nodes=num_nodes, seed=seed + 999))
+        wloop = SchedulerLoop(wcluster, cfg, method=method)
+        wloop.encoder.set_network(wlat, wbw)
+        feed_metrics(wcluster, wloop.encoder,
+                     np.random.default_rng(seed + 2))
+        warm = generate_workload(
+            WorkloadSpec(num_pods=min(batch_size, 8), seed=seed + 99),
+            scheduler_name=cfg.scheduler_name)
+        wcluster.add_pods(warm)
+        wloop.run_until_drained()
+
+    pods = generate_workload(WorkloadSpec(num_pods=num_pods, seed=seed),
+                             scheduler_name=cfg.scheduler_name)
+    start = time.perf_counter()
+    cluster.add_pods(pods)
+    loop.run_until_drained()
+    wall = time.perf_counter() - start
+
+    bound = loop.scheduled
+    return DensityResult(
+        num_nodes=num_nodes,
+        pods_submitted=len(pods),
+        pods_bound=bound,
+        pods_unschedulable=loop.unschedulable,
+        wall_s=wall,
+        pods_per_sec=bound / wall if wall > 0 else 0.0,
+        score_p50_ms=loop.timer.percentile("score_assign", 50) * 1e3,
+        score_p99_ms=loop.timer.percentile("score_assign", 99) * 1e3,
+        encode_p99_ms=loop.timer.percentile("encode", 99) * 1e3,
+        bind_p99_ms=loop.timer.percentile("bind", 99) * 1e3,
+    )

@@ -1,0 +1,192 @@
+"""Fake cluster generation: nodes, hierarchical network, workloads.
+
+SURVEY.md 4(b): the replacement for the reference's live 5-node edge
+cluster (hardcoded IPs scheduler.go:275-279, node names :252-256).  A
+generated cluster has:
+
+- heterogeneous nodes across zones and racks (the reference's analog:
+  one x86 master + four Raspberry Pis);
+- a hierarchical network model: same-rack links are fast/near,
+  cross-rack slower, cross-zone slowest — producing the ``lat``/``bw``
+  matrices the probe pipeline would measure (netperfScript/run.sh);
+- node_exporter-shaped metric samples;
+- workloads of services whose pods exchange traffic (peers), with
+  optional affinity/anti-affinity groups — the pod-aware dimension the
+  reference never modeled.
+
+Also provides fault injection (drop/corrupt/stale metric updates) for
+the failure-handling tests (SURVEY.md 5).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Sequence
+
+import numpy as np
+
+from kubernetesnetawarescheduler_tpu.config import Metric
+from kubernetesnetawarescheduler_tpu.k8s.client import FakeCluster
+from kubernetesnetawarescheduler_tpu.k8s.types import Node, Pod
+
+
+@dataclasses.dataclass(frozen=True)
+class ClusterSpec:
+    """Shape of a generated cluster."""
+
+    num_nodes: int = 100
+    zones: int = 2
+    racks_per_zone: int = 4
+    seed: int = 0
+
+    # Link model (lat ms / bw bits-per-sec) by proximity tier.
+    lat_same_rack: float = 0.1
+    lat_same_zone: float = 0.5
+    lat_cross_zone: float = 2.0
+    bw_same_rack: float = 25e9
+    bw_same_zone: float = 10e9
+    bw_cross_zone: float = 1e9
+    jitter: float = 0.15  # multiplicative noise on links
+
+    # Node capacity ranges (cpu cores, mem GiB, net Gbps).
+    cpu_range: tuple[float, float] = (8.0, 64.0)
+    mem_range: tuple[float, float] = (16.0, 256.0)
+    netbw_range: tuple[float, float] = (10.0, 40.0)
+
+    taint_fraction: float = 0.05  # nodes tainted "dedicated"
+
+
+@dataclasses.dataclass(frozen=True)
+class WorkloadSpec:
+    """Shape of a generated pod workload."""
+
+    num_pods: int = 300
+    services: int = 20           # pods are grouped into services
+    peer_fraction: float = 0.6   # fraction of pods with traffic peers
+    max_peers: int = 4
+    affinity_fraction: float = 0.1
+    anti_fraction: float = 0.1
+    tolerate_fraction: float = 0.05
+    seed: int = 0
+    cpu_range: tuple[float, float] = (0.1, 4.0)
+    mem_range: tuple[float, float] = (0.2, 8.0)
+    netbw_range: tuple[float, float] = (0.05, 2.0)
+
+
+def build_fake_cluster(spec: ClusterSpec) -> tuple[FakeCluster, np.ndarray,
+                                                   np.ndarray]:
+    """Create a populated :class:`FakeCluster` plus its ground-truth
+    ``(lat_ms, bw_bps)`` matrices (what a perfect probe pipeline would
+    measure)."""
+    rng = np.random.default_rng(spec.seed)
+    cluster = FakeCluster()
+    n = spec.num_nodes
+    zones = np.arange(n) % spec.zones
+    racks = (np.arange(n) // spec.zones) % spec.racks_per_zone
+
+    for i in range(n):
+        tainted = rng.random() < spec.taint_fraction
+        cluster.add_node(Node(
+            name=f"node-{i:04d}",
+            capacity={
+                "cpu": float(rng.uniform(*spec.cpu_range)),
+                "mem": float(rng.uniform(*spec.mem_range)),
+                "net_bw": float(rng.uniform(*spec.netbw_range)),
+            },
+            labels=frozenset({f"zone={zones[i]}", f"rack={racks[i]}"}),
+            taints=frozenset({"dedicated"}) if tainted else frozenset(),
+            zone=f"zone-{zones[i]}",
+            rack=f"rack-{zones[i]}-{racks[i]}",
+        ))
+
+    same_zone = zones[:, None] == zones[None, :]
+    same_rack = same_zone & (racks[:, None] == racks[None, :])
+    lat = np.where(same_rack, spec.lat_same_rack,
+                   np.where(same_zone, spec.lat_same_zone,
+                            spec.lat_cross_zone)).astype(np.float32)
+    bw = np.where(same_rack, spec.bw_same_rack,
+                  np.where(same_zone, spec.bw_same_zone,
+                           spec.bw_cross_zone)).astype(np.float32)
+    noise = 1.0 + spec.jitter * rng.standard_normal((n, n)).astype(np.float32)
+    noise = np.clip((noise + noise.T) / 2, 0.5, 1.5)
+    lat = lat * noise
+    bw = bw / noise
+    np.fill_diagonal(lat, 0.0)
+    np.fill_diagonal(bw, bw.max())
+    return cluster, lat, bw
+
+
+def sample_metrics(rng: np.random.Generator) -> dict[str, float]:
+    """One node_exporter-shaped sample (channels of config.Metric)."""
+    return {
+        "cpu_freq": float(rng.uniform(6e8, 2.4e9)),
+        "mem_pct": float(rng.uniform(5.0, 95.0)),
+        "net_tx": float(rng.uniform(1e4, 1e7)),
+        "net_rx": float(rng.uniform(1e4, 1e7)),
+        "bandwidth": float(rng.uniform(1e8, 1e10)),
+        "disk_io": float(rng.integers(0, 16)),
+    }
+
+
+assert set(sample_metrics(np.random.default_rng(0))) == set(Metric.NAMES)
+
+
+def feed_metrics(cluster: FakeCluster, encoder, rng: np.random.Generator,
+                 drop_fraction: float = 0.0) -> None:
+    """Push a metrics sample for every node into an Encoder; with
+    ``drop_fraction`` > 0, some nodes are skipped (scrape failure) —
+    their staleness keeps growing instead of crashing the scorer the
+    way the reference does on a failed scrape (it ``println``s the
+    error then dereferences the nil body, scheduler.go:397-405)."""
+    for node in cluster.list_nodes():
+        if drop_fraction and rng.random() < drop_fraction:
+            continue
+        encoder.update_metrics(node.name, sample_metrics(rng), age_s=0.0)
+
+
+def generate_workload(spec: WorkloadSpec,
+                      scheduler_name: str = "netAwareScheduler"
+                      ) -> list[Pod]:
+    """Pods grouped into services; pods of a service exchange traffic
+    with earlier pods of the same service (so peers resolve as the
+    batch schedules — the batch-internal dependency the conflict
+    resolver must handle)."""
+    rng = np.random.default_rng(spec.seed)
+    pods: list[Pod] = []
+    service_of = rng.integers(0, spec.services, spec.num_pods)
+    by_service: dict[int, list[str]] = {}
+    for i in range(spec.num_pods):
+        svc = int(service_of[i])
+        name = f"pod-{svc:03d}-{i:05d}"
+        earlier = by_service.setdefault(svc, [])
+        peers: dict[str, float] = {}
+        if earlier and rng.random() < spec.peer_fraction:
+            count = int(rng.integers(1, spec.max_peers + 1))
+            chosen = rng.choice(len(earlier), size=min(count, len(earlier)),
+                                replace=False)
+            for c in chosen:
+                peers[earlier[int(c)]] = float(rng.uniform(0.5, 20.0))
+        group = f"svc-{svc % 28}"  # bounded distinct groups (32-bit intern)
+        affinity = (frozenset({group})
+                    if rng.random() < spec.affinity_fraction else frozenset())
+        anti = (frozenset({f"svc-{int(rng.integers(0, 28))}"})
+                if rng.random() < spec.anti_fraction else frozenset())
+        pods.append(Pod(
+            name=name,
+            scheduler_name=scheduler_name,
+            requests={
+                "cpu": float(rng.uniform(*spec.cpu_range)),
+                "mem": float(rng.uniform(*spec.mem_range)),
+                "net_bw": float(rng.uniform(*spec.netbw_range)),
+            },
+            peers=peers,
+            tolerations=(frozenset({"dedicated"})
+                         if rng.random() < spec.tolerate_fraction
+                         else frozenset()),
+            group=group,
+            affinity_groups=affinity,
+            anti_groups=anti,
+            priority=float(rng.uniform(0, 10)),
+        ))
+        earlier.append(name)
+    return pods

@@ -38,6 +38,7 @@ from kubernetesnetawarescheduler_tpu.core.state import (
     ClusterState,
     PodBatch,
     commit_assignments,
+    scatter_or_onehot,
 )
 
 UNASSIGNED = jnp.int32(-1)
@@ -147,6 +148,15 @@ def assign_parallel(state: ClusterState, pods: PodBatch,
     w_bal = jnp.float32(cfg.weights.balance)
     pod_ids = jnp.arange(p, dtype=jnp.int32)
 
+    # Loop-invariant bitplane decomposition of the two per-pod bit
+    # fields, stacked so each round pays ONE [P, N, 64] any-reduce
+    # instead of two separate 32-plane scatters.
+    shifts = jnp.arange(32, dtype=jnp.uint32)
+    pod_planes = jnp.concatenate(
+        [((pods.group_bit[:, None] >> shifts) & 1).astype(bool),
+         ((pods.anti_bits[:, None] >> shifts) & 1).astype(bool)],
+        axis=1)  # [P, 64]
+
     def masked_scores(used, group_bits, resident_anti, assignment):
         dyn = _dynamic_mask(pods, used, state.cap, group_bits, resident_anti)
         ok = static_ok & dyn & (assignment == UNASSIGNED)[:, None]
@@ -178,17 +188,15 @@ def assign_parallel(state: ClusterState, pods: PodBatch,
         safe = jnp.where(winner, choice, 0)
         add = jnp.where(winner[:, None], pods.req, 0.0)
         new_used = used.at[safe].add(add, mode="drop")
-        w_onehot = winner[:, None] & (choice[:, None]
-                                      == jnp.arange(n)[None, :])
-
-        def scatter_or(bits):
-            contrib = jnp.where(w_onehot, bits[:, None], jnp.uint32(0))
-            return jax.lax.reduce(contrib, jnp.uint32(0),
-                                  jax.lax.bitwise_or, dimensions=[0])
-
+        w_onehot = onehot & winner[:, None]  # winner implies feasible
         progress = jnp.any(winner)
-        new_group = group_bits | scatter_or(pods.group_bit)
-        new_anti = resident_anti | scatter_or(pods.anti_bits)
+        present = jnp.any(w_onehot[:, :, None] & pod_planes[:, None, :],
+                          axis=0)  # [N, 64]
+        words = jnp.sum(
+            present.reshape(n, 2, 32).astype(jnp.uint32) << shifts,
+            axis=-1, dtype=jnp.uint32)
+        new_group = group_bits | words[:, 0]
+        new_anti = resident_anti | words[:, 1]
         new_s = masked_scores(new_used, new_group, new_anti, new_assignment)
         return (new_s, new_used, new_group, new_anti, new_assignment,
                 progress)

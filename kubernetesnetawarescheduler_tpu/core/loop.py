@@ -1,0 +1,131 @@
+"""The watch -> queue -> score -> bind loop.
+
+The reference's ``Schedule()`` cycle (scheduler.go:189-237) popped ONE
+pod, re-scraped the whole cluster synchronously, picked a node and
+POSTed a Binding plus a "Scheduled" Event.  This loop keeps the same
+external contract — pods in, Bindings + Events out — but pops a *batch*
+from the queue, encodes it once, runs the fused score/assign kernel on
+device, then emits one Binding/Event per pod.  Telemetry arrives
+asynchronously through the :class:`~.encode.Encoder`, never inside the
+cycle.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Sequence
+
+import numpy as np
+
+from kubernetesnetawarescheduler_tpu.config import SchedulerConfig
+from kubernetesnetawarescheduler_tpu.core.assign import (
+    assign_greedy,
+    assign_parallel,
+)
+from kubernetesnetawarescheduler_tpu.core.encode import Encoder
+from kubernetesnetawarescheduler_tpu.k8s.client import ClusterClient
+from kubernetesnetawarescheduler_tpu.k8s.informer import Informer, PodQueue
+from kubernetesnetawarescheduler_tpu.k8s.types import (
+    Binding,
+    Node,
+    Pod,
+    failed_event,
+    scheduled_event,
+)
+from kubernetesnetawarescheduler_tpu.utils.tracing import PhaseTimer
+
+
+class SchedulerLoop:
+    """Owns the informer, encoder and queue; drives scheduling cycles."""
+
+    def __init__(self, client: ClusterClient, cfg: SchedulerConfig,
+                 method: str = "parallel") -> None:
+        self.cfg = cfg
+        self.client = client
+        self.method = method
+        self.encoder = Encoder(cfg)
+        self.queue = PodQueue(cfg.queue_capacity)
+        self.timer = PhaseTimer()
+        self.scheduled = 0
+        self.unschedulable = 0
+        self._assign = {"greedy": assign_greedy,
+                        "parallel": assign_parallel}[method]
+        self.informer = Informer(client, self.queue, cfg.scheduler_name,
+                                 on_node=self._on_node)
+
+    def _on_node(self, node: Node) -> None:
+        self.encoder.upsert_node(node)
+
+    # ------------------------------------------------------------------
+
+    def run_once(self, timeout: float | None = 0.0) -> int:
+        """One cycle: pop up to ``max_pods`` pods, schedule, bind.
+        Returns the number of pods bound."""
+        pods = self.queue.pop_batch(self.cfg.max_pods, timeout)
+        if not pods:
+            return 0
+        return self.schedule_pods(pods)
+
+    def schedule_pods(self, pods: Sequence[Pod]) -> int:
+        with self.timer.phase("encode"):
+            batch = self.encoder.encode_pods(
+                pods, node_of=self._peer_node)
+            state = self.encoder.snapshot()
+        with self.timer.phase("score_assign"):
+            assignment = np.asarray(
+                jax_block(self._assign(state, batch, self.cfg)))
+        with self.timer.phase("bind"):
+            bound = self._bind_all(pods, assignment)
+        return bound
+
+    def _peer_node(self, pod_name: str) -> str:
+        try:
+            return self.client.node_of(pod_name)  # type: ignore[attr-defined]
+        except (AttributeError, KeyError):
+            return ""
+
+    def _bind_all(self, pods: Sequence[Pod],
+                  assignment: np.ndarray) -> int:
+        bound = 0
+        for i, pod in enumerate(pods):
+            node_idx = int(assignment[i])
+            if node_idx < 0:
+                self.unschedulable += 1
+                self.client.create_event(failed_event(
+                    pod, self.cfg.scheduler_name, "no feasible node"))
+                continue
+            node_name = self.encoder.node_name(node_idx)
+            self.client.bind(Binding(pod_name=pod.name,
+                                     namespace=pod.namespace,
+                                     node_name=node_name))
+            self.client.create_event(scheduled_event(
+                pod, node_name, self.cfg.scheduler_name))
+            self.encoder.commit(pod, node_name)
+            bound += 1
+            self.scheduled += 1
+        return bound
+
+    def run_until_drained(self, max_cycles: int = 10_000) -> int:
+        """Drain the queue; returns total pods bound."""
+        total = 0
+        for _ in range(max_cycles):
+            n = self.run_once(timeout=0.0)
+            if n == 0 and len(self.queue) == 0:
+                break
+            total += n
+        return total
+
+    def run_forever(self, poll_s: float = 0.05) -> None:
+        """The reference's ``wait.Until(s.Schedule, 0, quit)``
+        (scheduler.go:140), batched."""
+        while True:
+            if self.run_once(timeout=poll_s) == 0:
+                time.sleep(0.0)
+
+
+def jax_block(x):
+    """Block on device computation so bind never races the kernel."""
+    try:
+        return x.block_until_ready()
+    except AttributeError:
+        return x

@@ -170,6 +170,23 @@ def init_pod_batch(cfg: SchedulerConfig, **overrides: Any) -> PodBatch:
     return PodBatch(**fields)
 
 
+def scatter_or_onehot(onehot: jax.Array, bits: jax.Array) -> jax.Array:
+    """Per-node OR of per-pod bitmasks: ``out[n] = OR_p onehot[p,n] ?
+    bits[p]``.
+
+    Decomposed into bitplanes (any-reduce per bit, then a weighted sum
+    — exact because bit positions are distinct powers of two) instead
+    of a raw ``lax.reduce`` with ``bitwise_or``, which GSPMD cannot
+    partition across a sharded pod axis.
+    """
+    contrib = jnp.where(onehot, bits[:, None], jnp.uint32(0))
+    shifts = jnp.arange(32, dtype=jnp.uint32)
+    planes = (contrib[..., None] >> shifts) & jnp.uint32(1)  # [P, N, 32]
+    present = jnp.any(planes > 0, axis=0)                    # [N, 32]
+    return jnp.sum(present.astype(jnp.uint32) << shifts, axis=-1,
+                   dtype=jnp.uint32)
+
+
 def commit_assignments(state: ClusterState, pods: PodBatch,
                        assignment: jax.Array) -> ClusterState:
     """Apply a batch assignment to the allocation state.
@@ -189,16 +206,12 @@ def commit_assignments(state: ClusterState, pods: PodBatch,
     # one-hot [P, N] mask with bitwise-or instead.
     onehot = placed[:, None] & (
         assignment[:, None] == jnp.arange(state.num_nodes)[None, :])
-
-    def scatter_or(bits):
-        contrib = jnp.where(onehot, bits[:, None], jnp.uint32(0))
-        return jax.lax.reduce(contrib, jnp.uint32(0),
-                              jax.lax.bitwise_or, dimensions=[0])
-
     return state.replace(
         used=used,
-        group_bits=state.group_bits | scatter_or(pods.group_bit),
-        resident_anti=state.resident_anti | scatter_or(pods.anti_bits))
+        group_bits=state.group_bits | scatter_or_onehot(onehot,
+                                                        pods.group_bit),
+        resident_anti=state.resident_anti | scatter_or_onehot(
+            onehot, pods.anti_bits))
 
 
 def pad_axis(x: jax.Array, size: int, axis: int = 0,

@@ -1,0 +1,24 @@
+"""Kubernetes boundary: API object types, cluster clients, informers.
+
+The reference holds this boundary with client-go (informers at
+scheduler.go:161-187, Bind at :196-206, Events at :214-233).  Here the
+same contract is an abstract :class:`~.client.ClusterClient` with an
+in-memory :class:`~.client.FakeCluster` used by tests and benchmarks —
+the "test multi-node without a real cluster" answer of SURVEY.md 4 —
+and the native extender shim holding the real kube-scheduler boundary.
+"""
+
+from kubernetesnetawarescheduler_tpu.k8s.types import (  # noqa: F401
+    Binding,
+    Event,
+    Node,
+    Pod,
+)
+from kubernetesnetawarescheduler_tpu.k8s.client import (  # noqa: F401
+    ClusterClient,
+    FakeCluster,
+)
+from kubernetesnetawarescheduler_tpu.k8s.informer import (  # noqa: F401
+    Informer,
+    PodQueue,
+)

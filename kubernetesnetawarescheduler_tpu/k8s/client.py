@@ -1,0 +1,137 @@
+"""Cluster clients: the API-server boundary.
+
+:class:`ClusterClient` is the contract the scheduler core needs from
+Kubernetes — the same four touchpoints the reference uses through
+client-go: watch pods (scheduler.go:164-174), list nodes (:240), bind
+(:196-206), create event (:214-233).
+
+:class:`FakeCluster` is the in-memory implementation used by tests and
+the benchmark harness (SURVEY.md 4: "a fake cluster state generator …
+this is how we test multi-node without a cluster").  A real-cluster
+client would speak to the API server via the extender shim; the core
+never imports kubernetes libraries.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Iterable, Sequence
+
+from kubernetesnetawarescheduler_tpu.k8s.types import (
+    Binding,
+    Event,
+    Node,
+    Pod,
+)
+
+PodHandler = Callable[[Pod], None]
+NodeHandler = Callable[[Node], None]
+
+
+class ClusterClient:
+    """Abstract API-server boundary."""
+
+    def list_nodes(self) -> Sequence[Node]:
+        raise NotImplementedError
+
+    def on_pod_added(self, handler: PodHandler) -> None:
+        """Register a pod ADD handler (informer AddFunc,
+        scheduler.go:165-173)."""
+        raise NotImplementedError
+
+    def on_node_added(self, handler: NodeHandler) -> None:
+        raise NotImplementedError
+
+    def bind(self, binding: Binding) -> None:
+        raise NotImplementedError
+
+    def create_event(self, event: Event) -> None:
+        raise NotImplementedError
+
+    def list_pending_pods(self) -> Sequence[Pod]:
+        """Re-listable pending pods — the recovery path the reference
+        lacks (queued pods are lost on restart; it only ever enqueues
+        on ADD events, scheduler.go:165-173)."""
+        raise NotImplementedError
+
+
+class FakeCluster(ClusterClient):
+    """In-memory cluster: nodes, pods, bindings, events.
+
+    Thread-safe; pod/node additions fan out synchronously to registered
+    handlers, mimicking informer delivery.
+    """
+
+    def __init__(self) -> None:
+        self._lock = threading.RLock()
+        self._nodes: dict[str, Node] = {}
+        self._pods: dict[str, Pod] = {}
+        self.bindings: list[Binding] = []
+        self.events: list[Event] = []
+        self._pod_handlers: list[PodHandler] = []
+        self._node_handlers: list[NodeHandler] = []
+
+    # -- population ---------------------------------------------------
+
+    def add_node(self, node: Node) -> None:
+        with self._lock:
+            self._nodes[node.name] = node
+            handlers = list(self._node_handlers)
+        for h in handlers:
+            h(node)
+
+    def add_pod(self, pod: Pod) -> None:
+        with self._lock:
+            self._pods[pod.name] = pod
+            handlers = list(self._pod_handlers)
+        for h in handlers:
+            h(pod)
+
+    def add_pods(self, pods: Iterable[Pod]) -> None:
+        for pod in pods:
+            self.add_pod(pod)
+
+    # -- ClusterClient ------------------------------------------------
+
+    def list_nodes(self) -> Sequence[Node]:
+        with self._lock:
+            return list(self._nodes.values())
+
+    def on_pod_added(self, handler: PodHandler) -> None:
+        with self._lock:
+            self._pod_handlers.append(handler)
+
+    def on_node_added(self, handler: NodeHandler) -> None:
+        with self._lock:
+            self._node_handlers.append(handler)
+
+    def bind(self, binding: Binding) -> None:
+        with self._lock:
+            pod = self._pods.get(binding.pod_name)
+            if pod is None:
+                raise KeyError(f"unknown pod {binding.pod_name}")
+            if binding.node_name not in self._nodes:
+                raise KeyError(f"unknown node {binding.node_name}")
+            if pod.node_name:
+                raise ValueError(
+                    f"pod {pod.name} already bound to {pod.node_name}")
+            pod.node_name = binding.node_name
+            self.bindings.append(binding)
+
+    def create_event(self, event: Event) -> None:
+        with self._lock:
+            self.events.append(event)
+
+    def list_pending_pods(self) -> Sequence[Pod]:
+        with self._lock:
+            return [p for p in self._pods.values() if not p.node_name]
+
+    # -- introspection ------------------------------------------------
+
+    def pod(self, name: str) -> Pod:
+        with self._lock:
+            return self._pods[name]
+
+    def node_of(self, pod_name: str) -> str:
+        with self._lock:
+            return self._pods[pod_name].node_name

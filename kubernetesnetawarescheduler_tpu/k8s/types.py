@@ -1,0 +1,119 @@
+"""Lightweight Kubernetes API object mirrors.
+
+Only the fields the scheduling path actually consumes, mirroring what
+the reference touches on client-go objects: pod name/namespace/UID and
+``spec.schedulerName`` / ``spec.nodeName`` (scheduler.go:170, :196-206,
+:224-229), node names (scheduler.go:182), plus the request/affinity/
+toleration surface the reference *should* have consulted but never did
+(its ``prioritize`` ignores the pod, scheduler.go:248).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+from typing import Mapping, Sequence
+
+_uid_counter = itertools.count(1)
+
+
+def _next_uid(prefix: str) -> str:
+    return f"{prefix}-{next(_uid_counter):08x}"
+
+
+@dataclasses.dataclass
+class Node:
+    """A schedulable node.
+
+    ``capacity`` maps resource name -> allocatable amount (cpu cores,
+    mem GiB, net bandwidth Gbps — the :class:`~..config.Resource` axes).
+    ``labels`` and ``taints`` are plain string sets; the encoder interns
+    them into the bitmask columns of ``ClusterState``.
+    """
+
+    name: str
+    capacity: Mapping[str, float] = dataclasses.field(default_factory=dict)
+    labels: frozenset[str] = frozenset()
+    taints: frozenset[str] = frozenset()
+    ready: bool = True
+    # Optional topology hints used by the fake-cluster network model.
+    zone: str = ""
+    rack: str = ""
+
+
+@dataclasses.dataclass
+class Pod:
+    """A pod to schedule.
+
+    ``peers`` names already-known traffic partners (pod names) with
+    relative traffic volumes; the encoder resolves placed peers to node
+    indices.  ``group`` is the pod's (anti-)affinity group label —
+    the hostname-topology reduction of k8s inter-pod affinity.
+    """
+
+    name: str
+    namespace: str = "default"
+    uid: str = dataclasses.field(default_factory=lambda: _next_uid("pod"))
+    scheduler_name: str = "netAwareScheduler"
+    node_name: str = ""  # empty = pending (scheduler.go:170)
+    requests: Mapping[str, float] = dataclasses.field(default_factory=dict)
+    peers: Mapping[str, float] = dataclasses.field(default_factory=dict)
+    tolerations: frozenset[str] = frozenset()
+    node_selector: frozenset[str] = frozenset()
+    group: str = ""
+    affinity_groups: frozenset[str] = frozenset()
+    anti_groups: frozenset[str] = frozenset()
+    priority: float = 0.0
+
+
+@dataclasses.dataclass(frozen=True)
+class Binding:
+    """The bind record POSTed on placement (scheduler.go:196-206)."""
+
+    pod_name: str
+    namespace: str
+    node_name: str
+
+
+@dataclasses.dataclass(frozen=True)
+class Event:
+    """A ``Scheduled`` event (scheduler.go:214-233)."""
+
+    message: str
+    reason: str
+    involved_pod: str
+    namespace: str
+    component: str
+    count: int = 1
+    type: str = "Normal"
+
+
+def scheduled_event(pod: Pod, node_name: str, component: str) -> Event:
+    """Parity with the reference's event payload: ``Assigned pod X to Y``
+    (scheduler.go:211)."""
+    return Event(
+        message=f"Assigned pod {pod.name} to {node_name}",
+        reason="Scheduled",
+        involved_pod=pod.name,
+        namespace=pod.namespace,
+        component=component,
+    )
+
+
+def failed_event(pod: Pod, component: str, why: str) -> Event:
+    """Emitted when no feasible node exists — the reference silently
+    bound to the empty string in this case (findBestNode returns ""
+    when all priorities are 0-valued or the map is empty,
+    scheduler.go:384-394)."""
+    return Event(
+        message=f"Failed to schedule pod {pod.name}: {why}",
+        reason="FailedScheduling",
+        involved_pod=pod.name,
+        namespace=pod.namespace,
+        component=component,
+        type="Warning",
+    )
+
+
+__all__: Sequence[str] = ("Node", "Pod", "Binding", "Event",
+                          "scheduled_event", "failed_event")

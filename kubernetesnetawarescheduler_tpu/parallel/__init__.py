@@ -1,0 +1,25 @@
+"""Multi-device scaling: meshes, shardings, sharded scheduling steps.
+
+The reference is single-threaded — one ``Schedule()`` goroutine popping
+one pod at a time (scheduler.go:139-141, :191).  Here scale comes from
+a 2-D ``jax.sharding.Mesh``:
+
+- ``dp`` shards the pending-pod axis (batch data parallelism);
+- ``tp`` shards the node axis — the ``N x N`` latency/bandwidth
+  matrices, capacity vectors and metric columns split across devices,
+  which is what lets the state grow past one chip's HBM comfort at
+  5k+ nodes.
+
+Cross-shard reductions (the assignment argmax across node shards, the
+network-cost matmul contraction) are XLA collectives over ICI inserted
+by GSPMD from the sharding annotations — no hand-written NCCL/MPI
+analog (the reference had none either; its only transport was HTTP
+scrapes, scheduler.go:396-407).
+"""
+
+from kubernetesnetawarescheduler_tpu.parallel.sharding import (  # noqa: F401
+    make_mesh,
+    pods_sharding,
+    sharded_schedule_step,
+    state_sharding,
+)

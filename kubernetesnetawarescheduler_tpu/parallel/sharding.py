@@ -1,0 +1,119 @@
+"""Mesh construction and sharding specs for the scheduling step.
+
+Layout choices (see the scaling-book recipe: pick a mesh, annotate
+shardings, let XLA insert collectives):
+
+- ``metrics[N, M]``, ``cap/used[N, R]``, node bit vectors: row-sharded
+  over ``tp``.
+- ``lat/bw[N, N]``: row-sharded over ``tp`` (each device owns the
+  links of its node shard).
+- pod tensors (``req``, ``peers``, ...): row-sharded over ``dp``.
+- The traffic matrix ``T[P, N]`` is built sharded ``(dp, tp)``; the
+  network matmul ``T @ C.T`` contracts the full node axis, for which
+  GSPMD inserts an all-gather of the C row shards over ICI.
+- The assignment argmax runs over the full (replicated-per-dp-group)
+  ``P x N`` score matrix; the winner-per-node reduction crosses ``dp``.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from kubernetesnetawarescheduler_tpu.config import SchedulerConfig
+from kubernetesnetawarescheduler_tpu.core.assign import (
+    assign_greedy,
+    assign_parallel,
+)
+from kubernetesnetawarescheduler_tpu.core.state import (
+    ClusterState,
+    PodBatch,
+    commit_assignments,
+)
+
+
+def make_mesh(dp: int, tp: int, devices=None) -> Mesh:
+    """A ``(dp, tp)`` mesh over the given (or all) devices."""
+    if devices is None:
+        devices = jax.devices()
+    if dp * tp > len(devices):
+        raise ValueError(
+            f"mesh {dp}x{tp} needs {dp * tp} devices, have {len(devices)}")
+    grid = np.asarray(devices[:dp * tp]).reshape(dp, tp)
+    return Mesh(grid, axis_names=("dp", "tp"))
+
+
+def state_sharding(mesh: Mesh) -> ClusterState:
+    """A ClusterState-shaped pytree of NamedShardings (node axis on tp)."""
+    def s(*spec):
+        return NamedSharding(mesh, P(*spec))
+    return ClusterState(
+        metrics=s("tp", None),
+        metrics_age=s("tp"),
+        lat=s("tp", None),
+        bw=s("tp", None),
+        cap=s("tp", None),
+        used=s("tp", None),
+        node_valid=s("tp"),
+        label_bits=s("tp"),
+        taint_bits=s("tp"),
+        group_bits=s("tp"),
+        resident_anti=s("tp"),
+    )
+
+
+def pods_sharding(mesh: Mesh) -> PodBatch:
+    """A PodBatch-shaped pytree of NamedShardings (pod axis on dp)."""
+    def s(*spec):
+        return NamedSharding(mesh, P(*spec))
+    return PodBatch(
+        req=s("dp", None),
+        peers=s("dp", None),
+        peer_traffic=s("dp", None),
+        tol_bits=s("dp"),
+        sel_bits=s("dp"),
+        affinity_bits=s("dp"),
+        anti_bits=s("dp"),
+        group_bit=s("dp"),
+        priority=s("dp"),
+        pod_valid=s("dp"),
+    )
+
+
+def place(mesh: Mesh, state: ClusterState, pods: PodBatch):
+    """Device-put a (state, pods) pair onto the mesh with the canonical
+    shardings."""
+    state = jax.device_put(state, state_sharding(mesh))
+    pods = jax.device_put(pods, pods_sharding(mesh))
+    return state, pods
+
+
+def sharded_schedule_step(cfg: SchedulerConfig, mesh: Mesh,
+                          method: str = "parallel"):
+    """A jitted full scheduling step (score + assign + commit) with
+    dp/tp sharding constraints; GSPMD inserts the ICI collectives.
+
+    Returns ``step(state, pods) -> (assignment, new_state)``.
+    """
+    assign = {"greedy": assign_greedy, "parallel": assign_parallel}[method]
+
+    def _step(state: ClusterState, pods: PodBatch):
+        assignment = assign(state, pods, cfg)
+        return assignment, commit_assignments(state, pods, assignment)
+
+    return jax.jit(
+        _step,
+        in_shardings=(state_sharding(mesh), pods_sharding(mesh)),
+        out_shardings=(NamedSharding(mesh, P()), state_sharding(mesh)),
+    )
+
+
+def replicated(mesh: Mesh):
+    return NamedSharding(mesh, P())
+
+
+__all__ = ["make_mesh", "state_sharding", "pods_sharding", "place",
+           "sharded_schedule_step", "replicated"]

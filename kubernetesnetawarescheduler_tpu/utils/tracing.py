@@ -1,0 +1,62 @@
+"""Self-metrics: per-phase latency histograms and throughput counters.
+
+The reference had no observability of itself at all — only ``println``
+debugging of scraped values (scheduler.go:517, :525-526) and a node-name
+log line (scheduler.go:182).  Here per-phase (encode / score / assign /
+bind) timings and percentiles are first-class, because the north-star
+target is expressed as one (p99 Score() < 5 ms, BASELINE.json).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Iterator, Mapping
+
+
+class PhaseTimer:
+    """Accumulates wall-clock samples per named phase."""
+
+    def __init__(self) -> None:
+        self._samples: dict[str, list[float]] = {}
+
+    @contextlib.contextmanager
+    def phase(self, name: str) -> Iterator[None]:
+        start = time.perf_counter()
+        try:
+            yield
+        finally:
+            self._samples.setdefault(name, []).append(
+                time.perf_counter() - start)
+
+    def record(self, name: str, seconds: float) -> None:
+        self._samples.setdefault(name, []).append(seconds)
+
+    def count(self, name: str) -> int:
+        return len(self._samples.get(name, ()))
+
+    def total(self, name: str) -> float:
+        return sum(self._samples.get(name, ()))
+
+    def percentile(self, name: str, q: float) -> float:
+        """q in [0, 100]; nearest-rank on the sorted samples."""
+        samples = sorted(self._samples.get(name, ()))
+        if not samples:
+            return 0.0
+        rank = min(len(samples) - 1, max(0, int(round(
+            q / 100.0 * (len(samples) - 1)))))
+        return samples[rank]
+
+    def summary(self) -> Mapping[str, Mapping[str, float]]:
+        out: dict[str, dict[str, float]] = {}
+        for name in self._samples:
+            out[name] = {
+                "count": float(self.count(name)),
+                "total_s": self.total(name),
+                "p50_ms": self.percentile(name, 50) * 1e3,
+                "p99_ms": self.percentile(name, 99) * 1e3,
+            }
+        return out
+
+    def reset(self) -> None:
+        self._samples.clear()

@@ -1,11 +1,12 @@
 """Test env: force the CPU backend with 8 virtual devices so sharding
 tests run without TPU hardware (mirrors the driver's dryrun harness).
-Must run before anything imports jax."""
 
-import os
+Note: the environment's axon sitecustomize registers the TPU backend at
+interpreter start and wins over ``JAX_PLATFORMS``; overriding through
+``jax.config`` before first device use is the reliable path.
+"""
 
-os.environ["JAX_PLATFORMS"] = "cpu"
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (
-        _flags + " --xla_force_host_platform_device_count=8").strip()
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)

@@ -1,0 +1,63 @@
+"""Sharded scheduling over a virtual 8-device CPU mesh.
+
+SURVEY.md 4(d): multi-node behavior without hardware — conftest forces
+``--xla_force_host_platform_device_count=8``, mirroring the driver's
+multichip dryrun.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from kubernetesnetawarescheduler_tpu.config import SchedulerConfig
+from kubernetesnetawarescheduler_tpu.core import assign as assign_lib
+from kubernetesnetawarescheduler_tpu.core.state import commit_assignments
+from kubernetesnetawarescheduler_tpu.parallel import (
+    make_mesh,
+    sharded_schedule_step,
+)
+from kubernetesnetawarescheduler_tpu.parallel.sharding import place
+
+from tests import gen
+
+CFG = SchedulerConfig(max_nodes=64, max_pods=16, max_peers=4,
+                      use_bfloat16=False)
+
+
+def make(seed):
+    rng = np.random.default_rng(seed)
+    state_np, pods_np = gen.random_instance(rng, CFG, n_nodes=48, n_pods=12)
+    return gen.to_pytrees(CFG, state_np, pods_np)
+
+
+def test_eight_devices_available():
+    assert len(jax.devices()) >= 8
+
+
+@pytest.mark.parametrize("dp,tp", [(2, 4), (4, 2), (1, 8), (8, 1)])
+def test_sharded_step_matches_single_device(dp, tp):
+    state, pods = make(0)
+    want_assign = np.asarray(assign_lib.assign_parallel(state, pods, CFG))
+    want_state = commit_assignments(state, pods,
+                                    assign_lib.assign_parallel(
+                                        state, pods, CFG))
+    mesh = make_mesh(dp, tp)
+    step = sharded_schedule_step(CFG, mesh, method="parallel")
+    s_state, s_pods = place(mesh, state, pods)
+    got_assign, got_state = step(s_state, s_pods)
+    np.testing.assert_array_equal(np.asarray(got_assign), want_assign)
+    np.testing.assert_allclose(np.asarray(got_state.used),
+                               np.asarray(want_state.used), atol=1e-4)
+    np.testing.assert_array_equal(np.asarray(got_state.group_bits),
+                                  np.asarray(want_state.group_bits))
+
+
+def test_sharded_greedy_matches():
+    state, pods = make(1)
+    want = np.asarray(assign_lib.assign_greedy(state, pods, CFG))
+    mesh = make_mesh(2, 4)
+    step = sharded_schedule_step(CFG, mesh, method="greedy")
+    s_state, s_pods = place(mesh, state, pods)
+    got, _ = step(s_state, s_pods)
+    np.testing.assert_array_equal(np.asarray(got), want)
