@@ -132,6 +132,12 @@ class Encoder:
             self._node_valid[self._node_index[name]] = False
             self._dirty["topo"] = True
 
+    def mark_ready(self, name: str) -> None:
+        """Recovery hook: the inverse of :meth:`mark_unready`."""
+        with self._lock:
+            self._node_valid[self._node_index[name]] = True
+            self._dirty["topo"] = True
+
     # -- telemetry ----------------------------------------------------
 
     def update_metrics(self, name: str, values: Mapping[str, float],

@@ -48,6 +48,7 @@ class SchedulerLoop:
         self.timer = PhaseTimer()
         self.scheduled = 0
         self.unschedulable = 0
+        self.bind_failures = 0
         self._assign = {"greedy": assign_greedy,
                         "parallel": assign_parallel}[method]
         self.informer = Informer(client, self.queue, cfg.scheduler_name,
@@ -80,9 +81,9 @@ class SchedulerLoop:
 
     def _peer_node(self, pod_name: str) -> str:
         try:
-            return self.client.node_of(pod_name)  # type: ignore[attr-defined]
-        except (AttributeError, KeyError):
-            return ""
+            return self.client.node_of(pod_name)
+        except KeyError:
+            return ""  # peer not known to the API server (yet)
 
     def _bind_all(self, pods: Sequence[Pod],
                   assignment: np.ndarray) -> int:
@@ -95,9 +96,17 @@ class SchedulerLoop:
                     pod, self.cfg.scheduler_name, "no feasible node"))
                 continue
             node_name = self.encoder.node_name(node_idx)
-            self.client.bind(Binding(pod_name=pod.name,
-                                     namespace=pod.namespace,
-                                     node_name=node_name))
+            try:
+                self.client.bind(Binding(pod_name=pod.name,
+                                         namespace=pod.namespace,
+                                         node_name=node_name))
+            except Exception as exc:  # noqa: BLE001 — a rejected bind
+                # (pod gone, already bound by a duplicate delivery)
+                # must not kill the rest of the batch.
+                self.bind_failures += 1
+                self.client.create_event(failed_event(
+                    pod, self.cfg.scheduler_name, f"bind rejected: {exc}"))
+                continue
             self.client.create_event(scheduled_event(
                 pod, node_name, self.cfg.scheduler_name))
             self.encoder.commit(pod, node_name)

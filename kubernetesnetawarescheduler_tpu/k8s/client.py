@@ -54,6 +54,12 @@ class ClusterClient:
         on ADD events, scheduler.go:165-173)."""
         raise NotImplementedError
 
+    def node_of(self, pod_name: str) -> str:
+        """Node a pod is bound to ("" if pending).  Part of the core
+        contract: peer-traffic scoring resolves placed peers through
+        this (raises ``KeyError`` for unknown pods)."""
+        raise NotImplementedError
+
 
 class FakeCluster(ClusterClient):
     """In-memory cluster: nodes, pods, bindings, events.

@@ -34,21 +34,29 @@ class PodQueue:
     def __init__(self, capacity: int = 300) -> None:
         self._capacity = capacity
         self._dq: collections.deque[Pod] = collections.deque()
+        self._queued: set[str] = set()
         self._lock = threading.Lock()
         self._not_empty = threading.Condition(self._lock)
         self.dropped = 0
+        self.duplicates = 0
 
     def __len__(self) -> int:
         with self._lock:
             return len(self._dq)
 
     def push(self, pod: Pod) -> bool:
-        """Enqueue; returns False (and counts a drop) when full."""
+        """Enqueue; returns False when full (counted as a drop) or when
+        the pod is already queued (duplicate ADD delivery / resync
+        overlap — counted separately)."""
         with self._not_empty:
+            if pod.name in self._queued:
+                self.duplicates += 1
+                return False
             if len(self._dq) >= self._capacity:
                 self.dropped += 1
                 return False
             self._dq.append(pod)
+            self._queued.add(pod.name)
             self._not_empty.notify()
             return True
 
@@ -61,7 +69,9 @@ class PodQueue:
                 self._not_empty.wait(timeout)
             batch: list[Pod] = []
             while self._dq and len(batch) < max_batch:
-                batch.append(self._dq.popleft())
+                pod = self._dq.popleft()
+                self._queued.discard(pod.name)
+                batch.append(pod)
             return batch
 
 

@@ -1,0 +1,215 @@
+"""Ingest pipeline: prometheus parser, iperf3 schema, probes, scraper."""
+
+import numpy as np
+import pytest
+
+from kubernetesnetawarescheduler_tpu.config import SchedulerConfig
+from kubernetesnetawarescheduler_tpu.core.encode import Encoder
+from kubernetesnetawarescheduler_tpu.ingest import (
+    FakeProber,
+    NodeExporterExtractor,
+    ProbeOrchestrator,
+    ScrapePool,
+    parse_iperf_json,
+    parse_prometheus_text,
+)
+from kubernetesnetawarescheduler_tpu.ingest.iperf import synth_iperf_json
+from kubernetesnetawarescheduler_tpu.k8s.types import Node
+
+
+def synth_scrape(n_cpus=8, freqs=None, mem_total=8e9, mem_avail=2e9,
+                 nics=(("eth0", 1000, 2000), ("flannel.1", 50, 60)),
+                 disks=(("mmcblk0", 3), ("mmcblk0p1", 1))):
+    """A realistic node_exporter exposition body — including the shapes
+    that break the reference: more than 4 CPUs (scheduler.go:438-439),
+    overlay NICs adjacent to physical ones (:468), HELP/TYPE comments,
+    scientific notation."""
+    freqs = freqs or [1.2e9 + 1e8 * i for i in range(n_cpus)]
+    lines = [
+        "# HELP node_cpu_scaling_frequency_hertz Current scaled CPU "
+        "thread frequency in hertz.",
+        "# TYPE node_cpu_scaling_frequency_hertz gauge",
+    ]
+    for i, f in enumerate(freqs):
+        lines.append(
+            f'node_cpu_scaling_frequency_hertz{{cpu="{i}"}} {f:e}')
+    lines += [
+        "# HELP node_memory_MemTotal_bytes Memory information field "
+        "MemTotal_bytes.",
+        "# TYPE node_memory_MemTotal_bytes gauge",
+        f"node_memory_MemTotal_bytes {mem_total:e}",
+        "# TYPE node_memory_MemAvailable_bytes gauge",
+        f"node_memory_MemAvailable_bytes {mem_avail:e}",
+        "# TYPE node_memory_Mlocked_bytes gauge",
+        "node_memory_Mlocked_bytes 0",
+        "# TYPE node_memory_MemFree_bytes gauge",
+        f"node_memory_MemFree_bytes {mem_avail * 0.8:e}",
+    ]
+    for dev, tx, rx in nics:
+        lines.append(
+            f'node_network_transmit_packets_total{{device="{dev}"}} {tx}')
+        lines.append(
+            f'node_network_receive_packets_total{{device="{dev}"}} {rx}')
+    for dev, io in disks:
+        lines.append(f'node_disk_io_now{{device="{dev}"}} {io}')
+    return "\n".join(lines) + "\n"
+
+
+def test_parse_prometheus_basic():
+    parsed = parse_prometheus_text(synth_scrape())
+    assert len(parsed["node_cpu_scaling_frequency_hertz"]) == 8
+    labels = frozenset({("device", "eth0")})
+    assert parsed["node_network_transmit_packets_total"][labels] == 1000
+
+
+def test_parse_skips_malformed_lines():
+    body = "garbage line {{{\nnode_ok 1.5\nbad{unclosed 3\nnot_a_number x\n"
+    parsed = parse_prometheus_text(body)
+    assert parsed == {"node_ok": {frozenset(): 1.5}}
+
+
+def test_extractor_eight_cpus_no_fallback_bug():
+    """The reference mis-parsed the 8-core master and substituted cpu2's
+    value for cpu3 (scheduler.go:438-439); the real parser averages all
+    eight."""
+    freqs = [1e9] * 4 + [2e9] * 4
+    ex = NodeExporterExtractor()
+    got = ex.extract(synth_scrape(freqs=freqs))
+    assert got["cpu_freq"] == pytest.approx(1.5e9)
+
+
+def test_extractor_memory_and_devices():
+    ex = NodeExporterExtractor()
+    got = ex.extract(synth_scrape(mem_total=8e9, mem_avail=2e9))
+    assert got["mem_pct"] == pytest.approx(75.0)
+    # flannel.1 (overlay) is excluded; only eth0 counted.
+    assert got["net_tx"] == 1000
+    assert got["net_rx"] == 2000
+    # mmcblk0p1 (partition) is excluded.
+    assert got["disk_io"] == 3
+
+
+def test_iperf_roundtrip():
+    doc = synth_iperf_json(5.5e9, title="probe a->b")
+    res = parse_iperf_json(doc)
+    assert res.bandwidth_bps == pytest.approx(5.5e9)
+    assert res.title == "probe a->b"
+    assert res.protocol == "TCP"
+    assert res.sum_received.bits_per_second == pytest.approx(5.5e9)
+    assert res.intervals_bps == (pytest.approx(5.5e9),)
+
+
+def test_iperf_rejects_structurally_broken():
+    with pytest.raises(ValueError):
+        parse_iperf_json("{}")
+    with pytest.raises(Exception):
+        parse_iperf_json("not json at all")
+
+
+def make_encoder(names):
+    cfg = SchedulerConfig(max_nodes=16, max_pods=4, max_peers=2)
+    enc = Encoder(cfg)
+    for name in names:
+        enc.upsert_node(Node(name=name, capacity={"cpu": 4.0}))
+    return enc
+
+
+def test_probe_orchestrator_fills_matrices():
+    names = [f"n{i}" for i in range(4)]
+    enc = make_encoder(names)
+    truth_lat = np.arange(16, dtype=np.float32).reshape(4, 4)
+    truth_lat = (truth_lat + truth_lat.T) / 2
+    truth_bw = np.full((4, 4), 1e9, np.float32)
+    prober = FakeProber(names, truth_lat, truth_bw, noise=0.0)
+    orch = ProbeOrchestrator(enc, prober, names)
+    done = orch.run_cycle(budget=100)
+    assert done == 6  # all unordered pairs of 4 nodes
+    state = enc.snapshot()
+    lat = np.asarray(state.lat)[:4, :4]
+    np.testing.assert_allclose(lat + np.diag(np.diag(truth_lat)),
+                               truth_lat, atol=1e-5)
+
+
+def test_probe_budget_and_staleness_priority():
+    names = [f"n{i}" for i in range(6)]
+    enc = make_encoder(names)
+    prober = FakeProber(names, np.ones((6, 6), np.float32),
+                        np.ones((6, 6), np.float32))
+    orch = ProbeOrchestrator(enc, prober, names)
+    assert orch.run_cycle(budget=5) == 5
+    orch.advance_clock(60.0)
+    # next cycle prefers never-probed pairs (15 total pairs, 10 left)
+    assert orch.run_cycle(budget=10) == 10
+    assert len(orch.staleness()) == 15
+
+
+def test_probe_failures_counted_not_fatal():
+    names = ["a", "b", "c"]
+    enc = make_encoder(names)
+    prober = FakeProber(names, np.ones((3, 3), np.float32),
+                        np.ones((3, 3), np.float32), fail_fraction=1.0)
+    orch = ProbeOrchestrator(enc, prober, names)
+    assert orch.run_cycle(budget=10) == 0
+    assert orch.failures == 3
+
+
+def test_unescape_backslash_then_n():
+    """Sequential replaces would turn an escaped backslash + literal n
+    into a newline; the single-pass unescape must not."""
+    body = 'm{path="C:\\\\network"} 1\n'
+    parsed = parse_prometheus_text(body)
+    (labels, value), = parsed["m"].items()
+    assert dict(labels)["path"] == "C:\\network"
+    assert value == 1.0
+
+
+def test_scrape_pool_recovery_marks_ready_again():
+    """A node benched for scrape staleness must come back when its
+    exporter recovers (but not nodes cordoned via the API)."""
+    names = ["n0", "n1"]
+    enc = make_encoder(names)
+    healthy = {"n0"}
+
+    def fetch(url):
+        name = url.split("//")[1].split(":")[0]
+        if name not in healthy:
+            raise OSError("down")
+        return synth_scrape()
+
+    pool = ScrapePool(enc, {n: f"http://{n}:9100/metrics" for n in names},
+                      fetch=fetch, unready_after_s=100.0)
+    pool.scrape_all(now_s=0.0)
+    pool.scrape_all(now_s=150.0)
+    assert not bool(np.asarray(enc.snapshot().node_valid)[
+        enc.node_index("n1")])
+    healthy.add("n1")  # exporter recovers
+    pool.scrape_all(now_s=200.0)
+    assert bool(np.asarray(enc.snapshot().node_valid)[
+        enc.node_index("n1")])
+
+
+def test_scrape_pool_feeds_encoder_and_tolerates_failures():
+    names = ["n0", "n1", "n2"]
+    enc = make_encoder(names)
+
+    def fake_fetch(url):
+        if "n1" in url:
+            raise OSError("connection refused")
+        return synth_scrape()
+
+    pool = ScrapePool(enc, {n: f"http://{n}:9100/metrics" for n in names},
+                      fetch=fake_fetch, unready_after_s=100.0)
+    ok = pool.scrape_all(now_s=0.0)
+    assert ok == 2
+    assert pool.failures == 1
+    state = enc.snapshot()
+    m = np.asarray(state.metrics)
+    assert m[enc.node_index("n0"), 0] > 0  # cpu_freq ingested
+    assert m[enc.node_index("n1"), 0] == 0  # failed scrape left alone
+    # n1 keeps failing past the unready horizon -> marked unready
+    pool.scrape_all(now_s=50.0)
+    pool.scrape_all(now_s=150.0)
+    state = enc.snapshot()
+    valid = np.asarray(state.node_valid)
+    assert valid[enc.node_index("n0")]
+    assert not valid[enc.node_index("n1")]

@@ -95,6 +95,30 @@ def test_queue_overflow_drops_not_blocks():
     assert sum(1 for i in range(15) if cluster.node_of(f"p{i}")) == 15
 
 
+def test_duplicate_delivery_is_deduped_and_bind_failure_survives():
+    """Duplicate ADD (informer reconnect) must not double-schedule, and
+    a rejected bind must not kill the rest of the batch."""
+    cluster, loop = make_loop(num_nodes=8)
+    pod = Pod(name="dup", requests={"cpu": 0.1})
+    cluster.add_pod(pod)
+    loop.informer._handle_pod(pod)  # simulated duplicate delivery
+    assert loop.queue.duplicates == 1
+    # Force a bind failure mid-batch: externally bind one queued pod.
+    victim = Pod(name="raced", requests={"cpu": 0.1})
+    other = Pod(name="other", requests={"cpu": 0.1})
+    cluster.add_pod(victim)
+    cluster.add_pod(other)
+    from kubernetesnetawarescheduler_tpu.k8s.types import Binding
+    cluster.bind(Binding(pod_name="raced", namespace="default",
+                         node_name=cluster.list_nodes()[0].name))
+    loop.run_until_drained()
+    assert loop.bind_failures == 1
+    assert cluster.node_of("dup") != ""
+    assert cluster.node_of("other") != ""
+    rejects = [e for e in cluster.events if "bind rejected" in e.message]
+    assert len(rejects) == 1
+
+
 def test_peer_traffic_pulls_colocalization():
     """A pod with heavy traffic to a placed peer should land near it
     (same node or same rack) — the capability gap vs the reference,

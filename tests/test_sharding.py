@@ -1,8 +1,8 @@
 """Sharded scheduling over a virtual 8-device CPU mesh.
 
 SURVEY.md 4(d): multi-node behavior without hardware — conftest forces
-``--xla_force_host_platform_device_count=8``, mirroring the driver's
-multichip dryrun.
+the CPU backend with 8 virtual devices (``jax_num_cpu_devices``),
+mirroring the driver's multichip dryrun.
 """
 
 import numpy as np
