@@ -1,0 +1,22 @@
+"""Service boundary: Score/Filter APIs for external schedulers.
+
+Three transports over one semantic core (:mod:`.extender`):
+
+- :class:`~.server.ScorerServer` — length-prefixed frames over a unix
+  domain socket; what the native shim (native/extender.cpp) speaks.
+- :func:`~.grpc_server.serve_grpc` — the same ops over real gRPC
+  (generic byte handlers, JSON payloads) for remote/DCN clients.
+- The native ``netaware_extender`` binary — kube-scheduler's extender
+  webhook (HTTP) relaying to the UDS server.
+
+This keeps the reference's role split (its Go process held the
+kube-scheduler contract, scheduler.go:119-246) while the scoring lives
+on the TPU side.
+"""
+
+from kubernetesnetawarescheduler_tpu.api.extender import (  # noqa: F401
+    ExtenderHandlers,
+)
+from kubernetesnetawarescheduler_tpu.api.server import (  # noqa: F401
+    ScorerServer,
+)

@@ -1,0 +1,207 @@
+"""Kubernetes scheduler-extender semantics: /filter and /prioritize.
+
+Implements the stock extender webhook contract against the TPU scoring
+core:
+
+- ``/filter``: ExtenderArgs {pod, nodenames} -> ExtenderFilterResult
+  {nodenames, failedNodes} using the fused feasibility mask
+  (:func:`~..core.score.feasibility_mask`).
+- ``/prioritize``: ExtenderArgs -> HostPriorityList [{host, score}]
+  with scores scaled to k8s's 0..10 extender convention, from the full
+  masked score matrix.
+- ``/bind``: ExtenderBindingArgs -> bookkeeping + Binding via the
+  cluster client (optional; stock kube-scheduler can also bind itself).
+
+The reference had no such boundary — it *replaced* kube-scheduler
+outright (binding directly, scheduler.go:196-206); the extender shape
+lets our scorer augment a stock control plane, with its CPU path as
+fallback.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Mapping, Sequence
+
+import numpy as np
+
+from kubernetesnetawarescheduler_tpu.config import Resource
+from kubernetesnetawarescheduler_tpu.core.loop import SchedulerLoop
+from kubernetesnetawarescheduler_tpu.core.score import NEG_INF, score_pods
+from kubernetesnetawarescheduler_tpu.k8s.types import Binding, Pod
+
+MAX_EXTENDER_PRIORITY = 10  # k8s scheduler extender convention
+
+
+def _pod_from_k8s(obj: Mapping[str, Any]) -> Pod:
+    """Translate a (subset of a) v1.Pod manifest into our Pod.
+
+    Resource requests come from the max over containers' requests
+    (scheduling-relevant aggregate); netaware extensions ride in
+    annotations: ``netaware/peers`` (JSON {pod: traffic}),
+    ``netaware/group``, ``netaware/affinity``, ``netaware/anti``.
+    """
+    meta = obj.get("metadata") or {}
+    spec = obj.get("spec") or {}
+    annotations = meta.get("annotations") or {}
+    requests = {"cpu": 0.0, "mem": 0.0, "net_bw": 0.0}
+    for ctr in spec.get("containers") or ():
+        req = ((ctr.get("resources") or {}).get("requests") or {})
+        requests["cpu"] += _parse_cpu(req.get("cpu", "0"))
+        requests["mem"] += _parse_mem(req.get("memory", "0"))
+        requests["net_bw"] += float(req.get("netaware/bandwidth-gbps", 0.0))
+    peers = {}
+    if "netaware/peers" in annotations:
+        try:
+            peers = {str(k): float(v) for k, v in
+                     json.loads(annotations["netaware/peers"]).items()}
+        except (ValueError, AttributeError):
+            peers = {}
+    selector = spec.get("nodeSelector") or {}
+    tolerations = frozenset(
+        str(t.get("key")) for t in spec.get("tolerations") or ()
+        if t.get("key"))
+    return Pod(
+        name=meta.get("name", ""),
+        namespace=meta.get("namespace", "default"),
+        uid=meta.get("uid", "") or meta.get("name", ""),
+        scheduler_name=spec.get("schedulerName", ""),
+        requests=requests,
+        peers=peers,
+        tolerations=tolerations,
+        node_selector=frozenset(f"{k}={v}" for k, v in selector.items()),
+        group=annotations.get("netaware/group", ""),
+        affinity_groups=frozenset(
+            g for g in annotations.get("netaware/affinity", "").split(",")
+            if g),
+        anti_groups=frozenset(
+            g for g in annotations.get("netaware/anti", "").split(",") if g),
+        priority=float(spec.get("priority", 0) or 0),
+    )
+
+
+def _parse_cpu(text: str) -> float:
+    text = str(text)
+    if text.endswith("m"):
+        return float(text[:-1]) / 1000.0
+    try:
+        return float(text)
+    except ValueError:
+        return 0.0
+
+
+_MEM_SUFFIX = {"Ki": 2**10, "Mi": 2**20, "Gi": 2**30, "Ti": 2**40,
+               "K": 1e3, "M": 1e6, "G": 1e9, "T": 1e12}
+
+
+def _parse_mem(text: str) -> float:
+    """Memory quantity -> GiB (our mem resource unit)."""
+    text = str(text)
+    for suffix, mult in _MEM_SUFFIX.items():
+        if text.endswith(suffix):
+            try:
+                return float(text[: -len(suffix)]) * mult / 2**30
+            except ValueError:
+                return 0.0
+    try:
+        return float(text) / 2**30
+    except ValueError:
+        return 0.0
+
+
+class ExtenderHandlers:
+    """Stateless-per-request handlers bound to a SchedulerLoop."""
+
+    def __init__(self, loop: SchedulerLoop) -> None:
+        self._loop = loop
+
+    # -- ops ----------------------------------------------------------
+
+    def handle(self, path: str, body: bytes) -> bytes:
+        if path == "/filter":
+            return self._json(self.filter(json.loads(body or b"{}")))
+        if path == "/prioritize":
+            return self._json(self.prioritize(json.loads(body or b"{}")))
+        if path == "/bind":
+            return self._json(self.bind(json.loads(body or b"{}")))
+        if path == "/health":
+            return b'{"ok": true}'
+        raise ValueError(f"unknown op {path!r}")
+
+    @staticmethod
+    def _json(obj: Any) -> bytes:
+        return json.dumps(obj).encode()
+
+    def _candidate_names(self, args: Mapping[str, Any]) -> list[str]:
+        if args.get("nodenames"):
+            return list(args["nodenames"])
+        nodes = (args.get("nodes") or {}).get("items") or ()
+        return [((n.get("metadata") or {}).get("name", "")) for n in nodes]
+
+    def _score_row(self, args: Mapping[str, Any]
+                   ) -> tuple[list[str], np.ndarray, np.ndarray]:
+        """(names, feasible-mask row, score row) for the args' pod over
+        the args' candidate nodes."""
+        loop = self._loop
+        pod = _pod_from_k8s(args.get("pod") or {})
+        names = self._candidate_names(args)
+        if not names:
+            empty = np.zeros((0,))
+            return [], empty.astype(bool), empty
+        batch = loop.encoder.encode_pods([pod], node_of=loop._peer_node,
+                                         lenient=True)
+        state = loop.encoder.snapshot()
+        scores = np.asarray(score_pods(state, batch, loop.cfg))[0]
+        feasible = scores > float(NEG_INF) * 0.5
+        idx = []
+        for name in names:
+            try:
+                idx.append(loop.encoder.node_index(name))
+            except KeyError:
+                idx.append(-1)
+        idx_arr = np.asarray(idx, dtype=np.int64)
+        ok = np.where(idx_arr >= 0, feasible[np.maximum(idx_arr, 0)], False)
+        sc = np.where(ok, scores[np.maximum(idx_arr, 0)], float(NEG_INF))
+        return names, ok, sc
+
+    def filter(self, args: Mapping[str, Any]) -> Mapping[str, Any]:
+        names, ok, _ = self._score_row(args)
+        passed = [n for n, good in zip(names, ok) if good]
+        failed = {n: "netaware: infeasible (capacity/taint/affinity)"
+                  for n, good in zip(names, ok) if not good}
+        return {"nodenames": passed, "failedNodes": failed, "error": ""}
+
+    def prioritize(self, args: Mapping[str, Any]
+                   ) -> Sequence[Mapping[str, Any]]:
+        names, ok, scores = self._score_row(args)
+        if not names:
+            return []
+        finite = scores[ok]
+        lo = float(finite.min()) if finite.size else 0.0
+        hi = float(finite.max()) if finite.size else 1.0
+        span = max(hi - lo, 1e-9)
+        out = []
+        for name, good, sc in zip(names, ok, scores):
+            score10 = (int(round((sc - lo) / span * MAX_EXTENDER_PRIORITY))
+                       if good else 0)
+            out.append({"host": name, "score": score10})
+        return out
+
+    def bind(self, args: Mapping[str, Any]) -> Mapping[str, Any]:
+        pod_name = args.get("podName", "")
+        namespace = args.get("podNamespace", "default")
+        node = args.get("node", "")
+        try:
+            self._loop.client.bind(Binding(pod_name=pod_name,
+                                           namespace=namespace,
+                                           node_name=node))
+        except Exception as exc:  # relay the rejection, don't die
+            return {"error": str(exc)}
+        # Account the REAL resource requests, else extender-path binds
+        # would never raise usage and the scorer would overcommit.
+        pod = self._loop.client.get_pod(pod_name)
+        if pod is None:
+            pod = Pod(name=pod_name, namespace=namespace,
+                      requests={r: 0.0 for r in Resource.NAMES})
+        self._loop.encoder.commit(pod, node)
+        return {"error": ""}

@@ -30,26 +30,49 @@ from kubernetesnetawarescheduler_tpu.core.state import ClusterState, PodBatch
 from kubernetesnetawarescheduler_tpu.k8s.types import Node, Pod
 
 
+# Bit 31 is reserved: never assigned to a real key, so a mask carrying
+# it can never be satisfied by any node.  Lenient interning uses it to
+# keep un-internable *requirements* conservative (infeasible) instead
+# of silently weakened.
+UNKNOWN_BIT = np.uint32(1 << 31)
+_MAX_KEYS = 31
+
+
 class Interner:
-    """Stable string -> bit-position mapping (up to 32 bits)."""
+    """Stable string -> bit-position mapping (31 assignable bits).
+
+    Strict interning (trusted paths: node registration, the main
+    scheduling loop) raises when the slot space is exhausted.
+    Untrusted request paths (the extender webhook) pass
+    ``lenient=True``: an unknown-when-full key yields
+    ``on_overflow`` — callers choose the conservative direction for
+    their constraint (``UNKNOWN_BIT`` for must-match requirements,
+    0 for grants like tolerations) — so one exotic manifest degrades
+    only its own request instead of wedging scheduling for everyone."""
 
     def __init__(self, kind: str) -> None:
         self._kind = kind
         self._bits: dict[str, int] = {}
+        self.overflow_drops = 0
 
-    def bit(self, key: str) -> np.uint32:
+    def bit(self, key: str, lenient: bool = False,
+            on_overflow: np.uint32 = np.uint32(0)) -> np.uint32:
         if key not in self._bits:
-            if len(self._bits) >= 32:
+            if len(self._bits) >= _MAX_KEYS:
+                if lenient:
+                    self.overflow_drops += 1
+                    return on_overflow
                 raise ValueError(
-                    f"too many distinct {self._kind} keys (max 32): "
-                    f"cannot intern {key!r}")
+                    f"too many distinct {self._kind} keys "
+                    f"(max {_MAX_KEYS}): cannot intern {key!r}")
             self._bits[key] = len(self._bits)
         return np.uint32(1 << self._bits[key])
 
-    def mask(self, keys: Iterable[str]) -> np.uint32:
+    def mask(self, keys: Iterable[str], lenient: bool = False,
+             on_overflow: np.uint32 = np.uint32(0)) -> np.uint32:
         out = np.uint32(0)
         for key in keys:
-            out |= self.bit(key)
+            out |= self.bit(key, lenient=lenient, on_overflow=on_overflow)
         return out
 
 
@@ -231,12 +254,15 @@ class Encoder:
     # -- pods ---------------------------------------------------------
 
     def encode_pods(self, pods: Sequence[Pod],
-                    node_of: Callable[[str], str]) -> PodBatch:
+                    node_of: Callable[[str], str],
+                    lenient: bool = False) -> PodBatch:
         """Build a :class:`PodBatch` for up to ``cfg.max_pods`` pods.
 
         ``node_of`` resolves a peer pod name to its node name ("" if
         unplaced — such peers are dropped: traffic to a pod that has no
-        home yet cannot pull the placement anywhere).
+        home yet cannot pull the placement anywhere).  ``lenient``
+        governs interner overflow (see :class:`Interner`): pass True
+        for request-driven paths fed by untrusted manifests.
         """
         cfg = self.cfg
         p, k, r = cfg.max_pods, cfg.max_peers, cfg.num_resources
@@ -268,11 +294,19 @@ class Encoder:
                     peers[i, slot] = idx
                     traffic[i, slot] = vol
                     slot += 1
-                tol[i] = self.taints.mask(pod.tolerations)
-                sel[i] = self.labels.mask(pod.node_selector)
-                aff[i] = self.groups.mask(pod.affinity_groups)
-                anti[i] = self.groups.mask(pod.anti_groups)
-                gbit[i] = self.groups.bit(pod.group) if pod.group else 0
+                # Overflow direction per constraint: dropping a
+                # toleration/anti/own-group is conservative (more
+                # constrained / untracked); a must-match selector or
+                # required-affinity key degrades to UNKNOWN_BIT
+                # (infeasible) rather than silently matching anywhere.
+                tol[i] = self.taints.mask(pod.tolerations, lenient)
+                sel[i] = self.labels.mask(pod.node_selector, lenient,
+                                          on_overflow=UNKNOWN_BIT)
+                aff[i] = self.groups.mask(pod.affinity_groups, lenient,
+                                          on_overflow=UNKNOWN_BIT)
+                anti[i] = self.groups.mask(pod.anti_groups, lenient)
+                gbit[i] = (self.groups.bit(pod.group, lenient)
+                           if pod.group else 0)
                 prio[i] = pod.priority
                 valid[i] = True
         return PodBatch(

@@ -49,6 +49,8 @@ class SchedulerLoop:
         self.scheduled = 0
         self.unschedulable = 0
         self.bind_failures = 0
+        self.max_bind_retries = 3
+        self._bind_retries: dict[str, int] = {}
         self._assign = {"greedy": assign_greedy,
                         "parallel": assign_parallel}[method]
         self.informer = Informer(client, self.queue, cfg.scheduler_name,
@@ -100,13 +102,29 @@ class SchedulerLoop:
                 self.client.bind(Binding(pod_name=pod.name,
                                          namespace=pod.namespace,
                                          node_name=node_name))
-            except Exception as exc:  # noqa: BLE001 — a rejected bind
-                # (pod gone, already bound by a duplicate delivery)
-                # must not kill the rest of the batch.
+            except (KeyError, ValueError) as exc:
+                # Permanent rejection (pod gone / already bound by a
+                # duplicate delivery): event + drop, batch continues.
                 self.bind_failures += 1
                 self.client.create_event(failed_event(
                     pod, self.cfg.scheduler_name, f"bind rejected: {exc}"))
                 continue
+            except Exception as exc:  # noqa: BLE001 — transient API
+                # error: requeue with a retry budget instead of
+                # stranding the pod as Pending forever.
+                self.bind_failures += 1
+                key = f"{pod.namespace}/{pod.name}"
+                tries = self._bind_retries.get(key, 0) + 1
+                self._bind_retries[key] = tries
+                if tries <= self.max_bind_retries:
+                    self.queue.push(pod)
+                else:
+                    self._bind_retries.pop(key, None)
+                    self.client.create_event(failed_event(
+                        pod, self.cfg.scheduler_name,
+                        f"bind failed after {tries - 1} retries: {exc}"))
+                continue
+            self._bind_retries.pop(f"{pod.namespace}/{pod.name}", None)
             self.client.create_event(scheduled_event(
                 pod, node_name, self.cfg.scheduler_name))
             self.encoder.commit(pod, node_name)
@@ -124,12 +142,19 @@ class SchedulerLoop:
             total += n
         return total
 
-    def run_forever(self, poll_s: float = 0.05) -> None:
+    def run_forever(self, poll_s: float = 0.05,
+                    resync_every_s: float = 60.0) -> None:
         """The reference's ``wait.Until(s.Schedule, 0, quit)``
-        (scheduler.go:140), batched."""
+        (scheduler.go:140), batched, plus a periodic pending-pod
+        resync so pods lost to drops/transient failures are recovered
+        (the reference stranded them, scheduler.go:165-173)."""
+        last_resync = time.monotonic()
         while True:
             if self.run_once(timeout=poll_s) == 0:
                 time.sleep(0.0)
+            if time.monotonic() - last_resync >= resync_every_s:
+                self.informer.resync()
+                last_resync = time.monotonic()
 
 
 def jax_block(x):

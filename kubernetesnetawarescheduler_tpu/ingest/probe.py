@@ -31,9 +31,10 @@ from kubernetesnetawarescheduler_tpu.ingest.iperf import parse_iperf_json
 
 
 class Prober(Protocol):
-    def probe(self, a: str, b: str) -> tuple[float, float]:
-        """Measure (lat_ms, bw_bps) between two nodes; raises on
-        failure."""
+    def probe(self, a: str, b: str) -> tuple[float | None, float | None]:
+        """Measure (lat_ms, bw_bps) between two nodes; ``None`` means
+        "this prober has no figure for that quantity" (it is left
+        untouched for another prober).  Raises on failure."""
         ...
 
 
@@ -71,16 +72,16 @@ class Iperf3Prober:
         self._host_of = host_of
         self._duration = duration_s
 
-    def probe(self, a: str, b: str) -> tuple[float, float]:
+    def probe(self, a: str, b: str) -> tuple[None, float]:
         target = self._host_of[b]
         out = subprocess.run(
             ["iperf3", "-c", target, "-J", "-Z", "-t", str(self._duration),
              "-T", f"probe {a}->{b}"],
             capture_output=True, timeout=self._duration + 10, check=True)
         result = parse_iperf_json(out.stdout)
-        # iperf3 has no latency figure; approximate from min interval
-        # pacing or leave 0 for a separate ping prober to fill.
-        return 0.0, result.bandwidth_bps
+        # iperf3 has no latency figure: return None so a ping-based
+        # prober's latency for the pair is preserved, not zeroed.
+        return None, result.bandwidth_bps
 
 
 class ProbeOrchestrator:

@@ -47,7 +47,13 @@ class ScrapePool:
         self._encoder = encoder
         self._targets = dict(targets)
         self._fetch = fetch
-        self._extractor = extractor or NodeExporterExtractor()
+        if extractor is None:
+            # Native single-pass parser when built, Python fallback.
+            from kubernetesnetawarescheduler_tpu.ingest.native import (
+                make_extractor,
+            )
+            extractor = make_extractor()
+        self._extractor = extractor
         self._max_workers = max_workers
         self._unready_after_s = unready_after_s
         self._last_success: dict[str, float] = {}

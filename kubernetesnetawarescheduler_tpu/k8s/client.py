@@ -60,6 +60,11 @@ class ClusterClient:
         this (raises ``KeyError`` for unknown pods)."""
         raise NotImplementedError
 
+    def get_pod(self, pod_name: str) -> Pod | None:
+        """Full pod object (None if unknown) — the /bind path needs the
+        real resource requests to account usage."""
+        raise NotImplementedError
+
 
 class FakeCluster(ClusterClient):
     """In-memory cluster: nodes, pods, bindings, events.
@@ -137,6 +142,10 @@ class FakeCluster(ClusterClient):
     def pod(self, name: str) -> Pod:
         with self._lock:
             return self._pods[name]
+
+    def get_pod(self, pod_name: str) -> Pod | None:
+        with self._lock:
+            return self._pods.get(pod_name)
 
     def node_of(self, pod_name: str) -> str:
         with self._lock:

@@ -44,19 +44,25 @@ class PodQueue:
         with self._lock:
             return len(self._dq)
 
+    @staticmethod
+    def _key(pod: Pod) -> str:
+        # Namespaced: same-named pods in different namespaces are
+        # distinct (Binding is namespaced too).
+        return f"{pod.namespace}/{pod.name}"
+
     def push(self, pod: Pod) -> bool:
         """Enqueue; returns False when full (counted as a drop) or when
         the pod is already queued (duplicate ADD delivery / resync
         overlap — counted separately)."""
         with self._not_empty:
-            if pod.name in self._queued:
+            if self._key(pod) in self._queued:
                 self.duplicates += 1
                 return False
             if len(self._dq) >= self._capacity:
                 self.dropped += 1
                 return False
             self._dq.append(pod)
-            self._queued.add(pod.name)
+            self._queued.add(self._key(pod))
             self._not_empty.notify()
             return True
 
@@ -70,7 +76,7 @@ class PodQueue:
             batch: list[Pod] = []
             while self._dq and len(batch) < max_batch:
                 pod = self._dq.popleft()
-                self._queued.discard(pod.name)
+                self._queued.discard(self._key(pod))
                 batch.append(pod)
             return batch
 

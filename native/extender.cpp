@@ -1,0 +1,258 @@
+// netaware_extender — native kube-scheduler-extender shim.
+//
+// Holds the Kubernetes boundary the reference's Go process owned
+// (watch/bind loop, scheduler/scheduler.go:119-246) in the shape stock
+// kube-scheduler integrates with: the scheduler-extender webhook.
+// kube-scheduler POSTs ExtenderArgs JSON to /filter and /prioritize;
+// this shim forwards the raw payload over a unix-domain socket to the
+// Python/TPU scoring service (api/server.py) and relays the response.
+// Semantic parsing stays on the Python side — the shim does transport:
+// HTTP/1.1 keep-alive handling, concurrency (thread per connection),
+// backend framing, timeouts, and fail-open behavior on backend outage
+// (a scheduling webhook must degrade, not wedge kube-scheduler — the
+// reference instead crashed on its dependencies' failures,
+// scheduler.go:397-405).
+//
+// Usage: netaware_extender <listen_port> <backend_uds_path>
+// Build:  make -C native   (produces netaware_extender)
+//
+// Frame protocol to backend (both directions length-prefixed):
+//   request:  u32 path_len | path bytes | u32 body_len | body bytes
+//   response: u32 body_len | body bytes          (empty = backend error)
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <sys/un.h>
+#include <unistd.h>
+
+#include <cerrno>
+#include <csignal>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <thread>
+
+namespace {
+
+ssize_t read_full(int fd, void* buf, size_t len) {
+  auto* p = static_cast<char*>(buf);
+  size_t done = 0;
+  while (done < len) {
+    ssize_t n = ::read(fd, p + done, len - done);
+    if (n == 0) return static_cast<ssize_t>(done);
+    if (n < 0) {
+      if (errno == EINTR) continue;
+      return -1;
+    }
+    done += static_cast<size_t>(n);
+  }
+  return static_cast<ssize_t>(done);
+}
+
+bool write_full(int fd, const void* buf, size_t len) {
+  const auto* p = static_cast<const char*>(buf);
+  size_t done = 0;
+  while (done < len) {
+    ssize_t n = ::write(fd, p + done, len - done);
+    if (n < 0) {
+      if (errno == EINTR) continue;
+      return false;
+    }
+    done += static_cast<size_t>(n);
+  }
+  return true;
+}
+
+bool write_u32(int fd, uint32_t v) {
+  uint32_t be = htonl(v);
+  return write_full(fd, &be, 4);
+}
+
+bool read_u32(int fd, uint32_t* v) {
+  uint32_t be = 0;
+  if (read_full(fd, &be, 4) != 4) return false;
+  *v = ntohl(be);
+  return true;
+}
+
+// One round-trip to the Python scorer over the UDS backend.
+bool backend_call(const char* uds_path, const std::string& path,
+                  const std::string& body, std::string* response) {
+  int fd = ::socket(AF_UNIX, SOCK_STREAM, 0);
+  if (fd < 0) return false;
+  sockaddr_un addr{};
+  addr.sun_family = AF_UNIX;
+  std::snprintf(addr.sun_path, sizeof(addr.sun_path), "%s", uds_path);
+  if (::connect(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0) {
+    ::close(fd);
+    return false;
+  }
+  bool ok = write_u32(fd, static_cast<uint32_t>(path.size())) &&
+            write_full(fd, path.data(), path.size()) &&
+            write_u32(fd, static_cast<uint32_t>(body.size())) &&
+            write_full(fd, body.data(), body.size());
+  uint32_t resp_len = 0;
+  if (ok) ok = read_u32(fd, &resp_len);
+  if (ok && resp_len > (64u << 20)) ok = false;  // sanity cap 64 MB
+  // An empty frame is the backend's "handler failed" signal -> treat
+  // as an error so the shim fails open instead of relaying 200 "".
+  if (ok && resp_len == 0) ok = false;
+  if (ok) {
+    response->resize(resp_len);
+    ok = read_full(fd, response->empty() ? nullptr : &(*response)[0],
+                   resp_len) == static_cast<ssize_t>(resp_len);
+  }
+  ::close(fd);
+  return ok;
+}
+
+void http_respond(int fd, int code, const char* status,
+                  const std::string& body,
+                  const char* content_type = "application/json") {
+  char header[256];
+  int n = std::snprintf(header, sizeof(header),
+                        "HTTP/1.1 %d %s\r\n"
+                        "Content-Type: %s\r\n"
+                        "Content-Length: %zu\r\n"
+                        "Connection: keep-alive\r\n\r\n",
+                        code, status, content_type, body.size());
+  write_full(fd, header, static_cast<size_t>(n));
+  write_full(fd, body.data(), body.size());
+}
+
+// Minimal HTTP/1.1 request reader: method, path, content-length body.
+// `carry` holds surplus bytes read past the previous request so
+// pipelined / eagerly-sent keep-alive requests are not dropped.
+bool read_http_request(int fd, std::string* method, std::string* path,
+                       std::string* body, std::string* carry) {
+  std::string buf;
+  buf.swap(*carry);
+  char chunk[4096];
+  size_t header_end = buf.find("\r\n\r\n");
+  while (header_end == std::string::npos) {
+    ssize_t n = ::read(fd, chunk, sizeof(chunk));
+    if (n <= 0) return false;
+    buf.append(chunk, static_cast<size_t>(n));
+    header_end = buf.find("\r\n\r\n");
+    if (buf.size() > (1u << 20) && header_end == std::string::npos) {
+      return false;  // oversized header
+    }
+  }
+  size_t line_end = buf.find("\r\n");
+  std::string request_line = buf.substr(0, line_end);
+  size_t sp1 = request_line.find(' ');
+  size_t sp2 = request_line.find(' ', sp1 + 1);
+  if (sp1 == std::string::npos || sp2 == std::string::npos) return false;
+  *method = request_line.substr(0, sp1);
+  *path = request_line.substr(sp1 + 1, sp2 - sp1 - 1);
+
+  size_t content_length = 0;
+  // Case-insensitive scan for Content-Length.
+  for (size_t pos = line_end + 2; pos < header_end;) {
+    size_t eol = buf.find("\r\n", pos);
+    if (eol == std::string::npos || eol > header_end) eol = header_end;
+    std::string line = buf.substr(pos, eol - pos);
+    std::string lower;
+    lower.reserve(line.size());
+    for (char c : line) {
+      lower.push_back(static_cast<char>(
+          std::tolower(static_cast<unsigned char>(c))));
+    }
+    if (lower.rfind("content-length:", 0) == 0) {
+      content_length = static_cast<size_t>(
+          std::strtoull(line.c_str() + 15, nullptr, 10));
+    }
+    pos = eol + 2;
+  }
+  if (content_length > (64u << 20)) return false;
+
+  std::string rest = buf.substr(header_end + 4);
+  while (rest.size() < content_length) {
+    ssize_t n = ::read(fd, chunk, sizeof(chunk));
+    if (n <= 0) return false;
+    rest.append(chunk, static_cast<size_t>(n));
+  }
+  *body = rest.substr(0, content_length);
+  carry->assign(rest, content_length, std::string::npos);
+  return true;
+}
+
+struct ServerConfig {
+  const char* uds_path;
+};
+
+void handle_connection(int fd, ServerConfig cfg) {
+  std::string method, path, body, carry;
+  while (read_http_request(fd, &method, &path, &body, &carry)) {
+    if (path == "/healthz") {
+      http_respond(fd, 200, "OK", "ok", "text/plain");
+      continue;
+    }
+    if (method != "POST" ||
+        (path != "/filter" && path != "/prioritize" && path != "/bind")) {
+      http_respond(fd, 404, "Not Found", "{\"error\":\"unknown route\"}");
+      continue;
+    }
+    std::string response;
+    if (backend_call(cfg.uds_path, path, body, &response)) {
+      http_respond(fd, 200, "OK", response);
+    } else {
+      // Fail open: report every node unfiltered / zero priorities so
+      // kube-scheduler can fall back to its default scoring instead of
+      // blocking pods on our outage.
+      if (path == "/prioritize") {
+        http_respond(fd, 200, "OK", "[]");
+      } else {
+        http_respond(fd, 503, "Service Unavailable",
+                     "{\"error\":\"scorer backend unavailable\"}");
+      }
+    }
+  }
+  ::close(fd);
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  if (argc != 3) {
+    std::fprintf(stderr,
+                 "usage: %s <listen_port> <backend_uds_path>\n", argv[0]);
+    return 2;
+  }
+  int port = std::atoi(argv[1]);
+  ServerConfig cfg{argv[2]};
+  ::signal(SIGPIPE, SIG_IGN);
+
+  int srv = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (srv < 0) { std::perror("socket"); return 1; }
+  int one = 1;
+  ::setsockopt(srv, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (::bind(srv, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0) {
+    std::perror("bind");
+    return 1;
+  }
+  if (::listen(srv, 128) != 0) { std::perror("listen"); return 1; }
+  std::fprintf(stderr, "netaware_extender listening on 127.0.0.1:%d -> %s\n",
+               port, cfg.uds_path);
+
+  while (true) {
+    int fd = ::accept(srv, nullptr, nullptr);
+    if (fd < 0) {
+      if (errno == EINTR) continue;
+      std::perror("accept");
+      break;
+    }
+    ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    std::thread(handle_connection, fd, cfg).detach();
+  }
+  ::close(srv);
+  return 0;
+}

@@ -1,0 +1,184 @@
+// Fast node_exporter exposition-format metric extraction (C ABI).
+//
+// Native counterpart of ingest/prometheus.py: one linear pass over the
+// scrape body computing the scheduler's derived channels.  The
+// reference did this with repeated strings.Index substring slicing and
+// hardcoded byte offsets per metric (scheduler/scheduler.go:409-549);
+// at the 5k-node design point the host parses ~5k x ~100 KB bodies per
+// scrape sweep, which is worth a native inner loop (the Python parser
+// stays as the portable fallback).
+//
+// Exposed via a plain C ABI for ctypes (no pybind11 in the image).
+//
+// Build: make -C native  (produces libnetaware_parser.so)
+
+#include <cctype>
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <unordered_set>
+
+namespace {
+
+// Split a comma-separated device list into a set.
+std::unordered_set<std::string> split_csv(const char* csv) {
+  std::unordered_set<std::string> out;
+  if (csv == nullptr) return out;
+  const char* p = csv;
+  while (*p) {
+    const char* comma = std::strchr(p, ',');
+    size_t len = comma ? static_cast<size_t>(comma - p) : std::strlen(p);
+    if (len > 0) out.emplace(p, len);
+    p += len;
+    if (*p == ',') ++p;
+  }
+  return out;
+}
+
+struct Line {
+  const char* name;
+  size_t name_len;
+  const char* labels;   // inside braces, may be null
+  size_t labels_len;
+  double value;
+};
+
+// Parse one sample line; returns false for comments/blank/malformed.
+bool parse_line(const char* line, const char* end, Line* out) {
+  while (line < end && (*line == ' ' || *line == '\t')) ++line;
+  if (line >= end || *line == '#' || *line == '\n') return false;
+  const char* p = line;
+  while (p < end && (std::isalnum(static_cast<unsigned char>(*p)) ||
+                     *p == '_' || *p == ':')) {
+    ++p;
+  }
+  if (p == line) return false;
+  out->name = line;
+  out->name_len = static_cast<size_t>(p - line);
+  out->labels = nullptr;
+  out->labels_len = 0;
+  if (p < end && *p == '{') {
+    const char* close = p + 1;
+    bool esc = false, in_str = false;
+    while (close < end) {
+      char c = *close;
+      if (esc) { esc = false; }
+      else if (c == '\\') { esc = true; }
+      else if (c == '"') { in_str = !in_str; }
+      else if (c == '}' && !in_str) break;
+      ++close;
+    }
+    if (close >= end) return false;
+    out->labels = p + 1;
+    out->labels_len = static_cast<size_t>(close - (p + 1));
+    p = close + 1;
+  }
+  while (p < end && (*p == ' ' || *p == '\t')) ++p;
+  if (p >= end) return false;
+  char* value_end = nullptr;
+  out->value = std::strtod(p, &value_end);
+  if (value_end == p) return false;
+  return true;
+}
+
+bool name_is(const Line& l, const char* name) {
+  size_t n = std::strlen(name);
+  return l.name_len == n && std::memcmp(l.name, name, n) == 0;
+}
+
+// Extract the value of label `key` from the label blob (unescaped
+// label values are fine for device names).
+bool label_value(const Line& l, const char* key, std::string* out) {
+  size_t klen = std::strlen(key);
+  const char* p = l.labels;
+  const char* end = l.labels + l.labels_len;
+  while (p && p < end) {
+    // key="value"
+    const char* eq = static_cast<const char*>(
+        std::memchr(p, '=', static_cast<size_t>(end - p)));
+    if (!eq || eq + 1 >= end || eq[1] != '"') return false;
+    const char* vstart = eq + 2;
+    const char* v = vstart;
+    bool esc = false;
+    while (v < end) {
+      if (esc) { esc = false; }
+      else if (*v == '\\') { esc = true; }
+      else if (*v == '"') break;
+      ++v;
+    }
+    if (v >= end) return false;
+    if (static_cast<size_t>(eq - p) == klen && std::memcmp(p, key, klen) == 0) {
+      out->assign(vstart, static_cast<size_t>(v - vstart));
+      return true;
+    }
+    p = v + 1;
+    if (p < end && *p == ',') ++p;
+  }
+  return false;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Output layout matches config.Metric order minus `bandwidth` (probe-
+// sourced): [cpu_freq, mem_pct, net_tx, net_rx, disk_io].
+// Returns the number of channels successfully derived (0..5).
+int netaware_parse_scrape(const char* body, int64_t body_len,
+                          const char* nic_csv, const char* disk_csv,
+                          double out[5]) {
+  if (body == nullptr || body_len < 0) return -1;
+  auto nics = split_csv(nic_csv);
+  auto disks = split_csv(disk_csv);
+
+  double cpu_sum = 0.0; int64_t cpu_n = 0;
+  double mem_total = -1.0, mem_avail = -1.0;
+  double tx = 0.0, rx = 0.0, disk_io = 0.0;
+  bool saw_tx = false, saw_rx = false, saw_disk = false;
+
+  const char* p = body;
+  const char* end = body + body_len;
+  std::string dev;
+  while (p < end) {
+    const char* nl = static_cast<const char*>(
+        std::memchr(p, '\n', static_cast<size_t>(end - p)));
+    const char* line_end = nl ? nl : end;
+    Line l;
+    if (parse_line(p, line_end, &l)) {
+      if (name_is(l, "node_cpu_scaling_frequency_hertz")) {
+        cpu_sum += l.value; ++cpu_n;
+      } else if (name_is(l, "node_memory_MemTotal_bytes")) {
+        mem_total = l.value;
+      } else if (name_is(l, "node_memory_MemAvailable_bytes")) {
+        mem_avail = l.value;
+      } else if (name_is(l, "node_network_transmit_packets_total")) {
+        if (l.labels && label_value(l, "device", &dev) && nics.count(dev)) {
+          tx += l.value; saw_tx = true;
+        }
+      } else if (name_is(l, "node_network_receive_packets_total")) {
+        if (l.labels && label_value(l, "device", &dev) && nics.count(dev)) {
+          rx += l.value; saw_rx = true;
+        }
+      } else if (name_is(l, "node_disk_io_now")) {
+        if (l.labels && label_value(l, "device", &dev) && disks.count(dev)) {
+          disk_io += l.value; saw_disk = true;
+        }
+      }
+    }
+    p = line_end + 1;
+  }
+
+  int derived = 0;
+  for (int i = 0; i < 5; ++i) out[i] = 0.0;
+  if (cpu_n > 0) { out[0] = cpu_sum / static_cast<double>(cpu_n); ++derived; }
+  if (mem_total > 0.0 && mem_avail >= 0.0) {
+    out[1] = 100.0 - (mem_avail * 100.0 / mem_total); ++derived;
+  }
+  if (saw_tx) { out[2] = tx; ++derived; }
+  if (saw_rx) { out[3] = rx; ++derived; }
+  if (saw_disk) { out[4] = disk_io; ++derived; }
+  return derived;
+}
+
+}  // extern "C"

@@ -1,0 +1,251 @@
+"""API boundary: UDS scorer server, native extender shim, gRPC.
+
+The extender tests run the REAL native binary (built from
+native/extender.cpp) against the Python scorer, POSTing the JSON
+kube-scheduler would send.
+"""
+
+import json
+import shutil
+import socket
+import subprocess
+import time
+import urllib.error
+import urllib.request
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from kubernetesnetawarescheduler_tpu.api.extender import ExtenderHandlers
+from kubernetesnetawarescheduler_tpu.api.server import ScorerServer, call_uds
+
+from tests.test_loop import make_loop
+
+REPO = Path(__file__).resolve().parent.parent
+NATIVE = REPO / "native"
+
+
+@pytest.fixture(scope="session")
+def native_build():
+    if shutil.which("g++") is None:
+        pytest.skip("no C++ toolchain")
+    subprocess.run(["make", "-C", str(NATIVE)], check=True,
+                   capture_output=True)
+    return NATIVE
+
+
+@pytest.fixture()
+def scorer(tmp_path):
+    cluster, loop = make_loop(num_nodes=12)
+    handlers = ExtenderHandlers(loop)
+    server = ScorerServer(handlers, str(tmp_path / "scorer.sock"))
+    server.start()
+    yield cluster, loop, server
+    server.stop()
+
+
+def extender_args(node_names, cpu="500m", peers=None):
+    pod = {
+        "metadata": {"name": "web-1", "namespace": "default",
+                     "annotations": {}},
+        "spec": {
+            "schedulerName": "netAwareScheduler",
+            "containers": [{"resources": {"requests": {
+                "cpu": cpu, "memory": "1Gi"}}}],
+        },
+    }
+    if peers:
+        pod["metadata"]["annotations"]["netaware/peers"] = json.dumps(peers)
+    return {"pod": pod, "nodenames": node_names}
+
+
+def test_uds_filter_and_prioritize(scorer):
+    cluster, loop, server = scorer
+    names = [n.name for n in cluster.list_nodes()][:6]
+    args = json.dumps(extender_args(names)).encode()
+    out = json.loads(call_uds(server.uds_path, "/filter", args))
+    assert set(out) == {"nodenames", "failedNodes", "error"}
+    assert set(out["nodenames"]) <= set(names)
+    assert len(out["nodenames"]) + len(out["failedNodes"]) == len(names)
+
+    prio = json.loads(call_uds(server.uds_path, "/prioritize", args))
+    assert [p["host"] for p in prio] == names
+    assert all(0 <= p["score"] <= 10 for p in prio)
+    # Best feasible node gets the max extender score.
+    assert max(p["score"] for p in prio) == 10
+
+
+def test_uds_filter_excludes_overcommit(scorer):
+    cluster, loop, server = scorer
+    names = [n.name for n in cluster.list_nodes()]
+    args = json.dumps(extender_args(names, cpu="100000")).encode()
+    out = json.loads(call_uds(server.uds_path, "/filter", args))
+    assert out["nodenames"] == []
+    assert len(out["failedNodes"]) == len(names)
+
+
+def test_uds_bind_roundtrip(scorer):
+    cluster, loop, server = scorer
+    from kubernetesnetawarescheduler_tpu.k8s.types import Pod
+    cluster.add_pod(Pod(name="bindme", scheduler_name="other"))
+    node = cluster.list_nodes()[0].name
+    out = json.loads(call_uds(server.uds_path, "/bind", json.dumps({
+        "podName": "bindme", "podNamespace": "default",
+        "node": node}).encode()))
+    assert out["error"] == ""
+    assert cluster.node_of("bindme") == node
+    # Second bind of the same pod is rejected, relayed as error text.
+    out = json.loads(call_uds(server.uds_path, "/bind", json.dumps({
+        "podName": "bindme", "podNamespace": "default",
+        "node": node}).encode()))
+    assert "already bound" in out["error"]
+
+
+def _free_port():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _post(url, payload, timeout=5.0):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return resp.status, json.loads(resp.read() or b"null")
+
+
+@pytest.fixture()
+def extender_proc(native_build, scorer):
+    cluster, loop, server = scorer
+    port = _free_port()
+    proc = subprocess.Popen(
+        [str(native_build / "netaware_extender"), str(port),
+         server.uds_path],
+        stderr=subprocess.PIPE)
+    # wait for listen
+    deadline = time.time() + 5
+    while time.time() < deadline:
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/healthz", timeout=0.5):
+                break
+        except (urllib.error.URLError, ConnectionError, OSError):
+            time.sleep(0.05)
+    else:
+        proc.kill()
+        pytest.fail("extender did not come up")
+    yield cluster, loop, server, port
+    proc.terminate()
+    proc.wait(timeout=5)
+
+
+def test_native_extender_end_to_end(extender_proc):
+    cluster, loop, server, port = extender_proc
+    names = [n.name for n in cluster.list_nodes()][:5]
+    status, out = _post(f"http://127.0.0.1:{port}/filter",
+                        extender_args(names))
+    assert status == 200
+    assert set(out["nodenames"]) <= set(names)
+
+    status, prio = _post(f"http://127.0.0.1:{port}/prioritize",
+                         extender_args(names, peers={"x": 3.0}))
+    assert status == 200
+    assert [p["host"] for p in prio] == names
+
+    # Unknown route -> 404 from the shim itself.
+    try:
+        _post(f"http://127.0.0.1:{port}/nope", {})
+        assert False, "expected 404"
+    except urllib.error.HTTPError as e:
+        assert e.code == 404
+
+
+def test_native_extender_fails_open_on_handler_error(extender_proc):
+    """Malformed JSON makes the handler raise; the empty backend frame
+    must fail open (prioritize -> neutral []) instead of 200-empty."""
+    cluster, loop, server, port = extender_proc
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/prioritize", data=b"{not json",
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=5) as resp:
+        assert json.loads(resp.read()) == []
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/filter", data=b"{not json",
+        headers={"Content-Type": "application/json"})
+    try:
+        urllib.request.urlopen(req, timeout=5)
+        assert False, "expected 503"
+    except urllib.error.HTTPError as e:
+        assert e.code == 503
+
+
+def test_prioritize_empty_candidates(scorer):
+    cluster, loop, server = scorer
+    out = json.loads(call_uds(server.uds_path, "/prioritize",
+                              json.dumps({"pod": {}, "nodenames": []})
+                              .encode()))
+    assert out == []
+
+
+def test_native_extender_fails_open_when_backend_down(extender_proc):
+    cluster, loop, server, port = extender_proc
+    server.stop()  # kill the backend, keep the shim
+    names = [n.name for n in cluster.list_nodes()][:3]
+    status, prio = _post(f"http://127.0.0.1:{port}/prioritize",
+                         extender_args(names))
+    assert status == 200
+    assert prio == []  # neutral priorities -> stock scheduler decides
+    try:
+        _post(f"http://127.0.0.1:{port}/filter", extender_args(names))
+        assert False, "expected 503"
+    except urllib.error.HTTPError as e:
+        assert e.code == 503
+
+
+def test_native_parser_parity(native_build):
+    from kubernetesnetawarescheduler_tpu.ingest.native import (
+        NativeExtractor,
+        make_extractor,
+    )
+    from kubernetesnetawarescheduler_tpu.ingest.prometheus import (
+        NodeExporterExtractor,
+    )
+    from tests.test_ingest import synth_scrape
+
+    ex = make_extractor()
+    assert isinstance(ex, NativeExtractor), "native lib should be picked up"
+    body = synth_scrape()
+    native = ex.extract(body)
+    python = NodeExporterExtractor().extract(body)
+    for key, want in python.items():
+        assert native[key] == pytest.approx(want, rel=1e-9), key
+
+
+def test_native_parser_garbage_tolerant(native_build):
+    from kubernetesnetawarescheduler_tpu.ingest.native import make_extractor
+    ex = make_extractor()
+    assert ex.extract("") == {}
+    out = ex.extract("### \n\nnot metrics {{{ \x00\xff\n")
+    assert out == {}
+
+
+def test_grpc_transport(scorer):
+    grpc = pytest.importorskip("grpc")  # noqa: F841
+    from kubernetesnetawarescheduler_tpu.api.grpc_server import (
+        call_grpc,
+        serve_grpc,
+    )
+    cluster, loop, server = scorer
+    gserver, port = serve_grpc(ExtenderHandlers(loop))
+    try:
+        out = json.loads(call_grpc(f"127.0.0.1:{port}", "Health", b"{}"))
+        assert out == {"ok": True}
+        names = [n.name for n in cluster.list_nodes()][:4]
+        payload = json.dumps(extender_args(names)).encode()
+        prio = json.loads(call_grpc(f"127.0.0.1:{port}", "Prioritize",
+                                    payload))
+        assert [p["host"] for p in prio] == names
+    finally:
+        gserver.stop(0)
