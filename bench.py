@@ -26,11 +26,12 @@ def main() -> None:
     num_pods = int(os.environ.get("BENCH_PODS", "4096"))
     batch = int(os.environ.get("BENCH_BATCH", "256"))
     method = os.environ.get("BENCH_METHOD", "parallel")
+    mode = os.environ.get("BENCH_MODE", "device")
 
     from kubernetesnetawarescheduler_tpu.bench.density import run_density
 
     res = run_density(num_nodes=num_nodes, num_pods=num_pods,
-                      batch_size=batch, method=method)
+                      batch_size=batch, method=method, mode=mode)
     print(json.dumps({
         "metric": f"density_pods_per_sec_n{num_nodes}",
         "value": round(res.pods_per_sec, 1),
@@ -45,6 +46,7 @@ def main() -> None:
             "bind_p99_ms": round(res.bind_p99_ms, 2),
             "batch_size": batch,
             "method": method,
+            "mode": mode,
         },
     }))
 

@@ -27,7 +27,8 @@ import numpy as np
 
 from kubernetesnetawarescheduler_tpu.config import Resource
 from kubernetesnetawarescheduler_tpu.core.loop import SchedulerLoop
-from kubernetesnetawarescheduler_tpu.core.score import NEG_INF, score_pods
+from kubernetesnetawarescheduler_tpu.core.pallas_score import score_pods_auto
+from kubernetesnetawarescheduler_tpu.core.score import NEG_INF
 from kubernetesnetawarescheduler_tpu.k8s.types import Binding, Pod
 
 MAX_EXTENDER_PRIORITY = 10  # k8s scheduler extender convention
@@ -151,7 +152,10 @@ class ExtenderHandlers:
         batch = loop.encoder.encode_pods([pod], node_of=loop._peer_node,
                                          lenient=True)
         state = loop.encoder.snapshot()
-        scores = np.asarray(score_pods(state, batch, loop.cfg))[0]
+        # Kernel choice (dense XLA vs tiled Pallas) follows
+        # cfg.score_backend — this Score/Filter service path is where
+        # the 5k-node tiled kernel earns its keep.
+        scores = np.asarray(score_pods_auto(state, batch, loop.cfg))[0]
         feasible = scores > float(NEG_INF) * 0.5
         idx = []
         for name in names:

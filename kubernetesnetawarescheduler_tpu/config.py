@@ -155,12 +155,23 @@ class SchedulerConfig:
     # Compute dtype for the score matmuls (MXU-friendly).
     use_bfloat16: bool = True
 
+    # Score kernel for the Score/Filter service path (dispatched via
+    # core.pallas_score.score_pods_auto, used by api/extender): "xla"
+    # (dense, C[N,N] materialized, best under ~2k nodes) or "pallas"
+    # (tiled, lat/bw streamed through VMEM, the 5k-node path;
+    # interpreted off-TPU).
+    score_backend: str = "xla"
+
     def __post_init__(self) -> None:
         if self.max_nodes <= 0 or self.max_pods <= 0 or self.max_peers <= 0:
             raise ValueError("shape limits must be positive")
         if self.num_metrics < Metric.COUNT:
             raise ValueError(
                 f"need at least {Metric.COUNT} metric channels for parity")
+        if self.score_backend not in ("xla", "pallas"):
+            raise ValueError(
+                f"score_backend must be 'xla' or 'pallas', "
+                f"got {self.score_backend!r}")
 
 
 # ---------------------------------------------------------------------------

@@ -16,3 +16,12 @@ from kubernetesnetawarescheduler_tpu.core.assign import (  # noqa: F401
     assign_parallel,
     schedule_batch,
 )
+from kubernetesnetawarescheduler_tpu.core.pallas_score import (  # noqa: F401
+    score_pods_auto,
+    score_pods_tiled,
+)
+from kubernetesnetawarescheduler_tpu.core.replay import (  # noqa: F401
+    PodStream,
+    pad_stream,
+    replay_stream,
+)

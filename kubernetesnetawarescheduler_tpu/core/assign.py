@@ -149,13 +149,15 @@ def assign_parallel(state: ClusterState, pods: PodBatch,
     pod_ids = jnp.arange(p, dtype=jnp.int32)
 
     # Loop-invariant bitplane decomposition of the two per-pod bit
-    # fields, stacked so each round pays ONE [P, N, 64] any-reduce
-    # instead of two separate 32-plane scatters.
+    # fields, stacked [P, 64] so the per-round "which bits landed on
+    # which node" reduction is ONE [N, P] x [P, 64] matmul on the MXU
+    # (counts > 0 ⇔ bit present) instead of a [P, N, 64] any-reduce on
+    # the VPU — the dominant cost of a round at N ≥ 1k.
     shifts = jnp.arange(32, dtype=jnp.uint32)
     pod_planes = jnp.concatenate(
-        [((pods.group_bit[:, None] >> shifts) & 1).astype(bool),
-         ((pods.anti_bits[:, None] >> shifts) & 1).astype(bool)],
-        axis=1)  # [P, 64]
+        [((pods.group_bit[:, None] >> shifts) & 1),
+         ((pods.anti_bits[:, None] >> shifts) & 1)],
+        axis=1).astype(jnp.bfloat16)  # [P, 64] of exact 0/1
 
     def masked_scores(used, group_bits, resident_anti, assignment):
         dyn = _dynamic_mask(pods, used, state.cap, group_bits, resident_anti)
@@ -190,8 +192,12 @@ def assign_parallel(state: ClusterState, pods: PodBatch,
         new_used = used.at[safe].add(add, mode="drop")
         w_onehot = onehot & winner[:, None]  # winner implies feasible
         progress = jnp.any(winner)
-        present = jnp.any(w_onehot[:, :, None] & pod_planes[:, None, :],
-                          axis=0)  # [N, 64]
+        # [N, 64] win-count per (node, bitplane) via the MXU; 0/1 bf16
+        # inputs with f32 accumulation are exact for any P.
+        counts = jax.lax.dot_general(
+            w_onehot.astype(jnp.bfloat16), pod_planes,
+            (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+        present = counts > 0.5  # [N, 64]
         words = jnp.sum(
             present.reshape(n, 2, 32).astype(jnp.uint32) << shifts,
             axis=-1, dtype=jnp.uint32)

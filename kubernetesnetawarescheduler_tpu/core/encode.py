@@ -253,6 +253,30 @@ class Encoder:
 
     # -- pods ---------------------------------------------------------
 
+    def _constraint_bits(self, pod: Pod, lenient: bool
+                         ) -> tuple[np.uint32, np.uint32, np.uint32,
+                                    np.uint32, np.uint32]:
+        """Intern one pod's constraint sets → (tol, sel, aff, anti,
+        group) bitmasks; single source of truth for batch AND stream
+        encoding.
+
+        Overflow direction per constraint: dropping a toleration/anti/
+        own-group is conservative (more constrained / untracked); a
+        must-match selector or required-affinity key degrades to
+        ``UNKNOWN_BIT`` (infeasible) rather than silently matching
+        anywhere.
+        """
+        return (
+            self.taints.mask(pod.tolerations, lenient),
+            self.labels.mask(pod.node_selector, lenient,
+                             on_overflow=UNKNOWN_BIT),
+            self.groups.mask(pod.affinity_groups, lenient,
+                             on_overflow=UNKNOWN_BIT),
+            self.groups.mask(pod.anti_groups, lenient),
+            (self.groups.bit(pod.group, lenient)
+             if pod.group else np.uint32(0)),
+        )
+
     def encode_pods(self, pods: Sequence[Pod],
                     node_of: Callable[[str], str],
                     lenient: bool = False) -> PodBatch:
@@ -294,23 +318,85 @@ class Encoder:
                     peers[i, slot] = idx
                     traffic[i, slot] = vol
                     slot += 1
-                # Overflow direction per constraint: dropping a
-                # toleration/anti/own-group is conservative (more
-                # constrained / untracked); a must-match selector or
-                # required-affinity key degrades to UNKNOWN_BIT
-                # (infeasible) rather than silently matching anywhere.
-                tol[i] = self.taints.mask(pod.tolerations, lenient)
-                sel[i] = self.labels.mask(pod.node_selector, lenient,
-                                          on_overflow=UNKNOWN_BIT)
-                aff[i] = self.groups.mask(pod.affinity_groups, lenient,
-                                          on_overflow=UNKNOWN_BIT)
-                anti[i] = self.groups.mask(pod.anti_groups, lenient)
-                gbit[i] = (self.groups.bit(pod.group, lenient)
-                           if pod.group else 0)
+                (tol[i], sel[i], aff[i], anti[i],
+                 gbit[i]) = self._constraint_bits(pod, lenient)
                 prio[i] = pod.priority
                 valid[i] = True
         return PodBatch(
             req=jnp.asarray(req), peers=jnp.asarray(peers),
+            peer_traffic=jnp.asarray(traffic), tol_bits=jnp.asarray(tol),
+            sel_bits=jnp.asarray(sel), affinity_bits=jnp.asarray(aff),
+            anti_bits=jnp.asarray(anti), group_bit=jnp.asarray(gbit),
+            priority=jnp.asarray(prio), pod_valid=jnp.asarray(valid))
+
+    def encode_stream(self, pods: Sequence[Pod],
+                      node_of: Callable[[str], str],
+                      lenient: bool = False):
+        """Encode a whole workload for the device-resident replay
+        (:func:`~kubernetesnetawarescheduler_tpu.core.replay.replay_stream`).
+
+        Unlike :meth:`encode_pods`, peers naming pods *within this
+        stream* are kept as stream indices (resolved on device against
+        the replay's own assignments); peers already placed resolve to
+        node indices via ``node_of`` here, host-side.
+
+        Peer-slot allocation mirrors the host loop draining this stream
+        in ``cfg.max_pods``-sized batches: an in-stream peer in the same
+        or a later batch can never have a node by the time this pod is
+        scored (the host's ``node_of`` returns "" and skips it without
+        consuming a slot), so it is skipped here too.  Residual
+        divergence from the host loop is only possible past
+        ``max_peers`` when an earlier-batch peer ends up unschedulable
+        (the host frees its slot, the stream cannot know in advance).
+        """
+        from kubernetesnetawarescheduler_tpu.core.replay import PodStream
+
+        cfg = self.cfg
+        s, k, r = len(pods), cfg.max_peers, cfg.num_resources
+        stream_index = {pod.name: i for i, pod in enumerate(pods)}
+        req = np.zeros((s, r), np.float32)
+        peer_pods = np.full((s, k), -1, np.int32)
+        peer_nodes = np.full((s, k), -1, np.int32)
+        traffic = np.zeros((s, k), np.float32)
+        tol = np.zeros((s,), np.uint32)
+        sel = np.zeros((s,), np.uint32)
+        aff = np.zeros((s,), np.uint32)
+        anti = np.zeros((s,), np.uint32)
+        gbit = np.zeros((s,), np.uint32)
+        prio = np.zeros((s,), np.float32)
+        valid = np.zeros((s,), bool)
+        batch = self.cfg.max_pods
+        with self._lock:
+            for i, pod in enumerate(pods):
+                req[i] = _requests_vector(pod.requests, r)
+                slot = 0
+                for peer_name, vol in pod.peers.items():
+                    if slot >= k:
+                        break
+                    j = stream_index.get(peer_name)
+                    if j is not None:
+                        if j // batch >= i // batch:
+                            # Same/later batch: unresolvable at scoring
+                            # time, exactly as the host loop sees it —
+                            # don't burn a slot.
+                            continue
+                        peer_pods[i, slot] = j
+                    else:
+                        peer_node = node_of(peer_name)
+                        idx = (self._node_index.get(peer_node)
+                               if peer_node else None)
+                        if idx is None:
+                            continue
+                        peer_nodes[i, slot] = idx
+                    traffic[i, slot] = vol
+                    slot += 1
+                (tol[i], sel[i], aff[i], anti[i],
+                 gbit[i]) = self._constraint_bits(pod, lenient)
+                prio[i] = pod.priority
+                valid[i] = True
+        return PodStream(
+            req=jnp.asarray(req), peer_pods=jnp.asarray(peer_pods),
+            peer_nodes=jnp.asarray(peer_nodes),
             peer_traffic=jnp.asarray(traffic), tol_bits=jnp.asarray(tol),
             sel_bits=jnp.asarray(sel), affinity_bits=jnp.asarray(aff),
             anti_bits=jnp.asarray(anti), group_bit=jnp.asarray(gbit),

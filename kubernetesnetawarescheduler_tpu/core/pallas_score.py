@@ -1,0 +1,237 @@
+"""Tiled Pallas score/filter kernel: streaming node tiles through VMEM.
+
+This is the 5k-node scale path sketched in SURVEY.md §5 ("blockwise/
+tiled Pallas kernel over the N axis, ring-attention-style streaming of
+node tiles through VMEM").  The dense XLA kernel in
+:mod:`~kubernetesnetawarescheduler_tpu.core.score` materializes the
+``C[N, N]`` network-desirability matrix in HBM before the ``T @ C.T``
+contraction; at N=5k that is an extra 100 MB write + read per cycle.
+Here ``C`` never exists: each grid step loads one ``(bn, bk)`` tile of
+the raw ``lat``/``bw`` matrices (the state the netperf pipeline
+maintains — the reference's per-pair iperf3 files, scheduler.go:503-530,
+generalized), forms the desirability tile in VMEM, feeds the MXU, and
+accumulates into a VMEM scratch block.  The epilogue fuses everything
+the reference did in separate passes — the metric vote
+(scheduler.go:360-365), capacity fit, taint/selector/affinity
+feasibility (delegated to stock k8s by the reference,
+deployment.yaml:17-31) — into the final tile write, so the masked
+``P×N`` score matrix is produced in a single HBM pass.
+
+Numerics match :func:`~.score.score_pods` (same formula, f32
+accumulation); tests compare the two on the CPU interpreter.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from kubernetesnetawarescheduler_tpu.config import SchedulerConfig
+from kubernetesnetawarescheduler_tpu.core import score as score_lib
+from kubernetesnetawarescheduler_tpu.core.score import NEG_INF, _EPS
+from kubernetesnetawarescheduler_tpu.core.state import ClusterState, PodBatch
+
+# Row layout of the packed per-node float array ``nodef[(2R + 2 padded
+# to 8), N]``: used[0..R), cap[R..2R), base score, node_valid.
+# Column layout of the packed per-pod arrays:
+#   podf[P, 8]  = req[0..R), pod_valid, pad
+#   podi[P, 8]  = tol_bits, sel_bits, affinity_bits, anti_bits,
+#                 group_bit, pad
+# Row layout of the packed per-node int array ``nodei[8, N]``:
+#   taint_bits, label_bits, group_bits, resident_anti, pad.
+_PARAMS = 8  # wbw, wlat, inv_bwmax, inv_latmax, wbal, eps, pad, pad
+
+
+def _round_up(x: int, m: int) -> int:
+    return ((x + m - 1) // m) * m
+
+
+def _kernel(params_ref, t_ref, bw_ref, lat_ref, validk_ref, nodef_ref,
+            nodei_ref, podf_ref, podi_ref, out_ref, acc_ref, *,
+            block_n: int, block_k: int, num_resources: int,
+            use_bfloat16: bool):
+    j = pl.program_id(1)
+    k = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(k == 0)
+    def _zero():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    wbw = params_ref[0]
+    wlat = params_ref[1]
+    inv_bw = params_ref[2]
+    inv_lat = params_ref[3]
+
+    # Network-desirability tile C[j_tile, k_tile], built in VMEM from the
+    # raw lat/bw tiles (never materialized in HBM).  Diagonal pinned to
+    # the loopback optimum wbw (see score.net_cost_matrix); invalid peer
+    # columns zeroed (their T entries are zero too — belt & braces).
+    c = wbw * bw_ref[:] * inv_bw - wlat * lat_ref[:] * inv_lat
+    rows = j * block_n + jax.lax.broadcasted_iota(
+        jnp.int32, (block_n, block_k), 0)
+    cols = k * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_n, block_k), 1)
+    c = jnp.where(rows == cols, wbw, c)
+    c = c * validk_ref[:]
+
+    # MXU: contract the peer-node axis of this k tile.  bf16 inputs /
+    # f32 accumulation is the standard MXU recipe; the exact path asks
+    # for HIGHEST so f32 isn't silently truncated to bf16 passes.
+    t_blk = t_ref[:]
+    if use_bfloat16:
+        t_blk, c = t_blk.astype(jnp.bfloat16), c.astype(jnp.bfloat16)
+        precision = None
+    else:
+        precision = jax.lax.Precision.HIGHEST
+    acc_ref[:] += jax.lax.dot_general(
+        t_blk, c, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32, precision=precision)
+
+    @pl.when(k == nk - 1)
+    def _epilogue():
+        r_res = num_resources
+        eps = params_ref[5]
+        wbal = params_ref[4]
+        base = nodef_ref[2 * r_res:2 * r_res + 1, :]            # (1, bn)
+        nvalid = nodef_ref[2 * r_res + 1:2 * r_res + 2, :] > 0.5
+        pvalid = podf_ref[:, r_res:r_res + 1] > 0.5             # (bp, 1)
+
+        fits = nvalid & pvalid
+        bal = jnp.zeros_like(acc_ref)
+        for r in range(r_res):
+            used_r = nodef_ref[r:r + 1, :]                      # (1, bn)
+            cap_r = nodef_ref[r_res + r:r_res + r + 1, :]
+            req_r = podf_ref[:, r:r + 1]                        # (bp, 1)
+            fits = fits & (req_r <= cap_r - used_r + eps)
+            bal = jnp.maximum(
+                bal, (used_r + req_r) / jnp.maximum(cap_r, eps))
+
+        taint = nodei_ref[0:1, :]
+        label = nodei_ref[1:2, :]
+        group = nodei_ref[2:3, :]
+        ranti = nodei_ref[3:4, :]
+        tol = podi_ref[:, 0:1]
+        sel = podi_ref[:, 1:2]
+        aff = podi_ref[:, 2:3]
+        anti = podi_ref[:, 3:4]
+        gbit = podi_ref[:, 4:5]
+        ok = fits
+        ok = ok & ((taint & ~tol) == 0)
+        ok = ok & ((label & sel) == sel)
+        ok = ok & ((aff == 0) | ((group & aff) != 0))
+        ok = ok & ((group & anti) == 0)
+        ok = ok & ((ranti & gbit) == 0)
+
+        out_ref[:] = jnp.where(ok, acc_ref[:] + base - wbal * bal,
+                               jnp.float32(float(NEG_INF)))
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("cfg", "block_p", "block_n", "block_k", "interpret"))
+def score_pods_tiled(state: ClusterState, pods: PodBatch,
+                     cfg: SchedulerConfig, *, block_p: int = 128,
+                     block_n: int = 128, block_k: int = 128,
+                     interpret: bool = False) -> jax.Array:
+    """Masked score matrix ``f32[P, N]``, tiled-Pallas implementation.
+
+    Same contract as :func:`~.score.score_pods`.  Grid is
+    ``(P/bp, N/bn, N/bk)`` with the contraction axis innermost; VMEM
+    residency per step is ``O(bp·bk + 2·bn·bk + bp·bn)`` floats, so node
+    count is bounded by HBM (the ``N×N`` lat/bw state), not VMEM.
+    """
+    p_real, n_real = pods.num_pods, state.num_nodes
+    r_res = state.num_resources
+    bp = min(block_p, _round_up(p_real, 8))
+    p_pad = _round_up(p_real, bp)
+    n_pad = _round_up(n_real, max(block_n, block_k))
+    nb, kb = min(block_n, n_pad), min(block_k, n_pad)
+
+    def pad(x, rows, cols=None):
+        pr = rows - x.shape[0]
+        if cols is None:
+            return jnp.pad(x, ((0, pr),))
+        return jnp.pad(x, ((0, pr), (0, cols - x.shape[1])))
+
+    # Host-of-the-kernel prep (all cheap XLA, fused upstream): the dense
+    # traffic matrix, the pod-independent metric vote, and the global
+    # normalizers of the desirability tile.
+    t = pad(score_lib.peer_traffic_matrix(pods, n_real), p_pad, n_pad)
+    base = score_lib.metric_scores(state, cfg)
+    pair_valid = state.node_valid[:, None] & state.node_valid[None, :]
+    bw_max = jnp.maximum(jnp.max(jnp.where(pair_valid, state.bw, 0.0)), _EPS)
+    lat_max = jnp.maximum(jnp.max(jnp.where(pair_valid, state.lat, 0.0)),
+                          _EPS)
+    params = jnp.stack([
+        jnp.float32(cfg.weights.peer_bw), jnp.float32(cfg.weights.peer_lat),
+        1.0 / bw_max, 1.0 / lat_max,
+        jnp.float32(cfg.weights.balance), jnp.float32(_EPS),
+        jnp.float32(0), jnp.float32(0)])
+
+    bw = pad(state.bw, n_pad, n_pad)
+    lat = pad(state.lat, n_pad, n_pad)
+    validk = pad(state.node_valid.astype(jnp.float32), n_real)[None, :]
+    validk = pad(validk, 1, n_pad)
+
+    nodef = jnp.zeros((8, n_pad), jnp.float32)
+    nodef = nodef.at[0:r_res, :n_real].set(state.used.T)
+    nodef = nodef.at[r_res:2 * r_res, :n_real].set(state.cap.T)
+    nodef = nodef.at[2 * r_res, :n_real].set(base)
+    nodef = nodef.at[2 * r_res + 1, :n_real].set(
+        state.node_valid.astype(jnp.float32))
+
+    nodei = jnp.zeros((8, n_pad), jnp.int32)
+    nodei = nodei.at[0, :n_real].set(state.taint_bits.astype(jnp.int32))
+    nodei = nodei.at[1, :n_real].set(state.label_bits.astype(jnp.int32))
+    nodei = nodei.at[2, :n_real].set(state.group_bits.astype(jnp.int32))
+    nodei = nodei.at[3, :n_real].set(state.resident_anti.astype(jnp.int32))
+
+    podf = jnp.zeros((p_pad, 8), jnp.float32)
+    podf = podf.at[:p_real, 0:r_res].set(pods.req)
+    podf = podf.at[:p_real, r_res].set(pods.pod_valid.astype(jnp.float32))
+
+    podi = jnp.zeros((p_pad, 8), jnp.int32)
+    for col, bits in enumerate((pods.tol_bits, pods.sel_bits,
+                                pods.affinity_bits, pods.anti_bits,
+                                pods.group_bit)):
+        podi = podi.at[:p_real, col].set(bits.astype(jnp.int32))
+
+    grid = (p_pad // bp, n_pad // nb, n_pad // kb)
+    kernel = functools.partial(_kernel, block_n=nb, block_k=kb,
+                               num_resources=r_res,
+                               use_bfloat16=cfg.use_bfloat16)
+    out = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((p_pad, n_pad), jnp.float32),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),                 # params
+            pl.BlockSpec((bp, kb), lambda i, j, k: (i, k)),        # T
+            pl.BlockSpec((nb, kb), lambda i, j, k: (j, k)),        # bw
+            pl.BlockSpec((nb, kb), lambda i, j, k: (j, k)),        # lat
+            pl.BlockSpec((1, kb), lambda i, j, k: (0, k)),         # validk
+            pl.BlockSpec((8, nb), lambda i, j, k: (0, j)),         # nodef
+            pl.BlockSpec((8, nb), lambda i, j, k: (0, j)),         # nodei
+            pl.BlockSpec((bp, 8), lambda i, j, k: (i, 0)),         # podf
+            pl.BlockSpec((bp, 8), lambda i, j, k: (i, 0)),         # podi
+        ],
+        out_specs=pl.BlockSpec((bp, nb), lambda i, j, k: (i, j)),
+        scratch_shapes=[pltpu.VMEM((bp, nb), jnp.float32)],
+        interpret=interpret,
+    )(params, t, bw, lat, validk, nodef, nodei, podf, podi)
+    return out[:p_real, :n_real]
+
+
+def score_pods_auto(state: ClusterState, pods: PodBatch,
+                    cfg: SchedulerConfig) -> jax.Array:
+    """Dispatch on ``cfg.score_backend``: the dense XLA kernel or the
+    tiled Pallas kernel (interpreted off-TPU so CPU CI still runs it)."""
+    if cfg.score_backend == "pallas":
+        interpret = jax.default_backend() != "tpu"
+        return score_pods_tiled(state, pods, cfg, interpret=interpret)
+    return score_lib.score_pods(state, pods, cfg)

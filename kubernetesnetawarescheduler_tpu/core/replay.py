@@ -1,0 +1,159 @@
+"""Device-resident replay: the whole scheduling run as ONE XLA program.
+
+The host loop in :mod:`~kubernetesnetawarescheduler_tpu.core.loop` pays
+one host↔device round-trip per batch (encode → dispatch → fetch → bind).
+That is the right shape for live serving against a real API server, but
+for throughput it re-introduces — in miniature — the reference's defect
+of a synchronous network hop inside the scheduling cycle
+(scheduler.go:275-279).  Here the full pending-pod stream is encoded
+once, shipped to the device once, and a ``lax.scan`` drives batch after
+batch of score → assign → commit *entirely on device*; the only
+transfer back is the final assignment vector.
+
+Peers inside the stream (a pod exchanging traffic with an
+earlier-scheduled pod of its service) are carried as *stream indices*
+and resolved on device against the assignments made so far — the
+batch-to-batch dependency that forces the scan carry, and the analog of
+the reference's pods-bind-one-at-a-time ordering (scheduler.go:191).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Sequence
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from kubernetesnetawarescheduler_tpu.config import SchedulerConfig
+from kubernetesnetawarescheduler_tpu.core.assign import (
+    UNASSIGNED,
+    assign_greedy,
+    assign_parallel,
+)
+from kubernetesnetawarescheduler_tpu.core.state import (
+    ClusterState,
+    PodBatch,
+    commit_assignments,
+)
+
+
+@struct.dataclass
+class PodStream:
+    """A whole workload of pending pods, encoded columnar.
+
+    Same per-pod fields as :class:`~.state.PodBatch` except the peer
+    encoding: ``peer_pods[i, k] >= 0`` names another *stream index*
+    whose eventual node is the traffic endpoint; ``peer_nodes[i, k]``
+    carries peers already placed before the replay started (node index,
+    -1 = none).  Length is padded to a multiple of the batch size.
+    """
+
+    req: jax.Array            # f32[S, R]
+    peer_pods: jax.Array      # i32[S, K]  stream index or -1
+    peer_nodes: jax.Array     # i32[S, K]  node index or -1
+    peer_traffic: jax.Array   # f32[S, K]
+    tol_bits: jax.Array       # u32[S]
+    sel_bits: jax.Array       # u32[S]
+    affinity_bits: jax.Array  # u32[S]
+    anti_bits: jax.Array      # u32[S]
+    group_bit: jax.Array      # u32[S]
+    priority: jax.Array       # f32[S]
+    pod_valid: jax.Array      # bool[S]
+
+    @property
+    def num_pods(self) -> int:
+        return self.req.shape[0]
+
+
+@partial(jax.jit, static_argnames=("cfg", "method"))
+def replay_stream(state: ClusterState, stream: PodStream,
+                  cfg: SchedulerConfig, method: str = "parallel"
+                  ) -> tuple[jax.Array, ClusterState]:
+    """Run the full stream through score→assign→commit on device.
+
+    Returns ``(assignment i32[S], final_state)``; one dispatch, one
+    fetch.  ``stream`` length must be a multiple of ``cfg.max_pods``
+    (pad with invalid pods via :func:`pad_stream`).
+    """
+    assign_fn = {"greedy": assign_greedy,
+                 "parallel": assign_parallel}[method]
+    s_total = stream.num_pods
+    batch = cfg.max_pods
+    if s_total % batch != 0:
+        raise ValueError(
+            f"stream length {s_total} not a multiple of max_pods={batch}")
+    nb = s_total // batch
+
+    def fold(x):
+        return x.reshape((nb, batch) + x.shape[1:])
+
+    xs = (jnp.arange(nb, dtype=jnp.int32),
+          jax.tree_util.tree_map(fold, stream))
+
+    def step(carry, x):
+        used, group_bits, resident_anti, node_of_pod = carry
+        i, sl = x
+        # Only the three placement-mutated arrays ride the scan carry;
+        # the big immutable state (the N×N lat/bw matrices, metrics,
+        # capacities, label/taint bits) is closed over, so XLA keeps one
+        # HBM copy instead of round-tripping ~200 MB of carry per step.
+        st = state.replace(used=used, group_bits=group_bits,
+                           resident_anti=resident_anti)
+        # Resolve in-stream peers against assignments made so far; a
+        # peer that is still unplaced (or unschedulable) stays -1 and
+        # the scoring kernel drops it — traffic to a homeless pod
+        # cannot pull the placement anywhere.
+        pp = sl.peer_pods
+        from_stream = node_of_pod[jnp.clip(pp, 0, s_total - 1)]
+        peers = jnp.where(pp >= 0, from_stream, sl.peer_nodes)
+        pods = PodBatch(
+            req=sl.req, peers=peers, peer_traffic=sl.peer_traffic,
+            tol_bits=sl.tol_bits, sel_bits=sl.sel_bits,
+            affinity_bits=sl.affinity_bits, anti_bits=sl.anti_bits,
+            group_bit=sl.group_bit, priority=sl.priority,
+            pod_valid=sl.pod_valid)
+        assignment = assign_fn(st, pods, cfg)
+        st = commit_assignments(st, pods, assignment)
+        node_of_pod = jax.lax.dynamic_update_slice_in_dim(
+            node_of_pod, assignment, i * batch, 0)
+        return (st.used, st.group_bits, st.resident_anti,
+                node_of_pod), assignment
+
+    init = (state.used, state.group_bits, state.resident_anti,
+            jnp.full((s_total,), UNASSIGNED, jnp.int32))
+    (used, group_bits, resident_anti, _), assignments = jax.lax.scan(
+        step, init, xs)
+    final_state = state.replace(used=used, group_bits=group_bits,
+                                resident_anti=resident_anti)
+    return assignments.reshape(-1), final_state
+
+
+def pad_stream(stream: PodStream, multiple: int) -> PodStream:
+    """Pad the stream with invalid pods up to a multiple of ``multiple``."""
+    s = stream.num_pods
+    target = ((s + multiple - 1) // multiple) * multiple
+    if target == s:
+        return stream
+    pad = target - s
+
+    def pd(x, fill):
+        widths = [(0, pad)] + [(0, 0)] * (x.ndim - 1)
+        return jnp.pad(x, widths, constant_values=fill)
+
+    return PodStream(
+        req=pd(stream.req, 0.0),
+        peer_pods=pd(stream.peer_pods, -1),
+        peer_nodes=pd(stream.peer_nodes, -1),
+        peer_traffic=pd(stream.peer_traffic, 0.0),
+        tol_bits=pd(stream.tol_bits, 0),
+        sel_bits=pd(stream.sel_bits, 0),
+        affinity_bits=pd(stream.affinity_bits, 0),
+        anti_bits=pd(stream.anti_bits, 0),
+        group_bit=pd(stream.group_bit, 0),
+        priority=pd(stream.priority, 0.0),
+        pod_valid=pd(stream.pod_valid, False),
+    )
