@@ -45,8 +45,7 @@ class DensityResult:
         return dataclasses.asdict(self)
 
 
-def _round_up(x: int, mult: int) -> int:
-    return ((x + mult - 1) // mult) * mult
+from kubernetesnetawarescheduler_tpu.core.state import round_up as _round_up
 
 
 def _throwaway_loop(num_nodes: int, seed: int, cfg: SchedulerConfig,
@@ -67,7 +66,8 @@ def run_density(num_nodes: int = 100, num_pods: int = 300,
                 seed: int = 0, cfg: SchedulerConfig | None = None,
                 warmup: bool = True,
                 metric_drop_fraction: float = 0.0,
-                mode: str = "host") -> DensityResult:
+                mode: str = "host",
+                sampler=None) -> DensityResult:
     """Schedule ``num_pods`` generated pods onto a ``num_nodes`` fake
     cluster; returns throughput/latency stats (compile excluded via a
     warmup cycle).
@@ -77,7 +77,12 @@ def run_density(num_nodes: int = 100, num_pods: int = 300,
     ``mode="device"`` runs the whole workload as one
     :func:`~kubernetesnetawarescheduler_tpu.core.replay.replay_stream`
     dispatch — the throughput path; per-batch latency is then reported
-    amortized (wall / num_batches) for the score percentiles."""
+    amortized (wall / num_batches) for the score percentiles.
+
+    ``sampler``, if given, must have a ``start()`` method; it is started
+    after warmup/compilation so resource sampling covers only the
+    measured serving window (the clusterloader2 analogy: samples are of
+    the serving scheduler, not of XLA compiling)."""
     if cfg is None:
         cfg = SchedulerConfig(
             max_nodes=_round_up(num_nodes, 128),
@@ -98,7 +103,7 @@ def run_density(num_nodes: int = 100, num_pods: int = 300,
 
     if mode == "device":
         return _run_density_device(cluster, loop, pods, cfg, method,
-                                   num_nodes, seed, warmup)
+                                   num_nodes, seed, warmup, sampler)
 
     if warmup:
         wloop = _throwaway_loop(num_nodes, seed, cfg, method)
@@ -108,6 +113,8 @@ def run_density(num_nodes: int = 100, num_pods: int = 300,
         wloop.client.add_pods(warm)
         wloop.run_until_drained()
 
+    if sampler is not None:
+        sampler.start()
     start = time.perf_counter()
     cluster.add_pods(pods)
     loop.run_until_drained()
@@ -130,10 +137,18 @@ def run_density(num_nodes: int = 100, num_pods: int = 300,
 
 def _run_density_device(cluster, loop: SchedulerLoop, pods, cfg,
                         method: str, num_nodes: int, seed: int,
-                        warmup: bool) -> DensityResult:
-    """Whole-workload device replay: one dispatch, one fetch; the host
-    bind pass (fake API-server bookkeeping + events) runs after the
-    decisions and is included in the end-to-end wall."""
+                        warmup: bool, sampler=None) -> DensityResult:
+    """Whole-workload device replay: one dispatch, one fetch.
+
+    The timed window covers everything a serving deployment does per
+    pod — host encode of the stream, the device replay, and the host
+    bind pass (fake API-server bookkeeping + events) — so host- and
+    device-mode ``pods_per_sec`` are comparable.  Excluded: compilation
+    (warmup) and the initial bulk host→device copy of the ``N×N``
+    matrices (paid once at startup in a live deployment, then amortized
+    via dirty-group updates).  Per-batch score latency is reported
+    amortized (device wall / num_batches) — a mean, not a true
+    percentile, hence p50 == p99 in this mode."""
     from kubernetesnetawarescheduler_tpu.core.replay import (
         pad_stream,
         replay_stream,
@@ -141,30 +156,34 @@ def _run_density_device(cluster, loop: SchedulerLoop, pods, cfg,
 
     cluster.add_pods(pods)
     queued = loop.queue.pop_batch(len(pods), timeout=0.0)
-    stream = pad_stream(
-        loop.encoder.encode_stream(queued, node_of=loop._peer_node),
-        cfg.max_pods)
-    num_batches = stream.num_pods // cfg.max_pods
+    num_batches = _round_up(len(queued), cfg.max_pods) // cfg.max_pods
 
     if warmup:
-        # Compile against a throwaway cluster with identical shapes.
+        # Compile against a throwaway cluster with identical shapes
+        # (including its own encode pass, so the measured encode is
+        # warm Python, not first-touch imports).
         wloop = _throwaway_loop(num_nodes, seed, cfg, method)
-        wassign, _ = replay_stream(wloop.encoder.snapshot(), stream,
+        wstream = pad_stream(
+            wloop.encoder.encode_stream(queued, node_of=lambda name: ""),
+            cfg.max_pods)
+        wassign, _ = replay_stream(wloop.encoder.snapshot(), wstream,
                                    cfg, method)
         np.asarray(wassign)
 
     state = loop.encoder.snapshot()
-    # The snapshot/stream uploads are async; force them to complete so
-    # the measured window is pure scheduling, not the initial bulk
-    # host→device copy of the N×N matrices (which a live deployment
-    # pays once at startup, then amortizes via dirty-group updates).
     import jax
 
-    jax.block_until_ready((state, stream))
+    jax.block_until_ready(state)
+    if sampler is not None:
+        sampler.start()
     start = time.perf_counter()
+    stream = pad_stream(
+        loop.encoder.encode_stream(queued, node_of=loop._peer_node),
+        cfg.max_pods)
+    encode_wall = time.perf_counter() - start
     assignment_dev, _final = replay_stream(state, stream, cfg, method)
     assignment = np.asarray(assignment_dev)[:len(queued)]
-    device_wall = time.perf_counter() - start
+    device_wall = time.perf_counter() - start - encode_wall
     bound = loop._bind_all(queued, assignment)
     wall = time.perf_counter() - start
 
@@ -178,6 +197,6 @@ def _run_density_device(cluster, loop: SchedulerLoop, pods, cfg,
         pods_per_sec=bound / wall if wall > 0 else 0.0,
         score_p50_ms=amortized_ms,
         score_p99_ms=amortized_ms,
-        encode_p99_ms=0.0,
-        bind_p99_ms=(wall - device_wall) * 1e3,
+        encode_p99_ms=encode_wall / max(num_batches, 1) * 1e3,
+        bind_p99_ms=(wall - device_wall - encode_wall) * 1e3,
     )

@@ -36,18 +36,16 @@ from kubernetesnetawarescheduler_tpu.core.score import NEG_INF, _EPS
 from kubernetesnetawarescheduler_tpu.core.state import ClusterState, PodBatch
 
 # Row layout of the packed per-node float array ``nodef[(2R + 2 padded
-# to 8), N]``: used[0..R), cap[R..2R), base score, node_valid.
-# Column layout of the packed per-pod arrays:
-#   podf[P, 8]  = req[0..R), pod_valid, pad
-#   podi[P, 8]  = tol_bits, sel_bits, affinity_bits, anti_bits,
-#                 group_bit, pad
+# to a multiple of 8), N]``: used[0..R), cap[R..2R), base score,
+# node_valid.  Column layout of the packed per-pod arrays:
+#   podf[P, >=R+1]  = req[0..R), pod_valid, pad
+#   podi[P, 8]      = tol_bits, sel_bits, affinity_bits, anti_bits,
+#                     group_bit, pad
 # Row layout of the packed per-node int array ``nodei[8, N]``:
 #   taint_bits, label_bits, group_bits, resident_anti, pad.
 _PARAMS = 8  # wbw, wlat, inv_bwmax, inv_latmax, wbal, eps, pad, pad
 
-
-def _round_up(x: int, m: int) -> int:
-    return ((x + m - 1) // m) * m
+from kubernetesnetawarescheduler_tpu.core.state import round_up as _round_up
 
 
 def _kernel(params_ref, t_ref, bw_ref, lat_ref, validk_ref, nodef_ref,
@@ -145,12 +143,25 @@ def score_pods_tiled(state: ClusterState, pods: PodBatch,
     residency per step is ``O(bp·bk + 2·bn·bk + bp·bn)`` floats, so node
     count is bounded by HBM (the ``N×N`` lat/bw state), not VMEM.
     """
+    import math
+
     p_real, n_real = pods.num_pods, state.num_nodes
     r_res = state.num_resources
     bp = min(block_p, _round_up(p_real, 8))
     p_pad = _round_up(p_real, bp)
-    n_pad = _round_up(n_real, max(block_n, block_k))
-    nb, kb = min(block_n, n_pad), min(block_k, n_pad)
+    # Pad N to a common multiple of both block sizes so the grid tiles
+    # the output exactly — with max() instead of lcm(), a non-dividing
+    # block pair (e.g. 48/128) silently truncated the grid and left
+    # trailing node columns unwritten.  (On real TPU, Mosaic separately
+    # requires lane blocks in multiples of 128 and rejects others with
+    # a clear error; the interpreter accepts any size.)
+    nb, kb = block_n, block_k
+    n_pad = _round_up(n_real, math.lcm(nb, kb))
+    # Packed-array extents scale with the resource count (R resources
+    # need 2R+2 nodef rows / R+1 podf columns; 8 covers the default
+    # R=3 and the lane tiling).
+    nf_rows = _round_up(2 * r_res + 2, 8)
+    pf_cols = _round_up(r_res + 1, 8)
 
     def pad(x, rows, cols=None):
         pr = rows - x.shape[0]
@@ -178,7 +189,7 @@ def score_pods_tiled(state: ClusterState, pods: PodBatch,
     validk = pad(state.node_valid.astype(jnp.float32), n_real)[None, :]
     validk = pad(validk, 1, n_pad)
 
-    nodef = jnp.zeros((8, n_pad), jnp.float32)
+    nodef = jnp.zeros((nf_rows, n_pad), jnp.float32)
     nodef = nodef.at[0:r_res, :n_real].set(state.used.T)
     nodef = nodef.at[r_res:2 * r_res, :n_real].set(state.cap.T)
     nodef = nodef.at[2 * r_res, :n_real].set(base)
@@ -191,7 +202,7 @@ def score_pods_tiled(state: ClusterState, pods: PodBatch,
     nodei = nodei.at[2, :n_real].set(state.group_bits.astype(jnp.int32))
     nodei = nodei.at[3, :n_real].set(state.resident_anti.astype(jnp.int32))
 
-    podf = jnp.zeros((p_pad, 8), jnp.float32)
+    podf = jnp.zeros((p_pad, pf_cols), jnp.float32)
     podf = podf.at[:p_real, 0:r_res].set(pods.req)
     podf = podf.at[:p_real, r_res].set(pods.pod_valid.astype(jnp.float32))
 
@@ -215,9 +226,9 @@ def score_pods_tiled(state: ClusterState, pods: PodBatch,
             pl.BlockSpec((nb, kb), lambda i, j, k: (j, k)),        # bw
             pl.BlockSpec((nb, kb), lambda i, j, k: (j, k)),        # lat
             pl.BlockSpec((1, kb), lambda i, j, k: (0, k)),         # validk
-            pl.BlockSpec((8, nb), lambda i, j, k: (0, j)),         # nodef
+            pl.BlockSpec((nf_rows, nb), lambda i, j, k: (0, j)),   # nodef
             pl.BlockSpec((8, nb), lambda i, j, k: (0, j)),         # nodei
-            pl.BlockSpec((bp, 8), lambda i, j, k: (i, 0)),         # podf
+            pl.BlockSpec((bp, pf_cols), lambda i, j, k: (i, 0)),   # podf
             pl.BlockSpec((bp, 8), lambda i, j, k: (i, 0)),         # podi
         ],
         out_specs=pl.BlockSpec((bp, nb), lambda i, j, k: (i, j)),

@@ -214,6 +214,11 @@ def commit_assignments(state: ClusterState, pods: PodBatch,
             onehot, pods.anti_bits))
 
 
+def round_up(x: int, mult: int) -> int:
+    """Smallest multiple of ``mult`` that is >= ``x``."""
+    return ((x + mult - 1) // mult) * mult
+
+
 def pad_axis(x: jax.Array, size: int, axis: int = 0,
              fill: float = 0.0) -> jax.Array:
     """Pad ``x`` along ``axis`` up to ``size`` with ``fill``."""

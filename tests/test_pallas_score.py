@@ -59,6 +59,37 @@ def test_tiled_handles_ragged_shapes():
     np.testing.assert_allclose(got[~mask], want[~mask], rtol=1e-4, atol=1e-4)
 
 
+def test_tiled_non_dividing_blocks():
+    # Block sizes that do not divide the padded node count: N must be
+    # padded to lcm(block_n, block_k) or trailing output columns would
+    # silently hold uninitialized garbage (regression test).
+    cfg = SchedulerConfig(max_nodes=100, max_pods=8, max_peers=3,
+                          use_bfloat16=False)
+    state, pods = _pair(11, cfg=cfg, n_nodes=100, n_pods=8)
+    want = np.asarray(score_lib.score_pods(state, pods, cfg))
+    got = np.asarray(score_pods_tiled(state, pods, cfg, block_p=8,
+                                      block_n=48, block_k=128,
+                                      interpret=True))
+    mask = want <= NEG_INF / 2
+    np.testing.assert_array_equal(got <= NEG_INF / 2, mask)
+    np.testing.assert_allclose(got[~mask], want[~mask], rtol=1e-4, atol=1e-4)
+
+
+def test_tiled_wide_resource_axis():
+    # num_resources > 3 overflows the default 8-row nodef packing; the
+    # packed extents must scale with R (regression test).
+    cfg = SchedulerConfig(max_nodes=64, max_pods=8, max_peers=3,
+                          num_resources=4, use_bfloat16=False)
+    state, pods = _pair(13, cfg=cfg, n_nodes=50, n_pods=8)
+    want = np.asarray(score_lib.score_pods(state, pods, cfg))
+    got = np.asarray(score_pods_tiled(state, pods, cfg, block_p=8,
+                                      block_n=64, block_k=64,
+                                      interpret=True))
+    mask = want <= NEG_INF / 2
+    np.testing.assert_array_equal(got <= NEG_INF / 2, mask)
+    np.testing.assert_allclose(got[~mask], want[~mask], rtol=1e-4, atol=1e-4)
+
+
 def test_auto_dispatch():
     cfg = SchedulerConfig(max_nodes=64, max_pods=8, use_bfloat16=False,
                           score_backend="pallas")

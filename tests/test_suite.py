@@ -1,0 +1,83 @@
+"""Smoke + property tests for the five-config benchmark suite
+(bench/suite.py), at reduced shapes (SMALL) so CPU CI stays fast.
+
+Artifacts must match the reference's committed dataset schemas:
+``.data`` 5-line timing files (5podsCustomScheduler.data:1-5) and
+percentile-keyed ResourceUsageSummary JSON
+(ResourceUsageSummary_load_Custom_Scheduler.json:1-9).
+"""
+
+from __future__ import annotations
+
+import json
+
+import pytest
+
+from kubernetesnetawarescheduler_tpu.bench import suite
+
+
+def test_custom_network_emits_data_schema(tmp_path):
+    res = suite.run_custom_network_config(
+        out_dir=str(tmp_path), **suite.SMALL["custom_network"])
+    assert res.config == "custom_network"
+    run = res.metrics["runs"]["5"]
+    assert run["custom_ms"] > 0
+    # Network-aware placement must beat the oblivious spread.
+    assert run["custom_ms"] <= run["original_ms"]
+    data = (tmp_path / "5podsCustomScheduler.data").read_text().splitlines()
+    assert data[0] == "podsScheduled: 5"
+    assert data[1].startswith("dataPerPod(MB): 100")
+    assert data[2].startswith("affectedNodes: ")
+    assert set(data[3]) == {"-"}
+    assert data[4].startswith("time(ms): ")
+    assert (tmp_path / "5podsOriginalScheduler.data").exists()
+
+
+def test_density_emits_resource_usage_summary(tmp_path):
+    res = suite.run_density_config(out_dir=str(tmp_path),
+                                   **suite.SMALL["density"])
+    assert res.metrics["pods_bound"] > 0
+    assert res.metrics["pods_per_sec"] > 0
+    [artifact] = res.artifacts
+    doc = json.loads(open(artifact).read())
+    assert set(doc) == {"50", "90", "99", "100"}
+    for rows in doc.values():
+        [row] = rows
+        assert set(row) == {"Name", "Cpu", "Mem"}
+        assert row["Mem"] >= 0
+    # Percentiles are monotone.
+    assert doc["50"][0]["Cpu"] <= doc["100"][0]["Cpu"]
+
+
+def test_affinity_config_has_zero_violations(tmp_path):
+    res = suite.run_affinity_config(out_dir=str(tmp_path),
+                                    **suite.SMALL["affinity"])
+    assert res.metrics["pods_bound"] > 0
+    assert res.metrics["violations_total"] == 0
+
+
+def test_binpack_config_never_overcommits():
+    res = suite.run_binpack_config(**suite.SMALL["binpack"])
+    for label in ("balanced", "unbalanced"):
+        m = res.metrics[label]
+        assert m["pods_bound"] > 0
+        assert m["overcommit_nodes"] == 0
+        assert m["capacity_violations"] == 0
+    # The soft penalty should not worsen the utilization spread.
+    assert (res.metrics["balanced"]["util_std"]
+            <= res.metrics["unbalanced"]["util_std"] + 0.05)
+
+
+def test_sidecar_config_coplaces():
+    res = suite.run_sidecar_config(**suite.SMALL["sidecar"])
+    assert res.metrics["sidecar_pairs_placed"] > 0
+    # The dominant-peer sidecars should overwhelmingly land with their
+    # app (loopback-pinned diagonal of the net-cost matrix).
+    assert res.metrics["coplacement_rate"] >= 0.9
+    assert res.metrics["same_rack_rate"] >= res.metrics["coplacement_rate"]
+
+
+@pytest.mark.parametrize("name", list(suite.CONFIGS))
+def test_runner_dispatches(name, tmp_path):
+    [res] = suite.run_suite([name], out_dir=str(tmp_path), small=True)
+    assert res.config == name
