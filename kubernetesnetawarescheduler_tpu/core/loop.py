@@ -39,11 +39,18 @@ class SchedulerLoop:
     """Owns the informer, encoder and queue; drives scheduling cycles."""
 
     def __init__(self, client: ClusterClient, cfg: SchedulerConfig,
-                 method: str = "parallel") -> None:
+                 method: str = "parallel", decision_log=None,
+                 encoder: Encoder | None = None) -> None:
         self.cfg = cfg
         self.client = client
         self.method = method
-        self.encoder = Encoder(cfg)
+        # Optional core.checkpoint.DecisionLog: records the kernel's
+        # choice per pod (node or "" for unschedulable) at decision
+        # time, the replayable record behind restart-determinism.
+        self.decision_log = decision_log
+        # A restored encoder (core.checkpoint.load_checkpoint) can be
+        # injected to resume from a snapshot instead of re-ingesting.
+        self.encoder = encoder if encoder is not None else Encoder(cfg)
         self.queue = PodQueue(cfg.queue_capacity)
         self.timer = PhaseTimer()
         self.scheduled = 0
@@ -92,6 +99,11 @@ class SchedulerLoop:
         bound = 0
         for i, pod in enumerate(pods):
             node_idx = int(assignment[i])
+            if self.decision_log is not None:
+                self.decision_log.append(
+                    pod.name,
+                    self.encoder.node_name(node_idx) if node_idx >= 0
+                    else "")
             if node_idx < 0:
                 self.unschedulable += 1
                 self.client.create_event(failed_event(
