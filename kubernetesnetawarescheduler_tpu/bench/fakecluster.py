@@ -21,6 +21,7 @@ the failure-handling tests (SURVEY.md 5).
 from __future__ import annotations
 
 import dataclasses
+import threading
 from typing import Sequence
 
 import numpy as np
@@ -142,6 +143,97 @@ def feed_metrics(cluster: FakeCluster, encoder, rng: np.random.Generator,
         if drop_fraction and rng.random() < drop_fraction:
             continue
         encoder.update_metrics(node.name, sample_metrics(rng), age_s=0.0)
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultSpec:
+    """Fault-injection policy for the synthetic node_exporter fleet
+    (SURVEY.md §5 failure-detection row: "a fault-injection mode in the
+    fake cluster generator (drop/timeout/corrupt metric updates)").
+
+    Fractions are per-scrape probabilities, independent per node.  The
+    reference crashed on any of these: a failed ``http.Get`` left a nil
+    body that was read anyway (scheduler.go:397-405); a corrupt body
+    broke the fixed-offset substring slicing (scheduler.go:409-442)."""
+
+    drop_fraction: float = 0.0      # connection refused (raises)
+    timeout_fraction: float = 0.0   # request timeout (raises)
+    corrupt_fraction: float = 0.0   # body is binary garbage
+    nan_fraction: float = 0.0       # body parses but values are NaN/Inf
+    dead_nodes: frozenset[str] = frozenset()  # never answer at all
+    seed: int = 0
+
+
+def synth_exporter_body(values: dict[str, float], num_cpus: int = 4,
+                        nan: bool = False) -> str:
+    """A node_exporter-format scrape body realizing the given metric
+    channels (the inverse of
+    :class:`~..ingest.prometheus.NodeExporterExtractor`)."""
+    bad = "NaN"
+    cpu = bad if nan else f"{values['cpu_freq']:.1f}"
+    total = 16e9
+    avail = bad if nan else f"{(100.0 - values['mem_pct']) / 100.0 * total:.0f}"
+    tx = bad if nan else f"{values['net_tx']:.0f}"
+    rx = bad if nan else f"{values['net_rx']:.0f}"
+    disk = bad if nan else f"{values['disk_io']:.0f}"
+    lines = ["# HELP node_cpu_scaling_frequency_hertz freq",
+             "# TYPE node_cpu_scaling_frequency_hertz gauge"]
+    for c in range(num_cpus):
+        lines.append(
+            f'node_cpu_scaling_frequency_hertz{{cpu="{c}"}} {cpu}')
+    lines += [
+        f"node_memory_MemTotal_bytes {total:.0f}",
+        f"node_memory_MemAvailable_bytes {avail}",
+        f'node_network_transmit_packets_total{{device="eth0"}} {tx}',
+        f'node_network_receive_packets_total{{device="eth0"}} {rx}',
+        f'node_network_transmit_packets_total{{device="flannel.1"}} 12345',
+        f'node_disk_io_now{{device="sda"}} {disk}',
+    ]
+    return "\n".join(lines) + "\n"
+
+
+class FaultyExporterFleet:
+    """A ``fetch`` callable for :class:`~..ingest.scraper.ScrapePool`
+    backed by synthetic per-node exporters with injected faults.
+
+    Targets map node names to ``fake://<node-name>`` URLs."""
+
+    def __init__(self, node_names: Sequence[str],
+                 spec: FaultSpec = FaultSpec()) -> None:
+        self.spec = spec
+        self._rng = np.random.default_rng(spec.seed)
+        # ScrapePool fetches from a thread pool; numpy Generators are
+        # not thread-safe, so draws are serialized (the bodies are tiny
+        # — the lock is not a bench bottleneck, this is a test double).
+        self._lock = threading.Lock()
+        self._names = list(node_names)
+        self.calls = 0
+
+    def targets(self) -> dict[str, str]:
+        return {name: f"fake://{name}" for name in self._names}
+
+    def fetch(self, url: str) -> str:
+        assert url.startswith("fake://")
+        name = url[len("fake://"):]
+        with self._lock:
+            return self._fetch_locked(name)
+
+    def _fetch_locked(self, name: str) -> str:
+        self.calls += 1
+        spec, rng = self.spec, self._rng
+        if name in spec.dead_nodes:
+            raise ConnectionRefusedError(name)
+        roll = rng.random()
+        if roll < spec.drop_fraction:
+            raise ConnectionRefusedError(name)
+        if roll < spec.drop_fraction + spec.timeout_fraction:
+            raise TimeoutError(name)
+        if roll < (spec.drop_fraction + spec.timeout_fraction
+                   + spec.corrupt_fraction):
+            return "\x00\xff garbage {{{ not prometheus\n== 4 5 6"
+        nan = roll < (spec.drop_fraction + spec.timeout_fraction
+                      + spec.corrupt_fraction + spec.nan_fraction)
+        return synth_exporter_body(sample_metrics(rng), nan=nan)
 
 
 def generate_workload(spec: WorkloadSpec,

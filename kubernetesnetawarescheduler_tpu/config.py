@@ -144,6 +144,12 @@ class SchedulerConfig:
     # iperf JSON was last dropped into /home (scheduler.go:512).
     staleness_tau_s: float = 60.0
 
+    # Nodes whose staleness confidence exp(-age/tau) has fallen below
+    # this floor are excluded from the min/max normalization span: a
+    # long-silent node must not stretch the span (making every fresh
+    # node look bad) while itself coasting on the neutral 0.5 blend.
+    stale_conf_floor: float = 0.05
+
     # Pending-pod queue capacity; parity with the reference's
     # ``make(chan *v1.Pod, 300)`` (scheduler.go:129).
     queue_capacity: int = 300

@@ -166,14 +166,22 @@ class Encoder:
     def update_metrics(self, name: str, values: Mapping[str, float],
                        age_s: float = 0.0) -> None:
         """Ingest one node's metric sample (node_exporter shaped:
-        :class:`Metric` channel names)."""
+        :class:`Metric` channel names).  Non-finite values are dropped —
+        one NaN reaching the score matrix would poison every comparison
+        against that node — and a sample with no usable channel does not
+        reset staleness."""
         with self._lock:
             idx = self._node_index[name]
+            any_ok = False
             for chan, chan_name in enumerate(Metric.NAMES):
                 if chan_name in values:
-                    self._metrics[idx, chan] = float(values[chan_name])
-            self._metrics_age[idx] = age_s
-            self._dirty["metrics"] = True
+                    val = float(values[chan_name])
+                    if np.isfinite(val):
+                        self._metrics[idx, chan] = val
+                        any_ok = True
+            if any_ok:
+                self._metrics_age[idx] = age_s
+                self._dirty["metrics"] = True
 
     def age_metrics(self, dt_s: float) -> None:
         with self._lock:
@@ -186,9 +194,9 @@ class Encoder:
         run.sh:12, generalized to pairwise)."""
         with self._lock:
             i, j = self._node_index[a], self._node_index[b]
-            if lat_ms is not None:
+            if lat_ms is not None and np.isfinite(lat_ms) and lat_ms >= 0:
                 self._lat[i, j] = self._lat[j, i] = lat_ms
-            if bw_bps is not None:
+            if bw_bps is not None and np.isfinite(bw_bps) and bw_bps >= 0:
                 self._bw[i, j] = self._bw[j, i] = bw_bps
             self._dirty["net"] = True
 

@@ -73,13 +73,18 @@ def metric_scores(state: ClusterState, cfg: SchedulerConfig) -> jax.Array:
     Staleness decay replaces the reference's synchronous re-scrape per
     pod (scheduler.go:275-279): a node whose telemetry is old drifts
     toward a neutral 0.5 per channel instead of being trusted blindly.
+    Nodes below ``cfg.stale_conf_floor`` confidence are also excluded
+    from the normalization span, so a silent node's last (possibly
+    extreme) readings cannot stretch the span and make every fresh node
+    look bad while the silent one coasts on the neutral blend.
     """
     goodness = jnp.asarray(GOODNESS + (0.0,) * (cfg.num_metrics - len(GOODNESS)),
                            jnp.float32)
     w = jnp.asarray(cfg.weights.metric_vector() +
                     (0.0,) * (cfg.num_metrics - len(GOODNESS)), jnp.float32)
-    norm = normalize_metrics(state.metrics, state.node_valid, goodness)
     conf = jnp.exp(-state.metrics_age / cfg.staleness_tau_s)
+    span_valid = state.node_valid & (conf > cfg.stale_conf_floor)
+    norm = normalize_metrics(state.metrics, span_valid, goodness)
     blended = conf[:, None] * norm + (1.0 - conf[:, None]) * 0.5
     score = blended @ w
     return jnp.where(state.node_valid, score, 0.0)

@@ -36,7 +36,12 @@ def oracle_metric_scores(state, cfg: SchedulerConfig):
     n, m = state["metrics"].shape
     goodness = list(GOODNESS) + [0.0] * (m - len(GOODNESS))
     w = list(cfg.weights.metric_vector()) + [0.0] * (m - len(GOODNESS))
-    norm = oracle_normalize(state["metrics"], state["node_valid"], goodness)
+    span_valid = np.array([
+        state["node_valid"][i]
+        and np.exp(-state["metrics_age"][i] / cfg.staleness_tau_s)
+        > cfg.stale_conf_floor
+        for i in range(n)])
+    norm = oracle_normalize(state["metrics"], span_valid, goodness)
     out = np.zeros((n,), np.float32)
     for i in range(n):
         if not state["node_valid"][i]:

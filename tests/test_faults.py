@@ -1,0 +1,138 @@
+"""Fault injection end-to-end: the scheduler must degrade, never crash.
+
+SURVEY.md §5 failure-detection row.  The reference's behavior under
+every fault here was a crash (nil-body read on scrape failure,
+scheduler.go:397-405) or silent garbage (fixed-offset substring slicing
+over a corrupt body, scheduler.go:409-442).  Ours: failures become
+staleness (score decays to neutral), silent nodes get benched, corrupt
+and NaN payloads are rejected at the parse/ingest boundary.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from kubernetesnetawarescheduler_tpu.bench.fakecluster import (
+    ClusterSpec,
+    FaultSpec,
+    FaultyExporterFleet,
+    WorkloadSpec,
+    build_fake_cluster,
+    feed_metrics,
+    generate_workload,
+    sample_metrics,
+    synth_exporter_body,
+)
+from kubernetesnetawarescheduler_tpu.config import Metric, SchedulerConfig
+from kubernetesnetawarescheduler_tpu.core.loop import SchedulerLoop
+from kubernetesnetawarescheduler_tpu.core.score import metric_scores
+from kubernetesnetawarescheduler_tpu.ingest.prometheus import (
+    NodeExporterExtractor,
+)
+from kubernetesnetawarescheduler_tpu.ingest.scraper import ScrapePool
+
+CFG = SchedulerConfig(max_nodes=32, max_pods=8, max_peers=2,
+                      queue_capacity=400)
+
+
+def _loop(num_nodes=20, seed=0):
+    cluster, lat, bw = build_fake_cluster(
+        ClusterSpec(num_nodes=num_nodes, seed=seed))
+    loop = SchedulerLoop(cluster, CFG)
+    loop.encoder.set_network(lat, bw)
+    feed_metrics(cluster, loop.encoder, np.random.default_rng(seed + 1))
+    return cluster, loop
+
+
+def test_synth_body_roundtrips_through_real_parser():
+    rng = np.random.default_rng(0)
+    values = sample_metrics(rng)
+    channels = NodeExporterExtractor().extract(synth_exporter_body(values))
+    assert abs(channels["cpu_freq"] - values["cpu_freq"]) < 1.0
+    assert abs(channels["mem_pct"] - values["mem_pct"]) < 0.01
+    assert channels["net_tx"] == round(values["net_tx"])
+    assert channels["disk_io"] == round(values["disk_io"])
+
+
+def test_mixed_faults_never_crash_the_pool():
+    cluster, loop = _loop()
+    fleet = FaultyExporterFleet(
+        [n.name for n in cluster.list_nodes()],
+        FaultSpec(drop_fraction=0.2, timeout_fraction=0.1,
+                  corrupt_fraction=0.2, nan_fraction=0.2, seed=3))
+    pool = ScrapePool(loop.encoder, fleet.targets(), fetch=fleet.fetch)
+    for _ in range(5):
+        ok = pool.scrape_all()
+        assert ok >= 0
+        loop.encoder.age_metrics(15.0)
+    assert pool.failures > 0 and pool.successes > 0
+    # Whatever landed in the metric store is finite.
+    assert np.isfinite(loop.encoder._metrics).all()
+    # And scheduling still works on top of it.
+    pods = generate_workload(WorkloadSpec(num_pods=16, seed=5),
+                             scheduler_name=CFG.scheduler_name)
+    cluster.add_pods(pods)
+    assert loop.run_until_drained() > 0
+
+
+def test_dead_node_is_benched_and_avoided():
+    cluster, loop = _loop()
+    names = [n.name for n in cluster.list_nodes()]
+    dead = names[0]
+    fleet = FaultyExporterFleet(
+        names, FaultSpec(dead_nodes=frozenset({dead})))
+    pool = ScrapePool(loop.encoder, fleet.targets(), fetch=fleet.fetch,
+                      unready_after_s=30.0)
+    now = 0.0
+    for _ in range(4):
+        pool.scrape_all(now_s=now)
+        now += 20.0
+    assert not loop.encoder._node_valid[loop.encoder.node_index(dead)]
+    pods = generate_workload(WorkloadSpec(num_pods=24, seed=2),
+                             scheduler_name=CFG.scheduler_name)
+    cluster.add_pods(pods)
+    loop.run_until_drained()
+    for pod in pods:
+        assert cluster.node_of(pod.name) != dead
+
+
+def test_nan_ingest_is_rejected_and_staleness_grows():
+    _, loop = _loop(num_nodes=5)
+    enc = loop.encoder
+    name = enc.node_name(0)
+    before = enc._metrics[0].copy()
+    age_before = float(enc._metrics_age[0])
+    enc.age_metrics(42.0)
+    enc.update_metrics(name, {"cpu_freq": float("nan"),
+                              "mem_pct": float("inf")}, age_s=0.0)
+    np.testing.assert_array_equal(enc._metrics[0], before)
+    # The all-garbage sample must NOT have reset the node's staleness.
+    assert float(enc._metrics_age[0]) == age_before + 42.0
+    enc.update_link(name, enc.node_name(1), lat_ms=float("nan"),
+                    bw_bps=-5.0)
+    assert np.isfinite(enc._lat).all()
+    assert (enc._bw >= 0).all()
+
+
+def test_stale_node_decays_to_neutral():
+    _, loop = _loop(num_nodes=8)
+    enc = loop.encoder
+    # Varied honest competition, then make node 0 the clear winner.
+    rng = np.random.default_rng(4)
+    for i in range(8):
+        vals = {name: float(rng.uniform(40, 60)) for name in Metric.NAMES}
+        enc.update_metrics(enc.node_name(i), vals, age_s=0.0)
+    winner = {"cpu_freq": 20.0, "mem_pct": 20.0, "net_tx": 20.0,
+              "net_rx": 20.0, "bandwidth": 100.0, "disk_io": 20.0}
+    enc.update_metrics(enc.node_name(0), winner, age_s=0.0)
+    fresh = np.asarray(metric_scores(enc.snapshot(), CFG))[:8]
+    assert fresh[0] == fresh.max()
+
+    # 100x the decay constant: the silent winner converges to the
+    # neutral 0.5 blend and loses its top rank to fresh nodes.
+    enc._metrics_age[0] = CFG.staleness_tau_s * 100
+    enc._dirty["metrics"] = True
+    stale = np.asarray(metric_scores(enc.snapshot(), CFG))[:8]
+    assert stale[0] < stale[1:].max()
+    total_weight = sum(CFG.weights.metric_vector())
+    np.testing.assert_allclose(stale[0], 0.5 * total_weight, rtol=1e-3)
