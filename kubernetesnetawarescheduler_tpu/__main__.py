@@ -1,0 +1,4 @@
+from kubernetesnetawarescheduler_tpu.serve import main
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -127,6 +127,14 @@ class ExtenderHandlers:
             return self._json(self.bind(json.loads(body or b"{}")))
         if path == "/health":
             return b'{"ok": true}'
+        if path == "/metrics":
+            # Self-metrics in Prometheus exposition format (SURVEY.md
+            # §5 observability row) — the scheduler is scrapeable the
+            # same way it scrapes node_exporters.
+            from kubernetesnetawarescheduler_tpu.utils.selfmetrics import (
+                render_metrics,
+            )
+            return render_metrics(self._loop).encode()
         raise ValueError(f"unknown op {path!r}")
 
     @staticmethod

@@ -1,0 +1,219 @@
+"""The scorer daemon: ``python -m kubernetesnetawarescheduler_tpu``.
+
+The process the deploy manifests run (deploy/scorer.yaml).  Wires the
+whole serving stack the way the reference's single Go ``main`` did
+(scheduler.go:127-159), but with the roles split per SURVEY.md §7:
+
+- the Encoder + SchedulerLoop (batch score/assign on the TPU),
+- the UDS scorer server the native extender shim fronts,
+- optionally the gRPC transport for remote/DCN clients,
+- the scrape pool (node_exporter ingestion) and probe orchestrator
+  (pairwise lat/bw) on background threads,
+- checkpoint restore on start / save on SIGTERM (the restart story the
+  reference lacked — queued pods lost, scheduler.go:165-173),
+- a decision log for deterministic replay.
+
+The Kubernetes client is pluggable: ``--cluster fake:N`` serves against
+a generated N-node fake cluster (demo/CI shape), while a real
+API-server client plugs in through the same
+:class:`~.k8s.client.ClusterClient` contract via the extender webhook
+path (stock kube-scheduler calls /filter, /prioritize, /bind — no
+in-process informer needed, which is why this daemon has no dependency
+on a kubernetes client library).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import sys
+import threading
+import time
+
+from kubernetesnetawarescheduler_tpu.config import (
+    SchedulerConfig,
+    load_config,
+)
+
+
+def build_fake(num_nodes: int, seed: int, cfg: SchedulerConfig):
+    from kubernetesnetawarescheduler_tpu.bench.fakecluster import (
+        ClusterSpec,
+        build_fake_cluster,
+        feed_metrics,
+    )
+    from kubernetesnetawarescheduler_tpu.core.loop import SchedulerLoop
+
+    import numpy as np
+
+    cluster, lat, bw = build_fake_cluster(
+        ClusterSpec(num_nodes=num_nodes, seed=seed))
+    loop = SchedulerLoop(cluster, cfg)
+    loop.encoder.set_network(lat, bw)
+    feed_metrics(cluster, loop.encoder, np.random.default_rng(seed + 1))
+    return loop, lat, bw
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="kubernetesnetawarescheduler_tpu",
+        description=__doc__.splitlines()[0])
+    ap.add_argument("--config", help="SchedulerConfig JSON/YAML path")
+    ap.add_argument("--cluster", default="fake:128",
+                    help='"fake:<N>" (generated cluster) — the real '
+                         "API-server integration enters via the "
+                         "extender webhook, not this flag")
+    ap.add_argument("--uds", default="/run/netaware/scorer.sock",
+                    help="unix socket the native shim connects to")
+    ap.add_argument("--grpc", default="",
+                    help='gRPC bind address (e.g. "0.0.0.0:50051"); '
+                         "empty disables")
+    ap.add_argument("--scrape-targets", default="",
+                    help="JSON file {node name: metrics URL} for the "
+                         "node_exporter scrape pool")
+    ap.add_argument("--scrape-period-s", type=float, default=15.0)
+    ap.add_argument("--probe-period-s", type=float, default=60.0,
+                    help="pairwise lat/bw probe cadence (the "
+                         "reference's script.sh ran every 60s)")
+    ap.add_argument("--checkpoint-dir", default="",
+                    help="restore on start, save on SIGTERM")
+    ap.add_argument("--decision-log", default="",
+                    help="JSONL decision log path")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--once", action="store_true",
+                    help="serve one readiness cycle then exit "
+                         "(smoke-test mode)")
+    args = ap.parse_args(argv)
+
+    cfg = load_config(args.config) if args.config else SchedulerConfig()
+
+    kind, _, param = args.cluster.partition(":")
+    if kind != "fake":
+        ap.error(f"unknown cluster kind {kind!r} (only fake:<N>; real "
+                 "clusters integrate via the extender webhook)")
+    loop, lat_truth, bw_truth = build_fake(int(param or "128"), args.seed,
+                                           cfg)
+
+    if args.checkpoint_dir and os.path.exists(
+            os.path.join(args.checkpoint_dir, "meta.json")):
+        from kubernetesnetawarescheduler_tpu.core.checkpoint import (
+            load_checkpoint,
+        )
+        restored = load_checkpoint(args.checkpoint_dir, cfg)
+        # The checkpoint must describe THIS cluster: a node table that
+        # diverges from the live registrations would silently schedule
+        # onto a phantom subset and break ingest-by-name.  Shape checks
+        # alone (load_checkpoint) cannot catch that.
+        if restored._node_names == loop.encoder._node_names:
+            loop.encoder = restored
+            print(f"restored checkpoint from {args.checkpoint_dir}",
+                  file=sys.stderr)
+        else:
+            print(f"IGNORING checkpoint {args.checkpoint_dir}: node "
+                  f"table mismatch ({len(restored._node_names)} stored "
+                  f"vs {len(loop.encoder._node_names)} live nodes)",
+                  file=sys.stderr)
+
+    if args.decision_log:
+        from kubernetesnetawarescheduler_tpu.core.checkpoint import (
+            DecisionLog,
+        )
+        loop.decision_log = DecisionLog(args.decision_log)
+
+    from kubernetesnetawarescheduler_tpu.api.extender import (
+        ExtenderHandlers,
+    )
+    from kubernetesnetawarescheduler_tpu.api.server import ScorerServer
+
+    os.makedirs(os.path.dirname(args.uds) or ".", exist_ok=True)
+    handlers = ExtenderHandlers(loop)
+    uds = ScorerServer(handlers, args.uds)
+    uds.start()
+    print(f"scorer serving on uds://{args.uds}", file=sys.stderr)
+
+    grpc_server = None
+    if args.grpc:
+        from kubernetesnetawarescheduler_tpu.api.grpc_server import (
+            serve_grpc,
+        )
+        grpc_server, port = serve_grpc(handlers, args.grpc)
+        print(f"scorer serving on grpc://{args.grpc} (port {port})",
+              file=sys.stderr)
+
+    threads = []
+    stop = threading.Event()
+    if args.scrape_targets:
+        from kubernetesnetawarescheduler_tpu.ingest.scraper import (
+            ScrapePool,
+        )
+        with open(args.scrape_targets, encoding="utf-8") as fh:
+            targets = json.load(fh)
+        pool = ScrapePool(loop.encoder, targets)
+        threads.append(threading.Thread(
+            target=pool.run_forever, args=(args.scrape_period_s,),
+            daemon=True, name="scrape-pool"))
+
+    # Probe orchestrator: keeps the pairwise lat/bw matrices fresh (the
+    # reference's 60-second script.sh loop, as budgeted pair probing).
+    # The fake cluster gets the FakeProber against ground truth; a real
+    # fleet swaps in Iperf3Prober via the same Prober protocol.
+    if args.probe_period_s > 0:
+        from kubernetesnetawarescheduler_tpu.ingest.probe import (
+            FakeProber,
+            ProbeOrchestrator,
+        )
+        names = list(loop.encoder._node_names)
+        orch = ProbeOrchestrator(
+            loop.encoder,
+            FakeProber(names, lat_truth, bw_truth, seed=args.seed),
+            names)
+
+        def probe_forever() -> None:
+            while not stop.is_set():
+                orch.run_cycle(budget=64)
+                orch.advance_clock(args.probe_period_s)
+                stop.wait(args.probe_period_s)
+
+        threads.append(threading.Thread(target=probe_forever, daemon=True,
+                                        name="probe-orchestrator"))
+
+    def shutdown(signum, frame):
+        stop.set()
+
+    if threading.current_thread() is threading.main_thread():
+        # Handlers are installable only from the main thread; embedded/
+        # test harnesses drive shutdown through their own lifecycle.
+        signal.signal(signal.SIGTERM, shutdown)
+        signal.signal(signal.SIGINT, shutdown)
+
+    for t in threads:
+        t.start()
+
+    # Main serving loop: drain any informer-fed queue work (fake
+    # cluster path); extender-path requests are served by the UDS/gRPC
+    # threads directly.
+    try:
+        while not stop.is_set():
+            loop.run_once(timeout=0.25)
+            if args.once:
+                break
+    finally:
+        if args.checkpoint_dir:
+            from kubernetesnetawarescheduler_tpu.core.checkpoint import (
+                save_checkpoint,
+            )
+            save_checkpoint(args.checkpoint_dir, loop.encoder)
+            print(f"checkpoint saved to {args.checkpoint_dir}",
+                  file=sys.stderr)
+        if loop.decision_log is not None:
+            loop.decision_log.close()
+        uds.stop()
+        if grpc_server is not None:
+            grpc_server.stop(grace=1.0)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

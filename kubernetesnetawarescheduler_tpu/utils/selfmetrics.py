@@ -1,0 +1,100 @@
+"""Self-metrics: Prometheus text exposition of the scheduler itself.
+
+The reference *consumes* Prometheus (node_exporter scrapes,
+scheduler.go:275-279) but exposes nothing about itself — its only
+introspection was ``println`` of scraped values (scheduler.go:517,
+:525-526).  SURVEY.md §5's observability row requires self-metrics:
+pods/sec, Score() latency percentiles, queue depth, metric staleness.
+This module renders them in the same exposition format the ingest
+parser consumes, so an operator points Prometheus at the scheduler the
+same way the scheduler points at node_exporters (and our own parser
+round-trips it — see tests/test_selfmetrics.py).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+_QUANTILES = (0.5, 0.9, 0.99)
+
+
+def _fmt(value: float) -> str:
+    if value != value:  # NaN (empty percentile source)
+        return "NaN"
+    return repr(float(value))
+
+
+def render_metrics(loop) -> str:
+    """One exposition-format body for a
+    :class:`~kubernetesnetawarescheduler_tpu.core.loop.SchedulerLoop`."""
+    enc = loop.encoder
+    lines: list[str] = []
+
+    def counter(name: str, value: float, help_: str) -> None:
+        lines.append(f"# HELP {name} {help_}")
+        lines.append(f"# TYPE {name} counter")
+        lines.append(f"{name} {_fmt(value)}")
+
+    def gauge(name: str, value: float, help_: str) -> None:
+        lines.append(f"# HELP {name} {help_}")
+        lines.append(f"# TYPE {name} gauge")
+        lines.append(f"{name} {_fmt(value)}")
+
+    counter("netaware_pods_scheduled_total", loop.scheduled,
+            "Pods successfully bound")
+    counter("netaware_pods_unschedulable_total", loop.unschedulable,
+            "Pods with no feasible node")
+    counter("netaware_bind_failures_total", loop.bind_failures,
+            "Bind attempts rejected or errored")
+    gauge("netaware_queue_depth", len(loop.queue),
+          "Pending pods waiting in the scheduling queue")
+    counter("netaware_queue_dropped_total",
+            getattr(loop.queue, "dropped", 0),
+            "Pods dropped on queue overflow (recovered by resync)")
+
+    with enc._lock:
+        valid = enc._node_valid.copy()
+        ages = enc._metrics_age[valid]
+        overflow = (enc.labels.overflow_drops + enc.taints.overflow_drops
+                    + enc.groups.overflow_drops)
+    gauge("netaware_nodes_ready", float(valid.sum()),
+          "Nodes currently schedulable")
+    gauge("netaware_nodes_registered", float(enc.num_nodes),
+          "Nodes known to the encoder")
+    counter("netaware_intern_overflow_total", float(overflow),
+            "Constraint keys dropped by lenient interning")
+
+    # Metric staleness distribution over ready nodes — the quantity the
+    # exp(-age/tau) decay consumes.
+    lines.append("# HELP netaware_metric_staleness_seconds Age of each "
+                 "ready node's last telemetry sample")
+    lines.append("# TYPE netaware_metric_staleness_seconds summary")
+    for q in _QUANTILES:
+        v = float(np.quantile(ages, q)) if ages.size else float("nan")
+        lines.append(
+            f'netaware_metric_staleness_seconds{{quantile="{q:g}"}} '
+            f"{_fmt(v)}")
+    lines.append(f"netaware_metric_staleness_seconds_count {ages.size}")
+    lines.append("netaware_metric_staleness_seconds_sum "
+                 f"{_fmt(float(ages.sum()) if ages.size else 0.0)}")
+
+    # Per-phase latency summaries (encode / score_assign / bind) — p99
+    # Score() latency is a north-star metric (BASELINE.json).
+    lines.append("# HELP netaware_phase_latency_seconds Wall time per "
+                 "scheduling phase")
+    lines.append("# TYPE netaware_phase_latency_seconds summary")
+    for phase, stats in sorted(loop.timer.summary().items()):
+        for q in _QUANTILES:
+            v = loop.timer.percentile(phase, q * 100)
+            lines.append(
+                f'netaware_phase_latency_seconds{{phase="{phase}",'
+                f'quantile="{q:g}"}} {_fmt(v)}')
+        lines.append(
+            f'netaware_phase_latency_seconds_count{{phase="{phase}"}} '
+            f"{stats['count']:g}")
+        lines.append(
+            f'netaware_phase_latency_seconds_sum{{phase="{phase}"}} '
+            f"{_fmt(stats['total_s'])}")
+
+    return "\n".join(lines) + "\n"

@@ -1,0 +1,82 @@
+"""Self-metrics exposition (utils/selfmetrics.py): values must reflect
+the loop's counters, and the body must round-trip through our own
+Prometheus parser (the format the ingest side consumes,
+SURVEY.md §5 observability row)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from kubernetesnetawarescheduler_tpu.bench.fakecluster import (
+    ClusterSpec,
+    WorkloadSpec,
+    build_fake_cluster,
+    feed_metrics,
+    generate_workload,
+)
+from kubernetesnetawarescheduler_tpu.config import SchedulerConfig
+from kubernetesnetawarescheduler_tpu.core.loop import SchedulerLoop
+from kubernetesnetawarescheduler_tpu.ingest.prometheus import (
+    parse_prometheus_text,
+)
+from kubernetesnetawarescheduler_tpu.utils.selfmetrics import render_metrics
+
+CFG = SchedulerConfig(max_nodes=32, max_pods=8, max_peers=2,
+                      queue_capacity=200)
+
+
+def _run_loop(num_pods=24, seed=0):
+    cluster, lat, bw = build_fake_cluster(ClusterSpec(num_nodes=20,
+                                                      seed=seed))
+    loop = SchedulerLoop(cluster, CFG)
+    loop.encoder.set_network(lat, bw)
+    feed_metrics(cluster, loop.encoder, np.random.default_rng(seed + 1))
+    pods = generate_workload(WorkloadSpec(num_pods=num_pods, seed=seed),
+                             scheduler_name=CFG.scheduler_name)
+    cluster.add_pods(pods)
+    loop.run_until_drained()
+    return loop
+
+
+def test_render_roundtrips_through_own_parser():
+    loop = _run_loop()
+    parsed = parse_prometheus_text(render_metrics(loop))
+    flat = {name: next(iter(series.values()))
+            for name, series in parsed.items() if len(series) == 1}
+    assert flat["netaware_pods_scheduled_total"] == loop.scheduled
+    assert flat["netaware_pods_unschedulable_total"] == loop.unschedulable
+    assert flat["netaware_queue_depth"] == 0
+    assert flat["netaware_nodes_ready"] == 20
+    assert loop.scheduled > 0
+
+    lat_series = parsed["netaware_phase_latency_seconds"]
+    phases = {dict(labels).get("phase") for labels in lat_series}
+    assert {"encode", "score_assign", "bind"} <= phases
+    # p99 >= p50 for the score phase.
+    score = {dict(labels)["quantile"]: v for labels, v in lat_series.items()
+             if dict(labels).get("phase") == "score_assign"}
+    assert score["0.99"] >= score["0.5"] > 0
+
+    stale = parsed["netaware_metric_staleness_seconds_count"]
+    assert next(iter(stale.values())) == 20
+
+
+def test_metrics_served_over_uds(tmp_path):
+    from kubernetesnetawarescheduler_tpu.api.extender import (
+        ExtenderHandlers,
+    )
+    from kubernetesnetawarescheduler_tpu.api.server import (
+        ScorerServer,
+        call_uds,
+    )
+
+    loop = _run_loop(num_pods=8, seed=3)
+    server = ScorerServer(ExtenderHandlers(loop), str(tmp_path / "s.sock"))
+    server.start()
+    try:
+        body = call_uds(server.uds_path, "/metrics", b"")
+    finally:
+        server.stop()
+    parsed = parse_prometheus_text(body.decode())
+    assert "netaware_pods_scheduled_total" in parsed
+    assert "netaware_phase_latency_seconds" in parsed
