@@ -30,6 +30,7 @@ from functools import partial
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from kubernetesnetawarescheduler_tpu.config import SchedulerConfig
 from kubernetesnetawarescheduler_tpu.core import score as score_lib
@@ -41,7 +42,9 @@ from kubernetesnetawarescheduler_tpu.core.state import (
     scatter_or_onehot,
 )
 
-UNASSIGNED = jnp.int32(-1)
+# np scalar, not jnp — see core/score.py NEG_INF: module-level jnp
+# constants initialize the backend at import and lock the platform.
+UNASSIGNED = np.int32(-1)
 
 
 def _static_parts(state: ClusterState, pods: PodBatch, cfg: SchedulerConfig):

@@ -33,6 +33,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from kubernetesnetawarescheduler_tpu.config import (
     GOODNESS,
@@ -40,7 +41,10 @@ from kubernetesnetawarescheduler_tpu.config import (
 )
 from kubernetesnetawarescheduler_tpu.core.state import ClusterState, PodBatch
 
-NEG_INF = jnp.float32(-1e30)
+# np scalar, not jnp: a module-level jnp constant would initialize the
+# JAX backend at import time, locking the platform before callers
+# (tests, dryrun_multichip) can select cpu + virtual device count.
+NEG_INF = np.float32(-1e30)
 _EPS = 1e-9
 
 
