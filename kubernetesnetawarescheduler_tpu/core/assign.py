@@ -47,12 +47,19 @@ from kubernetesnetawarescheduler_tpu.core.state import (
 UNASSIGNED = np.int32(-1)
 
 
-def _static_parts(state: ClusterState, pods: PodBatch, cfg: SchedulerConfig):
+def _static_parts(state: ClusterState, pods: PodBatch, cfg: SchedulerConfig,
+                  static=None):
     """Batch-invariant pieces: base+network score and the static mask
-    (taints, node selectors, validity) that placements can't change."""
-    base = score_lib.metric_scores(state, cfg)[None, :]
-    net = score_lib.network_scores(state, pods, cfg)
-    raw = base + net
+    (taints, node selectors, validity) that placements can't change.
+
+    ``static``, if given, is the ``(base[N], C[N,N])`` pair from
+    :func:`~.score.static_node_scores` — precomputed once per replay so
+    the N×N normalization work is not re-done every batch."""
+    if static is None:
+        static = score_lib.static_node_scores(state, cfg)
+    base, c = static
+    net = score_lib.network_scores(state, pods, cfg, c=c)
+    raw = base[None, :] + net
     tol = (state.taint_bits[None, :] & ~pods.tol_bits[:, None]) == 0
     sel = (state.label_bits[None, :] & pods.sel_bits[:, None]) \
         == pods.sel_bits[:, None]
@@ -83,7 +90,7 @@ def _balance(pods: PodBatch, used: jax.Array, cap: jax.Array) -> jax.Array:
 
 @partial(jax.jit, static_argnames=("cfg",))
 def assign_greedy(state: ClusterState, pods: PodBatch,
-                  cfg: SchedulerConfig) -> jax.Array:
+                  cfg: SchedulerConfig, static=None) -> jax.Array:
     """Sequential greedy assignment, ``i32[P]`` (-1 = unschedulable).
 
     Exact semantics: pods are placed one at a time in (priority desc,
@@ -91,7 +98,7 @@ def assign_greedy(state: ClusterState, pods: PodBatch,
     group masks for the pods after it.
     """
     p = pods.num_pods
-    raw, static_ok = _static_parts(state, pods, cfg)
+    raw, static_ok = _static_parts(state, pods, cfg, static)
     w_bal = jnp.float32(cfg.weights.balance)
 
     # Stable order: priority descending, index ascending.
@@ -136,7 +143,7 @@ def assign_greedy(state: ClusterState, pods: PodBatch,
 
 @partial(jax.jit, static_argnames=("cfg",))
 def assign_parallel(state: ClusterState, pods: PodBatch,
-                    cfg: SchedulerConfig) -> jax.Array:
+                    cfg: SchedulerConfig, static=None) -> jax.Array:
     """Batched iterative conflict-resolution assignment, ``i32[P]``.
 
     Each round: every still-unassigned pod argmaxes its masked score
@@ -147,7 +154,7 @@ def assign_parallel(state: ClusterState, pods: PodBatch,
     """
     p = pods.num_pods
     n = state.num_nodes
-    raw, static_ok = _static_parts(state, pods, cfg)
+    raw, static_ok = _static_parts(state, pods, cfg, static)
     w_bal = jnp.float32(cfg.weights.balance)
     pod_ids = jnp.arange(p, dtype=jnp.int32)
 

@@ -96,34 +96,57 @@ class SchedulerLoop:
 
     def _bind_all(self, pods: Sequence[Pod],
                   assignment: np.ndarray) -> int:
-        bound = 0
+        """Bind a batch: one ``bind_many`` round-trip, batched events,
+        batched usage commit — per-pod work only on the error paths.
+
+        Semantically identical to binding pod-by-pod (the reference's
+        shape, scheduler.go:196-233): per-pod outcomes, permanent
+        rejections dropped with an event, transient errors requeued
+        with a retry budget."""
+        comp = self.cfg.scheduler_name
+        node_name = self.encoder.node_name
+        events: list = []
+
+        bindable: list[Pod] = []
+        node_idxs: list[int] = []
+        names: list[str] = []
         for i, pod in enumerate(pods):
-            node_idx = int(assignment[i])
-            if self.decision_log is not None:
-                self.decision_log.append(
-                    pod.name,
-                    self.encoder.node_name(node_idx) if node_idx >= 0
-                    else "")
-            if node_idx < 0:
+            idx = int(assignment[i])
+            if idx < 0:
+                if self.decision_log is not None:
+                    self.decision_log.append(pod.name, "")
                 self.unschedulable += 1
-                self.client.create_event(failed_event(
-                    pod, self.cfg.scheduler_name, "no feasible node"))
+                events.append(failed_event(pod, comp, "no feasible node"))
                 continue
-            node_name = self.encoder.node_name(node_idx)
-            try:
-                self.client.bind(Binding(pod_name=pod.name,
-                                         namespace=pod.namespace,
-                                         node_name=node_name))
-            except (KeyError, ValueError) as exc:
+            name = node_name(idx)
+            if self.decision_log is not None:
+                self.decision_log.append(pod.name, name)
+            bindable.append(pod)
+            node_idxs.append(idx)
+            names.append(name)
+
+        outcomes = self.client.bind_many([
+            Binding(pod_name=pod.name, namespace=pod.namespace,
+                    node_name=name)
+            for pod, name in zip(bindable, names)])
+
+        ok_pods: list[Pod] = []
+        ok_idxs: list[int] = []
+        for pod, idx, name, exc in zip(bindable, node_idxs, names,
+                                       outcomes):
+            if exc is None:
+                ok_pods.append(pod)
+                ok_idxs.append(idx)
+                events.append(scheduled_event(pod, name, comp))
+            elif isinstance(exc, (KeyError, ValueError)):
                 # Permanent rejection (pod gone / already bound by a
                 # duplicate delivery): event + drop, batch continues.
                 self.bind_failures += 1
-                self.client.create_event(failed_event(
-                    pod, self.cfg.scheduler_name, f"bind rejected: {exc}"))
-                continue
-            except Exception as exc:  # noqa: BLE001 — transient API
-                # error: requeue with a retry budget instead of
-                # stranding the pod as Pending forever.
+                events.append(failed_event(
+                    pod, comp, f"bind rejected: {exc}"))
+            else:
+                # Transient API error: requeue with a retry budget
+                # instead of stranding the pod as Pending forever.
                 self.bind_failures += 1
                 key = f"{pod.namespace}/{pod.name}"
                 tries = self._bind_retries.get(key, 0) + 1
@@ -132,17 +155,17 @@ class SchedulerLoop:
                     self.queue.push(pod)
                 else:
                     self._bind_retries.pop(key, None)
-                    self.client.create_event(failed_event(
-                        pod, self.cfg.scheduler_name,
+                    events.append(failed_event(
+                        pod, comp,
                         f"bind failed after {tries - 1} retries: {exc}"))
-                continue
-            self._bind_retries.pop(f"{pod.namespace}/{pod.name}", None)
-            self.client.create_event(scheduled_event(
-                pod, node_name, self.cfg.scheduler_name))
-            self.encoder.commit(pod, node_name)
-            bound += 1
-            self.scheduled += 1
-        return bound
+
+        if self._bind_retries:
+            for pod in ok_pods:
+                self._bind_retries.pop(f"{pod.namespace}/{pod.name}", None)
+        self.encoder.commit_many(ok_pods, ok_idxs)
+        self.client.create_events(events)
+        self.scheduled += len(ok_pods)
+        return len(ok_pods)
 
     def run_until_drained(self, max_cycles: int = 10_000) -> int:
         """Drain the queue; returns total pods bound."""

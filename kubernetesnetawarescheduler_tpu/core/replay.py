@@ -34,6 +34,7 @@ from kubernetesnetawarescheduler_tpu.core.assign import (
     assign_greedy,
     assign_parallel,
 )
+from kubernetesnetawarescheduler_tpu.core.score import static_node_scores
 from kubernetesnetawarescheduler_tpu.core.state import (
     ClusterState,
     PodBatch,
@@ -81,6 +82,11 @@ def replay_stream(state: ClusterState, stream: PodStream,
     """
     assign_fn = {"greedy": assign_greedy,
                  "parallel": assign_parallel}[method]
+    # Batch-invariant node scores (metric vote + N×N net-desirability):
+    # computed ONCE here, closed over by the scan body, instead of
+    # re-normalizing the N×N matrices inside every step (don't rely on
+    # XLA's loop-invariant code motion for ~100 MB intermediates).
+    static = static_node_scores(state, cfg)
     s_total = stream.num_pods
     batch = cfg.max_pods
     if s_total % batch != 0:
@@ -116,7 +122,7 @@ def replay_stream(state: ClusterState, stream: PodStream,
             affinity_bits=sl.affinity_bits, anti_bits=sl.anti_bits,
             group_bit=sl.group_bit, priority=sl.priority,
             pod_valid=sl.pod_valid)
-        assignment = assign_fn(st, pods, cfg)
+        assignment = assign_fn(st, pods, cfg, static)
         st = commit_assignments(st, pods, assignment)
         node_of_pod = jax.lax.dynamic_update_slice_in_dim(
             node_of_pod, assignment, i * batch, 0)
@@ -130,6 +136,89 @@ def replay_stream(state: ClusterState, stream: PodStream,
     final_state = state.replace(used=used, group_bits=group_bits,
                                 resident_anti=resident_anti)
     return assignments.reshape(-1), final_state
+
+
+@partial(jax.jit, static_argnames=("cfg", "method", "chunk_batches"))
+def _replay_chunk(state: ClusterState, static, carry, folded,
+                  chunk_start: jax.Array, s_total: int,
+                  cfg: SchedulerConfig, method: str, chunk_batches: int):
+    """One pipelined chunk of the replay: ``chunk_batches`` scan steps
+    starting at batch index ``chunk_start`` (traced, so every chunk
+    shares one executable).  ``carry`` is the placement-mutated state
+    plus the *global* ``node_of_pod`` vector; ``folded`` is the whole
+    stream pre-folded to ``[NB, batch, ...]`` and device-resident."""
+    assign_fn = {"greedy": assign_greedy,
+                 "parallel": assign_parallel}[method]
+    batch = cfg.max_pods
+
+    xs_stream = jax.tree_util.tree_map(
+        lambda x: jax.lax.dynamic_slice_in_dim(
+            x, chunk_start, chunk_batches, 0), folded)
+    batch_ids = chunk_start + jnp.arange(chunk_batches, dtype=jnp.int32)
+
+    def step(carry, x):
+        used, group_bits, resident_anti, node_of_pod = carry
+        i, sl = x
+        st = state.replace(used=used, group_bits=group_bits,
+                           resident_anti=resident_anti)
+        pp = sl.peer_pods
+        from_stream = node_of_pod[jnp.clip(pp, 0, s_total - 1)]
+        peers = jnp.where(pp >= 0, from_stream, sl.peer_nodes)
+        pods = PodBatch(
+            req=sl.req, peers=peers, peer_traffic=sl.peer_traffic,
+            tol_bits=sl.tol_bits, sel_bits=sl.sel_bits,
+            affinity_bits=sl.affinity_bits, anti_bits=sl.anti_bits,
+            group_bit=sl.group_bit, priority=sl.priority,
+            pod_valid=sl.pod_valid)
+        assignment = assign_fn(st, pods, cfg, static)
+        st = commit_assignments(st, pods, assignment)
+        node_of_pod = jax.lax.dynamic_update_slice_in_dim(
+            node_of_pod, assignment, i * batch, 0)
+        return (st.used, st.group_bits, st.resident_anti,
+                node_of_pod), assignment
+
+    carry, assignments = jax.lax.scan(step, carry, (batch_ids, xs_stream))
+    return carry, assignments.reshape(-1)
+
+
+def replay_stream_pipelined(state: ClusterState, stream: PodStream,
+                            cfg: SchedulerConfig, method: str = "parallel",
+                            chunk_batches: int = 8):
+    """Chunked replay for the pipelined drain: yields
+    ``(start_pod_index, assignment np.ndarray)`` per chunk, in order.
+
+    All chunks are dispatched eagerly (JAX's async dispatch queues them
+    with the carry threading the data dependency), so the device runs
+    chunk ``i+1`` while the host fetches/binds chunk ``i`` — the async
+    binding-cycle shape kube-scheduler itself uses, and the fix for the
+    reference's fully synchronous cycle (scheduler.go:189-237).
+    The final short chunk falls back to :func:`_replay_chunk` with a
+    smaller static ``chunk_batches`` (one extra compile, cached)."""
+    static = static_node_scores(state, cfg)
+    s_total = stream.num_pods
+    batch = cfg.max_pods
+    if s_total % batch != 0:
+        raise ValueError(
+            f"stream length {s_total} not a multiple of max_pods={batch}")
+    nb = s_total // batch
+
+    folded = jax.tree_util.tree_map(
+        lambda x: jax.device_put(
+            jnp.asarray(x).reshape((nb, batch) + x.shape[1:])), stream)
+    carry = (state.used, state.group_bits, state.resident_anti,
+             jnp.full((s_total,), UNASSIGNED, jnp.int32))
+
+    pending = []
+    start = 0
+    while start < nb:
+        cb = min(chunk_batches, nb - start)
+        carry, assignment = _replay_chunk(
+            state, static, carry, folded, jnp.int32(start), s_total,
+            cfg, method, cb)
+        pending.append((start * batch, assignment))
+        start += cb
+    for pod_start, assignment in pending:
+        yield pod_start, np.asarray(assignment)
 
 
 def pad_stream(stream: PodStream, multiple: int) -> PodStream:

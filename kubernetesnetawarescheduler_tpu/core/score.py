@@ -132,11 +132,29 @@ def net_cost_matrix(state: ClusterState, cfg: SchedulerConfig) -> jax.Array:
     return jnp.where(pair_valid, c, 0.0)
 
 
+def static_node_scores(state: ClusterState, cfg: SchedulerConfig
+                       ) -> tuple[jax.Array, jax.Array]:
+    """The two batch-invariant score ingredients: the per-node metric
+    vote ``base f32[N]`` and the net-desirability matrix ``C f32[N,N]``.
+
+    Neither depends on the pod batch nor on anything placements mutate
+    (``used``/``group_bits``/``resident_anti``), so a replay loop can
+    compute them ONCE and reuse them for every batch instead of
+    re-deriving ~3 HBM passes over the N×N matrices per batch (the
+    device-side analog of the reference re-scraping every node per pod,
+    scheduler.go:275-279)."""
+    return metric_scores(state, cfg), net_cost_matrix(state, cfg)
+
+
 def network_scores(state: ClusterState, pods: PodBatch,
-                   cfg: SchedulerConfig) -> jax.Array:
-    """Pod-aware network term ``f32[P, N]`` as a single MXU matmul."""
+                   cfg: SchedulerConfig,
+                   c: jax.Array | None = None) -> jax.Array:
+    """Pod-aware network term ``f32[P, N]`` as a single MXU matmul.
+
+    ``c`` lets callers pass a precomputed :func:`net_cost_matrix`."""
     t = peer_traffic_matrix(pods, state.num_nodes)
-    c = net_cost_matrix(state, cfg)
+    if c is None:
+        c = net_cost_matrix(state, cfg)
     if cfg.use_bfloat16:
         # bf16 inputs, f32 accumulation: standard MXU recipe.
         return jnp.dot(t.astype(jnp.bfloat16), c.T.astype(jnp.bfloat16),

@@ -48,6 +48,28 @@ class ClusterClient:
     def create_event(self, event: Event) -> None:
         raise NotImplementedError
 
+    def bind_many(self, bindings: Sequence[Binding]
+                  ) -> list[Exception | None]:
+        """Batched bind: one outcome per binding (None = bound).
+
+        Default delegates to :meth:`bind` per binding; implementations
+        with per-call overhead (a lock, an HTTP round-trip) override to
+        pay it once per batch.  A failure never aborts the batch."""
+        out: list[Exception | None] = []
+        for b in bindings:
+            try:
+                self.bind(b)
+                out.append(None)
+            except Exception as exc:  # noqa: BLE001 — per-pod outcome
+                out.append(exc)
+        return out
+
+    def create_events(self, events: Sequence[Event]) -> None:
+        """Batched event creation (best-effort, like the reference's
+        fire-and-forget Events().Create, scheduler.go:214-233)."""
+        for e in events:
+            self.create_event(e)
+
     def list_pending_pods(self) -> Sequence[Pod]:
         """Re-listable pending pods — the recovery path the reference
         lacks (queued pods are lost on restart; it only ever enqueues
@@ -116,22 +138,44 @@ class FakeCluster(ClusterClient):
         with self._lock:
             self._node_handlers.append(handler)
 
+    def _bind_locked(self, binding: Binding) -> None:
+        """Single-binding validation + apply; caller holds the lock.
+        Shared by :meth:`bind` and :meth:`bind_many` so the two paths
+        cannot drift."""
+        pod = self._pods.get(binding.pod_name)
+        if pod is None:
+            raise KeyError(f"unknown pod {binding.pod_name}")
+        if binding.node_name not in self._nodes:
+            raise KeyError(f"unknown node {binding.node_name}")
+        if pod.node_name:
+            raise ValueError(
+                f"pod {pod.name} already bound to {pod.node_name}")
+        pod.node_name = binding.node_name
+        self.bindings.append(binding)
+
     def bind(self, binding: Binding) -> None:
         with self._lock:
-            pod = self._pods.get(binding.pod_name)
-            if pod is None:
-                raise KeyError(f"unknown pod {binding.pod_name}")
-            if binding.node_name not in self._nodes:
-                raise KeyError(f"unknown node {binding.node_name}")
-            if pod.node_name:
-                raise ValueError(
-                    f"pod {pod.name} already bound to {pod.node_name}")
-            pod.node_name = binding.node_name
-            self.bindings.append(binding)
+            self._bind_locked(binding)
 
     def create_event(self, event: Event) -> None:
         with self._lock:
             self.events.append(event)
+
+    def bind_many(self, bindings: Sequence[Binding]
+                  ) -> list[Exception | None]:
+        out: list[Exception | None] = []
+        with self._lock:
+            for binding in bindings:
+                try:
+                    self._bind_locked(binding)
+                    out.append(None)
+                except (KeyError, ValueError) as exc:
+                    out.append(exc)
+        return out
+
+    def create_events(self, events: Sequence[Event]) -> None:
+        with self._lock:
+            self.events.extend(events)
 
     def list_pending_pods(self) -> Sequence[Pod]:
         with self._lock:

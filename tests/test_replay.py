@@ -71,6 +71,48 @@ def test_density_device_mode_runs():
     assert res.pods_per_sec > 0
 
 
+def test_pipelined_replay_matches_monolithic():
+    """Chunked/pipelined replay is the same computation re-dispatched:
+    identical assignments per chunk, including the short final chunk."""
+    from kubernetesnetawarescheduler_tpu.core.replay import (
+        replay_stream_pipelined,
+    )
+
+    cfg = SchedulerConfig(max_nodes=128, max_pods=8, max_peers=4,
+                          queue_capacity=64)
+    cluster, lat, bw = build_fake_cluster(ClusterSpec(num_nodes=24, seed=3))
+    loop = SchedulerLoop(cluster, cfg)
+    loop.encoder.set_network(lat, bw)
+    feed_metrics(cluster, loop.encoder, np.random.default_rng(4))
+    pods = generate_workload(WorkloadSpec(num_pods=40, seed=5),
+                             scheduler_name=cfg.scheduler_name)
+    cluster.add_pods(pods)
+    queued = loop.queue.pop_batch(40, timeout=0.0)
+    stream = pad_stream(
+        loop.encoder.encode_stream(queued, node_of=loop._peer_node),
+        cfg.max_pods)
+    state = loop.encoder.snapshot()
+    mono, _ = replay_stream(state, stream, cfg, "parallel")
+    mono = np.asarray(mono)
+    # 5 batches of 8 with chunk_batches=2 -> chunks of 2, 2, 1 (the
+    # final chunk exercises the smaller static shape).
+    got = np.full_like(mono, -2)
+    for start, chunk in replay_stream_pipelined(state, stream, cfg,
+                                                "parallel",
+                                                chunk_batches=2):
+        got[start:start + len(chunk)] = chunk
+    np.testing.assert_array_equal(mono, got)
+
+
+def test_density_pipeline_mode_matches_device():
+    dev = run_density(num_nodes=32, num_pods=48, batch_size=16,
+                      mode="device", warmup=False)
+    pipe = run_density(num_nodes=32, num_pods=48, batch_size=16,
+                       mode="pipeline", warmup=False)
+    assert pipe.pods_bound == dev.pods_bound
+    assert pipe.pods_unschedulable == dev.pods_unschedulable
+
+
 def test_stream_peers_resolve_across_batches():
     """A pod whose peer was placed in an earlier scan step must see the
     peer's node (not -1): co-location pull applies across batches."""
