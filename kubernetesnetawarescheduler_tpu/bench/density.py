@@ -101,12 +101,10 @@ def run_density(num_nodes: int = 100, num_pods: int = 300,
     pods = generate_workload(WorkloadSpec(num_pods=num_pods, seed=seed),
                              scheduler_name=cfg.scheduler_name)
 
-    if mode == "device":
+    if mode in ("device", "pipeline"):
         return _run_density_device(cluster, loop, pods, cfg, method,
-                                   num_nodes, seed, warmup, sampler)
-    if mode == "pipeline":
-        return _run_density_pipeline(cluster, loop, pods, cfg, method,
-                                     num_nodes, seed, warmup, sampler)
+                                   num_nodes, seed, warmup, sampler,
+                                   pipeline=(mode == "pipeline"))
 
     if warmup:
         wloop = _throwaway_loop(num_nodes, seed, cfg, method)
@@ -140,8 +138,21 @@ def run_density(num_nodes: int = 100, num_pods: int = 300,
 
 def _run_density_device(cluster, loop: SchedulerLoop, pods, cfg,
                         method: str, num_nodes: int, seed: int,
-                        warmup: bool, sampler=None) -> DensityResult:
-    """Whole-workload device replay: one dispatch, one fetch.
+                        warmup: bool, sampler=None,
+                        chunk_batches: int = 8,
+                        pipeline: bool = False) -> DensityResult:
+    """Device-resident drain, two strategies sharing one harness.
+
+    ``pipeline=False`` — whole-workload replay: ONE dispatch, one
+    fetch, then a synchronous bind pass.  The minimum-dispatch shape;
+    fastest when per-dispatch latency is high (tunneled chips).
+
+    ``pipeline=True`` — chunked replay with an async bind worker: all
+    chunks dispatched eagerly (the scan carry threads the dependency),
+    each chunk's assignments bound while the device runs later chunks —
+    the async binding-cycle shape kube-scheduler itself uses, vs the
+    reference's fully synchronous cycle (scheduler.go:189-237).  Wins
+    when per-dispatch latency is low.
 
     The timed window covers everything a serving deployment does per
     pod — host encode of the stream, the device replay, and the host
@@ -150,11 +161,17 @@ def _run_density_device(cluster, loop: SchedulerLoop, pods, cfg,
     (warmup) and the initial bulk host→device copy of the ``N×N``
     matrices (paid once at startup in a live deployment, then amortized
     via dirty-group updates).  Per-batch score latency is reported
-    amortized (device wall / num_batches) — a mean, not a true
-    percentile, hence p50 == p99 in this mode."""
+    amortized (device span / num_batches) — a mean, not a true
+    percentile, hence p50 == p99 in these modes; in pipeline mode
+    ``bind_p99_ms`` is the bind worker's residual tail after the last
+    fetch (the part the pipeline failed to hide)."""
+    import queue as queue_mod
+    import threading
+
     from kubernetesnetawarescheduler_tpu.core.replay import (
         pad_stream,
         replay_stream,
+        replay_stream_pipelined,
     )
 
     cluster.add_pods(pods)
@@ -169,77 +186,14 @@ def _run_density_device(cluster, loop: SchedulerLoop, pods, cfg,
         wstream = pad_stream(
             wloop.encoder.encode_stream(queued, node_of=lambda name: ""),
             cfg.max_pods)
-        wassign, _ = replay_stream(wloop.encoder.snapshot(), wstream,
-                                   cfg, method)
-        np.asarray(wassign)
-
-    state = loop.encoder.snapshot()
-    import jax
-
-    jax.block_until_ready(state)
-    if sampler is not None:
-        sampler.start()
-    start = time.perf_counter()
-    stream = pad_stream(
-        loop.encoder.encode_stream(queued, node_of=loop._peer_node),
-        cfg.max_pods)
-    encode_wall = time.perf_counter() - start
-    assignment_dev, _final = replay_stream(state, stream, cfg, method)
-    assignment = np.asarray(assignment_dev)[:len(queued)]
-    device_wall = time.perf_counter() - start - encode_wall
-    bound = loop._bind_all(queued, assignment)
-    wall = time.perf_counter() - start
-
-    amortized_ms = device_wall / max(num_batches, 1) * 1e3
-    return DensityResult(
-        num_nodes=num_nodes,
-        pods_submitted=len(pods),
-        pods_bound=bound,
-        pods_unschedulable=loop.unschedulable,
-        wall_s=wall,
-        pods_per_sec=bound / wall if wall > 0 else 0.0,
-        score_p50_ms=amortized_ms,
-        score_p99_ms=amortized_ms,
-        encode_p99_ms=encode_wall / max(num_batches, 1) * 1e3,
-        bind_p99_ms=(wall - device_wall - encode_wall) * 1e3,
-    )
-
-
-def _run_density_pipeline(cluster, loop: SchedulerLoop, pods, cfg,
-                          method: str, num_nodes: int, seed: int,
-                          warmup: bool, sampler=None,
-                          chunk_batches: int = 8) -> DensityResult:
-    """Three-stage pipelined drain: encode → chunked device replay →
-    async bind.
-
-    All device chunks are dispatched eagerly (the scan carry threads
-    the data dependency), and a bind worker thread drains each chunk's
-    assignments while the device executes later chunks — the async
-    binding-cycle shape kube-scheduler itself uses, vs the reference's
-    fully synchronous cycle (scheduler.go:189-237).  ``score_*_ms`` is
-    the device span (post-encode to last fetch) amortized per batch;
-    ``bind_p99_ms`` is the bind worker's *residual* tail after the last
-    fetch — the part the pipeline failed to hide."""
-    import queue as queue_mod
-    import threading
-
-    from kubernetesnetawarescheduler_tpu.core.replay import (
-        pad_stream,
-        replay_stream_pipelined,
-    )
-
-    cluster.add_pods(pods)
-    queued = loop.queue.pop_batch(len(pods), timeout=0.0)
-    num_batches = _round_up(len(queued), cfg.max_pods) // cfg.max_pods
-
-    if warmup:
-        wloop = _throwaway_loop(num_nodes, seed, cfg, method)
-        wstream = pad_stream(
-            wloop.encoder.encode_stream(queued, node_of=lambda name: ""),
-            cfg.max_pods)
-        for _ in replay_stream_pipelined(wloop.encoder.snapshot(), wstream,
-                                         cfg, method, chunk_batches):
-            pass
+        wstate = wloop.encoder.snapshot()
+        if pipeline:
+            for _ in replay_stream_pipelined(wstate, wstream, cfg,
+                                             method, chunk_batches):
+                pass
+        else:
+            wassign, _ = replay_stream(wstate, wstream, cfg, method)
+            np.asarray(wassign)
 
     state = loop.encoder.snapshot()
     import jax
@@ -266,36 +220,46 @@ def _run_density_pipeline(cluster, loop: SchedulerLoop, pods, cfg,
                 binder_error.append(exc)
                 return
 
-    t = threading.Thread(target=binder, daemon=True)
-    t.start()
+    t = None
+    if pipeline:
+        t = threading.Thread(target=binder, daemon=True)
+        t.start()
 
     start = time.perf_counter()
     stream = pad_stream(
         loop.encoder.encode_stream(queued, node_of=loop._peer_node),
         cfg.max_pods)
     encode_wall = time.perf_counter() - start
-    for pod_start, assignment in replay_stream_pipelined(
-            state, stream, cfg, method, chunk_batches):
-        end = min(pod_start + len(assignment), len(queued))
-        if pod_start >= end:
-            continue
-        work.put((queued[pod_start:end],
-                  assignment[:end - pod_start]))
-    device_span = time.perf_counter() - start - encode_wall
-    work.put(None)
-    t.join()
-    if binder_error:
-        raise binder_error[0]
+
+    if pipeline:
+        for pod_start, assignment in replay_stream_pipelined(
+                state, stream, cfg, method, chunk_batches):
+            end = min(pod_start + len(assignment), len(queued))
+            if pod_start >= end:
+                continue
+            work.put((queued[pod_start:end],
+                      assignment[:end - pod_start]))
+        device_span = time.perf_counter() - start - encode_wall
+        work.put(None)
+        t.join()
+        if binder_error:
+            raise binder_error[0]
+        bound = bound_total[0]
+    else:
+        assignment_dev, _final = replay_stream(state, stream, cfg, method)
+        assignment = np.asarray(assignment_dev)[:len(queued)]
+        device_span = time.perf_counter() - start - encode_wall
+        bound = loop._bind_all(queued, assignment)
     wall = time.perf_counter() - start
 
     amortized_ms = device_span / max(num_batches, 1) * 1e3
     return DensityResult(
         num_nodes=num_nodes,
         pods_submitted=len(pods),
-        pods_bound=bound_total[0],
+        pods_bound=bound,
         pods_unschedulable=loop.unschedulable,
         wall_s=wall,
-        pods_per_sec=bound_total[0] / wall if wall > 0 else 0.0,
+        pods_per_sec=bound / wall if wall > 0 else 0.0,
         score_p50_ms=amortized_ms,
         score_p99_ms=amortized_ms,
         encode_p99_ms=encode_wall / max(num_batches, 1) * 1e3,

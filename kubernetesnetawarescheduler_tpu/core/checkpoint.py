@@ -131,6 +131,12 @@ def save_checkpoint(path: str, encoder: Encoder) -> None:
                 "taints": dict(encoder.taints._bits),
                 "groups": dict(encoder.groups._bits),
             },
+            # Usage ledger: without it a restored daemon could not
+            # release usage for pods bound before the restart.
+            "committed": {
+                uid: [idx, [float(x) for x in req]]
+                for uid, (idx, req) in encoder._committed.items()
+            },
         }
     np.savez_compressed(os.path.join(path, "state.npz"), **arrays)
     tmp = os.path.join(path, "meta.json.tmp")
@@ -172,6 +178,9 @@ def load_checkpoint(path: str,
     enc._node_index = {n: i for i, n in enumerate(enc._node_names)}
     for attr, table in meta["interners"].items():
         getattr(enc, attr)._bits = {k: int(v) for k, v in table.items()}
+    enc._committed = {
+        uid: (int(idx), np.asarray(req, np.float32))
+        for uid, (idx, req) in meta.get("committed", {}).items()}
     # Everything is freshly loaded: first snapshot() must upload all.
     for key in enc._dirty:
         enc._dirty[key] = True

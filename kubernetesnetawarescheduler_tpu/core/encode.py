@@ -127,6 +127,14 @@ class Encoder:
         self._group_bits = np.zeros((n,), np.uint32)
         self._resident_anti = np.zeros((n,), np.uint32)
 
+        # Usage ledger: uid -> (node index, committed request vector);
+        # release() reverses exactly what commit recorded (see the
+        # allocation section).  _early_releases marks pods whose
+        # termination beat their commit — an insertion-ordered dict
+        # used as a set, so bounding evicts oldest-first (release()).
+        self._committed: dict[str, tuple[int, np.ndarray]] = {}
+        self._early_releases: dict[str, None] = {}
+
         # Dirty tracking per transfer group, so snapshot() uploads the
         # 100 MB-class N x N matrices only when the probe pipeline
         # actually moved them.
@@ -227,23 +235,24 @@ class Encoder:
             self._dirty["net"] = True
 
     # -- allocation ---------------------------------------------------
+    #
+    # Usage is LEDGERED by pod uid: release() reverses exactly what
+    # commit recorded, and only for pods we committed.  This makes the
+    # accounting robust against (a) foreign pods — a cluster-wide
+    # watch delivers deletions of pods other schedulers bound, which
+    # must not subtract usage we never added — and (b) the
+    # release-before-commit race: a pod that terminates between its
+    # bind POST and commit_many() gets an "early release" marker, and
+    # the late commit is then dropped instead of leaking forever.
 
     def commit(self, pod: Pod, node_name: str) -> None:
         """Host-side bookkeeping of a bind: usage + group/anti bits."""
-        with self._lock:
-            idx = self._node_index[node_name]
-            self._used[idx] += _requests_vector(pod.requests,
-                                                self.cfg.num_resources)
-            if pod.group:
-                self._group_bits[idx] |= self.groups.bit(pod.group)
-            if pod.anti_groups:
-                self._resident_anti[idx] |= self.groups.mask(pod.anti_groups)
-            self._dirty["alloc"] = True
+        self.commit_many([pod], [self._node_index[node_name]])
 
     def commit_many(self, pods: Sequence[Pod],
                     node_indices: Sequence[int]) -> None:
-        """Batched :meth:`commit`: one lock acquisition, vectorized
-        usage accounting (``np.add.at`` handles repeated nodes)."""
+        """Batched commit: one lock acquisition, vectorized usage
+        accounting (``np.add.at`` handles repeated nodes)."""
         if not pods:
             return
         r = self.cfg.num_resources
@@ -253,8 +262,24 @@ class Encoder:
         for i, pod in enumerate(pods):
             _fill_requests_row(reqs[i], pod.requests, res_names)
         with self._lock:
-            np.add.at(self._used, idx, reqs)
+            keep = np.ones(len(pods), bool)
             for i, pod in enumerate(pods):
+                if pod.uid in self._committed:
+                    # Already accounted (duplicate delivery healed as a
+                    # 409): committing again would double-count usage
+                    # that a single release can never fully undo.
+                    keep[i] = False
+                    continue
+                if pod.uid in self._early_releases:
+                    # Terminated before we could account it: skip.
+                    del self._early_releases[pod.uid]
+                    keep[i] = False
+                    continue
+                self._committed[pod.uid] = (int(idx[i]), reqs[i].copy())
+            np.add.at(self._used, idx[keep], reqs[keep])
+            for i, pod in enumerate(pods):
+                if not keep[i]:
+                    continue
                 if pod.group:
                     self._group_bits[idx[i]] |= self.groups.bit(pod.group)
                 if pod.anti_groups:
@@ -262,15 +287,29 @@ class Encoder:
                         pod.anti_groups)
             self._dirty["alloc"] = True
 
-    def release(self, pod: Pod, node_name: str) -> None:
-        """Inverse of :meth:`commit` for pod deletion (group bits are
-        recomputed conservatively: they stay set; precise refcounting
-        arrives with the eviction subsystem)."""
+    def release(self, pod: Pod, node_name: str = "") -> None:
+        """Reverse this pod's commit (pod deletion/completion).
+
+        Ledger-driven: the subtraction uses the committed record, not
+        the caller's view, so double-release is a no-op and foreign
+        pods (never committed) do not corrupt usage.  A release that
+        beats the commit leaves an early-release marker consumed by
+        :meth:`commit_many`.  (Group bits stay set conservatively;
+        precise refcounting arrives with the eviction subsystem.)"""
         with self._lock:
-            idx = self._node_index[node_name]
-            self._used[idx] = np.maximum(
-                self._used[idx] - _requests_vector(
-                    pod.requests, self.cfg.num_resources), 0.0)
+            rec = self._committed.pop(pod.uid, None)
+            if rec is None:
+                self._early_releases[pod.uid] = None
+                if len(self._early_releases) > 4096:
+                    # Bound stray markers (e.g. a pod whose bind failed
+                    # then got deleted) by evicting the OLDEST — a
+                    # fresh marker guards a live race; an old one is
+                    # almost certainly a stray.
+                    del self._early_releases[
+                        next(iter(self._early_releases))]
+                return
+            idx, req = rec
+            self._used[idx] = np.maximum(self._used[idx] - req, 0.0)
             self._dirty["alloc"] = True
 
     # -- snapshot -----------------------------------------------------
@@ -400,7 +439,13 @@ class Encoder:
 
         cfg = self.cfg
         s, k, r = len(pods), cfg.max_peers, cfg.num_resources
+        # Indexed under both the bare name and "namespace/name": fake
+        # workloads reference peers by bare name, KubeClient-sourced
+        # pods carry namespace-qualified references.
         stream_index = {pod.name: i for i, pod in enumerate(pods)}
+        stream_index.update(
+            {f"{pod.namespace}/{pod.name}": i
+             for i, pod in enumerate(pods)})
         req = np.zeros((s, r), np.float32)
         peer_pods = np.full((s, k), -1, np.int32)
         peer_nodes = np.full((s, k), -1, np.int32)

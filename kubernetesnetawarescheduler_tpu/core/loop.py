@@ -62,9 +62,23 @@ class SchedulerLoop:
                         "parallel": assign_parallel}[method]
         self.informer = Informer(client, self.queue, cfg.scheduler_name,
                                  on_node=self._on_node)
+        # Usage release on pod termination/deletion: without this a
+        # long-running daemon's committed usage grows monotonically
+        # until every node looks full.  Clients deliver at most once
+        # per pod (KubeClient dedups terminal-MODIFIED vs DELETED).
+        client.on_pod_deleted(self._on_pod_gone)
 
     def _on_node(self, node: Node) -> None:
         self.encoder.upsert_node(node)
+
+    def _on_pod_gone(self, pod: Pod) -> None:
+        # A cluster-wide watch also delivers pods other schedulers
+        # bound; the ledger would no-op them anyway, but filtering
+        # here keeps the early-release marker set quiet.
+        if not pod.node_name or \
+                pod.scheduler_name != self.cfg.scheduler_name:
+            return
+        self.encoder.release(pod, pod.node_name)
 
     # ------------------------------------------------------------------
 
@@ -93,6 +107,33 @@ class SchedulerLoop:
             return self.client.node_of(pod_name)
         except KeyError:
             return ""  # peer not known to the API server (yet)
+
+    def _requeue_transient(self, pod: Pod, exc: Exception,
+                           events: list, comp: str) -> None:
+        """Requeue a pod whose bind failed transiently, with a retry
+        budget so it cannot cycle forever."""
+        self.bind_failures += 1
+        key = f"{pod.namespace}/{pod.name}"
+        tries = self._bind_retries.get(key, 0) + 1
+        self._bind_retries[key] = tries
+        if tries <= self.max_bind_retries:
+            self.queue.push(pod)
+        else:
+            self._bind_retries.pop(key, None)
+            events.append(failed_event(
+                pod, comp,
+                f"bind failed after {tries - 1} retries: {exc}"))
+
+    def _bound_where(self, pod: Pod) -> str:
+        """Best-effort: which node (if any) the API server says the
+        pod is bound to.  Used to heal 409s on the bind path."""
+        try:
+            return self.client.node_of(f"{pod.namespace}/{pod.name}")
+        except KeyError:
+            try:
+                return self.client.node_of(pod.name)
+            except KeyError:
+                return ""
 
     def _bind_all(self, pods: Sequence[Pod],
                   assignment: np.ndarray) -> int:
@@ -139,25 +180,35 @@ class SchedulerLoop:
                 ok_idxs.append(idx)
                 events.append(scheduled_event(pod, name, comp))
             elif isinstance(exc, (KeyError, ValueError)):
-                # Permanent rejection (pod gone / already bound by a
-                # duplicate delivery): event + drop, batch continues.
+                # "Already bound" conflicts can be OUR bind succeeding
+                # without us seeing the response (connection dropped
+                # mid-batch, duplicate queue delivery): if the pod
+                # landed on the node we chose, it IS scheduled —
+                # account it, don't report failure.
+                where = (self._bound_where(pod)
+                         if isinstance(exc, ValueError) else None)
+                if where == name:
+                    ok_pods.append(pod)
+                    ok_idxs.append(idx)
+                    events.append(scheduled_event(pod, name, comp))
+                    continue
+                if where == "":
+                    # Conflict but our view doesn't know where the pod
+                    # sits yet (watch event still in flight): treat as
+                    # transient so the retry re-checks once the cache
+                    # catches up, instead of dropping a pod that may
+                    # be running on the node we chose.
+                    self._requeue_transient(pod, exc, events, comp)
+                    continue
+                # Permanent rejection (pod gone / bound elsewhere):
+                # event + drop, batch continues.
                 self.bind_failures += 1
                 events.append(failed_event(
                     pod, comp, f"bind rejected: {exc}"))
             else:
                 # Transient API error: requeue with a retry budget
                 # instead of stranding the pod as Pending forever.
-                self.bind_failures += 1
-                key = f"{pod.namespace}/{pod.name}"
-                tries = self._bind_retries.get(key, 0) + 1
-                self._bind_retries[key] = tries
-                if tries <= self.max_bind_retries:
-                    self.queue.push(pod)
-                else:
-                    self._bind_retries.pop(key, None)
-                    events.append(failed_event(
-                        pod, comp,
-                        f"bind failed after {tries - 1} retries: {exc}"))
+                self._requeue_transient(pod, exc, events, comp)
 
         if self._bind_retries:
             for pod in ok_pods:

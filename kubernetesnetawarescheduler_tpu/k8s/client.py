@@ -42,6 +42,12 @@ class ClusterClient:
     def on_node_added(self, handler: NodeHandler) -> None:
         raise NotImplementedError
 
+    def on_pod_deleted(self, handler: PodHandler) -> None:
+        """Register for pod-gone notifications (deletion or terminal
+        phase) so committed usage can be released.  Optional: the
+        default is no signal (callers must then rely on periodic
+        reconciliation)."""
+
     def bind(self, binding: Binding) -> None:
         raise NotImplementedError
 
@@ -103,6 +109,7 @@ class FakeCluster(ClusterClient):
         self.events: list[Event] = []
         self._pod_handlers: list[PodHandler] = []
         self._node_handlers: list[NodeHandler] = []
+        self._deleted_handlers: list[PodHandler] = []
 
     # -- population ---------------------------------------------------
 
@@ -124,6 +131,16 @@ class FakeCluster(ClusterClient):
         for pod in pods:
             self.add_pod(pod)
 
+    def delete_pod(self, name: str) -> None:
+        """Remove a pod; if it was bound, fan out to on_pod_deleted
+        handlers (the usage-release signal)."""
+        with self._lock:
+            pod = self._pods.pop(name, None)
+            handlers = list(self._deleted_handlers)
+        if pod is not None and pod.node_name:
+            for h in handlers:
+                h(pod)
+
     # -- ClusterClient ------------------------------------------------
 
     def list_nodes(self) -> Sequence[Node]:
@@ -137,6 +154,10 @@ class FakeCluster(ClusterClient):
     def on_node_added(self, handler: NodeHandler) -> None:
         with self._lock:
             self._node_handlers.append(handler)
+
+    def on_pod_deleted(self, handler: PodHandler) -> None:
+        with self._lock:
+            self._deleted_handlers.append(handler)
 
     def _bind_locked(self, binding: Binding) -> None:
         """Single-binding validation + apply; caller holds the lock.

@@ -1,0 +1,606 @@
+"""A real API-server :class:`~.client.ClusterClient` over stdlib HTTP.
+
+The reference reaches Kubernetes through client-go: in-cluster config
+(scheduler.go:144), a shared-informer watch on pods/nodes
+(scheduler.go:161-187), POST Binding (scheduler.go:196-206) and POST
+Event (scheduler.go:214-233).  This module provides the same four
+touchpoints as a standalone daemon WITHOUT a kubernetes client
+library — just ``http.client`` + ``ssl`` — so the core stays
+dependency-free and the daemon runs in any pod with a ServiceAccount.
+
+Scope: exactly what the scheduling path consumes (the contract in
+:class:`~.client.ClusterClient`), not a general k8s client.  Watches
+are plain ``?watch=true`` chunked streams decoded line-by-line;
+reconnect-with-resourceVersion handles the API server closing them.
+
+Pod/Node JSON is mapped into the framework's lightweight types:
+
+- resource requests: sum over containers of ``spec.containers[].
+  resources.requests`` (cpu/memory parsed with k8s quantity suffixes);
+  net bandwidth from the ``netaware.io/bandwidth-gbps`` annotation.
+- network peers: the ``netaware.io/peers`` annotation, a JSON object
+  ``{"other-pod": relative_traffic}`` — the declarative replacement
+  for the reference's pod-blind scoring (its ``prioritize`` ignored
+  the pod entirely, scheduler.go:248).
+- affinity groups: ``netaware.io/group``, ``netaware.io/affinity``,
+  ``netaware.io/anti-affinity`` annotations (comma-separated), the
+  hostname-topology reduction of inter-pod affinity the score kernel
+  masks on.
+- labels/taints/selectors: flattened to ``key=value`` strings for the
+  encoder's interners.
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import os
+import ssl
+import threading
+import time
+from typing import Callable, Mapping, Sequence
+
+from kubernetesnetawarescheduler_tpu.k8s.client import (
+    ClusterClient,
+    NodeHandler,
+    PodHandler,
+)
+from kubernetesnetawarescheduler_tpu.k8s.types import (
+    Binding,
+    Event,
+    Node,
+    Pod,
+)
+
+SA_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
+
+
+class _WatchExpired(Exception):
+    """Internal: the server reported the watch resourceVersion stale
+    (410 Gone) — reconnect from scratch."""
+
+ANN_PEERS = "netaware.io/peers"
+ANN_GROUP = "netaware.io/group"
+ANN_AFFINITY = "netaware.io/affinity"
+ANN_ANTI = "netaware.io/anti-affinity"
+ANN_BANDWIDTH = "netaware.io/bandwidth-gbps"
+
+
+# -- k8s quantity parsing ---------------------------------------------
+
+_SUFFIX = {
+    "k": 1e3, "M": 1e6, "G": 1e9, "T": 1e12, "P": 1e15, "E": 1e18,
+    "Ki": 2 ** 10, "Mi": 2 ** 20, "Gi": 2 ** 30, "Ti": 2 ** 40,
+    "Pi": 2 ** 50, "Ei": 2 ** 60,
+}
+
+
+def parse_quantity(q: str | int | float) -> float:
+    """Parse a k8s resource quantity (``500m``, ``2``, ``1Gi``) to a
+    float in base units (cores for cpu, bytes for memory)."""
+    if isinstance(q, (int, float)):
+        return float(q)
+    s = str(q).strip()
+    if not s:
+        return 0.0
+    if s.endswith("m"):
+        return float(s[:-1]) / 1000.0
+    for suf in ("Ki", "Mi", "Gi", "Ti", "Pi", "Ei"):
+        if s.endswith(suf):
+            return float(s[: -len(suf)]) * _SUFFIX[suf]
+    if s[-1] in _SUFFIX:
+        return float(s[:-1]) * _SUFFIX[s[-1]]
+    return float(s)
+
+
+def _flatten(m: Mapping[str, str] | None) -> frozenset[str]:
+    if not m:
+        return frozenset()
+    return frozenset(f"{k}={v}" for k, v in m.items())
+
+
+def pod_from_json(obj: Mapping) -> Pod:
+    """Map a v1.Pod JSON object to the framework :class:`Pod`."""
+    meta = obj.get("metadata", {})
+    spec = obj.get("spec", {})
+    ann = meta.get("annotations") or {}
+
+    cpu = mem = 0.0
+    for c in spec.get("containers", []) or []:
+        req = (c.get("resources") or {}).get("requests") or {}
+        cpu += parse_quantity(req.get("cpu", 0))
+        mem += parse_quantity(req.get("memory", 0))
+    requests: dict[str, float] = {}
+    if cpu:
+        requests["cpu"] = cpu
+    if mem:
+        requests["mem"] = mem / 2 ** 30  # GiB, the Resource axis unit
+    if ANN_BANDWIDTH in ann:
+        try:
+            requests["net"] = float(ann[ANN_BANDWIDTH])
+        except ValueError:
+            pass
+
+    peers: dict[str, float] = {}
+    if ANN_PEERS in ann:
+        try:
+            raw = json.loads(ann[ANN_PEERS])
+            peers = {str(k): float(v) for k, v in raw.items()}
+        except (ValueError, TypeError, AttributeError):
+            peers = {}  # malformed annotation degrades to pod-blind
+
+    tolerations = frozenset(
+        f"{t.get('key', '')}={t.get('value', '')}"
+        for t in spec.get("tolerations", []) or [] if t.get("key"))
+
+    def _csv(key: str) -> frozenset[str]:
+        v = ann.get(key, "")
+        return frozenset(x.strip() for x in v.split(",") if x.strip())
+
+    namespace = meta.get("namespace", "default")
+    # Qualify peer references with the pod's own namespace (unless the
+    # annotation already says "ns/name"): the pod cache and node_of()
+    # are namespace-keyed, and a bare name would collide across
+    # namespaces (same-named pods in staging/prod are routine).
+    peers = {(k if "/" in k else f"{namespace}/{k}"): v
+             for k, v in peers.items()}
+
+    return Pod(
+        name=meta.get("name", ""),
+        namespace=namespace,
+        uid=meta.get("uid", "") or meta.get("name", ""),
+        scheduler_name=spec.get("schedulerName", ""),
+        node_name=spec.get("nodeName", "") or "",
+        requests=requests,
+        peers=peers,
+        tolerations=tolerations,
+        node_selector=_flatten(spec.get("nodeSelector")),
+        group=ann.get(ANN_GROUP, ""),
+        affinity_groups=_csv(ANN_AFFINITY),
+        anti_groups=_csv(ANN_ANTI),
+        priority=float(spec.get("priority", 0) or 0),
+    )
+
+
+def node_from_json(obj: Mapping) -> Node:
+    meta = obj.get("metadata", {})
+    spec = obj.get("spec", {})
+    status = obj.get("status", {})
+    alloc = status.get("allocatable") or status.get("capacity") or {}
+    labels = meta.get("labels") or {}
+    capacity = {
+        "cpu": parse_quantity(alloc.get("cpu", 0)),
+        "mem": parse_quantity(alloc.get("memory", 0)) / 2 ** 30,
+    }
+    if ANN_BANDWIDTH in (meta.get("annotations") or {}):
+        try:
+            capacity["net"] = float(meta["annotations"][ANN_BANDWIDTH])
+        except ValueError:
+            pass
+    ready = True
+    for cond in status.get("conditions", []) or []:
+        if cond.get("type") == "Ready":
+            ready = cond.get("status") == "True"
+    taints = frozenset(
+        f"{t.get('key', '')}={t.get('value', '')}"
+        for t in spec.get("taints", []) or [] if t.get("key"))
+    return Node(
+        name=meta.get("name", ""),
+        capacity=capacity,
+        labels=_flatten(labels),
+        taints=taints,
+        ready=ready,
+        zone=labels.get("topology.kubernetes.io/zone", ""),
+        rack=labels.get("topology.kubernetes.io/rack", ""),
+    )
+
+
+# -- the client -------------------------------------------------------
+
+
+class KubeClient(ClusterClient):
+    """Standalone-daemon API-server client (stdlib HTTP only).
+
+    ``base_url`` like ``https://10.0.0.1:443``; ``token``/``ca_file``
+    default to the in-cluster ServiceAccount mount — the stdlib
+    equivalent of ``rest.InClusterConfig()`` (scheduler.go:144).
+    """
+
+    def __init__(self, base_url: str | None = None,
+                 token: str | None = None,
+                 ca_file: str | None = None,
+                 insecure: bool = False,
+                 timeout: float = 30.0) -> None:
+        if base_url is None:
+            host = os.environ.get("KUBERNETES_SERVICE_HOST")
+            port = os.environ.get("KUBERNETES_SERVICE_PORT", "443")
+            if not host:
+                raise RuntimeError(
+                    "not in-cluster (KUBERNETES_SERVICE_HOST unset) and "
+                    "no base_url given")
+            base_url = f"https://{host}:{port}"
+        self.base_url = base_url.rstrip("/")
+        if token is None:
+            tok_path = os.path.join(SA_DIR, "token")
+            token = (open(tok_path).read().strip()
+                     if os.path.exists(tok_path) else "")
+        self._token = token
+        scheme, rest = self.base_url.split("://", 1)
+        self._host = rest
+        self._tls = scheme == "https"
+        if self._tls:
+            if insecure:
+                self._ctx = ssl._create_unverified_context()
+            else:
+                ca = ca_file or os.path.join(SA_DIR, "ca.crt")
+                self._ctx = ssl.create_default_context(
+                    cafile=ca if os.path.exists(ca) else None)
+        else:
+            self._ctx = None
+        self._timeout = timeout
+        self._lock = threading.RLock()
+        # Pods are cached under "namespace/name" — bare names collide
+        # across namespaces (PodQueue._key namespaces for the same
+        # reason), and pod_from_json qualifies peer references to
+        # match.
+        self._pods: dict[str, Pod] = {}
+        self._pod_handlers: list[PodHandler] = []
+        self._node_handlers: list[NodeHandler] = []
+        self._deleted_handlers: list[PodHandler] = []
+        # At-most-once pod-gone delivery: a pod that reached a terminal
+        # phase (MODIFIED) is released then, and its later DELETED
+        # event must not release again.  Entries are removed when the
+        # DELETED event arrives, so the set is bounded by pods that
+        # completed but are not yet deleted from etcd.
+        self._released_uids: set[str] = set()
+        self._watchers: list[threading.Thread] = []
+        self._stop = threading.Event()
+        # One persistent keep-alive connection for request/response
+        # calls (watches stream on their own connections): a fresh
+        # TCP+TLS handshake per bind would undo the batched-bind
+        # amortization the loop relies on.
+        self._conn_lock = threading.Lock()
+        self._shared_conn: http.client.HTTPConnection | None = None
+
+    @staticmethod
+    def pod_key(namespace: str, name: str) -> str:
+        return f"{namespace}/{name}"
+
+    # -- transport ----------------------------------------------------
+
+    def _conn(self, timeout: float | None = None
+              ) -> http.client.HTTPConnection:
+        t = self._timeout if timeout is None else timeout
+        if self._tls:
+            return http.client.HTTPSConnection(
+                self._host, timeout=t, context=self._ctx)
+        return http.client.HTTPConnection(self._host, timeout=t)
+
+    def _headers(self, extra: Mapping[str, str] | None = None) -> dict:
+        h = {"Accept": "application/json"}
+        if self._token:
+            h["Authorization"] = f"Bearer {self._token}"
+        if extra:
+            h.update(extra)
+        return h
+
+    def _request(self, method: str, path: str, body: Mapping | None = None
+                 ) -> Mapping:
+        with self._conn_lock:
+            return self._request_locked(method, path, body)
+
+    def _request_locked(self, method: str, path: str,
+                        body: Mapping | None = None,
+                        _retried: bool = False) -> Mapping:
+        payload = json.dumps(body) if body is not None else None
+        headers = self._headers(
+            {"Content-Type": "application/json"} if payload else None)
+        if self._shared_conn is None:
+            self._shared_conn = self._conn()
+        conn = self._shared_conn
+        sent = False
+        try:
+            conn.request(method, path, body=payload, headers=headers)
+            sent = True
+            resp = conn.getresponse()
+            data = resp.read()
+        except (http.client.HTTPException, OSError):
+            # Keep-alive connection went stale (server closed it):
+            # rebuild and retry.  Safe whenever the request never left
+            # (send-phase failure) or the method is idempotent; an
+            # already-SENT POST may have been applied, and replaying
+            # it blind would dodge the server's conflict detection —
+            # raise instead (the bind path requeues and heals 409s
+            # against the watch cache, core/loop.py _bind_all).
+            self._shared_conn = None
+            try:
+                conn.close()
+            except OSError:
+                pass
+            if _retried or (sent and method != "GET"):
+                raise
+            return self._request_locked(method, path, body, _retried=True)
+        if resp.status == 404:
+            raise KeyError(f"{method} {path}: 404 {data[:200]!r}")
+        if resp.status == 409:
+            raise ValueError(f"{method} {path}: 409 {data[:200]!r}")
+        if resp.status >= 300:
+            raise RuntimeError(
+                f"{method} {path}: {resp.status} {data[:200]!r}")
+        return json.loads(data) if data else {}
+
+    # -- ClusterClient ------------------------------------------------
+
+    def list_nodes(self) -> Sequence[Node]:
+        obj = self._request("GET", "/api/v1/nodes")
+        return [node_from_json(it) for it in obj.get("items", [])]
+
+    def list_pending_pods(self) -> Sequence[Pod]:
+        obj = self._request(
+            "GET", "/api/v1/pods?fieldSelector=spec.nodeName%3D")
+        pods = [pod_from_json(it) for it in obj.get("items", [])]
+        with self._lock:
+            for p in pods:
+                self._pods[self.pod_key(p.namespace, p.name)] = p
+        return pods
+
+    @staticmethod
+    def _binding_body(binding: Binding) -> dict:
+        return {
+            "apiVersion": "v1",
+            "kind": "Binding",
+            "metadata": {"name": binding.pod_name},
+            "target": {"apiVersion": "v1", "kind": "Node",
+                       "name": binding.node_name},
+        }
+
+    def _record_bound(self, binding: Binding) -> None:
+        with self._lock:
+            pod = self._pods.get(
+                self.pod_key(binding.namespace, binding.pod_name))
+            if pod is not None:
+                pod.node_name = binding.node_name
+
+    def bind(self, binding: Binding) -> None:
+        """POST the Binding subresource — the reference's exact call
+        shape (scheduler.go:196-206)."""
+        self._request(
+            "POST",
+            f"/api/v1/namespaces/{binding.namespace}/pods/"
+            f"{binding.pod_name}/binding",
+            body=self._binding_body(binding))
+        self._record_bound(binding)
+
+    def bind_many(self, bindings: Sequence[Binding]
+                  ) -> list[Exception | None]:
+        """Batched bind on ONE keep-alive connection: the whole batch
+        pays a single connection setup instead of one TLS handshake
+        per pod (the loop's ``_bind_all`` is built around this)."""
+        out: list[Exception | None] = []
+        with self._conn_lock:
+            for binding in bindings:
+                try:
+                    self._request_locked(
+                        "POST",
+                        f"/api/v1/namespaces/{binding.namespace}/pods/"
+                        f"{binding.pod_name}/binding",
+                        body=self._binding_body(binding))
+                    out.append(None)
+                except Exception as exc:  # noqa: BLE001 — per-pod
+                    out.append(exc)
+                    continue
+        for binding, exc in zip(bindings, out):
+            if exc is None:
+                self._record_bound(binding)
+        return out
+
+    @staticmethod
+    def _event_body(event: Event) -> dict:
+        now = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+        return {
+            "apiVersion": "v1",
+            "kind": "Event",
+            "metadata": {"generateName": f"{event.involved_pod}."},
+            "involvedObject": {
+                "apiVersion": "v1", "kind": "Pod",
+                "name": event.involved_pod,
+                "namespace": event.namespace},
+            "reason": event.reason,
+            "message": event.message,
+            "type": event.type,
+            "count": event.count,
+            "firstTimestamp": now,
+            "lastTimestamp": now,
+            "source": {"component": event.component},
+        }
+
+    def create_event(self, event: Event) -> None:
+        """POST a v1.Event (scheduler.go:214-233); failures are
+        swallowed — events are best-effort, never worth failing a
+        bind over."""
+        try:
+            self._request(
+                "POST", f"/api/v1/namespaces/{event.namespace}/events",
+                body=self._event_body(event))
+        except Exception:  # noqa: BLE001 — best-effort
+            pass
+
+    def create_events(self, events: Sequence[Event]) -> None:
+        """Batched events on one keep-alive connection, best-effort."""
+        with self._conn_lock:
+            for event in events:
+                try:
+                    self._request_locked(
+                        "POST",
+                        f"/api/v1/namespaces/{event.namespace}/events",
+                        body=self._event_body(event))
+                except Exception:  # noqa: BLE001 — best-effort
+                    continue
+
+    def node_of(self, pod_name: str) -> str:
+        """``pod_name`` is a "namespace/name" key (pod_from_json
+        qualifies peer references); a bare name falls back to the
+        default namespace."""
+        key = pod_name if "/" in pod_name else f"default/{pod_name}"
+        with self._lock:
+            pod = self._pods.get(key)
+        if pod is None:
+            raise KeyError(pod_name)
+        return pod.node_name
+
+    def get_pod(self, pod_name: str) -> Pod | None:
+        key = pod_name if "/" in pod_name else f"default/{pod_name}"
+        with self._lock:
+            return self._pods.get(key)
+
+    # -- watches (informer layer) -------------------------------------
+
+    def on_pod_added(self, handler: PodHandler) -> None:
+        with self._lock:
+            self._pod_handlers.append(handler)
+        # Watch ALL pods (not just pending): completion/deletion of
+        # bound pods must reach on_pod_deleted so usage accounting can
+        # release — a pending-only field selector would hide those.
+        self._ensure_watcher("/api/v1/pods?watch=true",
+                             self._deliver_pod, name="pod-watch")
+
+    def on_pod_deleted(self, handler: PodHandler) -> None:
+        """Register for pod-gone events (DELETED, or MODIFIED into a
+        terminal phase): the usage-release path the reference never
+        had (it tracked no usage at all, scheduler.go:248)."""
+        with self._lock:
+            self._deleted_handlers.append(handler)
+        self._ensure_watcher("/api/v1/pods?watch=true",
+                             self._deliver_pod, name="pod-watch")
+
+    def on_node_added(self, handler: NodeHandler) -> None:
+        with self._lock:
+            self._node_handlers.append(handler)
+        self._ensure_watcher("/api/v1/nodes?watch=true",
+                             self._deliver_node, name="node-watch")
+
+    def _deliver_pod(self, kind: str, obj: Mapping) -> None:
+        if kind == "DELETED":
+            pod = pod_from_json(obj)
+            with self._lock:
+                cached = self._pods.pop(
+                    self.pod_key(pod.namespace, pod.name), None)
+                gone = cached if cached is not None else pod
+                already = gone.uid in self._released_uids
+                self._released_uids.discard(gone.uid)
+                handlers = list(self._deleted_handlers)
+            # Prefer the cached view: a DELETED payload may already be
+            # stripped, but release needs node_name + requests.
+            if gone.node_name and not already:
+                for h in handlers:
+                    h(gone)
+            return
+        if kind not in ("ADDED", "MODIFIED"):
+            return
+        pod = pod_from_json(obj)
+        phase = (obj.get("status") or {}).get("phase", "")
+        terminal = phase in ("Succeeded", "Failed")
+        with self._lock:
+            self._pods[self.pod_key(pod.namespace, pod.name)] = pod
+            pod_handlers = list(self._pod_handlers)
+            deleted_handlers = list(self._deleted_handlers)
+            if terminal and pod.node_name:
+                if pod.uid in self._released_uids:
+                    return  # already released on an earlier MODIFIED
+                self._released_uids.add(pod.uid)
+        if terminal and pod.node_name:
+            # Terminal-but-not-yet-deleted: its usage is already free.
+            for h in deleted_handlers:
+                h(pod)
+        elif not pod.node_name:
+            for h in pod_handlers:
+                h(pod)
+
+    def _deliver_node(self, kind: str, obj: Mapping) -> None:
+        if kind not in ("ADDED", "MODIFIED"):
+            return
+        node = node_from_json(obj)
+        with self._lock:
+            handlers = list(self._node_handlers)
+        for h in handlers:
+            h(node)
+
+    def _ensure_watcher(self, path: str,
+                        deliver: Callable[[str, Mapping], None],
+                        name: str) -> None:
+        with self._lock:
+            if any(t.name == name and t.is_alive()
+                   for t in self._watchers):
+                return
+            t = threading.Thread(target=self._watch_loop,
+                                 args=(path, deliver), name=name,
+                                 daemon=True)
+            self._watchers.append(t)
+            t.start()
+
+    def _watch_loop(self, path: str,
+                    deliver: Callable[[str, Mapping], None]) -> None:
+        """One ``?watch=true`` chunked stream, reconnecting with the
+        last seen resourceVersion — the client-go reflector's job
+        (scheduler.go:161-187), minus the full re-list (the scheduler
+        loop's periodic ``list_pending_pods`` resync covers missed
+        events)."""
+        rv = ""
+        while not self._stop.is_set():
+            try:
+                # Watches idle legitimately between cluster events: a
+                # request-sized read timeout would kill every quiet
+                # stream.  ~5 min matches the API server's own watch
+                # window; close() still interrupts via _stop checks.
+                conn = self._conn(timeout=330.0)
+                sep = "&" if "?" in path else "?"
+                url = path + (f"{sep}resourceVersion={rv}" if rv else "")
+                conn.request("GET", url, headers=self._headers())
+                resp = conn.getresponse()
+                if resp.status >= 300:
+                    conn.close()
+                    self._stop.wait(1.0)
+                    rv = ""  # stale resourceVersion: start fresh
+                    continue
+                buf = b""
+                while not self._stop.is_set():
+                    chunk = resp.read1(65536)
+                    if not chunk:
+                        break
+                    buf += chunk
+                    while b"\n" in buf:
+                        line, buf = buf.split(b"\n", 1)
+                        if not line.strip():
+                            continue
+                        try:
+                            evt = json.loads(line)
+                        except ValueError:
+                            continue
+                        kind = evt.get("type", "")
+                        obj = evt.get("object", {})
+                        if kind == "ERROR":
+                            # Usually a 410 Gone Status after etcd
+                            # compaction: the rv is stale.  Reset it so
+                            # the reconnect starts a fresh watch
+                            # instead of hot-looping on the same
+                            # stale version forever.
+                            rv = ""
+                            raise _WatchExpired()
+                        rv = (obj.get("metadata", {})
+                              .get("resourceVersion", rv))
+                        deliver(kind, obj)
+                conn.close()
+                # Clean EOF: brief pause so a server that instantly
+                # closes idle watches cannot drive a hot reconnect
+                # loop.
+                self._stop.wait(0.2)
+            except _WatchExpired:
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+            except Exception:  # noqa: BLE001 — reconnect
+                self._stop.wait(1.0)
+
+    def close(self) -> None:
+        self._stop.set()

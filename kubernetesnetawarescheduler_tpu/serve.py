@@ -62,9 +62,16 @@ def main(argv=None) -> int:
         description=__doc__.splitlines()[0])
     ap.add_argument("--config", help="SchedulerConfig JSON/YAML path")
     ap.add_argument("--cluster", default="fake:128",
-                    help='"fake:<N>" (generated cluster) — the real '
-                         "API-server integration enters via the "
-                         "extender webhook, not this flag")
+                    help='"fake:<N>" (generated cluster), '
+                         '"incluster" (ServiceAccount, the reference\'s '
+                         "rest.InClusterConfig, scheduler.go:144), or "
+                         '"kube:<url>" (explicit API server) — the '
+                         "standalone-scheduler shape; the extender "
+                         "webhook path works regardless")
+    ap.add_argument("--kube-token", default="",
+                    help="bearer token for kube:<url> (testing)")
+    ap.add_argument("--kube-insecure", action="store_true",
+                    help="skip TLS verification for kube:<url>")
     ap.add_argument("--uds", default="/run/netaware/scorer.sock",
                     help="unix socket the native shim connects to")
     ap.add_argument("--grpc", default="",
@@ -77,6 +84,10 @@ def main(argv=None) -> int:
     ap.add_argument("--probe-period-s", type=float, default=60.0,
                     help="pairwise lat/bw probe cadence (the "
                          "reference's script.sh ran every 60s)")
+    ap.add_argument("--probe-targets", default="",
+                    help="JSON file {node name: iperf3 host} enabling "
+                         "real pairwise probing on kube/incluster "
+                         "clusters (the reference's netperfScript role)")
     ap.add_argument("--checkpoint-dir", default="",
                     help="restore on start, save on SIGTERM")
     ap.add_argument("--decision-log", default="",
@@ -90,11 +101,25 @@ def main(argv=None) -> int:
     cfg = load_config(args.config) if args.config else SchedulerConfig()
 
     kind, _, param = args.cluster.partition(":")
-    if kind != "fake":
-        ap.error(f"unknown cluster kind {kind!r} (only fake:<N>; real "
-                 "clusters integrate via the extender webhook)")
-    loop, lat_truth, bw_truth = build_fake(int(param or "128"), args.seed,
-                                           cfg)
+    lat_truth = bw_truth = None
+    if kind == "fake":
+        loop, lat_truth, bw_truth = build_fake(int(param or "128"),
+                                               args.seed, cfg)
+    elif kind in ("incluster", "kube"):
+        from kubernetesnetawarescheduler_tpu.core.loop import SchedulerLoop
+        from kubernetesnetawarescheduler_tpu.k8s.kubeclient import KubeClient
+
+        client = KubeClient(base_url=param or None,
+                            token=args.kube_token or None,
+                            insecure=args.kube_insecure)
+        # SchedulerLoop's Informer lists + subscribes nodes itself;
+        # resync() recovers pods already pending at startup (the
+        # re-list the reference lacked — ADD-only, scheduler.go:165).
+        loop = SchedulerLoop(client, cfg)
+        loop.informer.resync()
+    else:
+        ap.error(f"unknown cluster kind {kind!r} "
+                 "(fake:<N> | incluster | kube:<url>)")
 
     if args.checkpoint_dir and os.path.exists(
             os.path.join(args.checkpoint_dir, "meta.json")):
@@ -159,16 +184,31 @@ def main(argv=None) -> int:
     # reference's 60-second script.sh loop, as budgeted pair probing).
     # The fake cluster gets the FakeProber against ground truth; a real
     # fleet swaps in Iperf3Prober via the same Prober protocol.
-    if args.probe_period_s > 0:
+    prober = None
+    if lat_truth is not None:
+        from kubernetesnetawarescheduler_tpu.ingest.probe import FakeProber
+
+        names = list(loop.encoder._node_names)
+        prober = FakeProber(names, lat_truth, bw_truth, seed=args.seed)
+    elif args.probe_targets:
         from kubernetesnetawarescheduler_tpu.ingest.probe import (
-            FakeProber,
+            Iperf3Prober,
+        )
+
+        with open(args.probe_targets, encoding="utf-8") as fh:
+            host_of = json.load(fh)
+        names = [n for n in loop.encoder._node_names if n in host_of]
+        prober = Iperf3Prober(host_of)
+    else:
+        print("WARNING: no probe source (--probe-targets unset on a "
+              "real cluster): lat/bw matrices stay empty and scoring "
+              "degrades to metric-vote only", file=sys.stderr)
+
+    if args.probe_period_s > 0 and prober is not None:
+        from kubernetesnetawarescheduler_tpu.ingest.probe import (
             ProbeOrchestrator,
         )
-        names = list(loop.encoder._node_names)
-        orch = ProbeOrchestrator(
-            loop.encoder,
-            FakeProber(names, lat_truth, bw_truth, seed=args.seed),
-            names)
+        orch = ProbeOrchestrator(loop.encoder, prober, names)
 
         def probe_forever() -> None:
             while not stop.is_set():

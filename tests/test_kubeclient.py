@@ -1,0 +1,314 @@
+"""KubeClient against a stdlib fake API server.
+
+Covers the four client-go touchpoints the reference uses — watch pods
+(scheduler.go:164-174), list nodes (:240), POST Binding (:196-206),
+POST Event (:214-233) — plus quantity/annotation parsing, end-to-end
+through the real SchedulerLoop.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from kubernetesnetawarescheduler_tpu.k8s.kubeclient import (
+    KubeClient,
+    node_from_json,
+    parse_quantity,
+    pod_from_json,
+)
+
+
+def _pod_json(name: str, node: str = "", sched: str = "netAwareScheduler",
+              peers: dict | None = None, rv: str = "1") -> dict:
+    ann = {}
+    if peers:
+        ann["netaware.io/peers"] = json.dumps(peers)
+    return {
+        "metadata": {"name": name, "namespace": "default", "uid": name,
+                     "resourceVersion": rv, "annotations": ann},
+        "spec": {
+            "schedulerName": sched,
+            "nodeName": node,
+            "containers": [
+                {"resources": {"requests": {"cpu": "500m",
+                                            "memory": "1Gi"}}},
+                {"resources": {"requests": {"cpu": "1",
+                                            "memory": "512Mi"}}},
+            ],
+        },
+    }
+
+
+def _node_json(name: str, rv: str = "1") -> dict:
+    return {
+        "metadata": {"name": name, "resourceVersion": rv,
+                     "labels": {"topology.kubernetes.io/zone": "z0"}},
+        "spec": {},
+        "status": {
+            "allocatable": {"cpu": "8", "memory": "16Gi"},
+            "conditions": [{"type": "Ready", "status": "True"}],
+        },
+    }
+
+
+class FakeApiServer:
+    """Just enough of the v1 API: list/watch nodes+pods, binding,
+    events.  Watch streams emit whatever is in ``pod_events`` /
+    ``node_events`` then idle."""
+
+    def __init__(self):
+        self.bindings: list[dict] = []
+        self.events: list[dict] = []
+        self.nodes = [_node_json("n0"), _node_json("n1")]
+        self.pods = [_pod_json("pending-1")]
+        self.pod_events = [
+            {"type": "ADDED", "object": _pod_json("pending-1")}]
+        # If set, replaces pod_events after the first watch connection
+        # (lets tests model "stream errored, reconnect sees new data").
+        self.pod_events_next: list | None = None
+        self.node_events = [
+            {"type": "ADDED", "object": n} for n in self.nodes]
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"  # keep-alive: the client
+            # reuses one connection for batched bind/event POSTs
+
+            def log_message(self, *a):  # quiet
+                pass
+
+            def _json(self, obj, status=200):
+                body = json.dumps(obj).encode()
+                self.send_response(status)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _stream(self, events):
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Transfer-Encoding", "chunked")
+                self.end_headers()
+                try:
+                    for e in events:
+                        line = (json.dumps(e) + "\n").encode()
+                        self.wfile.write(f"{len(line):x}\r\n".encode()
+                                         + line + b"\r\n")
+                        self.wfile.flush()
+                    # idle until client drops (bounded for hygiene)
+                    time.sleep(2.0)
+                    self.wfile.write(b"0\r\n\r\n")
+                except (BrokenPipeError, ConnectionResetError):
+                    pass  # client hung up mid-stream (expected)
+
+            def do_GET(self):
+                if self.path.startswith("/api/v1/nodes"):
+                    if "watch=true" in self.path:
+                        self._stream(outer.node_events)
+                    else:
+                        self._json({"items": outer.nodes})
+                elif self.path.startswith("/api/v1/pods"):
+                    if "watch=true" in self.path:
+                        events = outer.pod_events
+                        if outer.pod_events_next is not None:
+                            outer.pod_events = outer.pod_events_next
+                            outer.pod_events_next = None
+                        self._stream(events)
+                    else:
+                        self._json({"items": outer.pods})
+                else:
+                    self._json({}, 404)
+
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length", 0))
+                body = json.loads(self.rfile.read(n) or b"{}")
+                if self.path.endswith("/binding"):
+                    outer.bindings.append({"path": self.path,
+                                           "body": body})
+                    self._json({}, 201)
+                elif "/events" in self.path:
+                    outer.events.append(body)
+                    self._json({}, 201)
+                else:
+                    self._json({}, 404)
+
+        self.server = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.thread = threading.Thread(target=self.server.serve_forever,
+                                       daemon=True)
+        self.thread.start()
+
+    @property
+    def url(self) -> str:
+        host, port = self.server.server_address
+        return f"http://{host}:{port}"
+
+    def stop(self):
+        self.server.shutdown()
+        self.server.server_close()
+
+
+@pytest.fixture()
+def apiserver():
+    s = FakeApiServer()
+    yield s
+    s.stop()
+
+
+def test_parse_quantity():
+    assert parse_quantity("500m") == 0.5
+    assert parse_quantity("2") == 2.0
+    assert parse_quantity("1Gi") == 2 ** 30
+    assert parse_quantity("1M") == 1e6
+    assert parse_quantity(3) == 3.0
+    assert parse_quantity("") == 0.0
+
+
+def test_pod_from_json_requests_and_peers():
+    pod = pod_from_json(_pod_json("p", peers={"q": 2.5}))
+    assert pod.requests["cpu"] == pytest.approx(1.5)
+    assert pod.requests["mem"] == pytest.approx(1.5)  # GiB
+    # Peer references are qualified with the pod's namespace so the
+    # cache/node_of keys cannot collide across namespaces.
+    assert pod.peers == {"default/q": 2.5}
+    assert pod.scheduler_name == "netAwareScheduler"
+
+
+def test_node_from_json():
+    node = node_from_json(_node_json("n0"))
+    assert node.capacity["cpu"] == 8.0
+    assert node.capacity["mem"] == pytest.approx(16.0)
+    assert node.ready and node.zone == "z0"
+
+
+def test_list_bind_event_roundtrip(apiserver):
+    c = KubeClient(base_url=apiserver.url, token="t")
+    nodes = c.list_nodes()
+    assert [n.name for n in nodes] == ["n0", "n1"]
+    pending = c.list_pending_pods()
+    assert [p.name for p in pending] == ["pending-1"]
+
+    from kubernetesnetawarescheduler_tpu.k8s.types import (
+        Binding,
+        scheduled_event,
+    )
+    c.bind(Binding(pod_name="pending-1", namespace="default",
+                   node_name="n0"))
+    assert apiserver.bindings[0]["body"]["target"]["name"] == "n0"
+    assert c.node_of("pending-1") == "n0"
+
+    c.create_event(scheduled_event(pending[0], "n0", "netAwareScheduler"))
+    assert apiserver.events[0]["reason"] == "Scheduled"
+    c.close()
+
+
+def test_watch_delivers_pending_pods(apiserver):
+    c = KubeClient(base_url=apiserver.url, token="t")
+    got: list = []
+    c.on_pod_added(got.append)
+    deadline = time.monotonic() + 5.0
+    while not got and time.monotonic() < deadline:
+        time.sleep(0.02)
+    assert got and got[0].name == "pending-1"
+    c.close()
+
+
+def test_scheduler_loop_against_fake_apiserver(apiserver):
+    """End-to-end: watch -> queue -> score -> bind against HTTP."""
+    import numpy as np
+
+    from kubernetesnetawarescheduler_tpu.config import SchedulerConfig
+    from kubernetesnetawarescheduler_tpu.core.loop import SchedulerLoop
+
+    cfg = SchedulerConfig(max_nodes=8, max_pods=4, max_peers=2)
+    c = KubeClient(base_url=apiserver.url, token="t")
+    loop = SchedulerLoop(c, cfg)
+    for node in c.list_nodes():
+        loop.encoder.upsert_node(node)
+        loop.encoder.update_metrics(node.name,
+                                    {"cpu": 10.0, "mem": 20.0})
+    deadline = time.monotonic() + 5.0
+    while len(loop.queue) == 0 and time.monotonic() < deadline:
+        time.sleep(0.02)
+    bound = loop.run_once()
+    assert bound == 1
+    assert apiserver.bindings and np.asarray(True)  # bound via HTTP
+    c.close()
+
+
+def test_deliver_pod_release_dedup():
+    """Terminal-phase MODIFIED releases once; the later DELETED event
+    must not deliver a second release."""
+    c = KubeClient(base_url="http://127.0.0.1:1", token="t")
+    gone: list = []
+    c._deleted_handlers.append(gone.append)
+
+    bound = _pod_json("done-1", node="n0")
+    bound["status"] = {"phase": "Succeeded"}
+    c._deliver_pod("ADDED", _pod_json("done-1", node="n0"))
+    c._deliver_pod("MODIFIED", bound)
+    assert len(gone) == 1
+    c._deliver_pod("MODIFIED", bound)   # duplicate terminal event
+    assert len(gone) == 1
+    c._deliver_pod("DELETED", bound)    # after terminal: no re-release
+    assert len(gone) == 1
+    # Delete-while-running releases exactly once.
+    c._deliver_pod("ADDED", _pod_json("run-1", node="n1"))
+    c._deliver_pod("DELETED", _pod_json("run-1", node="n1"))
+    assert len(gone) == 2
+    assert not c._released_uids  # bounded: drained by DELETED
+    c.close()
+
+
+def test_watch_error_event_resets_resource_version(apiserver):
+    """A 410-style ERROR watch event must reset the resourceVersion so
+    the reconnect starts fresh instead of hot-looping."""
+    apiserver.pod_events = [
+        {"type": "ERROR",
+         "object": {"kind": "Status", "code": 410}},
+    ]
+    apiserver.pod_events_next = [
+        {"type": "ADDED", "object": _pod_json("pending-1", rv="7")},
+    ]
+    c = KubeClient(base_url=apiserver.url, token="t")
+    got: list = []
+    c.on_pod_added(got.append)
+    deadline = time.monotonic() + 5.0
+    while not got and time.monotonic() < deadline:
+        time.sleep(0.02)
+    assert got and got[0].name == "pending-1"
+    c.close()
+
+
+def test_fakecluster_delete_releases_usage():
+    """End-to-end on FakeCluster: bind commits usage, delete releases
+    it, so churn does not wedge the scheduler."""
+    import numpy as np
+
+    from kubernetesnetawarescheduler_tpu.config import SchedulerConfig
+    from kubernetesnetawarescheduler_tpu.core.loop import SchedulerLoop
+    from kubernetesnetawarescheduler_tpu.k8s.client import FakeCluster
+    from kubernetesnetawarescheduler_tpu.k8s.types import Node, Pod
+
+    cfg = SchedulerConfig(max_nodes=8, max_pods=4, max_peers=2)
+    cluster = FakeCluster()
+    cluster.add_node(Node(name="n0", capacity={"cpu": 4.0}))
+    loop = SchedulerLoop(cluster, cfg)
+    loop.encoder.update_metrics("n0", {"cpu": 10.0})
+
+    # 4-cpu node; each pod asks 2 -> only 2 fit at once.
+    for gen in range(3):
+        cluster.add_pods([Pod(name=f"p{gen}-{i}", requests={"cpu": 2.0})
+                          for i in range(2)])
+        assert loop.run_until_drained() == 2
+        used = loop.encoder._used[0, 0]
+        assert used == pytest.approx(4.0)
+        for i in range(2):
+            cluster.delete_pod(f"p{gen}-{i}")
+        assert loop.encoder._used[0, 0] == pytest.approx(0.0)
+    assert np.asarray(True)

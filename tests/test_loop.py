@@ -103,20 +103,44 @@ def test_duplicate_delivery_is_deduped_and_bind_failure_survives():
     cluster.add_pod(pod)
     loop.informer._handle_pod(pod)  # simulated duplicate delivery
     assert loop.queue.duplicates == 1
-    # Force a bind failure mid-batch: externally bind one queued pod.
+    # Force a bind failure mid-batch: externally bind one queued pod
+    # to a node the scheduler cannot have chosen (registered in the
+    # API server but never announced to the informer/encoder), so the
+    # 409 cannot be healed as "our own bind landed".
     victim = Pod(name="raced", requests={"cpu": 0.1})
     other = Pod(name="other", requests={"cpu": 0.1})
     cluster.add_pod(victim)
     cluster.add_pod(other)
-    from kubernetesnetawarescheduler_tpu.k8s.types import Binding
+    from kubernetesnetawarescheduler_tpu.k8s.types import Binding, Node
+    with cluster._lock:
+        cluster._nodes["hidden"] = Node(name="hidden",
+                                        capacity={"cpu": 64.0})
     cluster.bind(Binding(pod_name="raced", namespace="default",
-                         node_name=cluster.list_nodes()[0].name))
+                         node_name="hidden"))
     loop.run_until_drained()
     assert loop.bind_failures == 1
     assert cluster.node_of("dup") != ""
     assert cluster.node_of("other") != ""
     rejects = [e for e in cluster.events if "bind rejected" in e.message]
     assert len(rejects) == 1
+
+
+def test_conflicting_bind_to_same_node_is_healed():
+    """A 409 where the pod already sits on the node we chose (our own
+    bind applied but unacknowledged, or a duplicate delivery) counts
+    as scheduled, not as a failure."""
+    cluster, loop = make_loop(num_nodes=1)  # one node: choice is forced
+    pod = Pod(name="dup-bind", requests={"cpu": 0.1})
+    cluster.add_pod(pod)
+    from kubernetesnetawarescheduler_tpu.k8s.types import Binding
+    node = cluster.list_nodes()[0].name
+    cluster.bind(Binding(pod_name="dup-bind", namespace="default",
+                         node_name=node))
+    loop.run_until_drained()
+    assert loop.bind_failures == 0
+    assert loop.scheduled == 1
+    assert not [e for e in cluster.events
+                if "bind rejected" in e.message]
 
 
 def test_peer_traffic_pulls_colocalization():

@@ -65,3 +65,21 @@ def test_serve_answers_uds_requests(tmp_path):
     assert b"netaware_nodes_ready" in metrics
     # Daemon thread dies with the test process; no clean shutdown
     # needed for this smoke check.
+
+
+def test_serve_against_kube_apiserver(tmp_path):
+    """The standalone-daemon shape: --cluster kube:<url> drives the
+    full watch -> queue -> score -> bind loop over HTTP."""
+    from tests.test_kubeclient import FakeApiServer
+
+    api = FakeApiServer()
+    try:
+        uds = str(tmp_path / "scorer.sock")
+        rc = serve.main(["--cluster", f"kube:{api.url}",
+                         "--kube-token", "t", "--uds", uds, "--once"])
+        assert rc == 0
+        # The pending pod listed at startup was scheduled and bound.
+        assert api.bindings
+        assert api.bindings[0]["body"]["target"]["kind"] == "Node"
+    finally:
+        api.stop()
