@@ -132,10 +132,12 @@ def save_checkpoint(path: str, encoder: Encoder) -> None:
                 "groups": dict(encoder.groups._bits),
             },
             # Usage ledger: without it a restored daemon could not
-            # release usage for pods bound before the restart.
+            # release usage for pods bound before the restart.  The
+            # commit stamp is not persisted — pre-restart commits are
+            # by definition older than any post-restart listing.
             "committed": {
-                uid: [idx, [float(x) for x in req]]
-                for uid, (idx, req) in encoder._committed.items()
+                uid: [rec[0], [float(x) for x in rec[1]]]
+                for uid, rec in encoder._committed.items()
             },
         }
     np.savez_compressed(os.path.join(path, "state.npz"), **arrays)
@@ -179,7 +181,7 @@ def load_checkpoint(path: str,
     for attr, table in meta["interners"].items():
         getattr(enc, attr)._bits = {k: int(v) for k, v in table.items()}
     enc._committed = {
-        uid: (int(idx), np.asarray(req, np.float32))
+        uid: (int(idx), np.asarray(req, np.float32), 0.0)
         for uid, (idx, req) in meta.get("committed", {}).items()}
     # Everything is freshly loaded: first snapshot() must upload all.
     for key in enc._dirty:

@@ -16,6 +16,7 @@ positions so feasibility checks are bitmask algebra on device.
 from __future__ import annotations
 
 import threading
+import time
 from typing import Callable, Iterable, Mapping, Sequence
 
 import jax.numpy as jnp
@@ -275,7 +276,8 @@ class Encoder:
                     del self._early_releases[pod.uid]
                     keep[i] = False
                     continue
-                self._committed[pod.uid] = (int(idx[i]), reqs[i].copy())
+                self._committed[pod.uid] = (int(idx[i]), reqs[i].copy(),
+                                            time.monotonic())
             np.add.at(self._used, idx[keep], reqs[keep])
             for i, pod in enumerate(pods):
                 if not keep[i]:
@@ -308,9 +310,39 @@ class Encoder:
                     del self._early_releases[
                         next(iter(self._early_releases))]
                 return
-            idx, req = rec
+            idx, req = rec[0], rec[1]
             self._used[idx] = np.maximum(self._used[idx] - req, 0.0)
             self._dirty["alloc"] = True
+
+    def reconcile_committed(self, alive_uids,
+                            listed_at: float | None = None) -> int:
+        """Release every ledger entry whose pod no longer exists.
+
+        The watch cannot deliver deletions that happened while the
+        daemon was down (a restored checkpoint carries their committed
+        usage forever otherwise); a periodic listing of live pods
+        closes that gap.  ``listed_at`` (``time.monotonic()`` taken
+        BEFORE the listing request) guards the race where a pod is
+        committed after the listing was snapshotted — entries stamped
+        later are skipped this round.  Returns entries released."""
+        alive = set(alive_uids)
+        cutoff = float("inf") if listed_at is None else listed_at
+        released = 0
+        with self._lock:
+            stale = [u for u, rec in self._committed.items()
+                     if u not in alive and rec[2] < cutoff]
+            for uid in stale:
+                idx, req, _ = self._committed.pop(uid)
+                self._used[idx] = np.maximum(self._used[idx] - req, 0.0)
+                released += 1
+            # Early-release markers for pods that no longer exist can
+            # never be consumed by a commit — drop them.
+            for uid in [u for u in self._early_releases
+                        if u not in alive]:
+                del self._early_releases[uid]
+            if released:
+                self._dirty["alloc"] = True
+        return released
 
     # -- snapshot -----------------------------------------------------
 

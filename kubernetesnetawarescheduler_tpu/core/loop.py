@@ -228,18 +228,31 @@ class SchedulerLoop:
             total += n
         return total
 
+    def reconcile_usage(self) -> int:
+        """Release ledger entries for pods that no longer exist
+        (deleted while the daemon was down, or whose watch event was
+        lost).  No-op for clients that cannot list all pods."""
+        listed_at = time.monotonic()
+        pods = self.client.list_all_pods()
+        if pods is None:
+            return 0
+        return self.encoder.reconcile_committed(
+            (p.uid for p in pods), listed_at)
+
     def run_forever(self, poll_s: float = 0.05,
                     resync_every_s: float = 60.0) -> None:
         """The reference's ``wait.Until(s.Schedule, 0, quit)``
         (scheduler.go:140), batched, plus a periodic pending-pod
         resync so pods lost to drops/transient failures are recovered
-        (the reference stranded them, scheduler.go:165-173)."""
+        (the reference stranded them, scheduler.go:165-173) and a
+        usage-ledger reconcile against the live pod listing."""
         last_resync = time.monotonic()
         while True:
             if self.run_once(timeout=poll_s) == 0:
                 time.sleep(0.0)
             if time.monotonic() - last_resync >= resync_every_s:
                 self.informer.resync()
+                self.reconcile_usage()
                 last_resync = time.monotonic()
 
 

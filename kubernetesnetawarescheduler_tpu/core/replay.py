@@ -70,43 +70,24 @@ class PodStream:
         return self.req.shape[0]
 
 
-@partial(jax.jit, static_argnames=("cfg", "method"))
-def replay_stream(state: ClusterState, stream: PodStream,
-                  cfg: SchedulerConfig, method: str = "parallel"
-                  ) -> tuple[jax.Array, ClusterState]:
-    """Run the full stream through score→assign→commit on device.
+def _make_step(state: ClusterState, cfg: SchedulerConfig, method: str,
+               s_total: int, static):
+    """The per-batch scan body shared by every replay variant
+    (monolithic, chunked/pipelined, mesh-sharded).
 
-    Returns ``(assignment i32[S], final_state)``; one dispatch, one
-    fetch.  ``stream`` length must be a multiple of ``cfg.max_pods``
-    (pad with invalid pods via :func:`pad_stream`).
+    Carry is ``(used, group_bits, resident_anti, node_of_pod)`` — only
+    the placement-mutated arrays; the big immutable state (the N×N
+    lat/bw matrices, metrics, capacities, label/taint bits) is closed
+    over, so XLA keeps one HBM copy instead of round-tripping ~200 MB
+    of carry per step.  ``x`` is ``(batch_index, stream_slice)``.
     """
     assign_fn = {"greedy": assign_greedy,
                  "parallel": assign_parallel}[method]
-    # Batch-invariant node scores (metric vote + N×N net-desirability):
-    # computed ONCE here, closed over by the scan body, instead of
-    # re-normalizing the N×N matrices inside every step (don't rely on
-    # XLA's loop-invariant code motion for ~100 MB intermediates).
-    static = static_node_scores(state, cfg)
-    s_total = stream.num_pods
     batch = cfg.max_pods
-    if s_total % batch != 0:
-        raise ValueError(
-            f"stream length {s_total} not a multiple of max_pods={batch}")
-    nb = s_total // batch
-
-    def fold(x):
-        return x.reshape((nb, batch) + x.shape[1:])
-
-    xs = (jnp.arange(nb, dtype=jnp.int32),
-          jax.tree_util.tree_map(fold, stream))
 
     def step(carry, x):
         used, group_bits, resident_anti, node_of_pod = carry
         i, sl = x
-        # Only the three placement-mutated arrays ride the scan carry;
-        # the big immutable state (the N×N lat/bw matrices, metrics,
-        # capacities, label/taint bits) is closed over, so XLA keeps one
-        # HBM copy instead of round-tripping ~200 MB of carry per step.
         st = state.replace(used=used, group_bits=group_bits,
                            resident_anti=resident_anti)
         # Resolve in-stream peers against assignments made so far; a
@@ -129,6 +110,34 @@ def replay_stream(state: ClusterState, stream: PodStream,
         return (st.used, st.group_bits, st.resident_anti,
                 node_of_pod), assignment
 
+    return step
+
+
+def _check_stream(stream: PodStream, cfg: SchedulerConfig) -> int:
+    s_total = stream.num_pods
+    if s_total % cfg.max_pods != 0:
+        raise ValueError(f"stream length {s_total} not a multiple of "
+                         f"max_pods={cfg.max_pods}")
+    return s_total // cfg.max_pods
+
+
+def replay_folded(state: ClusterState, folded, cfg: SchedulerConfig,
+                  method: str = "parallel"
+                  ) -> tuple[jax.Array, ClusterState]:
+    """Scan over a pre-folded ``[NB, batch, ...]`` stream pytree.
+    Traceable core of :func:`replay_stream`; also jitted directly by
+    the mesh-sharded replay (which must keep the folded layout — a
+    flat reshape of a dp-sharded batch axis would force a reshard)."""
+    nb = jax.tree_util.tree_leaves(folded)[0].shape[0]
+    batch = cfg.max_pods
+    s_total = nb * batch
+    # Batch-invariant node scores (metric vote + N×N net-desirability):
+    # computed ONCE here, closed over by the scan body, instead of
+    # re-normalizing the N×N matrices inside every step (don't rely on
+    # XLA's loop-invariant code motion for ~100 MB intermediates).
+    static = static_node_scores(state, cfg)
+    step = _make_step(state, cfg, method, s_total, static)
+    xs = (jnp.arange(nb, dtype=jnp.int32), folded)
     init = (state.used, state.group_bits, state.resident_anti,
             jnp.full((s_total,), UNASSIGNED, jnp.int32))
     (used, group_bits, resident_anti, _), assignments = jax.lax.scan(
@@ -136,6 +145,24 @@ def replay_stream(state: ClusterState, stream: PodStream,
     final_state = state.replace(used=used, group_bits=group_bits,
                                 resident_anti=resident_anti)
     return assignments.reshape(-1), final_state
+
+
+@partial(jax.jit, static_argnames=("cfg", "method"))
+def replay_stream(state: ClusterState, stream: PodStream,
+                  cfg: SchedulerConfig, method: str = "parallel"
+                  ) -> tuple[jax.Array, ClusterState]:
+    """Run the full stream through score→assign→commit on device.
+
+    Returns ``(assignment i32[S], final_state)``; one dispatch, one
+    fetch.  ``stream`` length must be a multiple of ``cfg.max_pods``
+    (pad with invalid pods via :func:`pad_stream`).
+    """
+    nb = _check_stream(stream, cfg)
+    batch = cfg.max_pods
+
+    folded = jax.tree_util.tree_map(
+        lambda x: x.reshape((nb, batch) + x.shape[1:]), stream)
+    return replay_folded(state, folded, cfg, method)
 
 
 @partial(jax.jit, static_argnames=("cfg", "method", "chunk_batches"))
@@ -147,36 +174,11 @@ def _replay_chunk(state: ClusterState, static, carry, folded,
     shares one executable).  ``carry`` is the placement-mutated state
     plus the *global* ``node_of_pod`` vector; ``folded`` is the whole
     stream pre-folded to ``[NB, batch, ...]`` and device-resident."""
-    assign_fn = {"greedy": assign_greedy,
-                 "parallel": assign_parallel}[method]
-    batch = cfg.max_pods
-
     xs_stream = jax.tree_util.tree_map(
         lambda x: jax.lax.dynamic_slice_in_dim(
             x, chunk_start, chunk_batches, 0), folded)
     batch_ids = chunk_start + jnp.arange(chunk_batches, dtype=jnp.int32)
-
-    def step(carry, x):
-        used, group_bits, resident_anti, node_of_pod = carry
-        i, sl = x
-        st = state.replace(used=used, group_bits=group_bits,
-                           resident_anti=resident_anti)
-        pp = sl.peer_pods
-        from_stream = node_of_pod[jnp.clip(pp, 0, s_total - 1)]
-        peers = jnp.where(pp >= 0, from_stream, sl.peer_nodes)
-        pods = PodBatch(
-            req=sl.req, peers=peers, peer_traffic=sl.peer_traffic,
-            tol_bits=sl.tol_bits, sel_bits=sl.sel_bits,
-            affinity_bits=sl.affinity_bits, anti_bits=sl.anti_bits,
-            group_bit=sl.group_bit, priority=sl.priority,
-            pod_valid=sl.pod_valid)
-        assignment = assign_fn(st, pods, cfg, static)
-        st = commit_assignments(st, pods, assignment)
-        node_of_pod = jax.lax.dynamic_update_slice_in_dim(
-            node_of_pod, assignment, i * batch, 0)
-        return (st.used, st.group_bits, st.resident_anti,
-                node_of_pod), assignment
-
+    step = _make_step(state, cfg, method, s_total, static)
     carry, assignments = jax.lax.scan(step, carry, (batch_ids, xs_stream))
     return carry, assignments.reshape(-1)
 

@@ -82,6 +82,14 @@ class ClusterClient:
         on ADD events, scheduler.go:165-173)."""
         raise NotImplementedError
 
+    def list_all_pods(self) -> Sequence[Pod] | None:
+        """Every pod the API server knows (any phase), or None when
+        the client cannot provide it.  Drives usage-ledger
+        reconciliation: pods deleted while the daemon was down emit no
+        watch event, so their committed usage must be detected by
+        comparison against this listing."""
+        return None
+
     def node_of(self, pod_name: str) -> str:
         """Node a pod is bound to ("" if pending).  Part of the core
         contract: peer-traffic scoring resolves placed peers through
@@ -201,6 +209,10 @@ class FakeCluster(ClusterClient):
     def list_pending_pods(self) -> Sequence[Pod]:
         with self._lock:
             return [p for p in self._pods.values() if not p.node_name]
+
+    def list_all_pods(self) -> Sequence[Pod]:
+        with self._lock:
+            return list(self._pods.values())
 
     # -- introspection ------------------------------------------------
 

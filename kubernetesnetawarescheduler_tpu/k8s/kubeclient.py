@@ -69,28 +69,35 @@ ANN_BANDWIDTH = "netaware.io/bandwidth-gbps"
 # -- k8s quantity parsing ---------------------------------------------
 
 _SUFFIX = {
-    "k": 1e3, "M": 1e6, "G": 1e9, "T": 1e12, "P": 1e15, "E": 1e18,
+    "n": 1e-9, "u": 1e-6, "k": 1e3,
+    "M": 1e6, "G": 1e9, "T": 1e12, "P": 1e15, "E": 1e18,
     "Ki": 2 ** 10, "Mi": 2 ** 20, "Gi": 2 ** 30, "Ti": 2 ** 40,
     "Pi": 2 ** 50, "Ei": 2 ** 60,
 }
 
 
 def parse_quantity(q: str | int | float) -> float:
-    """Parse a k8s resource quantity (``500m``, ``2``, ``1Gi``) to a
-    float in base units (cores for cpu, bytes for memory)."""
+    """Parse a k8s resource quantity (``500m``, ``2``, ``1Gi``,
+    ``100n``) to a float in base units (cores for cpu, bytes for
+    memory).  Unparseable input yields 0.0 — the watch is
+    cluster-wide, and one pod with an exotic quantity must degrade
+    only itself, not crash event delivery."""
     if isinstance(q, (int, float)):
         return float(q)
     s = str(q).strip()
     if not s:
         return 0.0
-    if s.endswith("m"):
-        return float(s[:-1]) / 1000.0
-    for suf in ("Ki", "Mi", "Gi", "Ti", "Pi", "Ei"):
-        if s.endswith(suf):
-            return float(s[: -len(suf)]) * _SUFFIX[suf]
-    if s[-1] in _SUFFIX:
-        return float(s[:-1]) * _SUFFIX[s[-1]]
-    return float(s)
+    try:
+        if s.endswith("m"):
+            return float(s[:-1]) / 1000.0
+        for suf in ("Ki", "Mi", "Gi", "Ti", "Pi", "Ei"):
+            if s.endswith(suf):
+                return float(s[: -len(suf)]) * _SUFFIX[suf]
+        if s[-1] in _SUFFIX:
+            return float(s[:-1]) * _SUFFIX[s[-1]]
+        return float(s)
+    except ValueError:
+        return 0.0
 
 
 def _flatten(m: Mapping[str, str] | None) -> frozenset[str]:
@@ -220,10 +227,16 @@ class KubeClient(ClusterClient):
                     "no base_url given")
             base_url = f"https://{host}:{port}"
         self.base_url = base_url.rstrip("/")
+        # Bound ServiceAccount tokens rotate (~1h expiry; the kubelet
+        # rewrites the mounted file): when no explicit token is given,
+        # remember the path and re-read periodically instead of
+        # pinning the boot-time value (client-go re-reads per request).
+        self._token_path = ""
+        self._token_read_at = 0.0
         if token is None:
-            tok_path = os.path.join(SA_DIR, "token")
-            token = (open(tok_path).read().strip()
-                     if os.path.exists(tok_path) else "")
+            self._token_path = os.path.join(SA_DIR, "token")
+            token = (open(self._token_path).read().strip()
+                     if os.path.exists(self._token_path) else "")
         self._token = token
         scheme, rest = self.base_url.split("://", 1)
         self._host = rest
@@ -277,6 +290,14 @@ class KubeClient(ClusterClient):
         return http.client.HTTPConnection(self._host, timeout=t)
 
     def _headers(self, extra: Mapping[str, str] | None = None) -> dict:
+        if self._token_path:
+            now = time.monotonic()
+            if now - self._token_read_at > 60.0:
+                self._token_read_at = now
+                try:
+                    self._token = open(self._token_path).read().strip()
+                except OSError:
+                    pass  # keep the last-known token
         h = {"Accept": "application/json"}
         if self._token:
             h["Authorization"] = f"Bearer {self._token}"
@@ -343,6 +364,10 @@ class KubeClient(ClusterClient):
             for p in pods:
                 self._pods[self.pod_key(p.namespace, p.name)] = p
         return pods
+
+    def list_all_pods(self) -> Sequence[Pod]:
+        obj = self._request("GET", "/api/v1/pods")
+        return [pod_from_json(it) for it in obj.get("items", [])]
 
     @staticmethod
     def _binding_body(binding: Binding) -> dict:
@@ -547,6 +572,7 @@ class KubeClient(ClusterClient):
         events)."""
         rv = ""
         while not self._stop.is_set():
+            conn = None
             try:
                 # Watches idle legitimately between cluster events: a
                 # request-sized read timeout would kill every quiet
@@ -588,19 +614,25 @@ class KubeClient(ClusterClient):
                             raise _WatchExpired()
                         rv = (obj.get("metadata", {})
                               .get("resourceVersion", rv))
-                        deliver(kind, obj)
+                        try:
+                            deliver(kind, obj)
+                        except Exception:  # noqa: BLE001 — one poison
+                            continue  # object must not drop the rest
                 conn.close()
                 # Clean EOF: brief pause so a server that instantly
                 # closes idle watches cannot drive a hot reconnect
                 # loop.
                 self._stop.wait(0.2)
             except _WatchExpired:
-                try:
-                    conn.close()
-                except OSError:
-                    pass
+                pass  # reconnect immediately with a fresh rv
             except Exception:  # noqa: BLE001 — reconnect
                 self._stop.wait(1.0)
+            finally:
+                if conn is not None:
+                    try:
+                        conn.close()
+                    except OSError:
+                        pass
 
     def close(self) -> None:
         self._stop.set()

@@ -115,5 +115,49 @@ def replicated(mesh: Mesh):
     return NamedSharding(mesh, P())
 
 
+def sharded_replay_stream(state, stream, cfg: SchedulerConfig, mesh: Mesh,
+                          method: str = "parallel"):
+    """Whole-workload device-resident replay over the mesh: the
+    multi-chip form of :func:`~..core.replay.replay_stream`.
+
+    One dispatch; the ``lax.scan`` carries the tp-sharded cluster
+    state while each step's pod batch is dp-sharded, so every chip
+    holds only its node shard of the ``N x N`` matrices (the HBM scale
+    path) and GSPMD rides ICI for the all-gathers the score matmul and
+    winner-per-node reduction need.  Returns ``(assignment i32[S],
+    final_state)`` exactly like the single-chip replay (the equality
+    is tested on the 8-virtual-device CPU mesh).
+    """
+    from kubernetesnetawarescheduler_tpu.core.replay import replay_folded
+
+    # Pre-fold host-side to [NB, batch, ...] and shard the batch axis
+    # on dp (the scan walks the leading NB axis; replay_folded keeps
+    # the folded layout so the dp sharding survives the whole scan).
+    s_total = stream.num_pods
+    batch = cfg.max_pods
+    if s_total % batch != 0:
+        raise ValueError(
+            f"stream length {s_total} not a multiple of max_pods={batch}")
+    nb = s_total // batch
+
+    def fold_spec(x):
+        extra = (None,) * (x.ndim - 2)
+        return NamedSharding(mesh, P(None, "dp", *extra))
+
+    folded = jax.tree_util.tree_map(
+        lambda x: x.reshape((nb, batch) + x.shape[1:]), stream)
+    folded = jax.device_put(
+        folded, jax.tree_util.tree_map(fold_spec, folded))
+    state = jax.device_put(state, state_sharding(mesh))
+
+    fn = jax.jit(
+        partial(replay_folded, cfg=cfg, method=method),
+        in_shardings=(state_sharding(mesh),
+                      jax.tree_util.tree_map(fold_spec, folded)),
+        out_shardings=(replicated(mesh), state_sharding(mesh)),
+    )
+    return fn(state, folded)
+
+
 __all__ = ["make_mesh", "state_sharding", "pods_sharding", "place",
-           "sharded_schedule_step", "replicated"]
+           "sharded_schedule_step", "sharded_replay_stream", "replicated"]

@@ -231,12 +231,20 @@ def main(argv=None) -> int:
     for t in threads:
         t.start()
 
-    # Main serving loop: drain any informer-fed queue work (fake
-    # cluster path); extender-path requests are served by the UDS/gRPC
-    # threads directly.
+    # Main serving loop: drain any informer-fed queue work; extender-
+    # path requests are served by the UDS/gRPC threads directly.
+    # Every ~60s: resync pending pods (restart/drop recovery) and
+    # reconcile the usage ledger against the live pod listing (pods
+    # deleted while we were down emit no watch event).
+    last_maint = time.monotonic()
     try:
+        loop.reconcile_usage()
         while not stop.is_set():
             loop.run_once(timeout=0.25)
+            if time.monotonic() - last_maint >= 60.0:
+                loop.informer.resync()
+                loop.reconcile_usage()
+                last_maint = time.monotonic()
             if args.once:
                 break
     finally:

@@ -312,3 +312,38 @@ def test_fakecluster_delete_releases_usage():
             cluster.delete_pod(f"p{gen}-{i}")
         assert loop.encoder._used[0, 0] == pytest.approx(0.0)
     assert np.asarray(True)
+
+
+def test_parse_quantity_small_suffixes_and_garbage():
+    assert parse_quantity("100n") == pytest.approx(1e-7)
+    assert parse_quantity("250u") == pytest.approx(2.5e-4)
+    assert parse_quantity("definitely-not-a-quantity") == 0.0
+
+
+def test_reconcile_releases_orphaned_usage():
+    """Usage committed for a pod that vanished while the daemon was
+    down (no watch event) is released by reconciliation; usage for
+    live pods survives."""
+    from kubernetesnetawarescheduler_tpu.config import SchedulerConfig
+    from kubernetesnetawarescheduler_tpu.core.loop import SchedulerLoop
+    from kubernetesnetawarescheduler_tpu.k8s.client import FakeCluster
+    from kubernetesnetawarescheduler_tpu.k8s.types import Node, Pod
+
+    cfg = SchedulerConfig(max_nodes=8, max_pods=4, max_peers=2)
+    cluster = FakeCluster()
+    cluster.add_node(Node(name="n0", capacity={"cpu": 8.0}))
+    loop = SchedulerLoop(cluster, cfg)
+    loop.encoder.update_metrics("n0", {"cpu": 10.0})
+    cluster.add_pods([Pod(name="live", requests={"cpu": 2.0}),
+                      Pod(name="ghost", requests={"cpu": 3.0})])
+    assert loop.run_until_drained() == 2
+    assert loop.encoder._used[0, 0] == pytest.approx(5.0)
+    # Simulate a deletion the watch never saw (daemon was down).
+    with cluster._lock:
+        del cluster._pods["ghost"]
+    released = loop.reconcile_usage()
+    assert released == 1
+    assert loop.encoder._used[0, 0] == pytest.approx(2.0)
+    # Idempotent; live pod untouched.
+    assert loop.reconcile_usage() == 0
+    assert loop.encoder._used[0, 0] == pytest.approx(2.0)

@@ -61,3 +61,49 @@ def test_sharded_greedy_matches():
     s_state, s_pods = place(mesh, state, pods)
     got, _ = step(s_state, s_pods)
     np.testing.assert_array_equal(np.asarray(got), want)
+
+
+def test_sharded_replay_matches_single_device():
+    """The mesh-sharded whole-workload replay must equal the
+    single-device replay: same assignments, same final usage."""
+    import jax.numpy as jnp
+
+    from kubernetesnetawarescheduler_tpu.core.replay import (
+        PodStream,
+        replay_stream,
+    )
+    from kubernetesnetawarescheduler_tpu.parallel.sharding import (
+        sharded_replay_stream,
+    )
+
+    state, pods = make(2)
+    rng = np.random.default_rng(7)
+    s = CFG.max_pods * 4
+    n = CFG.max_nodes
+    k = CFG.max_peers
+    stream = PodStream(
+        req=jnp.asarray(rng.uniform(0.05, 0.5, (s, 3)).astype(np.float32)),
+        peer_pods=jnp.asarray(
+            np.where(rng.random((s, k)) < 0.2,
+                     rng.integers(0, s, (s, k)), -1).astype(np.int32)),
+        peer_nodes=jnp.asarray(
+            np.where(rng.random((s, k)) < 0.2,
+                     rng.integers(0, n, (s, k)), -1).astype(np.int32)),
+        peer_traffic=jnp.asarray(
+            rng.uniform(0, 3, (s, k)).astype(np.float32)),
+        tol_bits=jnp.zeros((s,), jnp.uint32),
+        sel_bits=jnp.zeros((s,), jnp.uint32),
+        affinity_bits=jnp.zeros((s,), jnp.uint32),
+        anti_bits=jnp.zeros((s,), jnp.uint32),
+        group_bit=jnp.zeros((s,), jnp.uint32),
+        priority=jnp.asarray(rng.uniform(0, 5, (s,)).astype(np.float32)),
+        pod_valid=jnp.ones((s,), bool),
+    )
+    want_assign, want_state = replay_stream(state, stream, CFG, "parallel")
+    mesh = make_mesh(2, 4)
+    got_assign, got_state = sharded_replay_stream(state, stream, CFG,
+                                                  mesh, "parallel")
+    np.testing.assert_array_equal(np.asarray(got_assign),
+                                  np.asarray(want_assign))
+    np.testing.assert_allclose(np.asarray(got_state.used),
+                               np.asarray(want_state.used), atol=1e-4)
