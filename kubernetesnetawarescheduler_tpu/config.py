@@ -168,6 +168,16 @@ class SchedulerConfig:
     # interpreted off-TPU).
     score_backend: str = "xla"
 
+    # Priority preemption: when a pod is unschedulable, evict the
+    # cheapest set of strictly-lower-priority pods from the best node
+    # and requeue it (core/preempt.py).  Off by default — eviction is
+    # a destructive action a deployment must opt into.
+    enable_preemption: bool = False
+
+    # Preemption attempts per pod before it is left Pending with a
+    # FailedScheduling event (guards against plan/evict/lose loops).
+    max_preemption_attempts: int = 2
+
     def __post_init__(self) -> None:
         if self.max_nodes <= 0 or self.max_pods <= 0 or self.max_peers <= 0:
             raise ValueError("shape limits must be positive")

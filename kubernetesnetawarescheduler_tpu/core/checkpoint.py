@@ -136,7 +136,8 @@ def save_checkpoint(path: str, encoder: Encoder) -> None:
             # commit stamp is not persisted — pre-restart commits are
             # by definition older than any post-restart listing.
             "committed": {
-                uid: [rec[0], [float(x) for x in rec[1]]]
+                uid: [rec.node, [float(x) for x in rec.req],
+                      rec.priority, rec.namespace, rec.name]
                 for uid, rec in encoder._committed.items()
             },
         }
@@ -180,9 +181,18 @@ def load_checkpoint(path: str,
     enc._node_index = {n: i for i, n in enumerate(enc._node_names)}
     for attr, table in meta["interners"].items():
         getattr(enc, attr)._bits = {k: int(v) for k, v in table.items()}
-    enc._committed = {
-        uid: (int(idx), np.asarray(req, np.float32), 0.0)
-        for uid, (idx, req) in meta.get("committed", {}).items()}
+    from kubernetesnetawarescheduler_tpu.core.encode import CommitRecord
+
+    def _rec(entry) -> CommitRecord:
+        idx, req = entry[0], entry[1]
+        prio = float(entry[2]) if len(entry) > 2 else 0.0
+        ns = entry[3] if len(entry) > 3 else "default"
+        name = entry[4] if len(entry) > 4 else ""
+        return CommitRecord(int(idx), np.asarray(req, np.float32), 0.0,
+                            prio, ns, name)
+
+    enc._committed = {uid: _rec(entry)
+                      for uid, entry in meta.get("committed", {}).items()}
     # Everything is freshly loaded: first snapshot() must upload all.
     for key in enc._dirty:
         enc._dirty[key] = True

@@ -17,7 +17,7 @@ from __future__ import annotations
 
 import threading
 import time
-from typing import Callable, Iterable, Mapping, Sequence
+from typing import Callable, Iterable, Mapping, NamedTuple, Sequence
 
 import jax.numpy as jnp
 import numpy as np
@@ -102,6 +102,19 @@ def _requests_vector(requests: Mapping[str, float], r: int) -> np.ndarray:
     return vec
 
 
+class CommitRecord(NamedTuple):
+    """One usage-ledger entry: everything needed to reverse a commit
+    (node + request vector), reconcile it (stamp), and consider the
+    pod as a preemption victim (priority + identity)."""
+
+    node: int
+    req: np.ndarray
+    stamp: float
+    priority: float
+    namespace: str
+    name: str
+
+
 class Encoder:
     """Owns the staging buffers and the node/pod index maps."""
 
@@ -128,12 +141,13 @@ class Encoder:
         self._group_bits = np.zeros((n,), np.uint32)
         self._resident_anti = np.zeros((n,), np.uint32)
 
-        # Usage ledger: uid -> (node index, committed request vector);
-        # release() reverses exactly what commit recorded (see the
-        # allocation section).  _early_releases marks pods whose
-        # termination beat their commit — an insertion-ordered dict
-        # used as a set, so bounding evicts oldest-first (release()).
-        self._committed: dict[str, tuple[int, np.ndarray]] = {}
+        # Usage ledger: uid -> CommitRecord; release() reverses exactly
+        # what commit recorded (see the allocation section), and the
+        # preemption planner reads it to find victims.  _early_releases
+        # marks pods whose termination beat their commit — an
+        # insertion-ordered dict used as a set, so bounding evicts
+        # oldest-first (release()).
+        self._committed: dict[str, CommitRecord] = {}
         self._early_releases: dict[str, None] = {}
 
         # Dirty tracking per transfer group, so snapshot() uploads the
@@ -276,8 +290,9 @@ class Encoder:
                     del self._early_releases[pod.uid]
                     keep[i] = False
                     continue
-                self._committed[pod.uid] = (int(idx[i]), reqs[i].copy(),
-                                            time.monotonic())
+                self._committed[pod.uid] = CommitRecord(
+                    int(idx[i]), reqs[i].copy(), time.monotonic(),
+                    float(pod.priority), pod.namespace, pod.name)
             np.add.at(self._used, idx[keep], reqs[keep])
             for i, pod in enumerate(pods):
                 if not keep[i]:
@@ -310,8 +325,8 @@ class Encoder:
                     del self._early_releases[
                         next(iter(self._early_releases))]
                 return
-            idx, req = rec[0], rec[1]
-            self._used[idx] = np.maximum(self._used[idx] - req, 0.0)
+            self._used[rec.node] = np.maximum(
+                self._used[rec.node] - rec.req, 0.0)
             self._dirty["alloc"] = True
 
     def reconcile_committed(self, alive_uids,
@@ -330,10 +345,11 @@ class Encoder:
         released = 0
         with self._lock:
             stale = [u for u, rec in self._committed.items()
-                     if u not in alive and rec[2] < cutoff]
+                     if u not in alive and rec.stamp < cutoff]
             for uid in stale:
-                idx, req, _ = self._committed.pop(uid)
-                self._used[idx] = np.maximum(self._used[idx] - req, 0.0)
+                rec = self._committed.pop(uid)
+                self._used[rec.node] = np.maximum(
+                    self._used[rec.node] - rec.req, 0.0)
                 released += 1
             # Early-release markers for pods that no longer exist can
             # never be consumed by a commit — drop them.

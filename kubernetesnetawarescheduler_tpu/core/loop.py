@@ -56,8 +56,10 @@ class SchedulerLoop:
         self.scheduled = 0
         self.unschedulable = 0
         self.bind_failures = 0
+        self.preemptions = 0
         self.max_bind_retries = 3
         self._bind_retries: dict[str, int] = {}
+        self._preempt_attempts: dict[str, int] = {}
         self._assign = {"greedy": assign_greedy,
                         "parallel": assign_parallel}[method]
         self.informer = Informer(client, self.queue, cfg.scheduler_name,
@@ -72,6 +74,7 @@ class SchedulerLoop:
         self.encoder.upsert_node(node)
 
     def _on_pod_gone(self, pod: Pod) -> None:
+        self._preempt_attempts.pop(pod.uid, None)
         # A cluster-wide watch also delivers pods other schedulers
         # bound; the ledger would no-op them anyway, but filtering
         # here keeps the early-release marker set quiet.
@@ -107,6 +110,43 @@ class SchedulerLoop:
             return self.client.node_of(pod_name)
         except KeyError:
             return ""  # peer not known to the API server (yet)
+
+    def _try_preempt(self, pod: Pod, events: list) -> bool:
+        """Attempt to make room for an unschedulable pod by evicting
+        strictly-lower-priority pods (core/preempt.py).  Returns True
+        when victims were evicted and the pod was requeued; the caller
+        then skips the FailedScheduling path for this cycle."""
+        from kubernetesnetawarescheduler_tpu.core.preempt import (
+            execute_preemption,
+            plan_preemption,
+        )
+
+        attempts = self._preempt_attempts.get(pod.uid, 0)
+        if attempts >= self.cfg.max_preemption_attempts:
+            # Budget exhausted: keep the counter (dropping it would let
+            # the periodic resync re-arm eviction forever for a pod
+            # preemption cannot help).  The entry is cleared when the
+            # pod finally schedules or is deleted.
+            return False
+        plan = plan_preemption(self.encoder, pod)
+        if plan is None or not plan.victims:
+            return False
+        self._preempt_attempts[pod.uid] = attempts + 1
+        done = execute_preemption(self.client, self.encoder, plan)
+        if not done:
+            return False
+        self.preemptions += len(done)
+        from kubernetesnetawarescheduler_tpu.k8s.types import Event
+
+        for v in done:
+            events.append(Event(
+                message=(f"Preempted by {pod.namespace}/{pod.name} "
+                         f"(priority {pod.priority:g} > {v.priority:g})"),
+                reason="Preempted", involved_pod=v.name,
+                namespace=v.namespace,
+                component=self.cfg.scheduler_name, type="Warning"))
+        self.queue.push(pod)
+        return True
 
     def _requeue_transient(self, pod: Pod, exc: Exception,
                            events: list, comp: str) -> None:
@@ -156,6 +196,9 @@ class SchedulerLoop:
             if idx < 0:
                 if self.decision_log is not None:
                     self.decision_log.append(pod.name, "")
+                if self.cfg.enable_preemption and \
+                        self._try_preempt(pod, events):
+                    continue
                 self.unschedulable += 1
                 events.append(failed_event(pod, comp, "no feasible node"))
                 continue
@@ -213,6 +256,9 @@ class SchedulerLoop:
         if self._bind_retries:
             for pod in ok_pods:
                 self._bind_retries.pop(f"{pod.namespace}/{pod.name}", None)
+        if self._preempt_attempts:
+            for pod in ok_pods:
+                self._preempt_attempts.pop(pod.uid, None)
         self.encoder.commit_many(ok_pods, ok_idxs)
         self.client.create_events(events)
         self.scheduled += len(ok_pods)
@@ -251,9 +297,22 @@ class SchedulerLoop:
             if self.run_once(timeout=poll_s) == 0:
                 time.sleep(0.0)
             if time.monotonic() - last_resync >= resync_every_s:
-                self.informer.resync()
-                self.reconcile_usage()
+                self.maintain()
                 last_resync = time.monotonic()
+
+    def maintain(self) -> None:
+        """One maintenance tick: pending-pod resync + usage-ledger
+        reconcile.  Transient API errors are swallowed — maintenance
+        must never take the serving loop down (the watch path already
+        catches-and-reconnects on exactly these errors)."""
+        try:
+            self.informer.resync()
+        except Exception:  # noqa: BLE001 — retried next tick
+            pass
+        try:
+            self.reconcile_usage()
+        except Exception:  # noqa: BLE001 — retried next tick
+            pass
 
 
 def jax_block(x):

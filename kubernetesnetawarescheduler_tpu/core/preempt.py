@@ -1,0 +1,147 @@
+"""Priority preemption: make room for a pod no feasible node can hold.
+
+The reference has no notion of priority or preemption (its scoring
+ignores the pod entirely, scheduler/scheduler.go:248); stock
+kube-scheduler's preemption is the capability users expect from a
+scheduler at this position, so the framework provides the same shape:
+when a pod is unschedulable, find the node where evicting the
+cheapest set of strictly-lower-priority pods frees enough capacity,
+evict them, and requeue the pod.
+
+The planner is host-side and ledger-driven: the usage ledger
+(:class:`~.encode.CommitRecord`) already knows, per bound pod, its
+node, request vector and priority — exactly the victim-candidate
+table.  Node-level static feasibility (taints/selector/validity) is
+checked against the encoder's host mirrors, mirroring the device
+kernel's mask (core/score.py feasibility_mask) so a plan is never made
+for a node the scorer would reject anyway.
+
+Semantics notes (documented deltas vs kube-scheduler):
+- victims are chosen lowest-priority-first until the pod fits; the
+  node is chosen to minimize (highest victim priority, victim count) —
+  kube-scheduler's primary tie-breakers;
+- PodDisruptionBudgets, graceful-termination waiting and nominated
+  nodes are out of scope for now: eviction is a plain pod delete and
+  the preemptor is requeued to be scored on a later cycle (after the
+  deletion's release lands in the ledger).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Sequence
+
+import numpy as np
+
+from kubernetesnetawarescheduler_tpu.core.encode import (
+    Encoder,
+    _requests_vector,
+)
+from kubernetesnetawarescheduler_tpu.k8s.types import Pod
+
+
+@dataclasses.dataclass(frozen=True)
+class Victim:
+    uid: str
+    namespace: str
+    name: str
+    priority: float
+    node: str
+
+
+@dataclasses.dataclass(frozen=True)
+class PreemptionPlan:
+    pod_name: str
+    node_name: str
+    victims: tuple[Victim, ...]
+
+
+def plan_preemption(encoder: Encoder, pod: Pod) -> PreemptionPlan | None:
+    """Find the cheapest eviction set that makes ``pod`` fit somewhere.
+
+    Returns None when no node can host the pod even after evicting
+    every strictly-lower-priority pod (the scoring kernel's own
+    verdict of "unschedulable" then stands).
+    """
+    cfg = encoder.cfg
+    req = _requests_vector(pod.requests, cfg.num_resources)
+    prio = float(pod.priority)
+
+    with encoder._lock:
+        n_real = len(encoder._node_names)
+        if n_real == 0:
+            return None
+        valid = encoder._node_valid[:n_real].copy()
+        cap = encoder._cap[:n_real].copy()
+        used = encoder._used[:n_real].copy()
+        taints = encoder._taint_bits[:n_real].copy()
+        labels = encoder._label_bits[:n_real].copy()
+        tol = np.uint32(encoder.taints.mask(pod.tolerations, lenient=True))
+        sel = np.uint32(encoder.labels.mask(pod.node_selector,
+                                            lenient=True))
+        # Victim candidates per node: strictly lower priority only.
+        victims_by_node: dict[int, list] = {}
+        for uid, rec in encoder._committed.items():
+            if rec.priority < prio and rec.node < n_real:
+                victims_by_node.setdefault(rec.node, []).append((uid, rec))
+        node_names = list(encoder._node_names)
+
+    static_ok = (valid
+                 & ((taints & ~tol) == 0)
+                 & ((labels & sel) == sel))
+
+    best: tuple[float, int, int] | None = None  # (max_vprio, count, node)
+    best_set: list[Victim] = []
+    for node in range(n_real):
+        if not static_ok[node]:
+            continue
+        cands = victims_by_node.get(node, [])
+        free = cap[node] - used[node]
+        if np.all(req <= free + 1e-9):
+            # Statically fits with free capacity, yet the kernel said
+            # unschedulable — the block is something eviction cannot
+            # lift (affinity masks, in-batch contention).  Skip.
+            continue
+        evictable = free + sum((rec.req for _, rec in cands),
+                               np.zeros_like(free))
+        if not np.all(req <= evictable + 1e-9):
+            continue
+        # Lowest-priority-first until the pod fits.
+        cands = sorted(cands, key=lambda e: (e[1].priority, e[1].stamp))
+        acc = free.copy()
+        chosen: list[Victim] = []
+        for uid, rec in cands:
+            if np.all(req <= acc + 1e-9):
+                break
+            acc = acc + rec.req
+            chosen.append(Victim(uid, rec.namespace, rec.name,
+                                 rec.priority, node_names[node]))
+        if not np.all(req <= acc + 1e-9):
+            continue
+        key = (max((v.priority for v in chosen), default=-np.inf),
+               len(chosen), node)
+        if best is None or key < best:
+            best = key
+            best_set = chosen
+    if best is None:
+        return None
+    return PreemptionPlan(pod.name, node_names[best[2]],
+                          tuple(best_set))
+
+
+def execute_preemption(client, encoder: Encoder,
+                       plan: PreemptionPlan) -> Sequence[Victim]:
+    """Delete the plan's victims through the API server.
+
+    Usage release is NOT done here: the deletion fans out through the
+    client's pod-deleted signal (watch DELETED / FakeCluster handler),
+    which routes into the ledger exactly once — the same path every
+    other deletion takes.  Returns the victims actually deleted."""
+    done = []
+    for v in plan.victims:
+        try:
+            client.delete_pod(v.name, namespace=v.namespace)
+            done.append(v)
+        except Exception:  # noqa: BLE001 — best-effort per victim
+            continue
+    return done

@@ -121,6 +121,16 @@ def _check_stream(stream: PodStream, cfg: SchedulerConfig) -> int:
     return s_total // cfg.max_pods
 
 
+def fold_stream(stream: PodStream, cfg: SchedulerConfig):
+    """Validate the stream length and fold every field to
+    ``[NB, batch, ...]`` (the layout the scan walks).  Shared by the
+    monolithic, chunked and mesh-sharded replays."""
+    nb = _check_stream(stream, cfg)
+    batch = cfg.max_pods
+    return jax.tree_util.tree_map(
+        lambda x: x.reshape((nb, batch) + x.shape[1:]), stream)
+
+
 def replay_folded(state: ClusterState, folded, cfg: SchedulerConfig,
                   method: str = "parallel"
                   ) -> tuple[jax.Array, ClusterState]:
@@ -157,12 +167,7 @@ def replay_stream(state: ClusterState, stream: PodStream,
     fetch.  ``stream`` length must be a multiple of ``cfg.max_pods``
     (pad with invalid pods via :func:`pad_stream`).
     """
-    nb = _check_stream(stream, cfg)
-    batch = cfg.max_pods
-
-    folded = jax.tree_util.tree_map(
-        lambda x: x.reshape((nb, batch) + x.shape[1:]), stream)
-    return replay_folded(state, folded, cfg, method)
+    return replay_folded(state, fold_stream(stream, cfg), cfg, method)
 
 
 @partial(jax.jit, static_argnames=("cfg", "method", "chunk_batches"))

@@ -101,6 +101,11 @@ class ClusterClient:
         real resource requests to account usage."""
         raise NotImplementedError
 
+    def delete_pod(self, name: str, namespace: str = "default") -> None:
+        """Delete a pod (the preemption eviction primitive).  Raises
+        ``KeyError`` when the pod is unknown."""
+        raise NotImplementedError
+
 
 class FakeCluster(ClusterClient):
     """In-memory cluster: nodes, pods, bindings, events.
@@ -139,13 +144,15 @@ class FakeCluster(ClusterClient):
         for pod in pods:
             self.add_pod(pod)
 
-    def delete_pod(self, name: str) -> None:
+    def delete_pod(self, name: str, namespace: str = "default") -> None:
         """Remove a pod; if it was bound, fan out to on_pod_deleted
         handlers (the usage-release signal)."""
         with self._lock:
             pod = self._pods.pop(name, None)
             handlers = list(self._deleted_handlers)
-        if pod is not None and pod.node_name:
+        if pod is None:
+            raise KeyError(name)
+        if pod.node_name:
             for h in handlers:
                 h(pod)
 

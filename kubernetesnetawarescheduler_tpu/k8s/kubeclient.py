@@ -462,6 +462,12 @@ class KubeClient(ClusterClient):
                 except Exception:  # noqa: BLE001 — best-effort
                     continue
 
+    def delete_pod(self, name: str, namespace: str = "default") -> None:
+        """DELETE the pod — the preemption eviction primitive (plain
+        delete; graceful-termination negotiation is out of scope)."""
+        self._request(
+            "DELETE", f"/api/v1/namespaces/{namespace}/pods/{name}")
+
     def node_of(self, pod_name: str) -> str:
         """``pod_name`` is a "namespace/name" key (pod_from_json
         qualifies peer references); a bare name falls back to the

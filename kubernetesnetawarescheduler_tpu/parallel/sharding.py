@@ -128,24 +128,19 @@ def sharded_replay_stream(state, stream, cfg: SchedulerConfig, mesh: Mesh,
     final_state)`` exactly like the single-chip replay (the equality
     is tested on the 8-virtual-device CPU mesh).
     """
-    from kubernetesnetawarescheduler_tpu.core.replay import replay_folded
+    from kubernetesnetawarescheduler_tpu.core.replay import (
+        fold_stream,
+        replay_folded,
+    )
 
     # Pre-fold host-side to [NB, batch, ...] and shard the batch axis
     # on dp (the scan walks the leading NB axis; replay_folded keeps
     # the folded layout so the dp sharding survives the whole scan).
-    s_total = stream.num_pods
-    batch = cfg.max_pods
-    if s_total % batch != 0:
-        raise ValueError(
-            f"stream length {s_total} not a multiple of max_pods={batch}")
-    nb = s_total // batch
-
     def fold_spec(x):
         extra = (None,) * (x.ndim - 2)
         return NamedSharding(mesh, P(None, "dp", *extra))
 
-    folded = jax.tree_util.tree_map(
-        lambda x: x.reshape((nb, batch) + x.shape[1:]), stream)
+    folded = fold_stream(stream, cfg)
     folded = jax.device_put(
         folded, jax.tree_util.tree_map(fold_spec, folded))
     state = jax.device_put(state, state_sharding(mesh))

@@ -238,12 +238,11 @@ def main(argv=None) -> int:
     # deleted while we were down emit no watch event).
     last_maint = time.monotonic()
     try:
-        loop.reconcile_usage()
+        loop.maintain()
         while not stop.is_set():
             loop.run_once(timeout=0.25)
             if time.monotonic() - last_maint >= 60.0:
-                loop.informer.resync()
-                loop.reconcile_usage()
+                loop.maintain()
                 last_maint = time.monotonic()
             if args.once:
                 break
