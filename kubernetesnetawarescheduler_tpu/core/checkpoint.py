@@ -137,7 +137,8 @@ def save_checkpoint(path: str, encoder: Encoder) -> None:
             # by definition older than any post-restart listing.
             "committed": {
                 uid: [rec.node, [float(x) for x in rec.req],
-                      rec.priority, rec.namespace, rec.name]
+                      rec.priority, rec.namespace, rec.name,
+                      int(rec.group_bit), int(rec.anti_bits)]
                 for uid, rec in encoder._committed.items()
             },
         }
@@ -188,11 +189,34 @@ def load_checkpoint(path: str,
         prio = float(entry[2]) if len(entry) > 2 else 0.0
         ns = entry[3] if len(entry) > 3 else "default"
         name = entry[4] if len(entry) > 4 else ""
+        gbit = int(entry[5]) if len(entry) > 5 else 0
+        abits = int(entry[6]) if len(entry) > 6 else 0
         return CommitRecord(int(idx), np.asarray(req, np.float32), 0.0,
-                            prio, ns, name)
+                            prio, ns, name, gbit, abits)
 
     enc._committed = {uid: _rec(entry)
                       for uid, entry in meta.get("committed", {}).items()}
+    # Group/anti refcounts are derived state: rebuild from the ledger.
+    for rec in enc._committed.values():
+        if rec.group_bit:
+            enc._ref_add(enc._group_refs, rec.node, rec.group_bit)
+        if rec.anti_bits:
+            enc._ref_add(enc._anti_refs, rec.node, rec.anti_bits)
+    # Bits set in the restored arrays with NO ledger member (ledger
+    # entries written before group bits were persisted) get a phantom
+    # +1 so a later same-group commit+release cycle cannot clear a bit
+    # whose pre-upgrade member may still be running — sticky-
+    # conservative, exactly the pre-refcount behavior for those bits.
+    for refs, bit_arr in ((enc._group_refs, enc._group_bits),
+                          (enc._anti_refs, enc._resident_anti)):
+        for node in range(len(enc._node_names)):
+            unaccounted = int(bit_arr[node])
+            while unaccounted:
+                b = unaccounted & -unaccounted
+                pos = b.bit_length() - 1
+                if refs[node, pos] == 0:
+                    refs[node, pos] = 1
+                unaccounted ^= b
     # Everything is freshly loaded: first snapshot() must upload all.
     for key in enc._dirty:
         enc._dirty[key] = True

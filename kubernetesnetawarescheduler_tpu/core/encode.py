@@ -104,8 +104,9 @@ def _requests_vector(requests: Mapping[str, float], r: int) -> np.ndarray:
 
 class CommitRecord(NamedTuple):
     """One usage-ledger entry: everything needed to reverse a commit
-    (node + request vector), reconcile it (stamp), and consider the
-    pod as a preemption victim (priority + identity)."""
+    (node + request vector + group/anti bits), reconcile it (stamp),
+    and consider the pod as a preemption victim (priority +
+    identity)."""
 
     node: int
     req: np.ndarray
@@ -113,6 +114,8 @@ class CommitRecord(NamedTuple):
     priority: float
     namespace: str
     name: str
+    group_bit: int = 0
+    anti_bits: int = 0
 
 
 class Encoder:
@@ -140,6 +143,11 @@ class Encoder:
         self._taint_bits = np.zeros((n,), np.uint32)
         self._group_bits = np.zeros((n,), np.uint32)
         self._resident_anti = np.zeros((n,), np.uint32)
+        # Per-(node, bit) member counts behind _group_bits /
+        # _resident_anti: a bit clears only when its count hits zero
+        # (precise release; see release()).
+        self._group_refs = np.zeros((n, 32), np.int32)
+        self._anti_refs = np.zeros((n, 32), np.int32)
 
         # Usage ledger: uid -> CommitRecord; release() reverses exactly
         # what commit recorded (see the allocation section), and the
@@ -276,6 +284,15 @@ class Encoder:
         res_names = _res_names(r)
         for i, pod in enumerate(pods):
             _fill_requests_row(reqs[i], pod.requests, res_names)
+        # Intern the group bits BEFORE any state mutation: a strict
+        # interner overflow must raise with the ledger and usage
+        # arrays untouched, never between the two (a ledger entry
+        # whose usage was never added would corrupt accounting on its
+        # eventual release).
+        bits = [((self.groups.bit(pod.group) if pod.group else 0),
+                 (self.groups.mask(pod.anti_groups)
+                  if pod.anti_groups else 0))
+                for pod in pods]
         with self._lock:
             keep = np.ones(len(pods), bool)
             for i, pod in enumerate(pods):
@@ -292,16 +309,21 @@ class Encoder:
                     continue
                 self._committed[pod.uid] = CommitRecord(
                     int(idx[i]), reqs[i].copy(), time.monotonic(),
-                    float(pod.priority), pod.namespace, pod.name)
+                    float(pod.priority), pod.namespace, pod.name,
+                    bits[i][0], bits[i][1])
             np.add.at(self._used, idx[keep], reqs[keep])
             for i, pod in enumerate(pods):
                 if not keep[i]:
                     continue
-                if pod.group:
-                    self._group_bits[idx[i]] |= self.groups.bit(pod.group)
-                if pod.anti_groups:
-                    self._resident_anti[idx[i]] |= self.groups.mask(
-                        pod.anti_groups)
+                rec = self._committed[pod.uid]
+                if rec.group_bit:
+                    self._group_bits[idx[i]] |= rec.group_bit
+                    self._ref_add(self._group_refs, int(idx[i]),
+                                  rec.group_bit)
+                if rec.anti_bits:
+                    self._resident_anti[idx[i]] |= rec.anti_bits
+                    self._ref_add(self._anti_refs, int(idx[i]),
+                                  rec.anti_bits)
             self._dirty["alloc"] = True
 
     def release(self, pod: Pod, node_name: str = "") -> None:
@@ -311,8 +333,10 @@ class Encoder:
         the caller's view, so double-release is a no-op and foreign
         pods (never committed) do not corrupt usage.  A release that
         beats the commit leaves an early-release marker consumed by
-        :meth:`commit_many`.  (Group bits stay set conservatively;
-        precise refcounting arrives with the eviction subsystem.)"""
+        :meth:`commit_many`.  Group/anti bits are refcounted per
+        (node, bit): the bit clears when the LAST member pod leaves —
+        without this, a node that ever hosted group ``g`` would block
+        anti-``g`` pods forever."""
         with self._lock:
             rec = self._committed.pop(pod.uid, None)
             if rec is None:
@@ -325,9 +349,45 @@ class Encoder:
                     del self._early_releases[
                         next(iter(self._early_releases))]
                 return
-            self._used[rec.node] = np.maximum(
-                self._used[rec.node] - rec.req, 0.0)
+            self._release_record(rec)
             self._dirty["alloc"] = True
+
+    def _release_record(self, rec: CommitRecord) -> None:
+        """Reverse one ledger record (caller holds the lock)."""
+        self._used[rec.node] = np.maximum(
+            self._used[rec.node] - rec.req, 0.0)
+        if rec.group_bit:
+            cleared = self._ref_sub(self._group_refs, rec.node,
+                                    rec.group_bit)
+            self._group_bits[rec.node] &= np.uint32(~cleared
+                                                    & 0xFFFFFFFF)
+        if rec.anti_bits:
+            cleared = self._ref_sub(self._anti_refs, rec.node,
+                                    rec.anti_bits)
+            self._resident_anti[rec.node] &= np.uint32(~cleared
+                                                       & 0xFFFFFFFF)
+
+    @staticmethod
+    def _ref_add(refs: np.ndarray, node: int, bits: int) -> None:
+        while bits:
+            b = bits & -bits
+            refs[node, b.bit_length() - 1] += 1
+            bits ^= b
+
+    @staticmethod
+    def _ref_sub(refs: np.ndarray, node: int, bits: int) -> int:
+        """Decrement refcounts for each set bit; returns the mask of
+        bits whose count reached zero (to be cleared)."""
+        cleared = 0
+        while bits:
+            b = bits & -bits
+            pos = b.bit_length() - 1
+            if refs[node, pos] > 0:
+                refs[node, pos] -= 1
+            if refs[node, pos] == 0:
+                cleared |= b
+            bits ^= b
+        return cleared
 
     def reconcile_committed(self, alive_uids,
                             listed_at: float | None = None) -> int:
@@ -347,9 +407,7 @@ class Encoder:
             stale = [u for u, rec in self._committed.items()
                      if u not in alive and rec.stamp < cutoff]
             for uid in stale:
-                rec = self._committed.pop(uid)
-                self._used[rec.node] = np.maximum(
-                    self._used[rec.node] - rec.req, 0.0)
+                self._release_record(self._committed.pop(uid))
                 released += 1
             # Early-release markers for pods that no longer exist can
             # never be consumed by a commit — drop them.

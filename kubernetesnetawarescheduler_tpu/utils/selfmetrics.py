@@ -47,6 +47,9 @@ def render_metrics(loop) -> str:
             "Pods with no feasible node")
     counter("netaware_bind_failures_total", loop.bind_failures,
             "Bind attempts rejected or errored")
+    counter("netaware_preemptions_total",
+            getattr(loop, "preemptions", 0),
+            "Pods evicted to make room for higher-priority pods")
     gauge("netaware_queue_depth", len(loop.queue),
           "Pending pods waiting in the scheduling queue")
     counter("netaware_queue_dropped_total",
@@ -58,6 +61,12 @@ def render_metrics(loop) -> str:
         ages = enc._metrics_age[valid]
         overflow = (enc.labels.overflow_drops + enc.taints.overflow_drops
                     + enc.groups.overflow_drops)
+        ledger_size = len(enc._committed)
+        early_releases = len(enc._early_releases)
+    gauge("netaware_usage_ledger_entries", float(ledger_size),
+          "Bound pods with committed usage (release/reconcile source)")
+    gauge("netaware_early_release_markers", float(early_releases),
+          "Terminations seen before their commit (in-flight races)")
     gauge("netaware_nodes_ready", float(valid.sum()),
           "Nodes currently schedulable")
     gauge("netaware_nodes_registered", float(enc.num_nodes),

@@ -110,3 +110,51 @@ def test_shape_mismatch_rejected(tmp_path):
     other = SchedulerConfig(max_nodes=128, max_pods=16)
     with pytest.raises(ValueError, match="shapes"):
         load_checkpoint(str(tmp_path / "ckpt"), other)
+
+
+def test_restore_rebuilds_group_refcounts(tmp_path):
+    """After save/load, group bits must clear exactly when the last
+    ledger-known member releases — and bits restored from pre-upgrade
+    checkpoints (no per-record group bits) must stay set forever
+    (sticky-conservative phantom ref)."""
+    import json
+    import os
+
+    from kubernetesnetawarescheduler_tpu.core.checkpoint import (
+        load_checkpoint,
+        save_checkpoint,
+    )
+    from kubernetesnetawarescheduler_tpu.core.encode import Encoder
+    from kubernetesnetawarescheduler_tpu.k8s.types import Node, Pod
+
+    cfg = SchedulerConfig(max_nodes=4, max_pods=2, max_peers=2)
+    enc = Encoder(cfg)
+    enc.upsert_node(Node(name="n0", capacity={"cpu": 8.0}))
+    p1 = Pod(name="p1", group="g", requests={"cpu": 1.0})
+    p2 = Pod(name="p2", group="g", requests={"cpu": 1.0})
+    enc.commit(p1, "n0")
+    enc.commit(p2, "n0")
+    gbit = enc.groups.bit("g")
+
+    path = str(tmp_path / "ck")
+    save_checkpoint(path, enc)
+    enc2 = load_checkpoint(path, cfg)
+    assert enc2._group_bits[0] & gbit
+    enc2.release(p1)
+    assert enc2._group_bits[0] & gbit  # one member left
+    enc2.release(p2)
+    assert not (enc2._group_bits[0] & gbit)  # last member gone
+
+    # Pre-upgrade shape: strip the persisted group bits from the meta.
+    meta_path = os.path.join(path, "meta.json")
+    meta = json.load(open(meta_path))
+    meta["committed"] = {uid: entry[:5]
+                         for uid, entry in meta["committed"].items()}
+    json.dump(meta, open(meta_path, "w"))
+    enc3 = load_checkpoint(path, cfg)
+    assert enc3._group_bits[0] & gbit
+    enc3.release(p1)
+    enc3.release(p2)
+    # Phantom ref: the bit must NOT clear (members may predate the
+    # ledger's group tracking).
+    assert enc3._group_bits[0] & gbit

@@ -347,3 +347,41 @@ def test_reconcile_releases_orphaned_usage():
     # Idempotent; live pod untouched.
     assert loop.reconcile_usage() == 0
     assert loop.encoder._used[0, 0] == pytest.approx(2.0)
+
+
+def test_group_bits_clear_when_last_member_leaves():
+    """Anti-affinity must not outlive the pods that caused it: a node
+    that hosted group 'g' becomes eligible for anti-'g' pods again
+    once every 'g' member is gone (refcounted, not sticky)."""
+    from kubernetesnetawarescheduler_tpu.config import SchedulerConfig
+    from kubernetesnetawarescheduler_tpu.core.loop import SchedulerLoop
+    from kubernetesnetawarescheduler_tpu.k8s.client import FakeCluster
+    from kubernetesnetawarescheduler_tpu.k8s.types import Node, Pod
+
+    cfg = SchedulerConfig(max_nodes=8, max_pods=4, max_peers=2)
+    cluster = FakeCluster()
+    cluster.add_node(Node(name="n0", capacity={"cpu": 8.0}))
+    loop = SchedulerLoop(cluster, cfg)
+    loop.encoder.update_metrics("n0", {"cpu": 10.0})
+
+    cluster.add_pods([Pod(name="g1", group="g", requests={"cpu": 1.0}),
+                      Pod(name="g2", group="g", requests={"cpu": 1.0})])
+    assert loop.run_until_drained() == 2
+    gbit = loop.encoder.groups.bit("g")
+    assert loop.encoder._group_bits[0] & gbit
+
+    # An anti-'g' pod is blocked while members remain.
+    cluster.add_pod(Pod(name="anti", anti_groups=frozenset({"g"}),
+                        requests={"cpu": 1.0}))
+    loop.run_until_drained()
+    assert cluster.node_of("anti") == ""
+
+    cluster.delete_pod("g1")
+    assert loop.encoder._group_bits[0] & gbit  # one member left
+    cluster.delete_pod("g2")
+    assert not (loop.encoder._group_bits[0] & gbit)  # last member gone
+
+    # The previously blocked pod now schedules via resync.
+    loop.informer.resync()
+    loop.run_until_drained()
+    assert cluster.node_of("anti") == "n0"
