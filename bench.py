@@ -32,8 +32,20 @@ def main() -> None:
 
     from kubernetesnetawarescheduler_tpu.bench.density import run_density
 
-    res = run_density(num_nodes=num_nodes, num_pods=num_pods,
-                      batch_size=batch, method=method, mode=mode)
+    import contextlib
+
+    profile_dir = os.environ.get("BENCH_PROFILE", "")
+    if profile_dir:
+        # JAX profiler trace of the measured window (SURVEY.md §5
+        # tracing row): view with tensorboard or xprof.
+        import jax
+
+        trace_cm = jax.profiler.trace(profile_dir)
+    else:
+        trace_cm = contextlib.nullcontext()
+    with trace_cm:
+        res = run_density(num_nodes=num_nodes, num_pods=num_pods,
+                          batch_size=batch, method=method, mode=mode)
     print(json.dumps({
         "metric": f"density_pods_per_sec_n{num_nodes}",
         "value": round(res.pods_per_sec, 1),

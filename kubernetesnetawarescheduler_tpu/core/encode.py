@@ -284,16 +284,17 @@ class Encoder:
         res_names = _res_names(r)
         for i, pod in enumerate(pods):
             _fill_requests_row(reqs[i], pod.requests, res_names)
-        # Intern the group bits BEFORE any state mutation: a strict
-        # interner overflow must raise with the ledger and usage
-        # arrays untouched, never between the two (a ledger entry
-        # whose usage was never added would corrupt accounting on its
-        # eventual release).
-        bits = [((self.groups.bit(pod.group) if pod.group else 0),
-                 (self.groups.mask(pod.anti_groups)
-                  if pod.anti_groups else 0))
-                for pod in pods]
         with self._lock:
+            # Intern the group bits FIRST, before any state mutation
+            # (under the lock — the Interner itself is unsynchronized):
+            # a strict interner overflow must raise with the ledger and
+            # usage arrays untouched, never between the two (a ledger
+            # entry whose usage was never added would corrupt
+            # accounting on its eventual release).
+            bits = [((self.groups.bit(pod.group) if pod.group else 0),
+                     (self.groups.mask(pod.anti_groups)
+                      if pod.anti_groups else 0))
+                    for pod in pods]
             keep = np.ones(len(pods), bool)
             for i, pod in enumerate(pods):
                 if pod.uid in self._committed:

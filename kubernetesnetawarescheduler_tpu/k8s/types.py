@@ -21,7 +21,7 @@ def _next_uid(prefix: str) -> str:
     return f"{prefix}-{next(_uid_counter):08x}"
 
 
-@dataclasses.dataclass
+@dataclasses.dataclass(slots=True)
 class Node:
     """A schedulable node.
 
@@ -41,7 +41,7 @@ class Node:
     rack: str = ""
 
 
-@dataclasses.dataclass
+@dataclasses.dataclass(slots=True)
 class Pod:
     """A pod to schedule.
 
@@ -66,7 +66,7 @@ class Pod:
     priority: float = 0.0
 
 
-@dataclasses.dataclass(frozen=True)
+@dataclasses.dataclass(frozen=True, slots=True)
 class Binding:
     """The bind record POSTed on placement (scheduler.go:196-206)."""
 
@@ -75,7 +75,7 @@ class Binding:
     node_name: str
 
 
-@dataclasses.dataclass(frozen=True)
+@dataclasses.dataclass(frozen=True, slots=True)
 class Event:
     """A ``Scheduled`` event (scheduler.go:214-233)."""
 

@@ -47,8 +47,7 @@ def render_metrics(loop) -> str:
             "Pods with no feasible node")
     counter("netaware_bind_failures_total", loop.bind_failures,
             "Bind attempts rejected or errored")
-    counter("netaware_preemptions_total",
-            getattr(loop, "preemptions", 0),
+    counter("netaware_preemptions_total", loop.preemptions,
             "Pods evicted to make room for higher-priority pods")
     gauge("netaware_queue_depth", len(loop.queue),
           "Pending pods waiting in the scheduling queue")
