@@ -16,12 +16,40 @@ from __future__ import annotations
 
 import json
 import os
+import subprocess
+import sys
 
 
 REFERENCE_PODS_PER_SEC = 10.0
 
 
+def _tpu_reachable(timeout_s: float = 120.0) -> bool:
+    """Probe device init in a THROWAWAY subprocess: when the axon
+    tunnel is wedged, any in-process ``jax.devices()`` hangs forever
+    at PJRT init (no exception to catch) — the probe must be
+    killable."""
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; jax.devices(); print('ok')"],
+            capture_output=True, timeout=timeout_s)
+        return b"ok" in proc.stdout
+    except (subprocess.TimeoutExpired, OSError):
+        return False
+
+
 def main() -> None:
+    if os.environ.get("BENCH_SKIP_TPU_PROBE", "") != "1" \
+            and not _tpu_reachable():
+        # Degrade to CPU instead of hanging the driver: the JSON line
+        # still appears, flagged via detail.backend (reported from
+        # jax.default_backend() after the run, so it is always the
+        # backend that actually executed).
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        print("WARNING: TPU unreachable (tunnel wedged?); benching on "
+              "CPU", file=sys.stderr)
     # Defaults are the BASELINE.json north-star config: 5k nodes
     # (padded to a 128 multiple), p99 Score() < 5 ms, >=10k pods/sec.
     num_nodes = int(os.environ.get("BENCH_NODES", "5120"))
@@ -61,6 +89,7 @@ def main() -> None:
             "batch_size": batch,
             "method": method,
             "mode": mode,
+            "backend": __import__("jax").default_backend(),
         },
     }))
 
