@@ -135,6 +135,17 @@ class SchedulerConfig:
     num_metrics: int = Metric.COUNT
     num_resources: int = Resource.COUNT
 
+    # Width (in uint32 words) of every constraint bitmask column
+    # (labels / taints / affinity groups).  ``32 * mask_words - 1``
+    # distinct keys are assignable per category (the top bit of the
+    # last word is the reserved UNKNOWN sentinel), so the default of 4
+    # supports 127 distinct selector-referenced labels, taints and pod
+    # groups each.  Node labels are interned lazily — only label
+    # strings some pod's selector actually references consume a slot —
+    # so per-node-unique labels (kubernetes.io/hostname=...) never
+    # count against this budget.
+    mask_words: int = 4
+
     weights: ScoreWeights = dataclasses.field(default_factory=ScoreWeights)
     mesh: MeshConfig = dataclasses.field(default_factory=MeshConfig)
 
@@ -184,6 +195,8 @@ class SchedulerConfig:
         if self.num_metrics < Metric.COUNT:
             raise ValueError(
                 f"need at least {Metric.COUNT} metric channels for parity")
+        if self.mask_words <= 0:
+            raise ValueError("mask_words must be positive")
         if self.score_backend not in ("xla", "pallas"):
             raise ValueError(
                 f"score_backend must be 'xla' or 'pallas', "

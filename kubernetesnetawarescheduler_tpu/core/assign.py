@@ -38,8 +38,9 @@ from kubernetesnetawarescheduler_tpu.core.score import NEG_INF, _EPS
 from kubernetesnetawarescheduler_tpu.core.state import (
     ClusterState,
     PodBatch,
+    bit_planes,
     commit_assignments,
-    scatter_or_onehot,
+    planes_to_words,
 )
 
 # np scalar, not jnp — see core/score.py NEG_INF: module-level jnp
@@ -60,9 +61,12 @@ def _static_parts(state: ClusterState, pods: PodBatch, cfg: SchedulerConfig,
     base, c = static
     net = score_lib.network_scores(state, pods, cfg, c=c)
     raw = base[None, :] + net
-    tol = (state.taint_bits[None, :] & ~pods.tol_bits[:, None]) == 0
-    sel = (state.label_bits[None, :] & pods.sel_bits[:, None]) \
-        == pods.sel_bits[:, None]
+    tol = jnp.all(
+        (state.taint_bits[None, :, :] & ~pods.tol_bits[:, None, :]) == 0,
+        axis=-1)
+    sel = jnp.all(
+        (state.label_bits[None, :, :] & pods.sel_bits[:, None, :])
+        == pods.sel_bits[:, None, :], axis=-1)
     static_ok = (tol & sel & state.node_valid[None, :]
                  & pods.pod_valid[:, None])
     return raw, static_ok
@@ -75,10 +79,15 @@ def _dynamic_mask(pods: PodBatch, used: jax.Array, cap: jax.Array,
     (both directions), recomputed against the *current* usage/groups."""
     free = cap - used
     fits = jnp.all(pods.req[:, None, :] <= free[None, :, :] + _EPS, axis=-1)
-    aff_req = pods.affinity_bits[:, None]
-    affinity = (aff_req == 0) | ((group_bits[None, :] & aff_req) != 0)
-    anti = (group_bits[None, :] & pods.anti_bits[:, None]) == 0
-    sym = (resident_anti[None, :] & pods.group_bit[:, None]) == 0
+    aff_req = pods.affinity_bits[:, None, :]
+    affinity = jnp.all(aff_req == 0, axis=-1) | jnp.any(
+        (group_bits[None, :, :] & aff_req) != 0, axis=-1)
+    anti = jnp.all(
+        (group_bits[None, :, :] & pods.anti_bits[:, None, :]) == 0,
+        axis=-1)
+    sym = jnp.all(
+        (resident_anti[None, :, :] & pods.group_bit[:, None, :]) == 0,
+        axis=-1)
     return fits & affinity & anti & sym
 
 
@@ -113,10 +122,14 @@ def assign_greedy(state: ClusterState, pods: PodBatch,
         cap = jnp.maximum(state.cap, _EPS)
         bal_row = jnp.max((used + req[None, :]) / cap, axis=-1)
         fits = jnp.all(req[None, :] <= state.cap - used + _EPS, axis=-1)
-        aff_req = pods.affinity_bits[pod_idx]
-        affinity = (aff_req == 0) | ((group_bits & aff_req) != 0)
-        anti = (group_bits & pods.anti_bits[pod_idx]) == 0
-        sym = (resident_anti & pods.group_bit[pod_idx]) == 0
+        aff_req = pods.affinity_bits[pod_idx]          # [W]
+        affinity = jnp.all(aff_req == 0) | jnp.any(
+            (group_bits & aff_req[None, :]) != 0, axis=-1)
+        anti = jnp.all(
+            (group_bits & pods.anti_bits[pod_idx][None, :]) == 0, axis=-1)
+        sym = jnp.all(
+            (resident_anti & pods.group_bit[pod_idx][None, :]) == 0,
+            axis=-1)
         ok = static_ok[pod_idx] & fits & affinity & anti & sym
         row = jnp.where(ok, raw[pod_idx] - w_bal * bal_row, NEG_INF)
         choice = jnp.argmax(row).astype(jnp.int32)  # first-max: deterministic
@@ -159,15 +172,15 @@ def assign_parallel(state: ClusterState, pods: PodBatch,
     pod_ids = jnp.arange(p, dtype=jnp.int32)
 
     # Loop-invariant bitplane decomposition of the two per-pod bit
-    # fields, stacked [P, 64] so the per-round "which bits landed on
-    # which node" reduction is ONE [N, P] x [P, 64] matmul on the MXU
-    # (counts > 0 ⇔ bit present) instead of a [P, N, 64] any-reduce on
-    # the VPU — the dominant cost of a round at N ≥ 1k.
-    shifts = jnp.arange(32, dtype=jnp.uint32)
+    # fields (each u32[P, W]), stacked [P, 2*W*32] so the per-round
+    # "which bits landed on which node" reduction is ONE
+    # [N, P] x [P, 2*W*32] matmul on the MXU (counts > 0 ⇔ bit
+    # present) instead of a [P, N, 2*W*32] any-reduce on the VPU — the
+    # dominant cost of a round at N ≥ 1k.
+    plane_cols = pods.group_bit.shape[1] * 32
     pod_planes = jnp.concatenate(
-        [((pods.group_bit[:, None] >> shifts) & 1),
-         ((pods.anti_bits[:, None] >> shifts) & 1)],
-        axis=1).astype(jnp.bfloat16)  # [P, 64] of exact 0/1
+        [bit_planes(pods.group_bit), bit_planes(pods.anti_bits)],
+        axis=1)  # [P, 2*W*32] of exact 0/1
 
     def masked_scores(used, group_bits, resident_anti, assignment):
         dyn = _dynamic_mask(pods, used, state.cap, group_bits, resident_anti)
@@ -202,17 +215,14 @@ def assign_parallel(state: ClusterState, pods: PodBatch,
         new_used = used.at[safe].add(add, mode="drop")
         w_onehot = onehot & winner[:, None]  # winner implies feasible
         progress = jnp.any(winner)
-        # [N, 64] win-count per (node, bitplane) via the MXU; 0/1 bf16
-        # inputs with f32 accumulation are exact for any P.
+        # [N, 2*W*32] win-count per (node, bitplane) via the MXU; 0/1
+        # bf16 inputs with f32 accumulation are exact for any P.
         counts = jax.lax.dot_general(
             w_onehot.astype(jnp.bfloat16), pod_planes,
             (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
-        present = counts > 0.5  # [N, 64]
-        words = jnp.sum(
-            present.reshape(n, 2, 32).astype(jnp.uint32) << shifts,
-            axis=-1, dtype=jnp.uint32)
-        new_group = group_bits | words[:, 0]
-        new_anti = resident_anti | words[:, 1]
+        present = counts > 0.5  # [N, 2*W*32]
+        new_group = group_bits | planes_to_words(present[:, :plane_cols])
+        new_anti = resident_anti | planes_to_words(present[:, plane_cols:])
         new_s = masked_scores(new_used, new_group, new_anti, new_assignment)
         return (new_s, new_used, new_group, new_anti, new_assignment,
                 progress)

@@ -38,7 +38,10 @@ from kubernetesnetawarescheduler_tpu.config import (
     config_from_dict,
     config_to_dict,
 )
-from kubernetesnetawarescheduler_tpu.core.encode import Encoder
+from kubernetesnetawarescheduler_tpu.core.encode import (
+    Encoder,
+    words_to_int,
+)
 
 _STATE_ARRAYS = (
     "_metrics", "_metrics_age", "_lat", "_bw", "_cap", "_used",
@@ -46,7 +49,10 @@ _STATE_ARRAYS = (
     "_resident_anti",
 )
 
-FORMAT_VERSION = 1
+# v2: constraint bitmask arrays widened to u32[N, mask_words]; raw
+# node-label sets persisted (lazy label interning needs them to
+# rebuild the reverse map on restore).
+FORMAT_VERSION = 2
 
 
 @dataclasses.dataclass(frozen=True)
@@ -126,6 +132,12 @@ def save_checkpoint(path: str, encoder: Encoder) -> None:
             "format_version": FORMAT_VERSION,
             "config": config_to_dict(encoder.cfg),
             "node_names": list(encoder._node_names),
+            # Raw label sets per node index (lazy interning: the bit
+            # arrays only carry selector-referenced labels; the raw
+            # strings are needed so future selectors can backfill).
+            "node_labels": {
+                str(idx): sorted(labels)
+                for idx, labels in encoder._node_labels.items()},
             "interners": {
                 "labels": dict(encoder.labels._bits),
                 "taints": dict(encoder.taints._bits),
@@ -161,13 +173,14 @@ def load_checkpoint(path: str,
             f"unsupported checkpoint format {meta.get('format_version')}")
     stored_cfg = config_from_dict(meta["config"])
     cfg = cfg or stored_cfg
-    if (cfg.max_nodes, cfg.num_metrics, cfg.num_resources) != (
+    if (cfg.max_nodes, cfg.num_metrics, cfg.num_resources,
+            cfg.mask_words) != (
             stored_cfg.max_nodes, stored_cfg.num_metrics,
-            stored_cfg.num_resources):
+            stored_cfg.num_resources, stored_cfg.mask_words):
         raise ValueError(
             "config shapes do not match checkpoint: "
-            f"{(cfg.max_nodes, cfg.num_metrics, cfg.num_resources)} vs "
-            f"{(stored_cfg.max_nodes, stored_cfg.num_metrics, stored_cfg.num_resources)}")
+            f"{(cfg.max_nodes, cfg.num_metrics, cfg.num_resources, cfg.mask_words)} vs "
+            f"{(stored_cfg.max_nodes, stored_cfg.num_metrics, stored_cfg.num_resources, stored_cfg.mask_words)}")
     enc = Encoder(cfg)
     with np.load(os.path.join(path, "state.npz")) as data:
         for name in _STATE_ARRAYS:
@@ -182,6 +195,11 @@ def load_checkpoint(path: str,
     enc._node_index = {n: i for i, n in enumerate(enc._node_names)}
     for attr, table in meta["interners"].items():
         getattr(enc, attr)._bits = {k: int(v) for k, v in table.items()}
+    for idx_s, labels in meta.get("node_labels", {}).items():
+        idx = int(idx_s)
+        enc._node_labels[idx] = frozenset(labels)
+        for s in labels:
+            enc._label_nodes.setdefault(s, set()).add(idx)
     from kubernetesnetawarescheduler_tpu.core.encode import CommitRecord
 
     def _rec(entry) -> CommitRecord:
@@ -210,7 +228,7 @@ def load_checkpoint(path: str,
     for refs, bit_arr in ((enc._group_refs, enc._group_bits),
                           (enc._anti_refs, enc._resident_anti)):
         for node in range(len(enc._node_names)):
-            unaccounted = int(bit_arr[node])
+            unaccounted = words_to_int(bit_arr[node])
             while unaccounted:
                 b = unaccounted & -unaccounted
                 pos = b.bit_length() - 1

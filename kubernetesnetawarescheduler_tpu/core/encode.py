@@ -31,31 +31,64 @@ from kubernetesnetawarescheduler_tpu.core.state import ClusterState, PodBatch
 from kubernetesnetawarescheduler_tpu.k8s.types import Node, Pod
 
 
-# Bit 31 is reserved: never assigned to a real key, so a mask carrying
-# it can never be satisfied by any node.  Lenient interning uses it to
-# keep un-internable *requirements* conservative (infeasible) instead
-# of silently weakened.
-# Plain Python ints throughout the interning path (coerced to uint32 at
-# array-store time): numpy scalar construction is ~10x a Python int op
-# and this runs 5x per pod on the encode fast path.
-UNKNOWN_BIT = 1 << 31
-_MAX_KEYS = 31
+# The top bit of the last mask word is reserved: never assigned to a
+# real key, so a mask carrying it can never be satisfied by any node.
+# Lenient interning uses it to keep un-internable *requirements*
+# conservative (infeasible) instead of silently weakened.
+# Plain Python ints throughout the interning path (arbitrary precision
+# — a mask spanning ``mask_words`` uint32 words is still ONE int here;
+# the split into word arrays happens at array-store time): numpy scalar
+# construction is ~10x a Python int op and this runs 5x per pod on the
+# encode fast path.
+def unknown_bit(words: int) -> int:
+    """The reserved can-never-match sentinel for a ``words``-wide mask."""
+    return 1 << (32 * words - 1)
+
+
+# Back-compat alias for the single-word layout (tests, extender docs).
+UNKNOWN_BIT = unknown_bit(1)
+
+
+def int_to_words(x: int, words: int) -> np.ndarray:
+    """Split an arbitrary-precision mask into ``words`` uint32 words
+    (little-endian: word 0 holds bits 0..31)."""
+    return np.fromiter(((x >> (32 * i)) & 0xFFFFFFFF
+                        for i in range(words)), np.uint32, words)
+
+
+def words_to_int(arr) -> int:
+    """Inverse of :func:`int_to_words` (accepts any uint32 sequence)."""
+    out = 0
+    for i, w in enumerate(arr):
+        out |= int(w) << (32 * i)
+    return out
+
+
+def _fill_words(row: np.ndarray, x: int) -> None:
+    """Write mask ``x`` into a preallocated uint32 word row in place
+    (allocation-free variant of :func:`int_to_words` for hot paths)."""
+    for i in range(row.shape[0]):
+        row[i] = (x >> (32 * i)) & 0xFFFFFFFF
 
 
 class Interner:
-    """Stable string -> bit-position mapping (31 assignable bits).
+    """Stable string -> bit-position mapping over ``32 * words - 1``
+    assignable bits.
 
     Strict interning (trusted paths: node registration, the main
     scheduling loop) raises when the slot space is exhausted.
     Untrusted request paths (the extender webhook) pass
     ``lenient=True``: an unknown-when-full key yields
     ``on_overflow`` — callers choose the conservative direction for
-    their constraint (``UNKNOWN_BIT`` for must-match requirements,
+    their constraint (``self.unknown`` for must-match requirements,
     0 for grants like tolerations) — so one exotic manifest degrades
     only its own request instead of wedging scheduling for everyone."""
 
-    def __init__(self, kind: str) -> None:
+    def __init__(self, kind: str, words: int = 1) -> None:
         self._kind = kind
+        self.words = words
+        self.max_keys = 32 * words - 1
+        self.unknown = unknown_bit(words)
         self._bits: dict[str, int] = {}
         self.overflow_drops = 0
 
@@ -63,13 +96,14 @@ class Interner:
             on_overflow: int = 0) -> int:
         b = self._bits.get(key)
         if b is None:
-            if len(self._bits) >= _MAX_KEYS:
+            if len(self._bits) >= self.max_keys:
                 if lenient:
                     self.overflow_drops += 1
                     return on_overflow
                 raise ValueError(
                     f"too many distinct {self._kind} keys "
-                    f"(max {_MAX_KEYS}): cannot intern {key!r}")
+                    f"(max {self.max_keys}; raise cfg.mask_words to "
+                    f"widen): cannot intern {key!r}")
             b = len(self._bits)
             self._bits[key] = b
         return 1 << b
@@ -124,12 +158,23 @@ class Encoder:
     def __init__(self, cfg: SchedulerConfig) -> None:
         self.cfg = cfg
         n, m, r = cfg.max_nodes, cfg.num_metrics, cfg.num_resources
-        self.labels = Interner("label")
-        self.taints = Interner("taint")
-        self.groups = Interner("group")
+        w = cfg.mask_words
+        self.labels = Interner("label", w)
+        self.taints = Interner("taint", w)
+        self.groups = Interner("group", w)
         self._node_index: dict[str, int] = {}
         self._node_names: list[str] = []
         self._lock = threading.RLock()
+
+        # Lazy label interning: a node's raw label strings live here;
+        # only strings some pod's selector references are ever given a
+        # bit (so per-node-unique labels like kubernetes.io/hostname
+        # never consume slots — the reference-scale failure mode of
+        # interning everything eagerly was a hard crash at node #32).
+        # _label_nodes is the reverse map used to backfill the bit
+        # column when a selector first references an existing label.
+        self._node_labels: dict[int, frozenset[str]] = {}
+        self._label_nodes: dict[str, set[int]] = {}
 
         # Staging (host) arrays — mirror of ClusterState fields.
         self._metrics = np.zeros((n, m), np.float32)
@@ -139,15 +184,15 @@ class Encoder:
         self._cap = np.zeros((n, r), np.float32)
         self._used = np.zeros((n, r), np.float32)
         self._node_valid = np.zeros((n,), bool)
-        self._label_bits = np.zeros((n,), np.uint32)
-        self._taint_bits = np.zeros((n,), np.uint32)
-        self._group_bits = np.zeros((n,), np.uint32)
-        self._resident_anti = np.zeros((n,), np.uint32)
+        self._label_bits = np.zeros((n, w), np.uint32)
+        self._taint_bits = np.zeros((n, w), np.uint32)
+        self._group_bits = np.zeros((n, w), np.uint32)
+        self._resident_anti = np.zeros((n, w), np.uint32)
         # Per-(node, bit) member counts behind _group_bits /
         # _resident_anti: a bit clears only when its count hits zero
         # (precise release; see release()).
-        self._group_refs = np.zeros((n, 32), np.int32)
-        self._anti_refs = np.zeros((n, 32), np.int32)
+        self._group_refs = np.zeros((n, 32 * w), np.int32)
+        self._anti_refs = np.zeros((n, 32 * w), np.int32)
 
         # Usage ledger: uid -> CommitRecord; release() reverses exactly
         # what commit recorded (see the allocation section), and the
@@ -178,7 +223,14 @@ class Encoder:
         return len(self._node_names)
 
     def upsert_node(self, node: Node) -> int:
-        """Register or refresh a node; returns its index."""
+        """Register or refresh a node; returns its index.
+
+        Labels are NOT interned here (lazy interning): the raw strings
+        are recorded in ``_node_labels``/``_label_nodes`` and the bit
+        row carries only labels already referenced by some pod's
+        selector.  Eager interning of every label crashed real clusters
+        around node #32 (per-node-unique ``kubernetes.io/hostname=…``
+        labels exhausting the slot space)."""
         with self._lock:
             idx = self._node_index.get(node.name)
             if idx is None:
@@ -191,11 +243,61 @@ class Encoder:
             self._cap[idx] = _requests_vector(node.capacity,
                                               self.cfg.num_resources)
             self._node_valid[idx] = node.ready
-            self._label_bits[idx] = self.labels.mask(node.labels)
-            self._taint_bits[idx] = self.taints.mask(node.taints)
+            self._set_node_labels(idx, node.labels)
+            # Node taints ARE eager: every taint must be representable
+            # or pods lacking a toleration could slip on (the
+            # conservative direction is a bit no pod tolerates, which
+            # is exactly what a fresh bit is until granted).
+            _fill_words(self._taint_bits[idx],
+                        self.taints.mask(node.taints))
             self._dirty["topo"] = True
             self._dirty["alloc"] = True
             return idx
+
+    def _set_node_labels(self, idx: int, labels: Iterable[str]) -> None:
+        """Record a node's raw label set and rebuild its bit row from
+        the already-interned subset (caller holds the lock)."""
+        new = frozenset(labels)
+        old = self._node_labels.get(idx, frozenset())
+        if new != old:
+            for s in old - new:
+                nodes = self._label_nodes.get(s)
+                if nodes is not None:
+                    nodes.discard(idx)
+                    if not nodes:
+                        del self._label_nodes[s]
+            for s in new - old:
+                self._label_nodes.setdefault(s, set()).add(idx)
+            self._node_labels[idx] = new
+        table = self.labels._bits
+        bits = 0
+        for s in new:
+            b = table.get(s)
+            if b is not None:
+                bits |= 1 << b
+        _fill_words(self._label_bits[idx], bits)
+
+    def _selector_mask(self, keys: Iterable[str], lenient: bool) -> int:
+        """Intern a pod selector's label keys, backfilling the bit of a
+        newly-interned label onto every node that already carries it
+        (caller holds the lock).  Overflow degrades to the UNKNOWN
+        sentinel: a selector we cannot represent matches nowhere rather
+        than everywhere."""
+        table = self.labels._bits
+        out = 0
+        for key in keys:
+            known = key in table
+            b = self.labels.bit(key, lenient,
+                                on_overflow=self.labels.unknown)
+            out |= b
+            if not known and key in table:
+                carriers = self._label_nodes.get(key)
+                if carriers:
+                    word, pos = divmod(table[key], 32)
+                    for idx in carriers:
+                        self._label_bits[idx, word] |= np.uint32(1 << pos)
+                    self._dirty["topo"] = True
+        return out
 
     def mark_unready(self, name: str) -> None:
         """Failure detection hook: an unready node drops out of every
@@ -313,16 +415,19 @@ class Encoder:
                     float(pod.priority), pod.namespace, pod.name,
                     bits[i][0], bits[i][1])
             np.add.at(self._used, idx[keep], reqs[keep])
+            w = self.cfg.mask_words
             for i, pod in enumerate(pods):
                 if not keep[i]:
                     continue
                 rec = self._committed[pod.uid]
                 if rec.group_bit:
-                    self._group_bits[idx[i]] |= rec.group_bit
+                    self._group_bits[idx[i]] |= int_to_words(
+                        rec.group_bit, w)
                     self._ref_add(self._group_refs, int(idx[i]),
                                   rec.group_bit)
                 if rec.anti_bits:
-                    self._resident_anti[idx[i]] |= rec.anti_bits
+                    self._resident_anti[idx[i]] |= int_to_words(
+                        rec.anti_bits, w)
                     self._ref_add(self._anti_refs, int(idx[i]),
                                   rec.anti_bits)
             self._dirty["alloc"] = True
@@ -355,18 +460,19 @@ class Encoder:
 
     def _release_record(self, rec: CommitRecord) -> None:
         """Reverse one ledger record (caller holds the lock)."""
+        w = self.cfg.mask_words
         self._used[rec.node] = np.maximum(
             self._used[rec.node] - rec.req, 0.0)
         if rec.group_bit:
             cleared = self._ref_sub(self._group_refs, rec.node,
                                     rec.group_bit)
-            self._group_bits[rec.node] &= np.uint32(~cleared
-                                                    & 0xFFFFFFFF)
+            self._group_bits[rec.node] &= np.invert(
+                int_to_words(cleared, w))
         if rec.anti_bits:
             cleared = self._ref_sub(self._anti_refs, rec.node,
                                     rec.anti_bits)
-            self._resident_anti[rec.node] &= np.uint32(~cleared
-                                                       & 0xFFFFFFFF)
+            self._resident_anti[rec.node] &= np.invert(
+                int_to_words(cleared, w))
 
     @staticmethod
     def _ref_add(refs: np.ndarray, node: int, bits: int) -> None:
@@ -455,16 +561,15 @@ class Encoder:
 
         Overflow direction per constraint: dropping a toleration/anti/
         own-group is conservative (more constrained / untracked); a
-        must-match selector or required-affinity key degrades to
-        ``UNKNOWN_BIT`` (infeasible) rather than silently matching
+        must-match selector or required-affinity key degrades to the
+        UNKNOWN sentinel (infeasible) rather than silently matching
         anywhere.
         """
         return (
             self.taints.mask(pod.tolerations, lenient),
-            self.labels.mask(pod.node_selector, lenient,
-                             on_overflow=UNKNOWN_BIT),
+            self._selector_mask(pod.node_selector, lenient),
             self.groups.mask(pod.affinity_groups, lenient,
-                             on_overflow=UNKNOWN_BIT),
+                             on_overflow=self.groups.unknown),
             self.groups.mask(pod.anti_groups, lenient),
             (self.groups.bit(pod.group, lenient)
              if pod.group else 0),
@@ -483,16 +588,17 @@ class Encoder:
         """
         cfg = self.cfg
         p, k, r = cfg.max_pods, cfg.max_peers, cfg.num_resources
+        w = cfg.mask_words
         if len(pods) > p:
             raise ValueError(f"batch of {len(pods)} exceeds max_pods={p}")
         req = np.zeros((p, r), np.float32)
         peers = np.full((p, k), -1, np.int32)
         traffic = np.zeros((p, k), np.float32)
-        tol = np.zeros((p,), np.uint32)
-        sel = np.zeros((p,), np.uint32)
-        aff = np.zeros((p,), np.uint32)
-        anti = np.zeros((p,), np.uint32)
-        gbit = np.zeros((p,), np.uint32)
+        tol = np.zeros((p, w), np.uint32)
+        sel = np.zeros((p, w), np.uint32)
+        aff = np.zeros((p, w), np.uint32)
+        anti = np.zeros((p, w), np.uint32)
+        gbit = np.zeros((p, w), np.uint32)
         prio = np.zeros((p,), np.float32)
         valid = np.zeros((p,), bool)
         with self._lock:
@@ -511,8 +617,9 @@ class Encoder:
                     peers[i, slot] = idx
                     traffic[i, slot] = vol
                     slot += 1
-                (tol[i], sel[i], aff[i], anti[i],
-                 gbit[i]) = self._constraint_bits(pod, lenient)
+                bits = self._constraint_bits(pod, lenient)
+                for row, val in zip((tol, sel, aff, anti, gbit), bits):
+                    _fill_words(row[i], val)
                 prio[i] = pod.priority
                 valid[i] = True
         return PodBatch(
@@ -546,6 +653,7 @@ class Encoder:
 
         cfg = self.cfg
         s, k, r = len(pods), cfg.max_peers, cfg.num_resources
+        w = cfg.mask_words
         # Indexed under both the bare name and "namespace/name": fake
         # workloads reference peers by bare name, KubeClient-sourced
         # pods carry namespace-qualified references.
@@ -557,11 +665,11 @@ class Encoder:
         peer_pods = np.full((s, k), -1, np.int32)
         peer_nodes = np.full((s, k), -1, np.int32)
         traffic = np.zeros((s, k), np.float32)
-        tol = np.zeros((s,), np.uint32)
-        sel = np.zeros((s,), np.uint32)
-        aff = np.zeros((s,), np.uint32)
-        anti = np.zeros((s,), np.uint32)
-        gbit = np.zeros((s,), np.uint32)
+        tol = np.zeros((s, w), np.uint32)
+        sel = np.zeros((s, w), np.uint32)
+        aff = np.zeros((s, w), np.uint32)
+        anti = np.zeros((s, w), np.uint32)
+        gbit = np.zeros((s, w), np.uint32)
         prio = np.zeros((s,), np.float32)
         valid = np.zeros((s,), bool)
         batch = self.cfg.max_pods
@@ -590,8 +698,9 @@ class Encoder:
                         peer_nodes[i, slot] = idx
                     traffic[i, slot] = vol
                     slot += 1
-                (tol[i], sel[i], aff[i], anti[i],
-                 gbit[i]) = self._constraint_bits(pod, lenient)
+                bits = self._constraint_bits(pod, lenient)
+                for row, val in zip((tol, sel, aff, anti, gbit), bits):
+                    _fill_words(row[i], val)
                 prio[i] = pod.priority
                 valid[i] = True
         return PodStream(

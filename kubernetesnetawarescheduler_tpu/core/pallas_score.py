@@ -37,12 +37,14 @@ from kubernetesnetawarescheduler_tpu.core.state import ClusterState, PodBatch
 
 # Row layout of the packed per-node float array ``nodef[(2R + 2 padded
 # to a multiple of 8), N]``: used[0..R), cap[R..2R), base score,
-# node_valid.  Column layout of the packed per-pod arrays:
-#   podf[P, >=R+1]  = req[0..R), pod_valid, pad
-#   podi[P, 8]      = tol_bits, sel_bits, affinity_bits, anti_bits,
-#                     group_bit, pad
-# Row layout of the packed per-node int array ``nodei[8, N]``:
-#   taint_bits, label_bits, group_bits, resident_anti, pad.
+# node_valid.  Column layout of the packed per-pod arrays (bit fields
+# are W-word masks, W = cfg.mask_words; each field occupies W
+# consecutive slots):
+#   podf[P, >=R+1]   = req[0..R), pod_valid, pad
+#   podi[P, >=5W]    = tol_bits[W], sel_bits[W], affinity_bits[W],
+#                      anti_bits[W], group_bit[W], pad
+# Row layout of the packed per-node int array ``nodei[>=4W, N]``:
+#   taint_bits[W], label_bits[W], group_bits[W], resident_anti[W], pad.
 _PARAMS = 8  # wbw, wlat, inv_bwmax, inv_latmax, wbal, eps, pad, pad
 
 from kubernetesnetawarescheduler_tpu.core.state import round_up as _round_up
@@ -51,7 +53,7 @@ from kubernetesnetawarescheduler_tpu.core.state import round_up as _round_up
 def _kernel(params_ref, t_ref, bw_ref, lat_ref, validk_ref, nodef_ref,
             nodei_ref, podf_ref, podi_ref, out_ref, acc_ref, *,
             block_n: int, block_k: int, num_resources: int,
-            use_bfloat16: bool):
+            mask_words: int, use_bfloat16: bool):
     j = pl.program_id(1)
     k = pl.program_id(2)
     nk = pl.num_programs(2)
@@ -109,21 +111,29 @@ def _kernel(params_ref, t_ref, bw_ref, lat_ref, validk_ref, nodef_ref,
             bal = jnp.maximum(
                 bal, (used_r + req_r) / jnp.maximum(cap_r, eps))
 
-        taint = nodei_ref[0:1, :]
-        label = nodei_ref[1:2, :]
-        group = nodei_ref[2:3, :]
-        ranti = nodei_ref[3:4, :]
-        tol = podi_ref[:, 0:1]
-        sel = podi_ref[:, 1:2]
-        aff = podi_ref[:, 2:3]
-        anti = podi_ref[:, 3:4]
-        gbit = podi_ref[:, 4:5]
+        # W-word bit fields: subset/overlap tests accumulate over the
+        # static word loop (unrolled at trace time).
+        mw = mask_words
         ok = fits
-        ok = ok & ((taint & ~tol) == 0)
-        ok = ok & ((label & sel) == sel)
-        ok = ok & ((aff == 0) | ((group & aff) != 0))
-        ok = ok & ((group & anti) == 0)
-        ok = ok & ((ranti & gbit) == 0)
+        aff_zero = jnp.ones_like(fits)
+        aff_hit = jnp.zeros_like(fits)
+        for w in range(mw):
+            taint = nodei_ref[w:w + 1, :]                    # (1, bn)
+            label = nodei_ref[mw + w:mw + w + 1, :]
+            group = nodei_ref[2 * mw + w:2 * mw + w + 1, :]
+            ranti = nodei_ref[3 * mw + w:3 * mw + w + 1, :]
+            tol = podi_ref[:, w:w + 1]                       # (bp, 1)
+            sel = podi_ref[:, mw + w:mw + w + 1]
+            aff = podi_ref[:, 2 * mw + w:2 * mw + w + 1]
+            anti = podi_ref[:, 3 * mw + w:3 * mw + w + 1]
+            gbit = podi_ref[:, 4 * mw + w:4 * mw + w + 1]
+            ok = ok & ((taint & ~tol) == 0)
+            ok = ok & ((label & sel) == sel)
+            ok = ok & ((group & anti) == 0)
+            ok = ok & ((ranti & gbit) == 0)
+            aff_zero = aff_zero & (aff == 0)
+            aff_hit = aff_hit | ((group & aff) != 0)
+        ok = ok & (aff_zero | aff_hit)
 
         out_ref[:] = jnp.where(ok, acc_ref[:] + base - wbal * bal,
                                jnp.float32(float(NEG_INF)))
@@ -159,9 +169,13 @@ def score_pods_tiled(state: ClusterState, pods: PodBatch,
     n_pad = _round_up(n_real, math.lcm(nb, kb))
     # Packed-array extents scale with the resource count (R resources
     # need 2R+2 nodef rows / R+1 podf columns; 8 covers the default
-    # R=3 and the lane tiling).
+    # R=3 and the lane tiling) and the mask width (4W nodei rows / 5W
+    # podi columns).
+    mw = cfg.mask_words
     nf_rows = _round_up(2 * r_res + 2, 8)
     pf_cols = _round_up(r_res + 1, 8)
+    ni_rows = _round_up(4 * mw, 8)
+    pi_cols = _round_up(5 * mw, 8)
 
     def pad(x, rows, cols=None):
         pr = rows - x.shape[0]
@@ -196,25 +210,26 @@ def score_pods_tiled(state: ClusterState, pods: PodBatch,
     nodef = nodef.at[2 * r_res + 1, :n_real].set(
         state.node_valid.astype(jnp.float32))
 
-    nodei = jnp.zeros((8, n_pad), jnp.int32)
-    nodei = nodei.at[0, :n_real].set(state.taint_bits.astype(jnp.int32))
-    nodei = nodei.at[1, :n_real].set(state.label_bits.astype(jnp.int32))
-    nodei = nodei.at[2, :n_real].set(state.group_bits.astype(jnp.int32))
-    nodei = nodei.at[3, :n_real].set(state.resident_anti.astype(jnp.int32))
+    nodei = jnp.zeros((ni_rows, n_pad), jnp.int32)
+    for f, bits in enumerate((state.taint_bits, state.label_bits,
+                              state.group_bits, state.resident_anti)):
+        nodei = nodei.at[f * mw:(f + 1) * mw, :n_real].set(
+            bits.astype(jnp.int32).T)
 
     podf = jnp.zeros((p_pad, pf_cols), jnp.float32)
     podf = podf.at[:p_real, 0:r_res].set(pods.req)
     podf = podf.at[:p_real, r_res].set(pods.pod_valid.astype(jnp.float32))
 
-    podi = jnp.zeros((p_pad, 8), jnp.int32)
-    for col, bits in enumerate((pods.tol_bits, pods.sel_bits,
-                                pods.affinity_bits, pods.anti_bits,
-                                pods.group_bit)):
-        podi = podi.at[:p_real, col].set(bits.astype(jnp.int32))
+    podi = jnp.zeros((p_pad, pi_cols), jnp.int32)
+    for f, bits in enumerate((pods.tol_bits, pods.sel_bits,
+                              pods.affinity_bits, pods.anti_bits,
+                              pods.group_bit)):
+        podi = podi.at[:p_real, f * mw:(f + 1) * mw].set(
+            bits.astype(jnp.int32))
 
     grid = (p_pad // bp, n_pad // nb, n_pad // kb)
     kernel = functools.partial(_kernel, block_n=nb, block_k=kb,
-                               num_resources=r_res,
+                               num_resources=r_res, mask_words=mw,
                                use_bfloat16=cfg.use_bfloat16)
     out = pl.pallas_call(
         kernel,
@@ -227,9 +242,9 @@ def score_pods_tiled(state: ClusterState, pods: PodBatch,
             pl.BlockSpec((nb, kb), lambda i, j, k: (j, k)),        # lat
             pl.BlockSpec((1, kb), lambda i, j, k: (0, k)),         # validk
             pl.BlockSpec((nf_rows, nb), lambda i, j, k: (0, j)),   # nodef
-            pl.BlockSpec((8, nb), lambda i, j, k: (0, j)),         # nodei
+            pl.BlockSpec((ni_rows, nb), lambda i, j, k: (0, j)),   # nodei
             pl.BlockSpec((bp, pf_cols), lambda i, j, k: (i, 0)),   # podf
-            pl.BlockSpec((bp, 8), lambda i, j, k: (i, 0)),         # podi
+            pl.BlockSpec((bp, pi_cols), lambda i, j, k: (i, 0)),   # podi
         ],
         out_specs=pl.BlockSpec((bp, nb), lambda i, j, k: (i, j)),
         scratch_shapes=[pltpu.VMEM((bp, nb), jnp.float32)],

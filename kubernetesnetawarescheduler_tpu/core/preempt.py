@@ -36,6 +36,7 @@ import numpy as np
 from kubernetesnetawarescheduler_tpu.core.encode import (
     Encoder,
     _requests_vector,
+    int_to_words,
 )
 from kubernetesnetawarescheduler_tpu.k8s.types import Pod
 
@@ -56,14 +57,43 @@ class PreemptionPlan:
     victims: tuple[Victim, ...]
 
 
+def _refs_after(refs_row: np.ndarray, evicted_bits: list[int]) -> int:
+    """Resident bit set remaining once the given members leave: bits
+    whose refcount survives the subtraction.  Phantom refs (checkpoint
+    restores without ledger members) keep their bit — conservative,
+    matching Encoder._release_record semantics."""
+    counts = refs_row.astype(np.int64).copy()
+    for bits in evicted_bits:
+        while bits:
+            b = bits & -bits
+            pos = b.bit_length() - 1
+            if counts[pos] > 0:
+                counts[pos] -= 1
+            bits ^= b
+    out = 0
+    for pos in np.nonzero(counts > 0)[0]:
+        out |= 1 << int(pos)
+    return out
+
+
 def plan_preemption(encoder: Encoder, pod: Pod) -> PreemptionPlan | None:
     """Find the cheapest eviction set that makes ``pod`` fit somewhere.
+
+    Mirrors the scoring kernel's FULL feasibility mask (not just
+    capacity): a plan is only made for a node where, after the chosen
+    victims leave, taints/selector/affinity/anti-affinity (both
+    directions) all pass — so real workloads are never evicted from a
+    node the kernel would still reject (the round-1 advisor finding).
+    Anti-affinity conflicts make their resident pods *mandatory*
+    victims; an un-internable selector keeps the node infeasible
+    (UNKNOWN sentinel, same as the kernel's lenient encode).
 
     Returns None when no node can host the pod even after evicting
     every strictly-lower-priority pod (the scoring kernel's own
     verdict of "unschedulable" then stands).
     """
     cfg = encoder.cfg
+    w = cfg.mask_words
     req = _requests_vector(pod.requests, cfg.num_resources)
     prio = float(pod.priority)
 
@@ -74,11 +104,16 @@ def plan_preemption(encoder: Encoder, pod: Pod) -> PreemptionPlan | None:
         valid = encoder._node_valid[:n_real].copy()
         cap = encoder._cap[:n_real].copy()
         used = encoder._used[:n_real].copy()
+        group_refs = encoder._group_refs[:n_real].copy()
+        anti_refs = encoder._anti_refs[:n_real].copy()
+        # Same interning (and overflow directions) as the kernel's
+        # lenient encode — _constraint_bits is the single source of
+        # truth; it also backfills lazily-interned selector labels,
+        # so the label/taint snapshots are taken AFTER it runs.
+        tol_i, sel_i, aff_i, anti_i, gbit_i = \
+            encoder._constraint_bits(pod, lenient=True)
         taints = encoder._taint_bits[:n_real].copy()
         labels = encoder._label_bits[:n_real].copy()
-        tol = np.uint32(encoder.taints.mask(pod.tolerations, lenient=True))
-        sel = np.uint32(encoder.labels.mask(pod.node_selector,
-                                            lenient=True))
         # Victim candidates per node: strictly lower priority only.
         victims_by_node: dict[int, list] = {}
         for uid, rec in encoder._committed.items():
@@ -86,9 +121,11 @@ def plan_preemption(encoder: Encoder, pod: Pod) -> PreemptionPlan | None:
                 victims_by_node.setdefault(rec.node, []).append((uid, rec))
         node_names = list(encoder._node_names)
 
+    tol_w = int_to_words(tol_i, w)
+    sel_w = int_to_words(sel_i, w)
     static_ok = (valid
-                 & ((taints & ~tol) == 0)
-                 & ((labels & sel) == sel))
+                 & np.all((taints & ~tol_w) == 0, axis=-1)
+                 & np.all((labels & sel_w) == sel_w, axis=-1))
 
     best: tuple[float, int, int] | None = None  # (max_vprio, count, node)
     best_set: list[Victim] = []
@@ -97,27 +134,66 @@ def plan_preemption(encoder: Encoder, pod: Pod) -> PreemptionPlan | None:
             continue
         cands = victims_by_node.get(node, [])
         free = cap[node] - used[node]
-        if np.all(req <= free + 1e-9):
-            # Statically fits with free capacity, yet the kernel said
-            # unschedulable — the block is something eviction cannot
-            # lift (affinity masks, in-batch contention).  Skip.
+
+        # Mandatory victims: residents whose group conflicts with the
+        # pod's anti-affinity, or who declared anti-affinity against
+        # the pod's group (the symmetric direction).  Only committed
+        # (ledgered, strictly-lower-priority) pods are evictable.
+        mandatory: list[tuple[str, object]] = []
+        if anti_i or gbit_i:
+            conflicted = [
+                (uid, rec) for uid, rec in cands
+                if (rec.group_bit & anti_i) or (rec.anti_bits & gbit_i)]
+            mandatory = conflicted
+
+        chosen_recs = list(mandatory)
+        chosen_uids = {uid for uid, _ in chosen_recs}
+
+        # After the mandatory set leaves, do the conflict bits clear?
+        # (A higher-priority member or a phantom ref keeps the bit —
+        # node infeasible.)
+        rem_group = _refs_after(
+            group_refs[node], [rec.group_bit for _, rec in chosen_recs])
+        rem_anti = _refs_after(
+            anti_refs[node], [rec.anti_bits for _, rec in chosen_recs])
+        if (rem_group & anti_i) or (rem_anti & gbit_i):
             continue
-        evictable = free + sum((rec.req for _, rec in cands),
-                               np.zeros_like(free))
-        if not np.all(req <= evictable + 1e-9):
-            continue
-        # Lowest-priority-first until the pod fits.
-        cands = sorted(cands, key=lambda e: (e[1].priority, e[1].stamp))
-        acc = free.copy()
-        chosen: list[Victim] = []
-        for uid, rec in cands:
-            if np.all(req <= acc + 1e-9):
-                break
-            acc = acc + rec.req
-            chosen.append(Victim(uid, rec.namespace, rec.name,
-                                 rec.priority, node_names[node]))
+
+        # Capacity: free + chosen victims' requests; extend
+        # lowest-priority-first until the pod fits.
+        acc = free + sum((rec.req for _, rec in chosen_recs),
+                         np.zeros_like(free))
         if not np.all(req <= acc + 1e-9):
+            extras = sorted(
+                (e for e in cands if e[0] not in chosen_uids),
+                key=lambda e: (e[1].priority, e[1].stamp))
+            for uid, rec in extras:
+                if np.all(req <= acc + 1e-9):
+                    break
+                acc = acc + rec.req
+                chosen_recs.append((uid, rec))
+                chosen_uids.add(uid)
+            if not np.all(req <= acc + 1e-9):
+                continue
+        elif not chosen_recs:
+            # Statically fits with free capacity and no conflicting
+            # residents, yet the kernel said unschedulable — the block
+            # is something eviction cannot lift (unsatisfied affinity,
+            # in-batch contention).  Skip.
             continue
+
+        # Required pod affinity must still hold after ALL evictions
+        # (capacity victims may carry the last member of a required
+        # group off the node).
+        if aff_i:
+            rem_group = _refs_after(
+                group_refs[node],
+                [rec.group_bit for _, rec in chosen_recs])
+            if not (rem_group & aff_i):
+                continue
+
+        chosen = [Victim(uid, rec.namespace, rec.name, rec.priority,
+                         node_names[node]) for uid, rec in chosen_recs]
         key = (max((v.priority for v in chosen), default=-np.inf),
                len(chosen), node)
         if best is None or key < best:

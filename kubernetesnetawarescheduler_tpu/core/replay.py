@@ -57,11 +57,11 @@ class PodStream:
     peer_pods: jax.Array      # i32[S, K]  stream index or -1
     peer_nodes: jax.Array     # i32[S, K]  node index or -1
     peer_traffic: jax.Array   # f32[S, K]
-    tol_bits: jax.Array       # u32[S]
-    sel_bits: jax.Array       # u32[S]
-    affinity_bits: jax.Array  # u32[S]
-    anti_bits: jax.Array      # u32[S]
-    group_bit: jax.Array      # u32[S]
+    tol_bits: jax.Array       # u32[S, W]
+    sel_bits: jax.Array       # u32[S, W]
+    affinity_bits: jax.Array  # u32[S, W]
+    anti_bits: jax.Array      # u32[S, W]
+    group_bit: jax.Array      # u32[S, W]
     priority: jax.Array       # f32[S]
     pod_valid: jax.Array      # bool[S]
 

@@ -192,13 +192,23 @@ def feasibility_mask(state: ClusterState, pods: PodBatch) -> jax.Array:
     """
     free = state.cap - state.used
     fits = jnp.all(pods.req[:, None, :] <= free[None, :, :] + _EPS, axis=-1)
-    tol = (state.taint_bits[None, :] & ~pods.tol_bits[:, None]) == 0
-    sel = (state.label_bits[None, :] & pods.sel_bits[:, None]) \
-        == pods.sel_bits[:, None]
-    aff_req = pods.affinity_bits[:, None]
-    affinity = (aff_req == 0) | ((state.group_bits[None, :] & aff_req) != 0)
-    anti = (state.group_bits[None, :] & pods.anti_bits[:, None]) == 0
-    sym = (state.resident_anti[None, :] & pods.group_bit[:, None]) == 0
+    # Bit fields are multi-word u32[., W]: subset/overlap tests reduce
+    # over the trailing word axis.
+    tol = jnp.all(
+        (state.taint_bits[None, :, :] & ~pods.tol_bits[:, None, :]) == 0,
+        axis=-1)
+    sel = jnp.all(
+        (state.label_bits[None, :, :] & pods.sel_bits[:, None, :])
+        == pods.sel_bits[:, None, :], axis=-1)
+    aff_req = pods.affinity_bits[:, None, :]
+    affinity = jnp.all(aff_req == 0, axis=-1) | jnp.any(
+        (state.group_bits[None, :, :] & aff_req) != 0, axis=-1)
+    anti = jnp.all(
+        (state.group_bits[None, :, :] & pods.anti_bits[:, None, :]) == 0,
+        axis=-1)
+    sym = jnp.all(
+        (state.resident_anti[None, :, :] & pods.group_bit[:, None, :]) == 0,
+        axis=-1)
     ok = fits & tol & sel & affinity & anti & sym
     return ok & state.node_valid[None, :] & pods.pod_valid[:, None]
 

@@ -48,12 +48,15 @@ class ClusterState:
     - ``cap``          f32[N, R]   allocatable capacity
     - ``used``         f32[N, R]   currently allocated
     - ``node_valid``   bool[N]     padding/health mask
-    - ``label_bits``   u32[N]      interned node-label set (bitmask)
-    - ``taint_bits``   u32[N]      interned taint set (bitmask)
-    - ``group_bits``   u32[N]      pod-groups present on the node
+    - ``label_bits``   u32[N, W]   interned node-label set (multi-word
+                                   bitmask, ``W = cfg.mask_words``;
+                                   lazily populated — only selector-
+                                   referenced labels carry bits)
+    - ``taint_bits``   u32[N, W]   interned taint set (bitmask)
+    - ``group_bits``   u32[N, W]   pod-groups present on the node
                                    (inter-pod affinity at hostname
                                    topology, as batched masks)
-    - ``resident_anti`` u32[N]     OR of the anti-affinity selectors of
+    - ``resident_anti`` u32[N, W]  OR of the anti-affinity selectors of
                                    pods already on the node — enforces
                                    k8s's *symmetric* required
                                    anti-affinity (a group-G pod may not
@@ -98,14 +101,14 @@ class PodBatch:
                                     the pod exchanges traffic with
                                     (-1 = padding)
     - ``peer_traffic``   f32[P, K]  relative traffic volume per peer
-    - ``tol_bits``       u32[P]     tolerated taints (bitmask)
-    - ``sel_bits``       u32[P]     required node labels (bitmask; node
+    - ``tol_bits``       u32[P, W]  tolerated taints (bitmask)
+    - ``sel_bits``       u32[P, W]  required node labels (bitmask; node
                                     must have ALL of these)
-    - ``affinity_bits``  u32[P]     required co-located pod groups (node
+    - ``affinity_bits``  u32[P, W]  required co-located pod groups (node
                                     must host at least one if nonzero)
-    - ``anti_bits``      u32[P]     anti-affinity pod groups (node must
+    - ``anti_bits``      u32[P, W]  anti-affinity pod groups (node must
                                     host NONE)
-    - ``group_bit``      u32[P]     the pod's own group bit (0 = none),
+    - ``group_bit``      u32[P, W]  the pod's own group bit (0 = none),
                                     committed to ``group_bits`` on bind
     - ``priority``       f32[P]     scheduling priority (higher first)
     - ``pod_valid``      bool[P]    padding mask
@@ -134,6 +137,7 @@ class PodBatch:
 def init_cluster_state(cfg: SchedulerConfig, **overrides: Any) -> ClusterState:
     """An empty, all-padding cluster of static shape."""
     n, m, r = cfg.max_nodes, cfg.num_metrics, cfg.num_resources
+    w = cfg.mask_words
     fields = dict(
         metrics=jnp.zeros((n, m), jnp.float32),
         metrics_age=jnp.zeros((n,), jnp.float32),
@@ -142,10 +146,10 @@ def init_cluster_state(cfg: SchedulerConfig, **overrides: Any) -> ClusterState:
         cap=jnp.zeros((n, r), jnp.float32),
         used=jnp.zeros((n, r), jnp.float32),
         node_valid=jnp.zeros((n,), jnp.bool_),
-        label_bits=jnp.zeros((n,), jnp.uint32),
-        taint_bits=jnp.zeros((n,), jnp.uint32),
-        group_bits=jnp.zeros((n,), jnp.uint32),
-        resident_anti=jnp.zeros((n,), jnp.uint32),
+        label_bits=jnp.zeros((n, w), jnp.uint32),
+        taint_bits=jnp.zeros((n, w), jnp.uint32),
+        group_bits=jnp.zeros((n, w), jnp.uint32),
+        resident_anti=jnp.zeros((n, w), jnp.uint32),
     )
     fields.update(overrides)
     return ClusterState(**fields)
@@ -154,15 +158,16 @@ def init_cluster_state(cfg: SchedulerConfig, **overrides: Any) -> ClusterState:
 def init_pod_batch(cfg: SchedulerConfig, **overrides: Any) -> PodBatch:
     """An empty, all-padding pod batch of static shape."""
     p, k, r = cfg.max_pods, cfg.max_peers, cfg.num_resources
+    w = cfg.mask_words
     fields = dict(
         req=jnp.zeros((p, r), jnp.float32),
         peers=jnp.full((p, k), -1, jnp.int32),
         peer_traffic=jnp.zeros((p, k), jnp.float32),
-        tol_bits=jnp.zeros((p,), jnp.uint32),
-        sel_bits=jnp.zeros((p,), jnp.uint32),
-        affinity_bits=jnp.zeros((p,), jnp.uint32),
-        anti_bits=jnp.zeros((p,), jnp.uint32),
-        group_bit=jnp.zeros((p,), jnp.uint32),
+        tol_bits=jnp.zeros((p, w), jnp.uint32),
+        sel_bits=jnp.zeros((p, w), jnp.uint32),
+        affinity_bits=jnp.zeros((p, w), jnp.uint32),
+        anti_bits=jnp.zeros((p, w), jnp.uint32),
+        group_bit=jnp.zeros((p, w), jnp.uint32),
         priority=jnp.zeros((p,), jnp.float32),
         pod_valid=jnp.zeros((p,), jnp.bool_),
     )
@@ -170,21 +175,41 @@ def init_pod_batch(cfg: SchedulerConfig, **overrides: Any) -> PodBatch:
     return PodBatch(**fields)
 
 
-def scatter_or_onehot(onehot: jax.Array, bits: jax.Array) -> jax.Array:
-    """Per-node OR of per-pod bitmasks: ``out[n] = OR_p onehot[p,n] ?
-    bits[p]``.
-
-    Decomposed into bitplanes (any-reduce per bit, then a weighted sum
-    — exact because bit positions are distinct powers of two) instead
-    of a raw ``lax.reduce`` with ``bitwise_or``, which GSPMD cannot
-    partition across a sharded pod axis.
-    """
-    contrib = jnp.where(onehot, bits[:, None], jnp.uint32(0))
+def bit_planes(bits: jax.Array) -> jax.Array:
+    """Decompose ``u32[P, W]`` masks into 0/1 bf16 bitplanes
+    ``[P, W*32]`` (bf16 so the plane reduction can ride the MXU; 0/1
+    inputs with f32 accumulation give exact counts for any P)."""
+    p, w = bits.shape
     shifts = jnp.arange(32, dtype=jnp.uint32)
-    planes = (contrib[..., None] >> shifts) & jnp.uint32(1)  # [P, N, 32]
-    present = jnp.any(planes > 0, axis=0)                    # [N, 32]
-    return jnp.sum(present.astype(jnp.uint32) << shifts, axis=-1,
-                   dtype=jnp.uint32)
+    return ((bits[:, :, None] >> shifts) & jnp.uint32(1)) \
+        .reshape(p, w * 32).astype(jnp.bfloat16)
+
+
+def planes_to_words(present: jax.Array) -> jax.Array:
+    """Re-pack boolean bitplanes ``[N, W*32]`` into ``u32[N, W]``
+    masks (inverse of :func:`bit_planes` on presence)."""
+    n, cols = present.shape
+    w = cols // 32
+    shifts = jnp.arange(32, dtype=jnp.uint32)
+    return jnp.sum(present.reshape(n, w, 32).astype(jnp.uint32) << shifts,
+                   axis=-1, dtype=jnp.uint32)
+
+
+def scatter_or_onehot(onehot: jax.Array, bits: jax.Array) -> jax.Array:
+    """Per-node OR of per-pod multi-word bitmasks: ``out[n, :] =
+    OR_p onehot[p, n] ? bits[p, :]`` for ``bits u32[P, W]``.
+
+    Decomposed into bitplanes and reduced over the pod axis with ONE
+    ``[N, P] x [P, W*32]`` MXU matmul (count > 0 ⇔ bit present)
+    instead of a ``lax.reduce`` with ``bitwise_or``, which GSPMD cannot
+    partition across a sharded pod axis (the matmul's pod-axis
+    contraction becomes a plain psum).
+    """
+    counts = jax.lax.dot_general(
+        onehot.astype(jnp.bfloat16), bit_planes(bits),
+        (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)          # [N, W*32]
+    return planes_to_words(counts > 0.5)
 
 
 def commit_assignments(state: ClusterState, pods: PodBatch,

@@ -58,10 +58,10 @@ def state_sharding(mesh: Mesh) -> ClusterState:
         cap=s("tp", None),
         used=s("tp", None),
         node_valid=s("tp"),
-        label_bits=s("tp"),
-        taint_bits=s("tp"),
-        group_bits=s("tp"),
-        resident_anti=s("tp"),
+        label_bits=s("tp", None),
+        taint_bits=s("tp", None),
+        group_bits=s("tp", None),
+        resident_anti=s("tp", None),
     )
 
 
@@ -73,11 +73,11 @@ def pods_sharding(mesh: Mesh) -> PodBatch:
         req=s("dp", None),
         peers=s("dp", None),
         peer_traffic=s("dp", None),
-        tol_bits=s("dp"),
-        sel_bits=s("dp"),
-        affinity_bits=s("dp"),
-        anti_bits=s("dp"),
-        group_bit=s("dp"),
+        tol_bits=s("dp", None),
+        sel_bits=s("dp", None),
+        affinity_bits=s("dp", None),
+        anti_bits=s("dp", None),
+        group_bit=s("dp", None),
         priority=s("dp"),
         pod_valid=s("dp"),
     )

@@ -26,6 +26,16 @@ def random_instance(rng: np.random.Generator, cfg: SchedulerConfig,
     n = n_nodes if n_nodes is not None else n_total
     p = n_pods if n_pods is not None else p_total
 
+    w = cfg.mask_words
+
+    def bits_col(col: np.ndarray) -> np.ndarray:
+        """Widen a single-word bit column to the u32[., W] layout,
+        placing the payload in the LAST word so multi-word handling is
+        exercised end-to-end (word 0 stays zero when W > 1)."""
+        out = np.zeros((col.shape[0], w), np.uint32)
+        out[:, w - 1] = col
+        return out
+
     node_valid = np.zeros((n_total,), bool)
     node_valid[:n] = True
     lat = rng.uniform(0.1, 20.0, (n_total, n_total)).astype(np.float32)
@@ -45,12 +55,16 @@ def random_instance(rng: np.random.Generator, cfg: SchedulerConfig,
         cap=cap,
         used=used,
         node_valid=node_valid,
-        label_bits=rng.integers(0, 8, (n_total,)).astype(np.uint32),
-        taint_bits=(rng.random((n_total,)) < 0.2).astype(np.uint32)
-        * np.uint32(1 if with_constraints else 0),
-        group_bits=rng.integers(0, 4, (n_total,)).astype(np.uint32),
-        resident_anti=(rng.integers(0, 4, (n_total,)).astype(np.uint32)
-                       * np.uint32(1 if with_constraints else 0)),
+        label_bits=bits_col(
+            rng.integers(0, 8, (n_total,)).astype(np.uint32)),
+        taint_bits=bits_col(
+            (rng.random((n_total,)) < 0.2).astype(np.uint32)
+            * np.uint32(1 if with_constraints else 0)),
+        group_bits=bits_col(
+            rng.integers(0, 4, (n_total,)).astype(np.uint32)),
+        resident_anti=bits_col(
+            rng.integers(0, 4, (n_total,)).astype(np.uint32)
+            * np.uint32(1 if with_constraints else 0)),
     )
 
     pod_valid = np.zeros((p_total,), bool)
@@ -60,14 +74,20 @@ def random_instance(rng: np.random.Generator, cfg: SchedulerConfig,
         req=rng.uniform(0.1, 4.0, (p_total, r)).astype(np.float32),
         peers=peers,
         peer_traffic=rng.uniform(0.0, 5.0, (p_total, k)).astype(np.float32),
-        tol_bits=(rng.random((p_total,)) < 0.5).astype(np.uint32),
-        sel_bits=(rng.integers(0, 4, (p_total,)).astype(np.uint32)
-                  * np.uint32(1 if with_constraints else 0)),
-        affinity_bits=(rng.random((p_total,)) < 0.15).astype(np.uint32)
-        * np.uint32(2 if with_constraints else 0),
-        anti_bits=(rng.random((p_total,)) < 0.15).astype(np.uint32)
-        * np.uint32(1 if with_constraints else 0),
-        group_bit=np.uint32(1) << rng.integers(0, 2, (p_total,)).astype(np.uint32),
+        tol_bits=bits_col(
+            (rng.random((p_total,)) < 0.5).astype(np.uint32)),
+        sel_bits=bits_col(
+            rng.integers(0, 4, (p_total,)).astype(np.uint32)
+            * np.uint32(1 if with_constraints else 0)),
+        affinity_bits=bits_col(
+            (rng.random((p_total,)) < 0.15).astype(np.uint32)
+            * np.uint32(2 if with_constraints else 0)),
+        anti_bits=bits_col(
+            (rng.random((p_total,)) < 0.15).astype(np.uint32)
+            * np.uint32(1 if with_constraints else 0)),
+        group_bit=bits_col(
+            np.uint32(1) << rng.integers(0, 2, (p_total,)).astype(
+                np.uint32)),
         priority=rng.uniform(0.0, 10.0, (p_total,)).astype(np.float32),
         pod_valid=pod_valid,
     )

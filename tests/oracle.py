@@ -15,6 +15,16 @@ NEG_INF = -1e30
 EPS = 1e-9
 
 
+def as_int(words) -> int:
+    """A multi-word uint32 bit row as one arbitrary-precision int
+    (little-endian words; independent reimplementation of
+    core.encode.words_to_int for oracle independence)."""
+    out = 0
+    for i, w in enumerate(np.atleast_1d(np.asarray(words))):
+        out |= int(w) << (32 * i)
+    return out
+
+
 def oracle_normalize(metrics, node_valid, goodness):
     n, m = metrics.shape
     out = np.zeros((n, m), np.float32)
@@ -106,13 +116,14 @@ def oracle_feasible(state, pods, used=None, group_bits=None,
                 continue
             fits = all(pods["req"][i, r] <= state["cap"][j, r] - used[j, r] + EPS
                        for r in range(state["cap"].shape[1]))
-            tol = (int(state["taint_bits"][j]) & ~int(pods["tol_bits"][i])) == 0
-            sel = (int(state["label_bits"][j]) & int(pods["sel_bits"][i])) \
-                == int(pods["sel_bits"][i])
-            aff = (int(pods["affinity_bits"][i]) == 0
-                   or (int(group_bits[j]) & int(pods["affinity_bits"][i])) != 0)
-            anti = (int(group_bits[j]) & int(pods["anti_bits"][i])) == 0
-            sym = (int(resident_anti[j]) & int(pods["group_bit"][i])) == 0
+            tol = (as_int(state["taint_bits"][j])
+                   & ~as_int(pods["tol_bits"][i])) == 0
+            sel = (as_int(state["label_bits"][j]) & as_int(pods["sel_bits"][i])) \
+                == as_int(pods["sel_bits"][i])
+            aff = (as_int(pods["affinity_bits"][i]) == 0
+                   or (as_int(group_bits[j]) & as_int(pods["affinity_bits"][i])) != 0)
+            anti = (as_int(group_bits[j]) & as_int(pods["anti_bits"][i])) == 0
+            sym = (as_int(resident_anti[j]) & as_int(pods["group_bit"][i])) == 0
             ok[i, j] = fits and tol and sel and aff and anti and sym
     return ok
 

@@ -10,6 +10,8 @@ property is unattainable there.)
 from __future__ import annotations
 
 import numpy as np
+
+from kubernetesnetawarescheduler_tpu.core.encode import words_to_int
 import pytest
 
 from kubernetesnetawarescheduler_tpu.bench.fakecluster import (
@@ -139,11 +141,11 @@ def test_restore_rebuilds_group_refcounts(tmp_path):
     path = str(tmp_path / "ck")
     save_checkpoint(path, enc)
     enc2 = load_checkpoint(path, cfg)
-    assert enc2._group_bits[0] & gbit
+    assert (words_to_int(enc2._group_bits[0]) & gbit)
     enc2.release(p1)
-    assert enc2._group_bits[0] & gbit  # one member left
+    assert (words_to_int(enc2._group_bits[0]) & gbit)  # one member left
     enc2.release(p2)
-    assert not (enc2._group_bits[0] & gbit)  # last member gone
+    assert not ((words_to_int(enc2._group_bits[0]) & gbit))  # last member gone
 
     # Pre-upgrade shape: strip the persisted group bits from the meta.
     meta_path = os.path.join(path, "meta.json")
@@ -152,9 +154,9 @@ def test_restore_rebuilds_group_refcounts(tmp_path):
                          for uid, entry in meta["committed"].items()}
     json.dump(meta, open(meta_path, "w"))
     enc3 = load_checkpoint(path, cfg)
-    assert enc3._group_bits[0] & gbit
+    assert (words_to_int(enc3._group_bits[0]) & gbit)
     enc3.release(p1)
     enc3.release(p2)
     # Phantom ref: the bit must NOT clear (members may predate the
     # ledger's group tracking).
-    assert enc3._group_bits[0] & gbit
+    assert (words_to_int(enc3._group_bits[0]) & gbit)

@@ -1,0 +1,147 @@
+"""Interner scalability: the round-1 "works only on 31 labels" fix.
+
+The reference hardcoded its 5 node names (scheduler.go:252-256); round 1
+of this build reproduced that failure shape at N=31 by eagerly interning
+every node label (including per-node-unique ``kubernetes.io/hostname``)
+into a single 31-bit space.  These tests pin the fix:
+
+- node labels are interned LAZILY — only selector-referenced strings get
+  bits, so 1,000 nodes with unique hostname labels register fine;
+- selectors referencing a label AFTER nodes carrying it registered get
+  the bit backfilled onto those nodes;
+- all bitmask columns are multi-word (``cfg.mask_words``), so >31
+  distinct groups/taints/selector labels work.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from kubernetesnetawarescheduler_tpu.config import SchedulerConfig
+from kubernetesnetawarescheduler_tpu.core.assign import assign_parallel
+from kubernetesnetawarescheduler_tpu.core.encode import (
+    Encoder,
+    words_to_int,
+)
+from kubernetesnetawarescheduler_tpu.k8s.types import Node, Pod
+
+
+def _node(i: int, extra: dict | None = None, n_shared: int = 20) -> Node:
+    labels = {f"kubernetes.io/hostname=node-{i:04d}",
+              f"topology.kubernetes.io/zone=zone-{i % 3}"}
+    labels |= {f"shared-label-{j}=v" for j in range(n_shared)}
+    if extra:
+        labels |= {f"{k}={v}" for k, v in extra.items()}
+    return Node(name=f"node-{i:04d}", capacity={"cpu": 16.0, "mem": 64.0},
+                labels=frozenset(labels))
+
+
+def test_thousand_nodes_with_unique_hostnames():
+    """VERDICT #2 done-criterion: 1,000 nodes each carrying a unique
+    hostname label plus 20 shared labels register and schedule."""
+    cfg = SchedulerConfig(max_nodes=1024, max_pods=4, max_peers=2)
+    enc = Encoder(cfg)
+    for i in range(1000):
+        enc.upsert_node(_node(i))
+    assert enc.num_nodes == 1000
+    # Unreferenced labels consumed zero interner slots.
+    assert len(enc.labels._bits) == 0
+
+    # An unconstrained pod schedules.
+    pods = [Pod(name="p0", requests={"cpu": 1.0})]
+    batch = enc.encode_pods(pods, node_of=lambda s: "")
+    state = enc.snapshot()
+    a = np.asarray(assign_parallel(state, batch, cfg))
+    assert a[0] >= 0
+
+    # A pod selecting a specific hostname lands exactly there
+    # (selector interned lazily, bit backfilled onto the carrier).
+    sel = Pod(name="p1", requests={"cpu": 1.0},
+              node_selector=frozenset(
+                  {"kubernetes.io/hostname=node-0777"}))
+    batch = enc.encode_pods([sel], node_of=lambda s: "")
+    state = enc.snapshot()
+    a = np.asarray(assign_parallel(state, batch, cfg))
+    assert enc.node_name(int(a[0])) == "node-0777"
+    # Exactly one label slot was consumed by that selector.
+    assert len(enc.labels._bits) == 1
+
+
+def test_selector_backfill_after_registration():
+    """A label interned by a selector AFTER its carriers registered is
+    set on every carrier (and only those)."""
+    cfg = SchedulerConfig(max_nodes=8, max_pods=2, max_peers=2)
+    enc = Encoder(cfg)
+    for i in range(6):
+        extra = {"disktype": "ssd"} if i % 2 == 0 else {}
+        enc.upsert_node(_node(i, extra=extra, n_shared=2))
+    pod = Pod(name="p", requests={"cpu": 1.0},
+              node_selector=frozenset({"disktype=ssd"}))
+    batch = enc.encode_pods([pod], node_of=lambda s: "")
+    bit = enc.labels._bits["disktype=ssd"]
+    for i in range(6):
+        has = bool(words_to_int(enc._label_bits[i]) >> bit & 1)
+        assert has == (i % 2 == 0)
+    state = enc.snapshot()
+    a = np.asarray(assign_parallel(state, batch, cfg))
+    assert int(a[0]) % 2 == 0
+
+
+def test_label_refresh_clears_stale_bits():
+    """Re-upserting a node with changed labels drops bits for labels it
+    no longer carries."""
+    cfg = SchedulerConfig(max_nodes=4, max_pods=2, max_peers=2)
+    enc = Encoder(cfg)
+    enc.upsert_node(Node(name="n0", capacity={"cpu": 4.0},
+                         labels=frozenset({"tier=gold"})))
+    pod = Pod(name="p", requests={"cpu": 1.0},
+              node_selector=frozenset({"tier=gold"}))
+    enc.encode_pods([pod], node_of=lambda s: "")
+    bit = enc.labels._bits["tier=gold"]
+    assert words_to_int(enc._label_bits[0]) >> bit & 1
+    enc.upsert_node(Node(name="n0", capacity={"cpu": 4.0},
+                         labels=frozenset({"tier=bronze"})))
+    assert not (words_to_int(enc._label_bits[0]) >> bit & 1)
+    assert 0 not in enc._label_nodes.get("tier=gold", set())
+
+
+def test_many_groups_beyond_32():
+    """Multi-word masks: 100 distinct affinity groups (over the old
+    31-bit ceiling) intern and enforce correctly."""
+    cfg = SchedulerConfig(max_nodes=8, max_pods=4, max_peers=2)
+    enc = Encoder(cfg)
+    assert cfg.mask_words * 32 - 1 >= 100
+    for i in range(4):
+        enc.upsert_node(Node(name=f"n{i}", capacity={"cpu": 100.0}))
+    # Burn 99 group slots.
+    for g in range(99):
+        enc.groups.bit(f"svc-{g}")
+    # Group 99 (bit position 99 — word 3) still works end-to-end:
+    # symmetric anti-affinity keeps an anti-svc pod off the node.
+    a_pod = Pod(name="a", uid="a", group="svc-99",
+                requests={"cpu": 1.0})
+    enc.commit(a_pod, "n0")
+    b = Pod(name="b", requests={"cpu": 1.0},
+            anti_groups=frozenset({"svc-99"}))
+    batch = enc.encode_pods([b], node_of=lambda s: "")
+    state = enc.snapshot()
+    a = np.asarray(assign_parallel(state, batch, cfg))
+    assert a[0] >= 0 and enc.node_name(int(a[0])) != "n0"
+    # And affinity to that group pulls a pod ONTO the node.
+    c = Pod(name="c", requests={"cpu": 1.0},
+            affinity_groups=frozenset({"svc-99"}))
+    batch = enc.encode_pods([c], node_of=lambda s: "")
+    a = np.asarray(assign_parallel(enc.snapshot(), batch, cfg))
+    assert enc.node_name(int(a[0])) == "n0"
+
+
+def test_interner_overflow_still_guarded():
+    """Strict interning still raises (with a helpful message) when the
+    widened space is exhausted."""
+    cfg = SchedulerConfig(max_nodes=4, max_pods=2, mask_words=1)
+    enc = Encoder(cfg)
+    for g in range(31):
+        enc.groups.bit(f"g{g}")
+    with pytest.raises(ValueError, match="mask_words"):
+        enc.groups.bit("one-too-many")

@@ -15,6 +15,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 import pytest
 
+from kubernetesnetawarescheduler_tpu.core.encode import words_to_int
 from kubernetesnetawarescheduler_tpu.k8s.kubeclient import (
     KubeClient,
     node_from_json,
@@ -368,7 +369,7 @@ def test_group_bits_clear_when_last_member_leaves():
                       Pod(name="g2", group="g", requests={"cpu": 1.0})])
     assert loop.run_until_drained() == 2
     gbit = loop.encoder.groups.bit("g")
-    assert loop.encoder._group_bits[0] & gbit
+    assert (words_to_int(loop.encoder._group_bits[0]) & gbit)
 
     # An anti-'g' pod is blocked while members remain.
     cluster.add_pod(Pod(name="anti", anti_groups=frozenset({"g"}),
@@ -377,9 +378,9 @@ def test_group_bits_clear_when_last_member_leaves():
     assert cluster.node_of("anti") == ""
 
     cluster.delete_pod("g1")
-    assert loop.encoder._group_bits[0] & gbit  # one member left
+    assert (words_to_int(loop.encoder._group_bits[0]) & gbit)  # one member left
     cluster.delete_pod("g2")
-    assert not (loop.encoder._group_bits[0] & gbit)  # last member gone
+    assert not ((words_to_int(loop.encoder._group_bits[0]) & gbit))  # last member gone
 
     # The previously blocked pod now schedules via resync.
     loop.informer.resync()

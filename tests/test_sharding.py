@@ -91,11 +91,11 @@ def test_sharded_replay_matches_single_device():
                      rng.integers(0, n, (s, k)), -1).astype(np.int32)),
         peer_traffic=jnp.asarray(
             rng.uniform(0, 3, (s, k)).astype(np.float32)),
-        tol_bits=jnp.zeros((s,), jnp.uint32),
-        sel_bits=jnp.zeros((s,), jnp.uint32),
-        affinity_bits=jnp.zeros((s,), jnp.uint32),
-        anti_bits=jnp.zeros((s,), jnp.uint32),
-        group_bit=jnp.zeros((s,), jnp.uint32),
+        tol_bits=jnp.zeros((s, CFG.mask_words), jnp.uint32),
+        sel_bits=jnp.zeros((s, CFG.mask_words), jnp.uint32),
+        affinity_bits=jnp.zeros((s, CFG.mask_words), jnp.uint32),
+        anti_bits=jnp.zeros((s, CFG.mask_words), jnp.uint32),
+        group_bit=jnp.zeros((s, CFG.mask_words), jnp.uint32),
         priority=jnp.asarray(rng.uniform(0, 5, (s,)).astype(np.float32)),
         pod_valid=jnp.ones((s,), bool),
     )
