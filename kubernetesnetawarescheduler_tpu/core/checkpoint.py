@@ -192,7 +192,14 @@ def load_checkpoint(path: str,
                     f"expected {target.shape}")
             target[...] = stored
     enc._node_names = list(meta["node_names"])
-    enc._node_index = {n: i for i, n in enumerate(enc._node_names)}
+    # "" entries are tombstones of removed nodes: not indexable, and
+    # their slots go back on the free list (order preserved).
+    enc._node_index = {n: i for i, n in enumerate(enc._node_names) if n}
+    enc._free_slots = [i for i, n in enumerate(enc._node_names) if not n]
+    # Generations/stamps are process-local guards (in-flight cycles,
+    # reconcile races) — a fresh process has neither, so zeros suffice.
+    enc._node_gen = [0] * len(enc._node_names)
+    enc._node_stamp = [0.0] * len(enc._node_names)
     for attr, table in meta["interners"].items():
         getattr(enc, attr)._bits = {k: int(v) for k, v in table.items()}
     for idx_s, labels in meta.get("node_labels", {}).items():

@@ -164,6 +164,17 @@ class Encoder:
         self.groups = Interner("group", w)
         self._node_index: dict[str, int] = {}
         self._node_names: list[str] = []
+        # Slots freed by remove_node, reused FIFO (oldest-freed first).
+        # _node_gen[i] increments on every removal, so an in-flight
+        # scheduling cycle that captured the pre-removal name table
+        # (node_table()) can detect that slot i now means a different
+        # node and drop the stale commit instead of booking usage onto
+        # the wrong node.  _node_stamp[i] is the registration time
+        # (monotonic) guarding the reconcile race where a node is
+        # registered after a list_nodes() snapshot was taken.
+        self._free_slots: "list[int]" = []
+        self._node_gen: "list[int]" = []
+        self._node_stamp: "list[float]" = []
         self._lock = threading.RLock()
 
         # Lazy label interning: a node's raw label strings live here;
@@ -234,15 +245,25 @@ class Encoder:
         with self._lock:
             idx = self._node_index.get(node.name)
             if idx is None:
-                if len(self._node_names) >= self.cfg.max_nodes:
+                if self._free_slots:
+                    idx = self._free_slots.pop(0)
+                    self._node_names[idx] = node.name
+                elif len(self._node_names) >= self.cfg.max_nodes:
                     raise ValueError(
                         f"cluster exceeds max_nodes={self.cfg.max_nodes}")
-                idx = len(self._node_names)
-                self._node_names.append(node.name)
+                else:
+                    idx = len(self._node_names)
+                    self._node_names.append(node.name)
+                    self._node_gen.append(0)
+                    self._node_stamp.append(0.0)
                 self._node_index[node.name] = idx
+                self._node_stamp[idx] = time.monotonic()
             self._cap[idx] = _requests_vector(node.capacity,
                                               self.cfg.num_resources)
-            self._node_valid[idx] = node.ready
+            # A cordoned (spec.unschedulable) node drops out of every
+            # mask exactly like an unready one — running pods keep
+            # their usage, new pods don't land.
+            self._node_valid[idx] = node.ready and not node.unschedulable
             self._set_node_labels(idx, node.labels)
             # Node taints ARE eager: every taint must be representable
             # or pods lacking a toleration could slip on (the
@@ -301,15 +322,110 @@ class Encoder:
 
     def mark_unready(self, name: str) -> None:
         """Failure detection hook: an unready node drops out of every
-        mask without resizing anything."""
+        mask without resizing anything.  Unknown names are ignored —
+        scrape/probe threads hold target lists that can lag a node
+        removal, and a KeyError here would kill the ingest thread."""
         with self._lock:
-            self._node_valid[self._node_index[name]] = False
+            idx = self._node_index.get(name)
+            if idx is None:
+                return
+            self._node_valid[idx] = False
             self._dirty["topo"] = True
+
+    def remove_node(self, name: str) -> None:
+        """Node DELETED: free the slot for reuse.
+
+        The reference was blind to node removal (scheduler.go:175-184
+        logs node ADDs only), and round 1 of this build leaked slots
+        until ``max_nodes`` — fatal for a long-running daemon on a
+        churning cluster.  Everything the node carried is cleared:
+        telemetry, lat/bw row+column, capacity/usage, constraint bits,
+        refcounts, the label reverse map, and every usage-ledger entry
+        for pods that lived there (their node is gone; the watch will
+        also deliver their deletions, which then no-op as early-release
+        markers).  Unknown names are ignored (duplicate DELETED
+        delivery)."""
+        with self._lock:
+            idx = self._node_index.pop(name, None)
+            if idx is None:
+                return
+            # Release ledger entries bound to this node BEFORE zeroing
+            # usage (release subtracts; the zeroing below makes the
+            # order moot, but the refcount arrays must agree).
+            for uid in [u for u, rec in self._committed.items()
+                        if rec.node == idx]:
+                del self._committed[uid]
+            self._metrics[idx] = 0.0
+            self._metrics_age[idx] = 1e9
+            self._lat[idx, :] = 0.0
+            self._lat[:, idx] = 0.0
+            self._bw[idx, :] = 0.0
+            self._bw[:, idx] = 0.0
+            self._cap[idx] = 0.0
+            self._used[idx] = 0.0
+            self._node_valid[idx] = False
+            self._set_node_labels(idx, ())
+            self._node_labels.pop(idx, None)
+            self._taint_bits[idx] = 0
+            self._group_bits[idx] = 0
+            self._resident_anti[idx] = 0
+            self._group_refs[idx] = 0
+            self._anti_refs[idx] = 0
+            self._node_names[idx] = ""
+            self._node_gen[idx] += 1
+            self._free_slots.append(idx)
+            for key in self._dirty:
+                self._dirty[key] = True
+
+    def is_committed(self, uid: str) -> bool:
+        """Whether a pod's usage is in the ledger (cheap duplicate
+        check for the loop's healed-409 path)."""
+        with self._lock:
+            return uid in self._committed
+
+    def known_node_names(self) -> list[str]:
+        """Currently registered node names (copy, lock-consistent)."""
+        with self._lock:
+            return list(self._node_index)
+
+    def node_table(self) -> tuple[list[str], list[int]]:
+        """Snapshot of ``(slot -> name, slot -> generation)`` taken in
+        one lock acquisition.  A scheduling cycle resolves assignment
+        indices against THIS table (not live lookups), and re-checks
+        the generation before committing usage — so a slot freed and
+        reused mid-cycle yields the old (now-unknown) node name at bind
+        (rejected by the API server) rather than a silent bind/commit
+        onto whatever node inherited the index."""
+        with self._lock:
+            return list(self._node_names), list(self._node_gen)
+
+    def slot_generation(self, idx: int) -> int:
+        with self._lock:
+            return self._node_gen[idx]
+
+    def reconcile_nodes(self, listed_names, listed_at: float) -> int:
+        """Remove registered nodes absent from a full node listing.
+
+        ``listed_at`` (``time.monotonic()`` taken BEFORE the listing
+        request) guards the race where a node is registered after the
+        listing was snapshotted — such nodes are skipped this round,
+        mirroring :meth:`reconcile_committed`.  Returns removals."""
+        listed = set(listed_names)
+        with self._lock:
+            stale = [name for name, idx in self._node_index.items()
+                     if name not in listed
+                     and self._node_stamp[idx] < listed_at]
+        for name in stale:
+            self.remove_node(name)
+        return len(stale)
 
     def mark_ready(self, name: str) -> None:
         """Recovery hook: the inverse of :meth:`mark_unready`."""
         with self._lock:
-            self._node_valid[self._node_index[name]] = True
+            idx = self._node_index.get(name)
+            if idx is None:
+                return
+            self._node_valid[idx] = True
             self._dirty["topo"] = True
 
     # -- telemetry ----------------------------------------------------
@@ -322,7 +438,9 @@ class Encoder:
         against that node — and a sample with no usable channel does not
         reset staleness."""
         with self._lock:
-            idx = self._node_index[name]
+            idx = self._node_index.get(name)
+            if idx is None:
+                return  # node removed; a late scrape result is noise
             any_ok = False
             for chan, chan_name in enumerate(Metric.NAMES):
                 if chan_name in values:
@@ -344,7 +462,10 @@ class Encoder:
         """Ingest one probe measurement (the iperf3 result of
         run.sh:12, generalized to pairwise)."""
         with self._lock:
-            i, j = self._node_index[a], self._node_index[b]
+            i = self._node_index.get(a)
+            j = self._node_index.get(b)
+            if i is None or j is None:
+                return  # an endpoint was removed; drop the late probe
             if lat_ms is not None and np.isfinite(lat_ms) and lat_ms >= 0:
                 self._lat[i, j] = self._lat[j, i] = lat_ms
             if bw_bps is not None and np.isfinite(bw_bps) and bw_bps >= 0:

@@ -69,18 +69,25 @@ class SchedulerLoop:
         # until every node looks full.  Clients deliver at most once
         # per pod (KubeClient dedups terminal-MODIFIED vs DELETED).
         client.on_pod_deleted(self._on_pod_gone)
+        # Node scale-down: free the encoder slot (round 1 leaked slots
+        # and kept binding to deleted nodes).
+        client.on_node_deleted(self._on_node_gone)
 
     def _on_node(self, node: Node) -> None:
         self.encoder.upsert_node(node)
 
+    def _on_node_gone(self, node: Node) -> None:
+        self.encoder.remove_node(node.name)
+
     def _on_pod_gone(self, pod: Pod) -> None:
         self._preempt_attempts.pop(pod.uid, None)
-        # A cluster-wide watch also delivers pods other schedulers
-        # bound; the ledger would no-op them anyway, but filtering
-        # here keeps the early-release marker set quiet.
-        if not pod.node_name or \
-                pod.scheduler_name != self.cfg.scheduler_name:
+        if not pod.node_name:
             return
+        # No scheduler_name filter: extender-path binds commit usage
+        # for pods whose schedulerName is the stock scheduler's, and
+        # their deletions must release it.  The uid-keyed ledger makes
+        # release a no-op for pods we never committed, so foreign pods
+        # cost at most an early-release marker (bounded set).
         self.encoder.release(pod, pod.node_name)
 
     # ------------------------------------------------------------------
@@ -98,11 +105,17 @@ class SchedulerLoop:
             batch = self.encoder.encode_pods(
                 pods, node_of=self._peer_node)
             state = self.encoder.snapshot()
+            # Name/generation table captured WITH the snapshot: the
+            # bind path resolves indices against this table, so a slot
+            # freed+reused mid-cycle binds to the old (gone) name —
+            # rejected upstream — instead of silently landing on the
+            # slot's new tenant.
+            node_table = self.encoder.node_table()
         with self.timer.phase("score_assign"):
             assignment = np.asarray(
                 jax_block(self._assign(state, batch, self.cfg)))
         with self.timer.phase("bind"):
-            bound = self._bind_all(pods, assignment)
+            bound = self._bind_all(pods, assignment, node_table)
         return bound
 
     def _peer_node(self, pod_name: str) -> str:
@@ -145,7 +158,14 @@ class SchedulerLoop:
                 reason="Preempted", involved_pod=v.name,
                 namespace=v.namespace,
                 component=self.cfg.scheduler_name, type="Warning"))
-        self.queue.push(pod)
+        if not self.queue.push(pod):
+            # Queue full: the eviction happened but the preemptor
+            # could not requeue — refund the attempt (the freed space
+            # means the next resync delivery likely schedules without
+            # another eviction) and fall through to FailedScheduling
+            # so the pod's state is visible.
+            self._preempt_attempts[pod.uid] = attempts
+            return False
         return True
 
     def _requeue_transient(self, pod: Pod, exc: Exception,
@@ -176,16 +196,22 @@ class SchedulerLoop:
                 return ""
 
     def _bind_all(self, pods: Sequence[Pod],
-                  assignment: np.ndarray) -> int:
+                  assignment: np.ndarray,
+                  node_table: tuple[list[str], list[int]] | None = None
+                  ) -> int:
         """Bind a batch: one ``bind_many`` round-trip, batched events,
         batched usage commit — per-pod work only on the error paths.
 
         Semantically identical to binding pod-by-pod (the reference's
         shape, scheduler.go:196-233): per-pod outcomes, permanent
         rejections dropped with an event, transient errors requeued
-        with a retry budget."""
+        with a retry budget.  ``node_table`` is the (names, generations)
+        snapshot taken with the cluster snapshot; commits are dropped
+        for slots whose generation moved (node removed mid-cycle)."""
         comp = self.cfg.scheduler_name
-        node_name = self.encoder.node_name
+        if node_table is None:
+            node_table = self.encoder.node_table()
+        table_names, table_gens = node_table
         events: list = []
 
         bindable: list[Pod] = []
@@ -202,7 +228,7 @@ class SchedulerLoop:
                 self.unschedulable += 1
                 events.append(failed_event(pod, comp, "no feasible node"))
                 continue
-            name = node_name(idx)
+            name = table_names[idx]
             if self.decision_log is not None:
                 self.decision_log.append(pod.name, name)
             bindable.append(pod)
@@ -231,6 +257,13 @@ class SchedulerLoop:
                 where = (self._bound_where(pod)
                          if isinstance(exc, ValueError) else None)
                 if where == name:
+                    if self.encoder.is_committed(pod.uid):
+                        # Duplicate delivery of a pod we already bound
+                        # AND accounted: healing it again would inflate
+                        # the scheduled counter and emit a second
+                        # "Scheduled" event (commit_many dedups the
+                        # ledger, but counters/events are not idempotent).
+                        continue
                     ok_pods.append(pod)
                     ok_idxs.append(idx)
                     events.append(scheduled_event(pod, name, comp))
@@ -259,7 +292,14 @@ class SchedulerLoop:
         if self._preempt_attempts:
             for pod in ok_pods:
                 self._preempt_attempts.pop(pod.uid, None)
-        self.encoder.commit_many(ok_pods, ok_idxs)
+        # Drop commits whose slot was freed (and possibly reused) since
+        # the snapshot: the node is gone, its pods are being garbage-
+        # collected, and booking usage onto the slot's new tenant would
+        # corrupt accounting.
+        fresh = [(pod, idx) for pod, idx in zip(ok_pods, ok_idxs)
+                 if self.encoder.slot_generation(idx) == table_gens[idx]]
+        self.encoder.commit_many([p for p, _ in fresh],
+                                 [i for _, i in fresh])
         self.client.create_events(events)
         self.scheduled += len(ok_pods)
         return len(ok_pods)
@@ -273,6 +313,19 @@ class SchedulerLoop:
                 break
             total += n
         return total
+
+    def reconcile_nodes(self) -> int:
+        """Remove encoder nodes the API server no longer lists (DELETED
+        events missed while the daemon was down, or a watch gap).
+        ``listed_at`` is taken before the listing so a node registered
+        concurrently (watch ADDED racing the list response) is never
+        wrongly removed.  Returns how many were removed."""
+        listed_at = time.monotonic()
+        try:
+            listed = [n.name for n in self.client.list_nodes()]
+        except Exception:  # noqa: BLE001 — transient; next tick retries
+            return 0
+        return self.encoder.reconcile_nodes(listed, listed_at)
 
     def reconcile_usage(self) -> int:
         """Release ledger entries for pods that no longer exist
@@ -311,6 +364,10 @@ class SchedulerLoop:
             pass
         try:
             self.reconcile_usage()
+        except Exception:  # noqa: BLE001 — retried next tick
+            pass
+        try:
+            self.reconcile_nodes()
         except Exception:  # noqa: BLE001 — retried next tick
             pass
 

@@ -48,6 +48,13 @@ class ClusterClient:
         default is no signal (callers must then rely on periodic
         reconciliation)."""
 
+    def on_node_deleted(self, handler: NodeHandler) -> None:
+        """Register for node DELETED events (scale-down, decommission)
+        so the encoder can free the slot.  Optional, like
+        :meth:`on_pod_deleted`; callers must also reconcile against
+        :meth:`list_nodes` periodically for events missed while
+        down."""
+
     def bind(self, binding: Binding) -> None:
         raise NotImplementedError
 
@@ -123,6 +130,7 @@ class FakeCluster(ClusterClient):
         self._pod_handlers: list[PodHandler] = []
         self._node_handlers: list[NodeHandler] = []
         self._deleted_handlers: list[PodHandler] = []
+        self._node_deleted_handlers: list[NodeHandler] = []
 
     # -- population ---------------------------------------------------
 
@@ -156,6 +164,25 @@ class FakeCluster(ClusterClient):
             for h in handlers:
                 h(pod)
 
+    def delete_node(self, name: str) -> None:
+        """Remove a node (scale-down); fans out to on_node_deleted
+        handlers.  Pods bound there are deleted too (the kubelet is
+        gone; mirrors the API server's garbage collection)."""
+        with self._lock:
+            node = self._nodes.pop(name, None)
+            node_handlers = list(self._node_deleted_handlers)
+            doomed = [p.name for p in self._pods.values()
+                      if p.node_name == name]
+        if node is None:
+            raise KeyError(name)
+        for pod_name in doomed:
+            try:
+                self.delete_pod(pod_name)
+            except KeyError:
+                pass
+        for h in node_handlers:
+            h(node)
+
     # -- ClusterClient ------------------------------------------------
 
     def list_nodes(self) -> Sequence[Node]:
@@ -173,6 +200,10 @@ class FakeCluster(ClusterClient):
     def on_pod_deleted(self, handler: PodHandler) -> None:
         with self._lock:
             self._deleted_handlers.append(handler)
+
+    def on_node_deleted(self, handler: NodeHandler) -> None:
+        with self._lock:
+            self._node_deleted_handlers.append(handler)
 
     def _bind_locked(self, binding: Binding) -> None:
         """Single-binding validation + apply; caller holds the lock.

@@ -197,6 +197,7 @@ def node_from_json(obj: Mapping) -> Node:
         labels=_flatten(labels),
         taints=taints,
         ready=ready,
+        unschedulable=bool(spec.get("unschedulable", False)),
         zone=labels.get("topology.kubernetes.io/zone", ""),
         rack=labels.get("topology.kubernetes.io/rack", ""),
     )
@@ -260,6 +261,7 @@ class KubeClient(ClusterClient):
         self._pod_handlers: list[PodHandler] = []
         self._node_handlers: list[NodeHandler] = []
         self._deleted_handlers: list[PodHandler] = []
+        self._node_deleted_handlers: list[NodeHandler] = []
         # At-most-once pod-gone delivery: a pod that reached a terminal
         # phase (MODIFIED) is released then, and its later DELETED
         # event must not release again.  Entries are removed when the
@@ -510,6 +512,16 @@ class KubeClient(ClusterClient):
         self._ensure_watcher("/api/v1/nodes?watch=true",
                              self._deliver_node, name="node-watch")
 
+    def on_node_deleted(self, handler: NodeHandler) -> None:
+        """Node DELETED events (scale-down): round 1 dropped these,
+        leaving deleted nodes node_valid=True forever — the scheduler
+        kept binding pods to them (the API server accepts Bindings to
+        nonexistent node names; the pods never run)."""
+        with self._lock:
+            self._node_deleted_handlers.append(handler)
+        self._ensure_watcher("/api/v1/nodes?watch=true",
+                             self._deliver_node, name="node-watch")
+
     def _deliver_pod(self, kind: str, obj: Mapping) -> None:
         if kind == "DELETED":
             pod = pod_from_json(obj)
@@ -548,6 +560,13 @@ class KubeClient(ClusterClient):
                 h(pod)
 
     def _deliver_node(self, kind: str, obj: Mapping) -> None:
+        if kind == "DELETED":
+            node = node_from_json(obj)
+            with self._lock:
+                handlers = list(self._node_deleted_handlers)
+            for h in handlers:
+                h(node)
+            return
         if kind not in ("ADDED", "MODIFIED"):
             return
         node = node_from_json(obj)

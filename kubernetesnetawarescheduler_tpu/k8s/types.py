@@ -36,6 +36,9 @@ class Node:
     labels: frozenset[str] = frozenset()
     taints: frozenset[str] = frozenset()
     ready: bool = True
+    # Cordoned (kubectl cordon -> spec.unschedulable): running pods
+    # stay, no new placements.
+    unschedulable: bool = False
     # Optional topology hints used by the fake-cluster network model.
     zone: str = ""
     rack: str = ""

@@ -1,0 +1,136 @@
+"""Node lifecycle: DELETED, cordon, slot reuse under churn.
+
+The reference only ever logs node ADDs (scheduler.go:175-184); round 1
+of this build inherited the blindness — deleted nodes stayed
+node_valid=True forever and slots leaked until ``max_nodes``.  These
+tests pin the fix: DELETED frees the slot (usage, bits, lat/bw rows,
+label reverse map), slots are reused FIFO, cordon
+(``spec.unschedulable``) masks placements without evicting, and a
+churn of 3x max_nodes registrations never exhausts the encoder.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from kubernetesnetawarescheduler_tpu.config import SchedulerConfig
+from kubernetesnetawarescheduler_tpu.core.assign import assign_parallel
+from kubernetesnetawarescheduler_tpu.core.encode import Encoder
+from kubernetesnetawarescheduler_tpu.core.loop import SchedulerLoop
+from kubernetesnetawarescheduler_tpu.k8s.client import FakeCluster
+from kubernetesnetawarescheduler_tpu.k8s.types import Node, Pod
+
+
+CFG = SchedulerConfig(max_nodes=8, max_pods=4, max_peers=2)
+
+
+def _node(name: str, **kw) -> Node:
+    return Node(name=name, capacity={"cpu": 8.0, "mem": 16.0}, **kw)
+
+
+def test_remove_node_frees_slot_and_state():
+    enc = Encoder(CFG)
+    enc.upsert_node(_node("a"))
+    enc.upsert_node(_node("b"))
+    enc.update_metrics("a", {"cpu_freq": 1.0})
+    enc.update_link("a", "b", lat_ms=3.0, bw_bps=1e9)
+    enc.commit(Pod(name="p", uid="p", requests={"cpu": 2.0}), "a")
+    enc.remove_node("a")
+    assert "a" not in enc._node_index
+    assert not enc._node_valid[0]
+    assert enc._used[0].sum() == 0
+    assert enc._lat[0, 1] == 0 and enc._lat[1, 0] == 0
+    assert not enc.is_committed("p")
+    # Slot 0 is reused by the next new node.
+    idx = enc.upsert_node(_node("c"))
+    assert idx == 0
+    assert enc.node_name(0) == "c"
+    # The late watch-delivery of p's deletion is a no-op.
+    enc.release(Pod(name="p", uid="p", requests={"cpu": 2.0}), "c")
+    assert enc._used[0].sum() == 0
+
+
+def test_churn_3x_max_nodes():
+    """VERDICT #7 done-criterion: register/delete 3x max_nodes nodes
+    over time without exhausting slots; scheduling stays correct."""
+    enc = Encoder(CFG)
+    alive: list[str] = []
+    for gen in range(3 * CFG.max_nodes):
+        name = f"n{gen:03d}"
+        enc.upsert_node(_node(name))
+        alive.append(name)
+        if len(alive) > 4:
+            enc.remove_node(alive.pop(0))
+    assert len(enc._node_index) == 4
+    pods = [Pod(name="p", requests={"cpu": 1.0})]
+    batch = enc.encode_pods(pods, node_of=lambda s: "")
+    a = np.asarray(assign_parallel(enc.snapshot(), batch, CFG))
+    assert a[0] >= 0
+    assert enc.node_name(int(a[0])) in alive
+
+
+def test_cordon_masks_placement():
+    enc = Encoder(CFG)
+    enc.upsert_node(_node("a", unschedulable=True))
+    enc.upsert_node(_node("b"))
+    pods = [Pod(name="p", requests={"cpu": 1.0})]
+    batch = enc.encode_pods(pods, node_of=lambda s: "")
+    a = np.asarray(assign_parallel(enc.snapshot(), batch, CFG))
+    assert enc.node_name(int(a[0])) == "b"
+    # Uncordon: both eligible again.
+    enc.upsert_node(_node("a"))
+    assert enc._node_valid[0]
+
+
+def test_loop_handles_node_deletion():
+    """End-to-end through FakeCluster: delete a node with a bound pod
+    -> encoder slot freed, usage released, new node reuses the slot,
+    scheduling continues."""
+    fc = FakeCluster()
+    fc.add_node(_node("a"))
+    fc.add_node(_node("b"))
+    loop = SchedulerLoop(fc, CFG)
+    fc.add_pod(Pod(name="p1", requests={"cpu": 2.0}))
+    assert loop.run_until_drained() == 1
+    where = fc.node_of("p1")
+    other = "b" if where == "a" else "a"
+    fc.delete_node(where)
+    assert where not in loop.encoder._node_index
+    # The bound pod was deleted with its node and released: the usage
+    # ledger holds nothing (p1 was the only commit).
+    assert not loop.encoder._committed
+    fc.add_pod(Pod(name="p2", requests={"cpu": 2.0}))
+    assert loop.run_until_drained() == 1
+    assert fc.node_of("p2") == other
+
+
+def test_reconcile_nodes_catches_missed_deletes():
+    """A node deleted while the daemon was down (no watch event) is
+    removed by the maintenance reconcile."""
+    fc = FakeCluster()
+    fc.add_node(_node("a"))
+    fc.add_node(_node("b"))
+    loop = SchedulerLoop(fc, CFG)
+    # Simulate a missed DELETED: remove from the cluster without
+    # fanning out.
+    with fc._lock:
+        del fc._nodes["a"]
+    assert loop.reconcile_nodes() == 1
+    assert "a" not in loop.encoder.known_node_names()
+    assert "b" in loop.encoder.known_node_names()
+
+
+def test_reconcile_nodes_spares_concurrent_registration():
+    """A node registered after the listing snapshot (watch ADDED racing
+    the list response) must NOT be removed."""
+    import time
+
+    fc = FakeCluster()
+    fc.add_node(_node("a"))
+    loop = SchedulerLoop(fc, CFG)
+    listed_at = time.monotonic()
+    listed = [n.name for n in fc.list_nodes()]  # snapshot: only "a"
+    # "c" registers after the snapshot was taken.
+    loop.encoder.upsert_node(_node("c"))
+    assert loop.encoder.reconcile_nodes(listed, listed_at) == 0
+    assert "c" in loop.encoder.known_node_names()
