@@ -189,6 +189,17 @@ class SchedulerConfig:
     # FailedScheduling event (guards against plan/evict/lose loops).
     max_preemption_attempts: int = 2
 
+    # Graceful termination window passed with preemption deletes
+    # (DeleteOptions.gracePeriodSeconds; the kubelet gets this long to
+    # stop the victim cleanly).
+    preemption_grace_s: int = 30
+
+    # How long the preemptor waits for its victims' deletions to be
+    # confirmed (watch DELETED -> ledger release) before it is requeued
+    # anyway; also the TTL of its node reservation (nominatedNodeName
+    # analog) so a wedged victim cannot hold capacity hostage.
+    preemption_wait_s: float = 120.0
+
     def __post_init__(self) -> None:
         if self.max_nodes <= 0 or self.max_pods <= 0 or self.max_peers <= 0:
             raise ValueError("shape limits must be positive")

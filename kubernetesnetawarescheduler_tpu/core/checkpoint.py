@@ -150,7 +150,8 @@ def save_checkpoint(path: str, encoder: Encoder) -> None:
             "committed": {
                 uid: [rec.node, [float(x) for x in rec.req],
                       rec.priority, rec.namespace, rec.name,
-                      int(rec.group_bit), int(rec.anti_bits)]
+                      int(rec.group_bit), int(rec.anti_bits),
+                      int(rec.pdb_min)]
                 for uid, rec in encoder._committed.items()
             },
         }
@@ -216,8 +217,9 @@ def load_checkpoint(path: str,
         name = entry[4] if len(entry) > 4 else ""
         gbit = int(entry[5]) if len(entry) > 5 else 0
         abits = int(entry[6]) if len(entry) > 6 else 0
+        pdb = int(entry[7]) if len(entry) > 7 else 0
         return CommitRecord(int(idx), np.asarray(req, np.float32), 0.0,
-                            prio, ns, name, gbit, abits)
+                            prio, ns, name, gbit, abits, pdb)
 
     enc._committed = {uid: _rec(entry)
                       for uid, entry in meta.get("committed", {}).items()}

@@ -150,6 +150,9 @@ class CommitRecord(NamedTuple):
     name: str
     group_bit: int = 0
     anti_bits: int = 0
+    # Annotation-level PDB: minimum live members of this pod's group
+    # (0 = unprotected).  Preemption planning consumes this.
+    pdb_min: int = 0
 
 
 class Encoder:
@@ -213,6 +216,20 @@ class Encoder:
         # oldest-first (release()).
         self._committed: dict[str, CommitRecord] = {}
         self._early_releases: dict[str, None] = {}
+
+        # Nominations (kube's nominatedNodeName analog): a preemptor
+        # whose victims are terminating holds a capacity reservation on
+        # its target node so the freed space is not stolen by the next
+        # batch.  _reserved is added to `used` in snapshot(); the hold
+        # is dropped when the preemptor is encoded for scoring (its own
+        # request takes over), commits, or expires.
+        self._nominations: dict[str, tuple[int, np.ndarray, float]] = {}
+        self._reserved = np.zeros((n, r), np.float32)
+        # Victims whose graceful deletion is in flight (delete accepted,
+        # DELETED not yet confirmed).  The preemption planner treats
+        # them as already gone: not victim candidates again, not live
+        # members for PDB min-available accounting.
+        self._terminating: set[str] = set()
 
         # Dirty tracking per transfer group, so snapshot() uploads the
         # 100 MB-class N x N matrices only when the probe pipeline
@@ -355,8 +372,13 @@ class Encoder:
             for uid in [u for u, rec in self._committed.items()
                         if rec.node == idx]:
                 del self._committed[uid]
+                self._terminating.discard(uid)
+            for uid in [u for u, (i, _, _) in self._nominations.items()
+                        if i == idx]:
+                self._drop_nomination_locked(uid)
             self._metrics[idx] = 0.0
             self._metrics_age[idx] = 1e9
+            self._reserved[idx] = 0.0
             self._lat[idx, :] = 0.0
             self._lat[:, idx] = 0.0
             self._bw[idx, :] = 0.0
@@ -534,7 +556,9 @@ class Encoder:
                 self._committed[pod.uid] = CommitRecord(
                     int(idx[i]), reqs[i].copy(), time.monotonic(),
                     float(pod.priority), pod.namespace, pod.name,
-                    bits[i][0], bits[i][1])
+                    bits[i][0], bits[i][1],
+                    int(getattr(pod, "pdb_min_available", 0)))
+                self._drop_nomination(pod.uid)
             np.add.at(self._used, idx[keep], reqs[keep])
             w = self.cfg.mask_words
             for i, pod in enumerate(pods):
@@ -565,6 +589,9 @@ class Encoder:
         without this, a node that ever hosted group ``g`` would block
         anti-``g`` pods forever."""
         with self._lock:
+            if self._nominations:
+                self._drop_nomination_locked(pod.uid)
+            self._terminating.discard(pod.uid)
             rec = self._committed.pop(pod.uid, None)
             if rec is None:
                 self._early_releases[pod.uid] = None
@@ -617,6 +644,55 @@ class Encoder:
             bits ^= b
         return cleared
 
+    # -- nominations --------------------------------------------------
+
+    def nominate(self, uid: str, node_name: str,
+                 requests: Mapping[str, float]) -> None:
+        """Reserve capacity on ``node_name`` for preemptor ``uid``
+        while its victims terminate (nominatedNodeName semantics:
+        without this, the space freed by eviction is up for grabs by
+        any pod scored in the interim)."""
+        with self._lock:
+            idx = self._node_index.get(node_name)
+            if idx is None:
+                return
+            self._drop_nomination_locked(uid)
+            req = _requests_vector(requests, self.cfg.num_resources)
+            self._nominations[uid] = (idx, req, time.monotonic())
+            self._reserved[idx] += req
+            self._dirty["alloc"] = True
+
+    def _drop_nomination_locked(self, uid: str) -> None:
+        entry = self._nominations.pop(uid, None)
+        if entry is not None:
+            idx, req, _ = entry
+            self._reserved[idx] = np.maximum(
+                self._reserved[idx] - req, 0.0)
+            self._dirty["alloc"] = True
+
+    def _drop_nomination(self, uid: str) -> None:
+        with self._lock:
+            self._drop_nomination_locked(uid)
+
+    def mark_terminating(self, uid: str) -> None:
+        """Record that a victim's graceful deletion was accepted; the
+        planner stops counting it as live.  Cleared on release (the
+        DELETED confirmation) or by reconcile."""
+        with self._lock:
+            if uid in self._committed:
+                self._terminating.add(uid)
+
+    def expire_nominations(self, ttl_s: float) -> int:
+        """Drop reservations older than ``ttl_s`` (a victim that never
+        terminates must not hold capacity hostage).  Returns drops."""
+        cutoff = time.monotonic() - ttl_s
+        with self._lock:
+            stale = [uid for uid, (_, _, t) in self._nominations.items()
+                     if t < cutoff]
+            for uid in stale:
+                self._drop_nomination_locked(uid)
+        return len(stale)
+
     def reconcile_committed(self, alive_uids,
                             listed_at: float | None = None) -> int:
         """Release every ledger entry whose pod no longer exists.
@@ -636,7 +712,10 @@ class Encoder:
                      if u not in alive and rec.stamp < cutoff]
             for uid in stale:
                 self._release_record(self._committed.pop(uid))
+                self._terminating.discard(uid)
                 released += 1
+            # Terminating markers must track the ledger.
+            self._terminating &= set(self._committed)
             # Early-release markers for pods that no longer exist can
             # never be consumed by a commit — drop them.
             for uid in [u for u in self._early_releases
@@ -661,7 +740,13 @@ class Encoder:
                 self._cache["bw"] = jnp.asarray(self._bw)
             if self._dirty["alloc"]:
                 self._cache["cap"] = jnp.asarray(self._cap)
-                self._cache["used"] = jnp.asarray(self._used)
+                # Nominated reservations count as used: the scoring
+                # kernel must not hand a preemptor's freed space to
+                # someone else (the preemptor's own hold is dropped
+                # when it is encoded for scoring).
+                self._cache["used"] = jnp.asarray(
+                    self._used + self._reserved
+                    if self._nominations else self._used)
                 self._cache["group_bits"] = jnp.asarray(self._group_bits)
                 self._cache["resident_anti"] = jnp.asarray(self._resident_anti)
             if self._dirty["topo"]:
@@ -724,6 +809,11 @@ class Encoder:
         valid = np.zeros((p,), bool)
         with self._lock:
             for i, pod in enumerate(pods):
+                # A nominated preemptor entering scoring: its own
+                # request is about to compete for the reserved space —
+                # drop the hold so it does not block itself.
+                if self._nominations:
+                    self._drop_nomination_locked(pod.uid)
                 req[i] = _requests_vector(pod.requests, r)
                 slot = 0
                 for peer_name, vol in pod.peers.items():

@@ -12,6 +12,7 @@ cycle.
 
 from __future__ import annotations
 
+import threading
 import time
 from typing import Sequence
 
@@ -60,10 +61,26 @@ class SchedulerLoop:
         self.max_bind_retries = 3
         self._bind_retries: dict[str, int] = {}
         self._preempt_attempts: dict[str, int] = {}
+        # Preemptors waiting for victim-deletion confirmation:
+        # uid -> (pod, outstanding victim uids, deadline).  Requeued by
+        # _on_pod_gone when the set drains, or by maintain() past the
+        # deadline.  Mutated from both the loop thread and the watch
+        # thread — every structural access holds _preempt_lock (the
+        # encoder's own lock is always acquired inside it, never the
+        # reverse).
+        self._awaiting_preemption: dict[
+            str, tuple[Pod, set, float]] = {}
+        self._preempt_lock = threading.Lock()
         self._assign = {"greedy": assign_greedy,
                         "parallel": assign_parallel}[method]
-        self.informer = Informer(client, self.queue, cfg.scheduler_name,
-                                 on_node=self._on_node)
+        # is_parked keeps resync/watch re-deliveries of a preemptor
+        # that is waiting for victim confirmation out of the queue —
+        # scoring it early would drop its reservation and burn its
+        # attempt budget against usage the victims still hold.
+        self.informer = Informer(
+            client, self.queue, cfg.scheduler_name,
+            on_node=self._on_node,
+            is_parked=lambda p: p.uid in self._awaiting_preemption)
         # Usage release on pod termination/deletion: without this a
         # long-running daemon's committed usage grows monotonically
         # until every node looks full.  Clients deliver at most once
@@ -81,14 +98,35 @@ class SchedulerLoop:
 
     def _on_pod_gone(self, pod: Pod) -> None:
         self._preempt_attempts.pop(pod.uid, None)
-        if not pod.node_name:
-            return
+        # A deleted preemptor abandons its reservation and wait.
+        with self._preempt_lock:
+            if self._awaiting_preemption.pop(pod.uid, None) is not None:
+                self.encoder._drop_nomination(pod.uid)
+        # Release BEFORE the confirmation drain below: a requeued
+        # preemptor must never be scored against usage its just-
+        # terminated victim still held.
         # No scheduler_name filter: extender-path binds commit usage
         # for pods whose schedulerName is the stock scheduler's, and
         # their deletions must release it.  The uid-keyed ledger makes
         # release a no-op for pods we never committed, so foreign pods
         # cost at most an early-release marker (bounded set).
-        self.encoder.release(pod, pod.node_name)
+        if pod.node_name:
+            self.encoder.release(pod, pod.node_name)
+        # Victim-deletion confirmation: requeue preemptors whose last
+        # outstanding victim just terminated.  A failed push is fine —
+        # the entry is gone, so the pod is no longer parked and the
+        # next resync re-delivers it.
+        ready: list[Pod] = []
+        with self._preempt_lock:
+            for puid, (pp, vset, _dl) in list(
+                    self._awaiting_preemption.items()):
+                if pod.uid in vset:
+                    vset.discard(pod.uid)
+                    if not vset:
+                        del self._awaiting_preemption[puid]
+                        ready.append(pp)
+        for pp in ready:
+            self.queue.push(pp)
 
     # ------------------------------------------------------------------
 
@@ -145,7 +183,8 @@ class SchedulerLoop:
         if plan is None or not plan.victims:
             return False
         self._preempt_attempts[pod.uid] = attempts + 1
-        done = execute_preemption(self.client, self.encoder, plan)
+        done = execute_preemption(self.client, self.encoder, plan,
+                                  self.cfg.preemption_grace_s)
         if not done:
             return False
         self.preemptions += len(done)
@@ -158,13 +197,34 @@ class SchedulerLoop:
                 reason="Preempted", involved_pod=v.name,
                 namespace=v.namespace,
                 component=self.cfg.scheduler_name, type="Warning"))
-        if not self.queue.push(pod):
-            # Queue full: the eviction happened but the preemptor
-            # could not requeue — refund the attempt (the freed space
-            # means the next resync delivery likely schedules without
-            # another eviction) and fall through to FailedScheduling
-            # so the pod's state is visible.
+        # Reserve the target (nominatedNodeName) and hold the
+        # preemptor until every victim's deletion is confirmed through
+        # the watch.  The wait entry is published BEFORE checking for
+        # already-landed releases so a watch event racing this thread
+        # can never slip between check and registration.
+        self.encoder.nominate(pod.uid, plan.node_name, pod.requests)
+        outstanding = {v.uid for v in done}
+        with self._preempt_lock:
+            self._awaiting_preemption[pod.uid] = (
+                pod, outstanding,
+                time.monotonic() + self.cfg.preemption_wait_s)
+        with self._preempt_lock:
+            for uid in list(outstanding):
+                if not self.encoder.is_committed(uid):
+                    # Release already landed (synchronous client
+                    # fanout, or the watch beat us here).
+                    outstanding.discard(uid)
+            drained = (not outstanding
+                       and pod.uid in self._awaiting_preemption)
+            if drained:
+                del self._awaiting_preemption[pod.uid]
+        if drained and not self.queue.push(pod):
+            # Queue full: refund the attempt (the freed space means
+            # the next resync delivery likely schedules without
+            # another eviction), drop the reservation, and fall
+            # through to FailedScheduling so the state is visible.
             self._preempt_attempts[pod.uid] = attempts
+            self.encoder._drop_nomination(pod.uid)
             return False
         return True
 
@@ -370,6 +430,27 @@ class SchedulerLoop:
             self.reconcile_nodes()
         except Exception:  # noqa: BLE001 — retried next tick
             pass
+        self._flush_preemption_waits()
+        self.encoder.expire_nominations(self.cfg.preemption_wait_s)
+
+    def _flush_preemption_waits(self) -> None:
+        """Requeue preemptors whose confirmation deadline passed (a
+        victim stuck terminating must not strand the preemptor forever
+        — its reservation also expires) or whose victim set drained
+        but whose requeue push failed earlier.  Entries are removed
+        first; an unparked pod is re-delivered by resync if the push
+        fails again."""
+        now = time.monotonic()
+        ready: list[Pod] = []
+        with self._preempt_lock:
+            for uid, (pod, vset, deadline) in list(
+                    self._awaiting_preemption.items()):
+                if vset and now < deadline:
+                    continue
+                del self._awaiting_preemption[uid]
+                ready.append(pod)
+        for pod in ready:
+            self.queue.push(pod)
 
 
 def jax_block(x):

@@ -20,10 +20,17 @@ Semantics notes (documented deltas vs kube-scheduler):
 - victims are chosen lowest-priority-first until the pod fits; the
   node is chosen to minimize (highest victim priority, victim count) —
   kube-scheduler's primary tie-breakers;
-- PodDisruptionBudgets, graceful-termination waiting and nominated
-  nodes are out of scope for now: eviction is a plain pod delete and
-  the preemptor is requeued to be scored on a later cycle (after the
-  deletion's release lands in the ledger).
+- PodDisruptionBudgets are annotation-level
+  (``netaware.io/pdb-min-available`` on the members of a ``group``),
+  not PDB objects: the planner never disrupts a protected group below
+  its min-available, and a groupless pod with the annotation is
+  outright unevictable;
+- eviction is graceful (``cfg.preemption_grace_s`` becomes
+  DeleteOptions.gracePeriodSeconds) and the preemptor is requeued only
+  after every victim's deletion is CONFIRMED through the watch (or
+  ``cfg.preemption_wait_s`` expires), holding a capacity reservation
+  on the target node in the interim (nominatedNodeName semantics —
+  Encoder.nominate) so the freed space is not stolen.
 """
 
 from __future__ import annotations
@@ -103,9 +110,13 @@ def plan_preemption(encoder: Encoder, pod: Pod) -> PreemptionPlan | None:
             return None
         valid = encoder._node_valid[:n_real].copy()
         cap = encoder._cap[:n_real].copy()
-        used = encoder._used[:n_real].copy()
+        # Reservations count as used (the scoring snapshot does the
+        # same): a second preemptor must not plan onto capacity an
+        # earlier preemptor's nomination already holds.
+        used = (encoder._used[:n_real] + encoder._reserved[:n_real])
         group_refs = encoder._group_refs[:n_real].copy()
         anti_refs = encoder._anti_refs[:n_real].copy()
+        terminating = set(encoder._terminating)
         # Same interning (and overflow directions) as the kernel's
         # lenient encode — _constraint_bits is the single source of
         # truth; it also backfills lazily-interned selector labels,
@@ -115,10 +126,34 @@ def plan_preemption(encoder: Encoder, pod: Pod) -> PreemptionPlan | None:
         taints = encoder._taint_bits[:n_real].copy()
         labels = encoder._label_bits[:n_real].copy()
         # Victim candidates per node: strictly lower priority only.
+        # PDB accounting (annotation-level): per group bit, how many
+        # members are live cluster-wide and the strictest min-available
+        # any member declared.  A groupless pod with pdb_min > 0 is
+        # simply not a candidate (it protects itself).
         victims_by_node: dict[int, list] = {}
+        group_members: dict[int, int] = {}
+        group_min: dict[int, int] = {}
         for uid, rec in encoder._committed.items():
+            if uid in terminating:
+                # Graceful deletion in flight: not live for PDB
+                # accounting, not evictable again (re-deleting a
+                # terminating pod frees nothing).
+                continue
+            if rec.group_bit:
+                group_members[rec.group_bit] = \
+                    group_members.get(rec.group_bit, 0) + 1
+                if rec.pdb_min:
+                    group_min[rec.group_bit] = max(
+                        group_min.get(rec.group_bit, 0), rec.pdb_min)
             if rec.priority < prio and rec.node < n_real:
+                if rec.pdb_min and not rec.group_bit:
+                    continue  # self-protecting singleton
                 victims_by_node.setdefault(rec.node, []).append((uid, rec))
+        # Disruptions allowed per protected group before min-available
+        # is violated (never negative: an already-underprovisioned
+        # group cannot be disrupted at all).
+        group_budget = {g: max(group_members.get(g, 0) - m, 0)
+                        for g, m in group_min.items()}
         node_names = list(encoder._node_names)
 
     tol_w = int_to_words(tol_i, w)
@@ -135,16 +170,37 @@ def plan_preemption(encoder: Encoder, pod: Pod) -> PreemptionPlan | None:
         cands = victims_by_node.get(node, [])
         free = cap[node] - used[node]
 
+        # Per-plan PDB budget: evicting a member of a protected group
+        # consumes one of its allowed disruptions.
+        budget = dict(group_budget)
+
+        def takeable(rec) -> bool:
+            g = rec.group_bit
+            return g not in budget or budget[g] > 0
+
+        def take(rec) -> None:
+            g = rec.group_bit
+            if g in budget:
+                budget[g] -= 1
+
         # Mandatory victims: residents whose group conflicts with the
         # pod's anti-affinity, or who declared anti-affinity against
         # the pod's group (the symmetric direction).  Only committed
-        # (ledgered, strictly-lower-priority) pods are evictable.
+        # (ledgered, strictly-lower-priority) pods are evictable; a
+        # PDB-protected mandatory victim makes the node infeasible.
         mandatory: list[tuple[str, object]] = []
         if anti_i or gbit_i:
-            conflicted = [
+            mandatory = [
                 (uid, rec) for uid, rec in cands
                 if (rec.group_bit & anti_i) or (rec.anti_bits & gbit_i)]
-            mandatory = conflicted
+        ok_budget = True
+        for _, rec in mandatory:
+            if not takeable(rec):
+                ok_budget = False
+                break
+            take(rec)
+        if not ok_budget:
+            continue
 
         chosen_recs = list(mandatory)
         chosen_uids = {uid for uid, _ in chosen_recs}
@@ -170,6 +226,9 @@ def plan_preemption(encoder: Encoder, pod: Pod) -> PreemptionPlan | None:
             for uid, rec in extras:
                 if np.all(req <= acc + 1e-9):
                     break
+                if not takeable(rec):
+                    continue  # PDB budget exhausted for its group
+                take(rec)
                 acc = acc + rec.req
                 chosen_recs.append((uid, rec))
                 chosen_uids.add(uid)
@@ -206,17 +265,26 @@ def plan_preemption(encoder: Encoder, pod: Pod) -> PreemptionPlan | None:
 
 
 def execute_preemption(client, encoder: Encoder,
-                       plan: PreemptionPlan) -> Sequence[Victim]:
-    """Delete the plan's victims through the API server.
+                       plan: PreemptionPlan,
+                       grace_seconds: int | None = None
+                       ) -> Sequence[Victim]:
+    """Delete the plan's victims through the API server (graceful:
+    ``grace_seconds`` becomes DeleteOptions.gracePeriodSeconds).
 
     Usage release is NOT done here: the deletion fans out through the
     client's pod-deleted signal (watch DELETED / FakeCluster handler),
     which routes into the ledger exactly once — the same path every
-    other deletion takes.  Returns the victims actually deleted."""
+    other deletion takes.  The loop holds the preemptor until those
+    confirmations land (see SchedulerLoop._try_preempt).  Returns the
+    victims actually deleted."""
     done = []
     for v in plan.victims:
         try:
-            client.delete_pod(v.name, namespace=v.namespace)
+            client.delete_pod(v.name, namespace=v.namespace,
+                              grace_seconds=grace_seconds)
+            # Planner-side bookkeeping: this victim is no longer live
+            # (PDB accounting) nor re-evictable while it terminates.
+            encoder.mark_terminating(v.uid)
             done.append(v)
         except Exception:  # noqa: BLE001 — best-effort per victim
             continue

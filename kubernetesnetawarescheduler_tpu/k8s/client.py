@@ -108,9 +108,12 @@ class ClusterClient:
         real resource requests to account usage."""
         raise NotImplementedError
 
-    def delete_pod(self, name: str, namespace: str = "default") -> None:
-        """Delete a pod (the preemption eviction primitive).  Raises
-        ``KeyError`` when the pod is unknown."""
+    def delete_pod(self, name: str, namespace: str = "default",
+                   grace_seconds: int | None = None) -> None:
+        """Delete a pod (the preemption eviction primitive).
+        ``grace_seconds`` maps to DeleteOptions.gracePeriodSeconds
+        where the transport supports it.  Raises ``KeyError`` when the
+        pod is unknown."""
         raise NotImplementedError
 
 
@@ -152,9 +155,11 @@ class FakeCluster(ClusterClient):
         for pod in pods:
             self.add_pod(pod)
 
-    def delete_pod(self, name: str, namespace: str = "default") -> None:
+    def delete_pod(self, name: str, namespace: str = "default",
+                   grace_seconds: int | None = None) -> None:
         """Remove a pod; if it was bound, fan out to on_pod_deleted
-        handlers (the usage-release signal)."""
+        handlers (the usage-release signal).  ``grace_seconds`` is
+        accepted for interface parity (deletion is immediate here)."""
         with self._lock:
             pod = self._pods.pop(name, None)
             handlers = list(self._deleted_handlers)

@@ -87,11 +87,16 @@ class Informer:
 
     def __init__(self, client: ClusterClient, queue: PodQueue,
                  scheduler_name: str,
-                 on_node: Callable[[Node], None] | None = None) -> None:
+                 on_node: Callable[[Node], None] | None = None,
+                 is_parked: Callable[[Pod], bool] | None = None) -> None:
         self._client = client
         self._queue = queue
         self._scheduler_name = scheduler_name
         self._on_node = on_node
+        # Pods the scheduler is deliberately holding out of the queue
+        # (e.g. preemptors awaiting victim confirmation): resync and
+        # watch re-deliveries must not enqueue them early.
+        self._is_parked = is_parked
         self._nodes: dict[str, Node] = {}
         self._lock = threading.Lock()
         client.on_pod_added(self._handle_pod)
@@ -102,6 +107,8 @@ class Informer:
     def _wants(self, pod: Pod) -> bool:
         # The reference's filter: unbound + addressed to us
         # (scheduler.go:170).
+        if self._is_parked is not None and self._is_parked(pod):
+            return False
         return (not pod.node_name
                 and pod.scheduler_name == self._scheduler_name)
 

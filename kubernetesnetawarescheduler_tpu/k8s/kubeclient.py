@@ -64,6 +64,7 @@ ANN_GROUP = "netaware.io/group"
 ANN_AFFINITY = "netaware.io/affinity"
 ANN_ANTI = "netaware.io/anti-affinity"
 ANN_BANDWIDTH = "netaware.io/bandwidth-gbps"
+ANN_PDB = "netaware.io/pdb-min-available"
 
 
 # -- k8s quantity parsing ---------------------------------------------
@@ -166,6 +167,7 @@ def pod_from_json(obj: Mapping) -> Pod:
         affinity_groups=_csv(ANN_AFFINITY),
         anti_groups=_csv(ANN_ANTI),
         priority=float(spec.get("priority", 0) or 0),
+        pdb_min_available=int(ann.get(ANN_PDB, 0) or 0),
     )
 
 
@@ -464,11 +466,19 @@ class KubeClient(ClusterClient):
                 except Exception:  # noqa: BLE001 — best-effort
                     continue
 
-    def delete_pod(self, name: str, namespace: str = "default") -> None:
-        """DELETE the pod — the preemption eviction primitive (plain
-        delete; graceful-termination negotiation is out of scope)."""
+    def delete_pod(self, name: str, namespace: str = "default",
+                   grace_seconds: int | None = None) -> None:
+        """DELETE the pod — the preemption eviction primitive.
+        ``grace_seconds`` becomes DeleteOptions.gracePeriodSeconds so
+        the kubelet can stop the victim cleanly (the watch delivers
+        DELETED once termination completes)."""
+        body = None
+        if grace_seconds is not None:
+            body = {"apiVersion": "v1", "kind": "DeleteOptions",
+                    "gracePeriodSeconds": int(grace_seconds)}
         self._request(
-            "DELETE", f"/api/v1/namespaces/{namespace}/pods/{name}")
+            "DELETE", f"/api/v1/namespaces/{namespace}/pods/{name}",
+            body=body)
 
     def node_of(self, pod_name: str) -> str:
         """``pod_name`` is a "namespace/name" key (pod_from_json

@@ -67,6 +67,11 @@ class Pod:
     affinity_groups: frozenset[str] = frozenset()
     anti_groups: frozenset[str] = frozenset()
     priority: float = 0.0
+    # Annotation-level PodDisruptionBudget: at least this many members
+    # of the pod's ``group`` must stay up — preemption may not disrupt
+    # below it.  With no group, a nonzero value protects the pod
+    # itself from preemption outright.
+    pdb_min_available: int = 0
 
 
 @dataclasses.dataclass(frozen=True, slots=True)

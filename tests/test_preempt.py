@@ -115,11 +115,13 @@ def test_preemption_attempt_budget_is_enforced_and_sticky():
                          priority=1.0) for i in range(2)]
         cluster.add_pods(fill_pods)
         # Simulate the preemptor losing the race every time: drop the
-        # requeued vip so the controller's replacements take the
-        # freed capacity first.
+        # requeued vip AND expire its node reservation (the nomination
+        # normally prevents exactly this theft; only after its TTL can
+        # the controller's replacements take the freed capacity).
         for p in loop.queue.pop_batch(16, timeout=0.0):
             if p.name != "vip":
                 loop.queue.push(p)
+        loop.encoder.expire_nominations(0.0)
         assert loop.run_until_drained() >= 2
         events: list = []
         assert loop._try_preempt(vip, events) is True
@@ -132,6 +134,7 @@ def test_preemption_attempt_budget_is_enforced_and_sticky():
     for p in loop.queue.pop_batch(16, timeout=0.0):
         if p.name != "vip":
             loop.queue.push(p)
+    loop.encoder.expire_nominations(0.0)
     assert loop.run_until_drained() >= 2
     for _ in range(3):  # repeated resync cycles must stay capped
         events = []
@@ -145,3 +148,151 @@ def test_preemption_attempt_budget_is_enforced_and_sticky():
     loop._on_pod_gone(vip_bound)
     assert vip.uid not in loop._preempt_attempts
     assert np.asarray(True)
+
+
+def test_pdb_protected_group_is_not_disrupted():
+    """VERDICT #10 done-criterion: a preemptor whose only victim set
+    would violate the victims' PDB min-available is NOT preempted onto
+    that node."""
+    cluster, loop = make(num_nodes=1)
+    protected = [Pod(name=f"g{i}", requests={"cpu": 2.0}, priority=1.0,
+                     group="svc", pdb_min_available=2)
+                 for i in range(2)]
+    cluster.add_pods(protected)
+    assert loop.run_until_drained() == 2
+    plan = plan_preemption(loop.encoder,
+                           Pod(name="vip", requests={"cpu": 3.0},
+                               priority=9.0))
+    assert plan is None  # evicting either member drops svc below 2
+
+
+def test_pdb_allows_disruption_within_budget():
+    """With min-available=1 of 2 members, exactly one may be evicted."""
+    cluster, loop = make(num_nodes=1)
+    protected = [Pod(name=f"g{i}", requests={"cpu": 2.0}, priority=1.0,
+                     group="svc", pdb_min_available=1)
+                 for i in range(2)]
+    cluster.add_pods(protected)
+    assert loop.run_until_drained() == 2
+    plan = plan_preemption(loop.encoder,
+                           Pod(name="vip", requests={"cpu": 2.0},
+                               priority=9.0))
+    assert plan is not None and len(plan.victims) == 1
+    # But a pod needing BOTH slots cannot get them.
+    plan2 = plan_preemption(loop.encoder,
+                            Pod(name="vip2", requests={"cpu": 4.0},
+                                priority=9.0))
+    assert plan2 is None
+
+
+def test_groupless_pdb_pod_is_unevictable():
+    cluster, loop = make(num_nodes=1)
+    cluster.add_pods([Pod(name="solo", requests={"cpu": 4.0},
+                          priority=1.0, pdb_min_available=1)])
+    assert loop.run_until_drained() == 1
+    plan = plan_preemption(loop.encoder,
+                           Pod(name="vip", requests={"cpu": 2.0},
+                               priority=9.0))
+    assert plan is None
+
+
+def test_nomination_reserves_freed_capacity():
+    """nominatedNodeName semantics: after eviction, the freed space is
+    reserved — a lower-priority interloper scored in the interim does
+    not steal it, and the preemptor still lands."""
+    cluster, loop = make(num_nodes=1)
+    fill(cluster, loop, 1)  # n0 full: 2x2cpu
+    vip = Pod(name="vip", requests={"cpu": 4.0}, priority=9.0)
+    cluster.add_pod(vip)
+    # One cycle: vip is unschedulable, victims evicted, vip requeued
+    # with a 4-cpu reservation on n0 (FakeCluster confirms deletions
+    # synchronously).
+    loop.run_once(timeout=0.0)
+    assert loop.preemptions == 2
+    # Interloper arrives before vip's next cycle: the reservation must
+    # keep it off n0 entirely (only node), leaving it unschedulable.
+    interloper = Pod(name="thief", requests={"cpu": 2.0}, priority=1.0)
+    assert loop.schedule_pods([interloper]) == 0
+    assert all(b.pod_name != "thief" for b in cluster.bindings)
+    # vip (still queued) lands on its nominated node.
+    assert loop.run_until_drained() >= 1
+    assert cluster.node_of("vip") == "n0"
+
+
+def test_graceful_delete_confirmation_gates_requeue():
+    """With an async client (deletions confirmed later), the preemptor
+    waits for the watch confirmation instead of racing its victims'
+    shutdown."""
+
+    class SlowDeleteCluster(FakeCluster):
+        def __init__(self):
+            super().__init__()
+            self.pending_deletes: list = []
+
+        def delete_pod(self, name, namespace="default",
+                       grace_seconds=None):
+            with self._lock:
+                if name not in self._pods:
+                    raise KeyError(name)
+            self.pending_deletes.append((name, namespace))
+
+        def finish_deletes(self):
+            for name, ns in self.pending_deletes:
+                FakeCluster.delete_pod(self, name, namespace=ns)
+            self.pending_deletes.clear()
+
+    cfg = SchedulerConfig(max_nodes=8, max_pods=4, max_peers=2,
+                          enable_preemption=True)
+    cluster = SlowDeleteCluster()
+    cluster.add_node(Node(name="n0", capacity={"cpu": 4.0}))
+    loop = SchedulerLoop(cluster, cfg)
+    fill(cluster, loop, 1)
+    vip = Pod(name="vip", requests={"cpu": 3.0}, priority=9.0)
+    cluster.add_pod(vip)
+    loop.run_until_drained()
+    # Victims' deletions not confirmed yet: vip must NOT be in the
+    # queue (it would be scored against still-held usage and burn its
+    # attempt budget).
+    assert vip.uid in loop._awaiting_preemption
+    assert len(loop.queue) == 0
+    # Confirmations land -> vip requeues and binds.
+    cluster.finish_deletes()
+    assert vip.uid not in loop._awaiting_preemption
+    assert loop.run_until_drained() == 1
+    assert cluster.node_of("vip") == "n0"
+
+
+def test_overlapping_preemption_respects_pdb_and_reservations():
+    """While a protected victim is still terminating (graceful delete
+    unconfirmed), a second preemptor must not (a) count it live, (b)
+    re-pick it, or (c) plan onto capacity reserved for the first
+    preemptor."""
+
+    class SlowDeleteCluster(FakeCluster):
+        def delete_pod(self, name, namespace="default",
+                       grace_seconds=None):
+            with self._lock:
+                if name not in self._pods:
+                    raise KeyError(name)
+            # accepted, termination pending: no handler fanout yet
+
+    cfg = SchedulerConfig(max_nodes=8, max_pods=4, max_peers=2,
+                          enable_preemption=True)
+    cluster = SlowDeleteCluster()
+    cluster.add_node(Node(name="n0", capacity={"cpu": 4.0}))
+    loop = SchedulerLoop(cluster, cfg)
+    # Two svc members with min-available=1: budget is exactly 1.
+    cluster.add_pods([
+        Pod(name=f"g{i}", requests={"cpu": 2.0}, priority=1.0,
+            group="svc", pdb_min_available=1) for i in range(2)])
+    assert loop.run_until_drained() == 2
+    vip_a = Pod(name="vipA", requests={"cpu": 2.0}, priority=9.0)
+    events: list = []
+    assert loop._try_preempt(vip_a, events) is True  # evicts one member
+    assert len(loop.encoder._terminating) == 1
+    # Second preemptor: the other member is the last live one — PDB
+    # forbids it; the terminating one is not re-pickable.
+    plan_b = plan_preemption(loop.encoder,
+                             Pod(name="vipB", requests={"cpu": 2.0},
+                                 priority=9.0))
+    assert plan_b is None
