@@ -40,9 +40,23 @@ class DensityResult:
     score_p99_ms: float
     encode_p99_ms: float
     bind_p99_ms: float
+    # How many independent latency samples back the score percentiles.
+    # Host mode: one per cycle.  Pipeline mode: one per chunk arrival
+    # (true percentiles).  Monolithic device mode: 1 — the score
+    # numbers there are an amortized mean, honestly labeled.
+    score_samples: int = 0
 
     def to_dict(self) -> dict:
         return dataclasses.asdict(self)
+
+
+def _percentile_ms(samples, q: float) -> float:
+    ordered = sorted(samples)
+    if not ordered:
+        return 0.0
+    rank = min(len(ordered) - 1,
+               max(0, int(round(q / 100.0 * (len(ordered) - 1)))))
+    return ordered[rank] * 1e3
 
 
 from kubernetesnetawarescheduler_tpu.core.state import round_up as _round_up
@@ -67,6 +81,7 @@ def run_density(num_nodes: int = 100, num_pods: int = 300,
                 warmup: bool = True,
                 metric_drop_fraction: float = 0.0,
                 mode: str = "host",
+                chunk_batches: int = 2,
                 sampler=None) -> DensityResult:
     """Schedule ``num_pods`` generated pods onto a ``num_nodes`` fake
     cluster; returns throughput/latency stats (compile excluded via a
@@ -104,6 +119,7 @@ def run_density(num_nodes: int = 100, num_pods: int = 300,
     if mode in ("device", "pipeline"):
         return _run_density_device(cluster, loop, pods, cfg, method,
                                    num_nodes, seed, warmup, sampler,
+                                   chunk_batches=chunk_batches,
                                    pipeline=(mode == "pipeline"))
 
     if warmup:
@@ -133,13 +149,14 @@ def run_density(num_nodes: int = 100, num_pods: int = 300,
         score_p99_ms=loop.timer.percentile("score_assign", 99) * 1e3,
         encode_p99_ms=loop.timer.percentile("encode", 99) * 1e3,
         bind_p99_ms=loop.timer.percentile("bind", 99) * 1e3,
+        score_samples=loop.timer.count("score_assign"),
     )
 
 
 def _run_density_device(cluster, loop: SchedulerLoop, pods, cfg,
                         method: str, num_nodes: int, seed: int,
                         warmup: bool, sampler=None,
-                        chunk_batches: int = 8,
+                        chunk_batches: int = 2,
                         pipeline: bool = False) -> DensityResult:
     """Device-resident drain, two strategies sharing one harness.
 
@@ -160,11 +177,18 @@ def _run_density_device(cluster, loop: SchedulerLoop, pods, cfg,
     device-mode ``pods_per_sec`` are comparable.  Excluded: compilation
     (warmup) and the initial bulk host→device copy of the ``N×N``
     matrices (paid once at startup in a live deployment, then amortized
-    via dirty-group updates).  Per-batch score latency is reported
-    amortized (device span / num_batches) — a mean, not a true
-    percentile, hence p50 == p99 in these modes; in pipeline mode
-    ``bind_p99_ms`` is the bind worker's residual tail after the last
-    fetch (the part the pipeline failed to hide)."""
+    via dirty-group updates).
+
+    Score-latency reporting: in pipeline mode, every chunk arrival is
+    host-timed (the blocking fetch of its assignment) and the
+    percentiles are TRUE percentiles over those per-batch-normalized
+    samples — one sample per chunk, so ``num_batches / chunk_batches``
+    samples total (chunk_batches=2 at the bench's 64 batches gives 32).
+    In monolithic device mode there is a single dispatch, so per-batch
+    latency is the amortized mean (p50 == p99, score_samples == 1 —
+    honestly labeled, not a percentile).  ``bind_p99_ms`` in pipeline
+    mode is the bind worker's residual tail after the last fetch (the
+    part the pipeline failed to hide)."""
     import queue as queue_mod
     import threading
 
@@ -231,9 +255,18 @@ def _run_density_device(cluster, loop: SchedulerLoop, pods, cfg,
         cfg.max_pods)
     encode_wall = time.perf_counter() - start
 
+    chunk_times: list[float] = []
     if pipeline:
+        prev = time.perf_counter()
         for pod_start, assignment in replay_stream_pipelined(
                 state, stream, cfg, method, chunk_batches):
+            now = time.perf_counter()
+            # Host-observed latency of this chunk (blocking fetch),
+            # normalized per batch: a true sample, not an average over
+            # the whole run.
+            batches_in_chunk = max(1, len(assignment) // cfg.max_pods)
+            chunk_times.append((now - prev) / batches_in_chunk)
+            prev = now
             end = min(pod_start + len(assignment), len(queued))
             if pod_start >= end:
                 continue
@@ -252,7 +285,14 @@ def _run_density_device(cluster, loop: SchedulerLoop, pods, cfg,
         bound = loop._bind_all(queued, assignment)
     wall = time.perf_counter() - start
 
-    amortized_ms = device_span / max(num_batches, 1) * 1e3
+    if chunk_times:
+        score_p50 = _percentile_ms(chunk_times, 50)
+        score_p99 = _percentile_ms(chunk_times, 99)
+        samples = len(chunk_times)
+    else:
+        amortized_ms = device_span / max(num_batches, 1) * 1e3
+        score_p50 = score_p99 = amortized_ms
+        samples = 1
     return DensityResult(
         num_nodes=num_nodes,
         pods_submitted=len(pods),
@@ -260,8 +300,9 @@ def _run_density_device(cluster, loop: SchedulerLoop, pods, cfg,
         pods_unschedulable=loop.unschedulable,
         wall_s=wall,
         pods_per_sec=bound / wall if wall > 0 else 0.0,
-        score_p50_ms=amortized_ms,
-        score_p99_ms=amortized_ms,
+        score_p50_ms=score_p50,
+        score_p99_ms=score_p99,
         encode_p99_ms=encode_wall / max(num_batches, 1) * 1e3,
         bind_p99_ms=(wall - device_span - encode_wall) * 1e3,
+        score_samples=samples,
     )

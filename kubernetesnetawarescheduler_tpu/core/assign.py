@@ -53,13 +53,13 @@ def _static_parts(state: ClusterState, pods: PodBatch, cfg: SchedulerConfig,
     """Batch-invariant pieces: base+network score and the static mask
     (taints, node selectors, validity) that placements can't change.
 
-    ``static``, if given, is the ``(base[N], C[N,N])`` pair from
+    ``static``, if given, is the ``(base[N], C.T prepared)`` pair from
     :func:`~.score.static_node_scores` — precomputed once per replay so
-    the N×N normalization work is not re-done every batch."""
+    the N×N normalization/transpose work is not re-done every batch."""
     if static is None:
         static = score_lib.static_node_scores(state, cfg)
-    base, c = static
-    net = score_lib.network_scores(state, pods, cfg, c=c)
+    base, ct = static
+    net = score_lib.network_scores(state, pods, cfg, ct=ct)
     raw = base[None, :] + net
     tol = jnp.all(
         (state.taint_bits[None, :, :] & ~pods.tol_bits[:, None, :]) == 0,

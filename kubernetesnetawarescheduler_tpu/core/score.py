@@ -132,10 +132,23 @@ def net_cost_matrix(state: ClusterState, cfg: SchedulerConfig) -> jax.Array:
     return jnp.where(pair_valid, c, 0.0)
 
 
+def prep_net_matrix(c: jax.Array, cfg: SchedulerConfig) -> jax.Array:
+    """Transpose (and cast, in bf16 mode) the desirability matrix into
+    the layout the score matmul consumes.  At N=5k this touches 100 MB
+    — done once per replay/static-compute, NOT per batch: inside one
+    jitted scan XLA hoists it as loop-invariant, but a chunked/
+    pipelined drain dispatches many separate executables and would
+    otherwise re-transpose per chunk (measured ~2x per-batch cost on
+    the CPU fallback)."""
+    ct = c.T
+    return ct.astype(jnp.bfloat16) if cfg.use_bfloat16 else ct
+
+
 def static_node_scores(state: ClusterState, cfg: SchedulerConfig
                        ) -> tuple[jax.Array, jax.Array]:
     """The two batch-invariant score ingredients: the per-node metric
-    vote ``base f32[N]`` and the net-desirability matrix ``C f32[N,N]``.
+    vote ``base f32[N]`` and the PREPARED net-desirability matrix
+    ``C.T`` (:func:`prep_net_matrix` layout/dtype).
 
     Neither depends on the pod batch nor on anything placements mutate
     (``used``/``group_bits``/``resident_anti``), so a replay loop can
@@ -143,25 +156,27 @@ def static_node_scores(state: ClusterState, cfg: SchedulerConfig
     re-deriving ~3 HBM passes over the N×N matrices per batch (the
     device-side analog of the reference re-scraping every node per pod,
     scheduler.go:275-279)."""
-    return metric_scores(state, cfg), net_cost_matrix(state, cfg)
+    return (metric_scores(state, cfg),
+            prep_net_matrix(net_cost_matrix(state, cfg), cfg))
 
 
 def network_scores(state: ClusterState, pods: PodBatch,
                    cfg: SchedulerConfig,
-                   c: jax.Array | None = None) -> jax.Array:
+                   ct: jax.Array | None = None) -> jax.Array:
     """Pod-aware network term ``f32[P, N]`` as a single MXU matmul.
 
-    ``c`` lets callers pass a precomputed :func:`net_cost_matrix`."""
+    ``ct`` lets callers pass a precomputed :func:`prep_net_matrix`
+    (the transposed, compute-dtype desirability matrix)."""
     t = peer_traffic_matrix(pods, state.num_nodes)
-    if c is None:
-        c = net_cost_matrix(state, cfg)
+    if ct is None:
+        ct = prep_net_matrix(net_cost_matrix(state, cfg), cfg)
     if cfg.use_bfloat16:
         # bf16 inputs, f32 accumulation: standard MXU recipe.
-        return jnp.dot(t.astype(jnp.bfloat16), c.T.astype(jnp.bfloat16),
+        return jnp.dot(t.astype(jnp.bfloat16), ct,
                        preferred_element_type=jnp.float32)
     # Full f32: on TPU the default matmul precision is bf16 passes, so
     # ask for HIGHEST explicitly when exactness is requested.
-    return jnp.dot(t, c.T, precision=jax.lax.Precision.HIGHEST)
+    return jnp.dot(t, ct, precision=jax.lax.Precision.HIGHEST)
 
 
 def balance_penalty(state: ClusterState, pods: PodBatch) -> jax.Array:

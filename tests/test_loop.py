@@ -181,3 +181,29 @@ def test_density_replay_smoke():
     assert res.pods_bound + res.pods_unschedulable == 64
     assert res.pods_per_sec > 0
     assert res.score_p99_ms > 0
+
+
+def test_bind_phase_overlaps_api_latency_at_batch_128():
+    """VERDICT #6 done-criterion: with 1 ms of per-bind API latency at
+    batch=128, the bind phase must land well under the 128 ms a serial
+    client would pay (target < 20 ms; allow scheduler-side slack on
+    slow CI).  FakeCluster emulates an 8-way-concurrent API server."""
+    from kubernetesnetawarescheduler_tpu.config import SchedulerConfig
+    from kubernetesnetawarescheduler_tpu.core.loop import SchedulerLoop
+    from kubernetesnetawarescheduler_tpu.k8s.client import FakeCluster
+    from kubernetesnetawarescheduler_tpu.k8s.types import Node, Pod
+
+    cfg = SchedulerConfig(max_nodes=16, max_pods=128, max_peers=2)
+    fc = FakeCluster(bind_latency_s=0.001, api_concurrency=8)
+    for i in range(16):
+        fc.add_node(Node(name=f"n{i}",
+                         capacity={"cpu": 64.0, "mem": 128.0}))
+    loop = SchedulerLoop(fc, cfg)
+    fc.add_pods([Pod(name=f"p{i}", requests={"cpu": 0.5})
+                 for i in range(128)])
+    assert loop.run_until_drained() == 128
+    bind_p99_ms = loop.timer.percentile("bind", 99) * 1e3
+    # Serial would be >= 128 ms of pure latency; concurrent should be
+    # ~16 ms plus bookkeeping.  60 ms keeps CI noise out while still
+    # proving the overlap.
+    assert bind_p99_ms < 60.0, f"bind_p99 {bind_p99_ms:.1f} ms"
