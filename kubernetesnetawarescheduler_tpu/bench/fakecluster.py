@@ -68,6 +68,13 @@ class WorkloadSpec:
     affinity_fraction: float = 0.1
     anti_fraction: float = 0.1
     tolerate_fraction: float = 0.05
+    # Preferred (soft) affinity: fraction of pods carrying a weighted
+    # zone preference (``soft_node_affinity`` toward a random zone
+    # label) / a weighted spread preference away from their own
+    # service's group (negative ``soft_group_affinity``).
+    soft_zone_fraction: float = 0.0
+    soft_spread_fraction: float = 0.0
+    zones: int = 2  # must match the ClusterSpec the workload runs on
     seed: int = 0
     cpu_range: tuple[float, float] = (0.1, 4.0)
     mem_range: tuple[float, float] = (0.2, 8.0)
@@ -263,6 +270,14 @@ def generate_workload(spec: WorkloadSpec,
                     if rng.random() < spec.affinity_fraction else frozenset())
         anti = (frozenset({f"svc-{int(rng.integers(0, 28))}"})
                 if rng.random() < spec.anti_fraction else frozenset())
+        soft_node = ()
+        if rng.random() < spec.soft_zone_fraction:
+            zone = int(rng.integers(0, spec.zones))
+            soft_node = ((frozenset({f"zone={zone}"}),
+                          float(rng.uniform(40.0, 100.0))),)
+        soft_group = ()
+        if rng.random() < spec.soft_spread_fraction:
+            soft_group = ((group, -float(rng.uniform(40.0, 100.0))),)
         pods.append(Pod(
             name=name,
             scheduler_name=scheduler_name,
@@ -278,6 +293,8 @@ def generate_workload(spec: WorkloadSpec,
             group=group,
             affinity_groups=affinity,
             anti_groups=anti,
+            soft_node_affinity=soft_node,
+            soft_group_affinity=soft_group,
             priority=float(rng.uniform(0, 10)),
         ))
         earlier.append(name)

@@ -425,6 +425,98 @@ def run_affinity_config(out_dir: str | None = None, num_nodes: int = 512,
     return SuiteResult("affinity", metrics, artifacts)
 
 
+def run_soft_affinity_config(out_dir: str | None = None,
+                             num_nodes: int = 256, num_pods: int = 1024,
+                             batch: int = 128, seed: int = 0
+                             ) -> SuiteResult:
+    """Preferred (soft) affinity under load: pods carry weighted zone
+    preferences (``preferredDuringSchedulingIgnoredDuringExecution``
+    nodeAffinity semantics, the stanza the reference's probe server
+    used — netperfScript/deployment.yaml:17-26) and weighted spread
+    preferences (negative soft group affinity).
+
+    Audited outcomes: the fraction of zone-preferring pods landing in
+    their preferred zone (soft pull), and same-node co-location of
+    spread-preferring pods vs. a control run with the soft term
+    disabled (soft push).  Hard-constraint audit stays green — soft
+    terms bias scores, never override masks."""
+    weights = ScoreWeights(soft_affinity=4.0)
+    loop, cfg = _make_loop(num_nodes, seed, weights, batch=batch,
+                           queue=num_pods + batch)
+    # Zone count comes from the same ClusterSpec default _make_loop
+    # builds with, so workload preferences always target zones that
+    # exist on the cluster.
+    spec = WorkloadSpec(num_pods=num_pods, soft_zone_fraction=0.5,
+                        soft_spread_fraction=0.3,
+                        zones=ClusterSpec().zones, seed=seed)
+    pods = generate_workload(spec, scheduler_name=cfg.scheduler_name)
+    wall = _drain(loop, pods)
+
+    zones = {n.name: n.zone for n in loop.client.list_nodes()}
+    prefer = [p for p in pods if p.soft_node_affinity]
+    satisfied = 0
+    placed_prefer = 0
+    for p in prefer:
+        node = loop.client.node_of(p.name)
+        if not node:
+            continue
+        placed_prefer += 1
+        (labels, _w), = p.soft_node_affinity
+        want_zone = next(iter(labels)).split("=", 1)[1]
+        if zones[node] == f"zone-{want_zone}":
+            satisfied += 1
+
+    def _max_colocation(workload: Sequence[Pod], lp) -> float:
+        """Mean over spread-preferring pods of same-group co-residents
+        on their node (lower = better spreading)."""
+        by_node: dict[str, list[Pod]] = {}
+        for p in workload:
+            node = lp.client.node_of(p.name)
+            if node:
+                by_node.setdefault(node, []).append(p)
+        counts = []
+        for p in workload:
+            if not p.soft_group_affinity:
+                continue
+            node = lp.client.node_of(p.name)
+            if not node:
+                continue
+            counts.append(sum(1 for q in by_node[node]
+                              if q is not p and q.group == p.group))
+        return float(np.mean(counts)) if counts else 0.0
+
+    coloc = _max_colocation(pods, loop)
+    # Control: identical workload, soft term off.
+    control_loop, ccfg = _make_loop(num_nodes, seed,
+                                    ScoreWeights(soft_affinity=0.0),
+                                    batch=batch, queue=num_pods + batch)
+    control_pods = generate_workload(spec,
+                                     scheduler_name=ccfg.scheduler_name)
+    _drain(control_loop, control_pods)
+    coloc_control = _max_colocation(control_pods, control_loop)
+    viol = check_constraint_violations(loop, pods)
+    metrics = {
+        "num_nodes": num_nodes,
+        "pods_bound": loop.scheduled,
+        "pods_unschedulable": loop.unschedulable,
+        "pods_per_sec": round(loop.scheduled / wall, 1) if wall else 0.0,
+        "zone_pref_pods": placed_prefer,
+        "zone_pref_satisfied": satisfied,
+        "zone_pref_rate": round(satisfied / placed_prefer, 3)
+        if placed_prefer else 0.0,
+        "spread_colocation": round(coloc, 3),
+        "spread_colocation_control": round(coloc_control, 3),
+        "violations_total": sum(viol.values()),
+    }
+    artifacts = []
+    if out_dir:
+        path = os.path.join(out_dir, "soft_affinity_audit.json")
+        with open(path, "w", encoding="utf-8") as fh:
+            json.dump(metrics, fh, indent=2)
+        artifacts.append(path)
+    return SuiteResult("soft_affinity", metrics, artifacts)
+
+
 # ---------------------------------------------------------------------------
 # Config 4 — multi-resource bin-packing with soft penalties.
 # ---------------------------------------------------------------------------
@@ -577,6 +669,7 @@ CONFIGS: dict[str, Callable[..., SuiteResult]] = {
     "density": run_density_config,
     "custom_network": run_custom_network_config,
     "affinity": run_affinity_config,
+    "soft_affinity": run_soft_affinity_config,
     "binpack": run_binpack_config,
     "sidecar": run_sidecar_config,
 }
@@ -586,6 +679,7 @@ SMALL = {
     "density": dict(num_nodes=64, num_pods=128, batch=32),
     "custom_network": dict(num_nodes=128, pod_counts=(5,)),
     "affinity": dict(num_nodes=64, num_pods=128, batch=32),
+    "soft_affinity": dict(num_nodes=64, num_pods=256, batch=32),
     "binpack": dict(num_nodes=64, num_pods=256, batch=32),
     "sidecar": dict(num_nodes=128, num_apps=48, batch=32),
 }

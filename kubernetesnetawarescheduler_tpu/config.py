@@ -94,6 +94,13 @@ class ScoreWeights:
     peer_bw: float = 2.0
     peer_lat: float = 2.0
     balance: float = 1.0
+    # Multiplier on the weighted preferred-affinity score term
+    # (``preferredDuringSchedulingIgnoredDuringExecution`` semantics —
+    # the mechanism the reference's own probe deployment relied on,
+    # netperfScript/deployment.yaml:17-26).  Per-term weights live on
+    # the pod (k8s weight scale, 1-100); this scales them into the
+    # normalized-score units of the vote/net terms (100 -> 1.0).
+    soft_affinity: float = 1.0
 
     def metric_vector(self) -> tuple[float, ...]:
         """Per-channel weights aligned with :class:`Metric` order."""
@@ -131,6 +138,11 @@ class SchedulerConfig:
     max_nodes: int = 128
     max_pods: int = 64
     max_peers: int = 8
+    # Preferred (soft) affinity terms carried per pod, per bank (one
+    # bank of node-label preference terms, one of pod-group preference
+    # terms).  Terms beyond this are dropped in declaration order —
+    # soft constraints degrade score-neutrally, unlike hard ones.
+    max_soft_terms: int = 2
 
     num_metrics: int = Metric.COUNT
     num_resources: int = Resource.COUNT

@@ -60,7 +60,11 @@ def _static_parts(state: ClusterState, pods: PodBatch, cfg: SchedulerConfig,
         static = score_lib.static_node_scores(state, cfg)
     base, ct = static
     net = score_lib.network_scores(state, pods, cfg, ct=ct)
-    raw = base[None, :] + net
+    # Soft (preferred) affinity is batch-invariant by design: group
+    # terms score against batch-entry group_bits, like kube-scheduler
+    # scoring against committed state (score.soft_affinity_scores).
+    soft = score_lib.soft_affinity_scores(state, pods, cfg)
+    raw = base[None, :] + net + soft
     tol = jnp.all(
         (state.taint_bits[None, :, :] & ~pods.tol_bits[:, None, :]) == 0,
         axis=-1)

@@ -781,6 +781,46 @@ class Encoder:
              if pod.group else 0),
         )
 
+    def _soft_rows(self, pod: Pod, sel_bits_row: np.ndarray,
+                   sel_w_row: np.ndarray, grp_bits_row: np.ndarray,
+                   grp_w_row: np.ndarray) -> None:
+        """Fill one pod's soft-affinity term rows (caller holds the
+        lock; rows are ``u32[T, W]`` / ``f32[T]`` slices).
+
+        Always lenient: a preference we cannot intern degrades
+        score-neutrally.  Label terms go through
+        :meth:`_selector_mask` so a newly-referenced label backfills
+        onto already-registered nodes; on overflow the mask carries
+        the UNKNOWN sentinel, which no node has — the term then simply
+        never matches (0 contribution), exactly the right degradation
+        for a *preference*.  Group terms intern like anti-affinity
+        groups (0 on overflow = no contribution).
+        """
+        t_max = sel_w_row.shape[0]
+
+        def top_terms(terms):
+            # Over budget, keep the strongest preferences: zero-weight
+            # terms are no-ops (dropped outright), and the k8s parser's
+            # multi-value In expansion can inflate one stanza into
+            # several terms — truncating by declaration order would let
+            # such an expansion evict an unrelated, heavier stanza.
+            live = [(x, float(w)) for x, w in terms if w]
+            live.sort(key=lambda t: -abs(t[1]))  # stable: ties keep order
+            return live[:t_max]
+
+        for t, (labels, weight) in enumerate(
+                top_terms(pod.soft_node_affinity)):
+            mask = self._selector_mask(labels, lenient=True)
+            if mask:
+                _fill_words(sel_bits_row[t], mask)
+                sel_w_row[t] = weight
+        for t, (grp, weight) in enumerate(
+                top_terms(pod.soft_group_affinity)):
+            bit = self.groups.bit(grp, lenient=True) if grp else 0
+            if bit:
+                _fill_words(grp_bits_row[t], bit)
+                grp_w_row[t] = weight
+
     def encode_pods(self, pods: Sequence[Pod],
                     node_of: Callable[[str], str],
                     lenient: bool = False) -> PodBatch:
@@ -807,6 +847,11 @@ class Encoder:
         gbit = np.zeros((p, w), np.uint32)
         prio = np.zeros((p,), np.float32)
         valid = np.zeros((p,), bool)
+        t_soft = cfg.max_soft_terms
+        ssel = np.zeros((p, t_soft, w), np.uint32)
+        ssel_w = np.zeros((p, t_soft), np.float32)
+        sgrp = np.zeros((p, t_soft, w), np.uint32)
+        sgrp_w = np.zeros((p, t_soft), np.float32)
         with self._lock:
             for i, pod in enumerate(pods):
                 # A nominated preemptor entering scoring: its own
@@ -831,6 +876,8 @@ class Encoder:
                 bits = self._constraint_bits(pod, lenient)
                 for row, val in zip((tol, sel, aff, anti, gbit), bits):
                     _fill_words(row[i], val)
+                self._soft_rows(pod, ssel[i], ssel_w[i],
+                                sgrp[i], sgrp_w[i])
                 prio[i] = pod.priority
                 valid[i] = True
         return PodBatch(
@@ -838,7 +885,9 @@ class Encoder:
             peer_traffic=jnp.asarray(traffic), tol_bits=jnp.asarray(tol),
             sel_bits=jnp.asarray(sel), affinity_bits=jnp.asarray(aff),
             anti_bits=jnp.asarray(anti), group_bit=jnp.asarray(gbit),
-            priority=jnp.asarray(prio), pod_valid=jnp.asarray(valid))
+            priority=jnp.asarray(prio), pod_valid=jnp.asarray(valid),
+            soft_sel_bits=jnp.asarray(ssel), soft_sel_w=jnp.asarray(ssel_w),
+            soft_grp_bits=jnp.asarray(sgrp), soft_grp_w=jnp.asarray(sgrp_w))
 
     def encode_stream(self, pods: Sequence[Pod],
                       node_of: Callable[[str], str],
@@ -883,6 +932,11 @@ class Encoder:
         gbit = np.zeros((s, w), np.uint32)
         prio = np.zeros((s,), np.float32)
         valid = np.zeros((s,), bool)
+        t_soft = cfg.max_soft_terms
+        ssel = np.zeros((s, t_soft, w), np.uint32)
+        ssel_w = np.zeros((s, t_soft), np.float32)
+        sgrp = np.zeros((s, t_soft, w), np.uint32)
+        sgrp_w = np.zeros((s, t_soft), np.float32)
         batch = self.cfg.max_pods
         res_names = _res_names(r)
         with self._lock:
@@ -912,6 +966,8 @@ class Encoder:
                 bits = self._constraint_bits(pod, lenient)
                 for row, val in zip((tol, sel, aff, anti, gbit), bits):
                     _fill_words(row[i], val)
+                self._soft_rows(pod, ssel[i], ssel_w[i],
+                                sgrp[i], sgrp_w[i])
                 prio[i] = pod.priority
                 valid[i] = True
         return PodStream(
@@ -920,4 +976,6 @@ class Encoder:
             peer_traffic=jnp.asarray(traffic), tol_bits=jnp.asarray(tol),
             sel_bits=jnp.asarray(sel), affinity_bits=jnp.asarray(aff),
             anti_bits=jnp.asarray(anti), group_bit=jnp.asarray(gbit),
-            priority=jnp.asarray(prio), pod_valid=jnp.asarray(valid))
+            priority=jnp.asarray(prio), pod_valid=jnp.asarray(valid),
+            soft_sel_bits=jnp.asarray(ssel), soft_sel_w=jnp.asarray(ssel_w),
+            soft_grp_bits=jnp.asarray(sgrp), soft_grp_w=jnp.asarray(sgrp_w))

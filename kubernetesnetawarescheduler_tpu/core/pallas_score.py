@@ -39,13 +39,17 @@ from kubernetesnetawarescheduler_tpu.core.state import ClusterState, PodBatch
 # to a multiple of 8), N]``: used[0..R), cap[R..2R), base score,
 # node_valid.  Column layout of the packed per-pod arrays (bit fields
 # are W-word masks, W = cfg.mask_words; each field occupies W
-# consecutive slots):
-#   podf[P, >=R+1]   = req[0..R), pod_valid, pad
-#   podi[P, >=5W]    = tol_bits[W], sel_bits[W], affinity_bits[W],
-#                      anti_bits[W], group_bit[W], pad
+# consecutive slots; T = cfg.max_soft_terms):
+#   podf[P, >=R+1+2T] = req[0..R), pod_valid, soft_sel_w[T],
+#                       soft_grp_w[T], pad  (soft weights pre-zeroed
+#                       for empty-bit terms, so the kernel never needs
+#                       a nonempty check)
+#   podi[P, >=(5+2T)W] = tol_bits[W], sel_bits[W], affinity_bits[W],
+#                      anti_bits[W], group_bit[W],
+#                      soft_sel_bits[T*W], soft_grp_bits[T*W], pad
 # Row layout of the packed per-node int array ``nodei[>=4W, N]``:
 #   taint_bits[W], label_bits[W], group_bits[W], resident_anti[W], pad.
-_PARAMS = 8  # wbw, wlat, inv_bwmax, inv_latmax, wbal, eps, pad, pad
+_PARAMS = 8  # wbw, wlat, inv_bwmax, inv_latmax, wbal, eps, wsoft, pad
 
 from kubernetesnetawarescheduler_tpu.core.state import round_up as _round_up
 
@@ -53,7 +57,7 @@ from kubernetesnetawarescheduler_tpu.core.state import round_up as _round_up
 def _kernel(params_ref, t_ref, bw_ref, lat_ref, validk_ref, nodef_ref,
             nodei_ref, podf_ref, podi_ref, out_ref, acc_ref, *,
             block_n: int, block_k: int, num_resources: int,
-            mask_words: int, use_bfloat16: bool):
+            mask_words: int, soft_terms: int, use_bfloat16: bool):
     j = pl.program_id(1)
     k = pl.program_id(2)
     nk = pl.num_programs(2)
@@ -135,8 +139,32 @@ def _kernel(params_ref, t_ref, bw_ref, lat_ref, validk_ref, nodef_ref,
             aff_hit = aff_hit | ((group & aff) != 0)
         ok = ok & (aff_zero | aff_hit)
 
-        out_ref[:] = jnp.where(ok, acc_ref[:] + base - wbal * bal,
-                               jnp.float32(float(NEG_INF)))
+        # Soft (preferred) affinity: weighted bonuses, fused into the
+        # same tile write (score.soft_affinity_scores semantics; the
+        # packer zeroed weights of empty-bit terms).
+        wsoft = params_ref[6]
+        soft = jnp.zeros_like(acc_ref)
+        for t in range(soft_terms):
+            sel_match = jnp.ones_like(fits)
+            grp_hit = jnp.zeros_like(fits)
+            for w in range(mw):
+                label = nodei_ref[mw + w:mw + w + 1, :]
+                group = nodei_ref[2 * mw + w:2 * mw + w + 1, :]
+                sbits = podi_ref[:, (5 + t) * mw + w:(5 + t) * mw + w + 1]
+                gbits = podi_ref[
+                    :, (5 + soft_terms + t) * mw + w:
+                    (5 + soft_terms + t) * mw + w + 1]
+                sel_match = sel_match & ((label & sbits) == sbits)
+                grp_hit = grp_hit | ((group & gbits) != 0)
+            wsel = podf_ref[:, r_res + 1 + t:r_res + 2 + t]
+            wgrp = podf_ref[:, r_res + 1 + soft_terms + t:
+                            r_res + 2 + soft_terms + t]
+            soft += (jnp.where(sel_match, wsel, 0.0)
+                     + jnp.where(grp_hit, wgrp, 0.0))
+
+        out_ref[:] = jnp.where(
+            ok, acc_ref[:] + base + wsoft * soft - wbal * bal,
+            jnp.float32(float(NEG_INF)))
 
 
 @functools.partial(
@@ -172,10 +200,11 @@ def score_pods_tiled(state: ClusterState, pods: PodBatch,
     # R=3 and the lane tiling) and the mask width (4W nodei rows / 5W
     # podi columns).
     mw = cfg.mask_words
+    t_soft = cfg.max_soft_terms
     nf_rows = _round_up(2 * r_res + 2, 8)
-    pf_cols = _round_up(r_res + 1, 8)
+    pf_cols = _round_up(r_res + 1 + 2 * t_soft, 8)
     ni_rows = _round_up(4 * mw, 8)
-    pi_cols = _round_up(5 * mw, 8)
+    pi_cols = _round_up((5 + 2 * t_soft) * mw, 8)
 
     def pad(x, rows, cols=None):
         pr = rows - x.shape[0]
@@ -196,7 +225,7 @@ def score_pods_tiled(state: ClusterState, pods: PodBatch,
         jnp.float32(cfg.weights.peer_bw), jnp.float32(cfg.weights.peer_lat),
         1.0 / bw_max, 1.0 / lat_max,
         jnp.float32(cfg.weights.balance), jnp.float32(_EPS),
-        jnp.float32(0), jnp.float32(0)])
+        jnp.float32(cfg.weights.soft_affinity / 100.0), jnp.float32(0)])
 
     bw = pad(state.bw, n_pad, n_pad)
     lat = pad(state.lat, n_pad, n_pad)
@@ -219,6 +248,15 @@ def score_pods_tiled(state: ClusterState, pods: PodBatch,
     podf = jnp.zeros((p_pad, pf_cols), jnp.float32)
     podf = podf.at[:p_real, 0:r_res].set(pods.req)
     podf = podf.at[:p_real, r_res].set(pods.pod_valid.astype(jnp.float32))
+    # Soft-term weights, zeroed where the term's bits are empty so the
+    # kernel's trivially-true subset match cannot add phantom weight.
+    sel_w_eff = jnp.where(jnp.any(pods.soft_sel_bits != 0, axis=-1),
+                          pods.soft_sel_w, 0.0)
+    grp_w_eff = jnp.where(jnp.any(pods.soft_grp_bits != 0, axis=-1),
+                          pods.soft_grp_w, 0.0)
+    podf = podf.at[:p_real, r_res + 1:r_res + 1 + t_soft].set(sel_w_eff)
+    podf = podf.at[:p_real,
+                   r_res + 1 + t_soft:r_res + 1 + 2 * t_soft].set(grp_w_eff)
 
     podi = jnp.zeros((p_pad, pi_cols), jnp.int32)
     for f, bits in enumerate((pods.tol_bits, pods.sel_bits,
@@ -226,10 +264,15 @@ def score_pods_tiled(state: ClusterState, pods: PodBatch,
                               pods.group_bit)):
         podi = podi.at[:p_real, f * mw:(f + 1) * mw].set(
             bits.astype(jnp.int32))
+    podi = podi.at[:p_real, 5 * mw:(5 + t_soft) * mw].set(
+        pods.soft_sel_bits.astype(jnp.int32).reshape(p_real, -1))
+    podi = podi.at[:p_real, (5 + t_soft) * mw:(5 + 2 * t_soft) * mw].set(
+        pods.soft_grp_bits.astype(jnp.int32).reshape(p_real, -1))
 
     grid = (p_pad // bp, n_pad // nb, n_pad // kb)
     kernel = functools.partial(_kernel, block_n=nb, block_k=kb,
                                num_resources=r_res, mask_words=mw,
+                               soft_terms=t_soft,
                                use_bfloat16=cfg.use_bfloat16)
     out = pl.pallas_call(
         kernel,

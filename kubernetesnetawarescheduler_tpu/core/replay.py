@@ -64,6 +64,10 @@ class PodStream:
     group_bit: jax.Array      # u32[S, W]
     priority: jax.Array       # f32[S]
     pod_valid: jax.Array      # bool[S]
+    soft_sel_bits: jax.Array  # u32[S, T, W]
+    soft_sel_w: jax.Array     # f32[S, T]
+    soft_grp_bits: jax.Array  # u32[S, T, W]
+    soft_grp_w: jax.Array     # f32[S, T]
 
     @property
     def num_pods(self) -> int:
@@ -102,7 +106,9 @@ def _make_step(state: ClusterState, cfg: SchedulerConfig, method: str,
             tol_bits=sl.tol_bits, sel_bits=sl.sel_bits,
             affinity_bits=sl.affinity_bits, anti_bits=sl.anti_bits,
             group_bit=sl.group_bit, priority=sl.priority,
-            pod_valid=sl.pod_valid)
+            pod_valid=sl.pod_valid,
+            soft_sel_bits=sl.soft_sel_bits, soft_sel_w=sl.soft_sel_w,
+            soft_grp_bits=sl.soft_grp_bits, soft_grp_w=sl.soft_grp_w)
         assignment = assign_fn(st, pods, cfg, static)
         st = commit_assignments(st, pods, assignment)
         node_of_pod = jax.lax.dynamic_update_slice_in_dim(
@@ -273,4 +279,8 @@ def pad_stream(stream: PodStream, multiple: int) -> PodStream:
         group_bit=pd(stream.group_bit, 0),
         priority=pd(stream.priority, 0.0),
         pod_valid=pd(stream.pod_valid, False),
+        soft_sel_bits=pd(stream.soft_sel_bits, 0),
+        soft_sel_w=pd(stream.soft_sel_w, 0.0),
+        soft_grp_bits=pd(stream.soft_grp_bits, 0),
+        soft_grp_w=pd(stream.soft_grp_w, 0.0),
     )

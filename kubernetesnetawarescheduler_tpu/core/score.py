@@ -179,6 +179,51 @@ def network_scores(state: ClusterState, pods: PodBatch,
     return jnp.dot(t, ct, precision=jax.lax.Precision.HIGHEST)
 
 
+def soft_affinity_scores(state: ClusterState, pods: PodBatch,
+                         cfg: SchedulerConfig) -> jax.Array:
+    """Weighted preferred-affinity score term ``f32[P, N]``.
+
+    The score-side counterpart of the hard masks in
+    :func:`feasibility_mask` — ``preferredDuringSchedulingIgnoredDuring
+    Execution`` semantics, which the reference's own probe deployment
+    used to pull its iperf3 server toward the master node
+    (netperfScript/deployment.yaml:17-26) while delegating evaluation
+    to stock kube-scheduler.  Two term banks per pod (``T`` terms
+    each):
+
+    - node-label terms: bonus ``w_t`` on nodes carrying ALL of the
+      term's labels (``soft_sel_bits`` ⊆ ``label_bits``); empty terms
+      (padding) contribute nothing.
+    - pod-group terms: bonus ``w_t`` on nodes whose resident pods
+      include the term's group (ANY overlap with ``group_bits``) —
+      negative ``w_t`` is preferred spreading (soft anti-affinity).
+
+    Weights follow the k8s 1-100 scale; ``cfg.weights.soft_affinity``
+    scales the sum into normalized-score units (/100, so a weight-100
+    term moves a node by ``soft_affinity`` score units).
+
+    Group terms are evaluated against the batch-entry ``group_bits``
+    (same-batch placements do not attract each other within the batch)
+    — matching kube-scheduler, which scores each pod against committed
+    state only; hard affinity, by contrast, is re-derived per
+    conflict-resolution round.
+    """
+    lb = state.label_bits[None, None, :, :]        # [1, 1, N, W]
+    sb = pods.soft_sel_bits[:, :, None, :]         # [P, T, 1, W]
+    label_match = jnp.all((lb & sb) == sb, axis=-1)        # [P, T, N]
+    nonempty = jnp.any(pods.soft_sel_bits != 0, axis=-1)   # [P, T]
+    label_term = jnp.sum(
+        jnp.where(nonempty[:, :, None] & label_match,
+                  pods.soft_sel_w[:, :, None], 0.0), axis=1)
+    gb = state.group_bits[None, None, :, :]
+    pg = pods.soft_grp_bits[:, :, None, :]
+    group_match = jnp.any((gb & pg) != 0, axis=-1)
+    group_term = jnp.sum(
+        jnp.where(group_match, pods.soft_grp_w[:, :, None], 0.0), axis=1)
+    scale = jnp.float32(cfg.weights.soft_affinity / 100.0)
+    return scale * (label_term + group_term)
+
+
 def balance_penalty(state: ClusterState, pods: PodBatch) -> jax.Array:
     """Worst-fit fractional utilization after placement, ``f32[P, N]``:
     ``max_r (used[n,r] + req[p,r]) / cap[n,r]``.  Soft bin-packing
@@ -233,7 +278,8 @@ def score_pods(state: ClusterState, pods: PodBatch,
     """Full masked score matrix ``f32[P, N]``; -inf marks infeasible."""
     base = metric_scores(state, cfg)[None, :]
     net = network_scores(state, pods, cfg)
+    soft = soft_affinity_scores(state, pods, cfg)
     bal = cfg.weights.balance * balance_penalty(state, pods)
-    raw = base + net - bal
+    raw = base + net + soft - bal
     ok = feasibility_mask(state, pods)
     return jnp.where(ok, raw, NEG_INF)

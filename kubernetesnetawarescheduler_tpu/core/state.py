@@ -124,6 +124,13 @@ class PodBatch:
     group_bit: jax.Array
     priority: jax.Array
     pod_valid: jax.Array
+    # Preferred (soft) affinity terms, ``T = cfg.max_soft_terms`` per
+    # bank: weighted score bonuses, not masks (types.py Pod
+    # soft_node_affinity / soft_group_affinity).
+    soft_sel_bits: jax.Array   # u32[P, T, W] node labels (ALL must match)
+    soft_sel_w: jax.Array      # f32[P, T]    signed term weight
+    soft_grp_bits: jax.Array   # u32[P, T, W] resident groups (ANY overlap)
+    soft_grp_w: jax.Array      # f32[P, T]    signed term weight
 
     @property
     def num_pods(self) -> int:
@@ -170,6 +177,10 @@ def init_pod_batch(cfg: SchedulerConfig, **overrides: Any) -> PodBatch:
         group_bit=jnp.zeros((p, w), jnp.uint32),
         priority=jnp.zeros((p,), jnp.float32),
         pod_valid=jnp.zeros((p,), jnp.bool_),
+        soft_sel_bits=jnp.zeros((p, cfg.max_soft_terms, w), jnp.uint32),
+        soft_sel_w=jnp.zeros((p, cfg.max_soft_terms), jnp.float32),
+        soft_grp_bits=jnp.zeros((p, cfg.max_soft_terms, w), jnp.uint32),
+        soft_grp_w=jnp.zeros((p, cfg.max_soft_terms), jnp.float32),
     )
     fields.update(overrides)
     return PodBatch(**fields)

@@ -78,6 +78,7 @@ ANN_AFFINITY = "netaware.io/affinity"
 ANN_ANTI = "netaware.io/anti-affinity"
 ANN_BANDWIDTH = "netaware.io/bandwidth-gbps"
 ANN_PDB = "netaware.io/pdb-min-available"
+ANN_SOFT_AFFINITY = "netaware.io/soft-affinity"
 
 
 # -- k8s quantity parsing ---------------------------------------------
@@ -118,6 +119,80 @@ def _flatten(m: Mapping[str, str] | None) -> frozenset[str]:
     if not m:
         return frozenset()
     return frozenset(f"{k}={v}" for k, v in m.items())
+
+
+def _preferred_node_terms(spec: Mapping) -> tuple:
+    """Extract ``preferredDuringSchedulingIgnoredDuringExecution``
+    nodeAffinity terms as ``((frozenset{"k=v", ...}, weight), ...)`` —
+    the stanza the reference's own probe deployment used
+    (netperfScript/deployment.yaml:17-26).
+
+    Representable shapes (soft semantics, so anything else degrades
+    score-neutrally by skipping the term):
+
+    - every matchExpression a single-value ``In`` → one term ANDing
+      all ``key=value`` labels (k8s: expressions within a term AND);
+    - exactly one multi-value ``In`` expression → one term per value,
+      same weight (k8s: values within an expression OR).
+    """
+    na = (spec.get("affinity") or {}).get("nodeAffinity") or {}
+    out = []
+    for term in na.get(
+            "preferredDuringSchedulingIgnoredDuringExecution") or []:
+        try:
+            weight = float(term.get("weight", 0) or 0)
+        except (TypeError, ValueError):
+            continue
+        exprs = (term.get("preference") or {}).get("matchExpressions") or []
+        if not weight or not exprs:
+            continue
+        if all(e.get("operator") == "In" and e.get("key")
+               and len(e.get("values") or []) == 1 for e in exprs):
+            labels = frozenset(
+                f"{e['key']}={e['values'][0]}" for e in exprs)
+            out.append((labels, weight))
+        elif (len(exprs) == 1 and exprs[0].get("operator") == "In"
+              and exprs[0].get("key") and exprs[0].get("values")):
+            key = exprs[0]["key"]
+            out.extend((frozenset({f"{key}={v}"}), weight)
+                       for v in exprs[0]["values"])
+    return tuple(out)
+
+
+def _preferred_group_terms(spec: Mapping, ann: Mapping) -> tuple:
+    """Soft pod-(anti-)affinity as ``(("group", weight), ...)``.
+
+    Two surfaces merge here: the native annotation
+    ``netaware.io/soft-affinity`` (JSON ``{"group": weight}``, negative
+    = preferred spreading), and the k8s ``podAffinity``/
+    ``podAntiAffinity`` preferred stanzas, whose ``labelSelector
+    .matchLabels`` reduce to the canonical sorted ``k=v[,k=v...]``
+    group key (matching pods whose ``netaware.io/group`` annotation
+    uses the same convention — the same hostname-topology reduction
+    the hard masks use)."""
+    out = []
+    if ANN_SOFT_AFFINITY in ann:
+        try:
+            raw = json.loads(ann[ANN_SOFT_AFFINITY])
+            out.extend((str(g), float(v)) for g, v in raw.items()
+                       if float(v))  # weight-0 entries are no-ops
+        except (ValueError, TypeError, AttributeError):
+            pass  # malformed annotation degrades score-neutrally
+    aff = spec.get("affinity") or {}
+    for kind, sign in (("podAffinity", 1.0), ("podAntiAffinity", -1.0)):
+        for term in (aff.get(kind) or {}).get(
+                "preferredDuringSchedulingIgnoredDuringExecution") or []:
+            try:
+                weight = float(term.get("weight", 0) or 0)
+            except (TypeError, ValueError):
+                continue
+            match = ((term.get("podAffinityTerm") or {})
+                     .get("labelSelector") or {}).get("matchLabels") or {}
+            if not weight or not match:
+                continue
+            group = ",".join(f"{k}={v}" for k, v in sorted(match.items()))
+            out.append((group, sign * weight))
+    return tuple(out)
 
 
 def pod_from_json(obj: Mapping) -> Pod:
@@ -179,6 +254,8 @@ def pod_from_json(obj: Mapping) -> Pod:
         group=ann.get(ANN_GROUP, ""),
         affinity_groups=_csv(ANN_AFFINITY),
         anti_groups=_csv(ANN_ANTI),
+        soft_node_affinity=_preferred_node_terms(spec),
+        soft_group_affinity=_preferred_group_terms(spec, ann),
         priority=float(spec.get("priority", 0) or 0),
         pdb_min_available=int(ann.get(ANN_PDB, 0) or 0),
     )

@@ -66,6 +66,20 @@ class Pod:
     group: str = ""
     affinity_groups: frozenset[str] = frozenset()
     anti_groups: frozenset[str] = frozenset()
+    # Preferred (soft) affinity, the weighted score-term counterpart of
+    # the hard masks above — ``preferredDuringSchedulingIgnoredDuring
+    # Execution`` semantics (the reference's own probe server relied on
+    # it, netperfScript/deployment.yaml:17-26).  Each term is
+    # ``(labels-or-group, weight)``; weight follows the k8s 1-100
+    # scale and may be negative for avoidance (soft anti-affinity).
+    #
+    # - ``soft_node_affinity``: ((frozenset{"k=v", ...}, weight), ...)
+    #   — score bonus on nodes carrying ALL labels of the term.
+    # - ``soft_group_affinity``: (("group", weight), ...) — score
+    #   bonus on nodes already hosting a pod of that group (negative
+    #   weight = preferred spreading).
+    soft_node_affinity: tuple = ()
+    soft_group_affinity: tuple = ()
     priority: float = 0.0
     # Annotation-level PodDisruptionBudget: at least this many members
     # of the pod's ``group`` must stay up — preemption may not disrupt

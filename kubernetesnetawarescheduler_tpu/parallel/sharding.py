@@ -80,6 +80,10 @@ def pods_sharding(mesh: Mesh) -> PodBatch:
         group_bit=s("dp", None),
         priority=s("dp"),
         pod_valid=s("dp"),
+        soft_sel_bits=s("dp", None, None),
+        soft_sel_w=s("dp", None),
+        soft_grp_bits=s("dp", None, None),
+        soft_grp_w=s("dp", None),
     )
 
 

@@ -91,6 +91,37 @@ def random_instance(rng: np.random.Generator, cfg: SchedulerConfig,
         priority=rng.uniform(0.0, 10.0, (p_total,)).astype(np.float32),
         pod_valid=pod_valid,
     )
+    # Soft (preferred) affinity terms: single-word bit patterns widened
+    # like the hard masks; ~1/3 of pods carry a label preference, ~1/4
+    # a group preference (negative weights exercise soft anti).
+    t_soft = cfg.max_soft_terms
+    ssel = np.zeros((p_total, t_soft), np.uint32)
+    ssel_w = np.zeros((p_total, t_soft), np.float32)
+    sgrp = np.zeros((p_total, t_soft), np.uint32)
+    sgrp_w = np.zeros((p_total, t_soft), np.float32)
+    if with_constraints:
+        has_sel = rng.random((p_total, t_soft)) < 0.33
+        ssel = np.where(has_sel,
+                        rng.integers(1, 8, (p_total, t_soft)), 0
+                        ).astype(np.uint32)
+        ssel_w = np.where(has_sel,
+                          rng.uniform(1.0, 100.0, (p_total, t_soft)), 0.0
+                          ).astype(np.float32)
+        has_grp = rng.random((p_total, t_soft)) < 0.25
+        sgrp = np.where(has_grp,
+                        rng.integers(1, 4, (p_total, t_soft)), 0
+                        ).astype(np.uint32)
+        sgrp_w = np.where(has_grp,
+                          rng.uniform(-100.0, 100.0, (p_total, t_soft)),
+                          0.0).astype(np.float32)
+    pods.update(
+        soft_sel_bits=np.stack([bits_col(ssel[:, t])
+                                for t in range(t_soft)], axis=1),
+        soft_sel_w=ssel_w,
+        soft_grp_bits=np.stack([bits_col(sgrp[:, t])
+                                for t in range(t_soft)], axis=1),
+        soft_grp_w=sgrp_w,
+    )
     return state, pods
 
 

@@ -128,6 +128,27 @@ def oracle_feasible(state, pods, used=None, group_bits=None,
     return ok
 
 
+def oracle_soft(state, pods, cfg: SchedulerConfig):
+    """Weighted preferred-affinity term (batch-entry group state by
+    design — see core.score.soft_affinity_scores)."""
+    p = pods["req"].shape[0]
+    n = state["cap"].shape[0]
+    out = np.zeros((p, n), np.float32)
+    t_terms = pods["soft_sel_w"].shape[1]
+    for i in range(p):
+        for j in range(n):
+            s = 0.0
+            for t in range(t_terms):
+                bits = as_int(pods["soft_sel_bits"][i, t])
+                if bits and (as_int(state["label_bits"][j]) & bits) == bits:
+                    s += pods["soft_sel_w"][i, t]
+                gbits = as_int(pods["soft_grp_bits"][i, t])
+                if gbits and (as_int(state["group_bits"][j]) & gbits) != 0:
+                    s += pods["soft_grp_w"][i, t]
+            out[i, j] = s * cfg.weights.soft_affinity / 100.0
+    return out
+
+
 def oracle_balance(state, pods, used=None):
     used = state["used"] if used is None else used
     p = pods["req"].shape[0]
@@ -146,9 +167,10 @@ def oracle_scores(state, pods, cfg: SchedulerConfig):
     t = oracle_traffic_matrix(pods, state["cap"].shape[0])
     c = oracle_net_cost(state, cfg)
     net = t @ c.T
+    soft = oracle_soft(state, pods, cfg)
     bal = cfg.weights.balance * oracle_balance(state, pods)
     ok = oracle_feasible(state, pods)
-    raw = base[None, :] + net - bal
+    raw = base[None, :] + net + soft - bal
     return np.where(ok, raw, NEG_INF).astype(np.float32)
 
 
@@ -159,6 +181,7 @@ def oracle_assign_greedy(state, pods, cfg: SchedulerConfig):
     t = oracle_traffic_matrix(pods, state["cap"].shape[0])
     c = oracle_net_cost(state, cfg)
     net = t @ c.T
+    soft = oracle_soft(state, pods, cfg)
     used = state["used"].copy()
     group = state["group_bits"].copy()
     res_anti = state["resident_anti"].copy()
@@ -170,7 +193,7 @@ def oracle_assign_greedy(state, pods, cfg: SchedulerConfig):
             continue
         ok = oracle_feasible(state, pods, used, group, res_anti)[i]
         bal = cfg.weights.balance * oracle_balance(state, pods, used)[i]
-        row = np.where(ok, base + net[i] - bal, NEG_INF)
+        row = np.where(ok, base + net[i] + soft[i] - bal, NEG_INF)
         j = int(np.argmax(row))
         if row[j] <= NEG_INF * 0.5:
             continue

@@ -434,3 +434,50 @@ def test_pooled_requests_preserve_outcome_order(apiserver):
         assert sorted(names) == sorted(f"ok{i}" for i in range(6))
     finally:
         c.close()
+
+
+def test_pod_from_json_preferred_affinity():
+    """preferredDuringSchedulingIgnoredDuringExecution stanzas (the
+    reference's own probe deployment used the nodeAffinity one,
+    netperfScript/deployment.yaml:17-26) parse into weighted soft
+    terms; unsupported operators degrade by skipping the term."""
+    obj = _pod_json("p")
+    obj["spec"]["affinity"] = {
+        "nodeAffinity": {
+            "preferredDuringSchedulingIgnoredDuringExecution": [
+                {"weight": 1,
+                 "preference": {"matchExpressions": [
+                     {"key": "kubernetes.io/hostname", "operator": "In",
+                      "values": ["ubuntu"]}]}},
+                {"weight": 50,
+                 "preference": {"matchExpressions": [
+                     {"key": "zone", "operator": "In",
+                      "values": ["a", "b"]}]}},
+                {"weight": 10,   # unsupported operator: skipped
+                 "preference": {"matchExpressions": [
+                     {"key": "arch", "operator": "NotIn",
+                      "values": ["arm"]}]}},
+            ]},
+        "podAffinity": {
+            "preferredDuringSchedulingIgnoredDuringExecution": [
+                {"weight": 30, "podAffinityTerm": {
+                    "labelSelector": {"matchLabels": {"app": "db"}},
+                    "topologyKey": "kubernetes.io/hostname"}}]},
+        "podAntiAffinity": {
+            "preferredDuringSchedulingIgnoredDuringExecution": [
+                {"weight": 20, "podAffinityTerm": {
+                    "labelSelector": {"matchLabels": {"app": "web"}},
+                    "topologyKey": "kubernetes.io/hostname"}}]},
+    }
+    obj["metadata"]["annotations"]["netaware.io/soft-affinity"] = \
+        '{"cache": -15}'
+    pod = pod_from_json(obj)
+    assert (frozenset({"kubernetes.io/hostname=ubuntu"}), 1.0) \
+        in pod.soft_node_affinity
+    # multi-value In expands to one term per value, same weight
+    assert (frozenset({"zone=a"}), 50.0) in pod.soft_node_affinity
+    assert (frozenset({"zone=b"}), 50.0) in pod.soft_node_affinity
+    assert len(pod.soft_node_affinity) == 3
+    assert ("cache", -15.0) in pod.soft_group_affinity
+    assert ("app=db", 30.0) in pod.soft_group_affinity
+    assert ("app=web", -20.0) in pod.soft_group_affinity

@@ -158,3 +158,72 @@ def test_staleness_decays_toward_neutral():
     neutral = 0.5 * sum(cfg.weights.metric_vector())
     np.testing.assert_allclose(s_stale, neutral, atol=1e-4)
     assert np.std(s_fresh) > np.std(s_stale)
+
+
+def test_soft_node_affinity_pulls_placement():
+    """A weighted preferred-node term must flip an otherwise-tied
+    choice toward the labeled node, without overriding hard masks
+    (preferredDuringScheduling semantics, deployment.yaml:17-26)."""
+    import jax.numpy as jnp
+    from kubernetesnetawarescheduler_tpu.core.assign import assign_greedy
+
+    cfg = SchedulerConfig(max_nodes=8, max_pods=2, max_peers=2,
+                          use_bfloat16=False)
+    labels = np.zeros((8, cfg.mask_words), np.uint32)
+    labels[3, 0] = 0b1  # node 3 carries the preferred label (bit 0)
+    state = init_cluster_state(
+        cfg,
+        node_valid=jnp.ones((8,), bool),
+        cap=jnp.full((8, cfg.num_resources), 10.0),
+        label_bits=jnp.asarray(labels),
+    )
+    ssel = np.zeros((2, cfg.max_soft_terms, cfg.mask_words), np.uint32)
+    ssel_w = np.zeros((2, cfg.max_soft_terms), np.float32)
+    ssel[0, 0, 0] = 0b1
+    ssel_w[0, 0] = 80.0
+    pods = init_pod_batch(
+        cfg,
+        req=jnp.full((2, cfg.num_resources), 1.0),
+        pod_valid=jnp.ones((2,), bool),
+        soft_sel_bits=jnp.asarray(ssel),
+        soft_sel_w=jnp.asarray(ssel_w),
+    )
+    a = np.asarray(assign_greedy(state, pods, cfg))
+    assert a[0] == 3  # pulled by the soft term
+    # Infeasible node keeps losing no matter the weight: taint node 3.
+    taints = np.zeros((8, cfg.mask_words), np.uint32)
+    taints[3, 0] = 0b10
+    state2 = state.replace(taint_bits=jnp.asarray(taints))
+    a2 = np.asarray(assign_greedy(state2, pods, cfg))
+    assert a2[0] != 3
+
+
+def test_soft_group_spread_pushes_away():
+    """Negative soft group weight (preferred spreading) steers a pod
+    off nodes already hosting its group."""
+    import jax.numpy as jnp
+    from kubernetesnetawarescheduler_tpu.core.assign import assign_greedy
+
+    cfg = SchedulerConfig(max_nodes=4, max_pods=1, max_peers=2,
+                          use_bfloat16=False)
+    groups = np.zeros((4, cfg.mask_words), np.uint32)
+    groups[:3, 0] = 0b1  # group bit resident on nodes 0-2
+    state = init_cluster_state(
+        cfg,
+        node_valid=jnp.ones((4,), bool),
+        cap=jnp.full((4, cfg.num_resources), 10.0),
+        group_bits=jnp.asarray(groups),
+    )
+    sgrp = np.zeros((1, cfg.max_soft_terms, cfg.mask_words), np.uint32)
+    sgrp_w = np.zeros((1, cfg.max_soft_terms), np.float32)
+    sgrp[0, 0, 0] = 0b1
+    sgrp_w[0, 0] = -90.0
+    pods = init_pod_batch(
+        cfg,
+        req=jnp.full((1, cfg.num_resources), 1.0),
+        pod_valid=jnp.ones((1,), bool),
+        soft_grp_bits=jnp.asarray(sgrp),
+        soft_grp_w=jnp.asarray(sgrp_w),
+    )
+    a = np.asarray(assign_greedy(state, pods, cfg))
+    assert a[0] == 3  # the only group-free node

@@ -98,6 +98,12 @@ def test_sharded_replay_matches_single_device():
         group_bit=jnp.zeros((s, CFG.mask_words), jnp.uint32),
         priority=jnp.asarray(rng.uniform(0, 5, (s,)).astype(np.float32)),
         pod_valid=jnp.ones((s,), bool),
+        soft_sel_bits=jnp.zeros((s, CFG.max_soft_terms, CFG.mask_words),
+                                jnp.uint32),
+        soft_sel_w=jnp.zeros((s, CFG.max_soft_terms), jnp.float32),
+        soft_grp_bits=jnp.zeros((s, CFG.max_soft_terms, CFG.mask_words),
+                                jnp.uint32),
+        soft_grp_w=jnp.zeros((s, CFG.max_soft_terms), jnp.float32),
     )
     want_assign, want_state = replay_stream(state, stream, CFG, "parallel")
     mesh = make_mesh(2, 4)

@@ -81,3 +81,16 @@ def test_sidecar_config_coplaces():
 def test_runner_dispatches(name, tmp_path):
     [res] = suite.run_suite([name], out_dir=str(tmp_path), small=True)
     assert res.config == name
+
+
+def test_soft_affinity_config_biases_without_violating():
+    res = suite.run_soft_affinity_config(**suite.SMALL["soft_affinity"])
+    m = res.metrics
+    assert m["pods_bound"] > 0
+    assert m["violations_total"] == 0
+    # Soft pull: zone preference satisfied well above the 1/zones
+    # chance rate (2 zones -> 0.5).
+    assert m["zone_pref_rate"] > 0.6
+    # Soft push: spread-preferring pods co-locate less than the
+    # control run with the term disabled.
+    assert m["spread_colocation"] <= m["spread_colocation_control"]
