@@ -21,13 +21,18 @@ fallback.
 from __future__ import annotations
 
 import json
+import threading
+import time
 from typing import Any, Mapping, Sequence
 
 import numpy as np
 
 from kubernetesnetawarescheduler_tpu.config import Resource
 from kubernetesnetawarescheduler_tpu.core.loop import SchedulerLoop
-from kubernetesnetawarescheduler_tpu.core.pallas_score import score_pods_auto
+from kubernetesnetawarescheduler_tpu.core.pallas_score import (
+    compute_static,
+    score_pods_auto,
+)
 from kubernetesnetawarescheduler_tpu.core.score import NEG_INF
 from kubernetesnetawarescheduler_tpu.k8s.types import Binding, Pod
 
@@ -110,11 +115,126 @@ def _parse_mem(text: str) -> float:
         return 0.0
 
 
-class ExtenderHandlers:
-    """Stateless-per-request handlers bound to a SchedulerLoop."""
+class _ScoreBatcher:
+    """Coalesces concurrent webhook score requests into one kernel
+    dispatch, sized to the actual demand.
 
-    def __init__(self, loop: SchedulerLoop) -> None:
+    Two defects of the per-request path this replaces (the reference's
+    per-pod-synchronous ``prioritize()``, scheduler.go:248, reborn at
+    the webhook boundary):
+
+    - every request encoded ONE pod into a full ``max_pods``-shaped
+      batch, so a single ``/prioritize`` at the deploy config paid a
+      256 x 5120 kernel;
+    - concurrent requests each dispatched their own kernel.
+
+    Here requests queue; one thread at a time becomes the *leader*,
+    drains everything queued (natural batching: while a dispatch is in
+    flight, arrivals pile up and ride the next one — batch size adapts
+    to load with zero added latency when idle), pads the pod count to a
+    multiple of 8, and runs ONE kernel whose pod axis is the demand,
+    not ``max_pods``.  An optional fixed window (``window_s``) can
+    force extra coalescing for latency-insensitive deployments.
+    """
+
+    _PAD = 8  # pod-axis pad quantum: keeps jit cache small, lanes happy
+
+    def __init__(self, loop: SchedulerLoop, window_s: float = 0.0) -> None:
         self._loop = loop
+        self._window = window_s
+        self._lock = threading.Lock()          # guards _queue
+        self._dispatch_lock = threading.Lock()  # one kernel at a time
+        self._queue: list[list] = []  # entries: [pod, event, row|exc]
+        self.dispatches = 0  # kernel dispatch count (observability)
+        # Static-score cache: the O(N^2) batch-invariant prep (metric
+        # vote + net normalization) depends only on metrics/network/
+        # validity — NOT on placements — so binds between requests do
+        # not invalidate it.  Keyed on the encoder's static_version
+        # counter (its explicit contract for exactly this caching).
+        self._static_version: int | None = None
+        self._static_val = None
+
+    def score(self, pod: Pod) -> np.ndarray:
+        """Full masked score row ``f32[N]`` for one pod (blocking)."""
+        entry = [pod, threading.Event(), None]
+        with self._lock:
+            self._queue.append(entry)
+        if self._window:
+            time.sleep(self._window)
+        while not entry[1].is_set():
+            # Whoever gets the dispatch lock first leads and drains the
+            # whole queue (including this entry — it was appended
+            # before the acquire, so a successful acquire guarantees
+            # progress).  The rest block on the acquire; on wake-up
+            # their entry is usually already served and the loop exits.
+            with self._dispatch_lock:
+                if entry[1].is_set():
+                    break
+                self._drain_locked()
+        if isinstance(entry[2], BaseException):
+            raise entry[2]
+        return entry[2]
+
+    def _drain_locked(self) -> None:
+        """Dispatch everything queued (caller holds _dispatch_lock)."""
+        with self._lock:
+            batch = self._queue
+            self._queue = []
+        if not batch:
+            return
+        loop = self._loop
+        max_pods = loop.cfg.max_pods
+        try:
+            for start in range(0, len(batch), max_pods):
+                chunk = batch[start:start + max_pods]
+                pods = [e[0] for e in chunk]
+                enc = loop.encoder.encode_pods(
+                    pods, node_of=loop._peer_node, lenient=True,
+                    pad_to=min(_round8(len(pods)), max_pods))
+                # Version read BEFORE the snapshot: if another thread's
+                # snapshot bumps it in between, our stored version is
+                # already stale relative to our (newer) state, so the
+                # next request recomputes — over-recompute is the safe
+                # direction, stale-static never happens.
+                version = loop.encoder.static_version
+                state = loop.encoder.snapshot()
+                static = self._static_for(state, version)
+                self.dispatches += 1
+                rows = np.asarray(
+                    score_pods_auto(state, enc, loop.cfg, static))
+                for i, e in enumerate(chunk):
+                    e[2] = rows[i]
+                    e[1].set()
+        except BaseException as exc:  # deliver, don't strand waiters
+            for e in batch:
+                if not e[1].is_set():
+                    e[2] = exc
+                    e[1].set()
+
+
+    def _static_for(self, state, version: int):
+        if self._static_version != version:
+            self._static_val = compute_static(state, self._loop.cfg)
+            self._static_version = version
+        return self._static_val
+
+
+def _round8(n: int) -> int:
+    return max(8, (n + 7) // 8 * 8)
+
+
+class ExtenderHandlers:
+    """Stateless-per-request handlers bound to a SchedulerLoop.
+
+    Scoring requests flow through a :class:`_ScoreBatcher`, so
+    concurrent ``/filter``/``/prioritize`` calls share kernel
+    dispatches and a lone request pays for an 8-pod batch, not
+    ``max_pods``."""
+
+    def __init__(self, loop: SchedulerLoop,
+                 batch_window_s: float = 0.0) -> None:
+        self._loop = loop
+        self._batcher = _ScoreBatcher(loop, window_s=batch_window_s)
 
     # -- ops ----------------------------------------------------------
 
@@ -157,13 +277,11 @@ class ExtenderHandlers:
         if not names:
             empty = np.zeros((0,))
             return [], empty.astype(bool), empty
-        batch = loop.encoder.encode_pods([pod], node_of=loop._peer_node,
-                                         lenient=True)
-        state = loop.encoder.snapshot()
         # Kernel choice (dense XLA vs tiled Pallas) follows
         # cfg.score_backend — this Score/Filter service path is where
-        # the 5k-node tiled kernel earns its keep.
-        scores = np.asarray(score_pods_auto(state, batch, loop.cfg))[0]
+        # the 5k-node tiled kernel earns its keep.  The batcher
+        # coalesces concurrent requests into one dispatch.
+        scores = self._batcher.score(pod)
         feasible = scores > float(NEG_INF) * 0.5
         idx = []
         for name in names:

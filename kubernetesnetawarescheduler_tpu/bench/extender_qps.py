@@ -1,0 +1,146 @@
+"""Extender webhook QPS bench: the micro-batched Score/Filter path.
+
+The reference scheduler's cycle was per-pod synchronous — 5 serial
+node_exporter scrapes per scheduled pod (scheduler.go:191, :275-279).
+Round 1 of this build reproduced that defect in miniature at the
+webhook boundary: every ``/prioritize`` encoded one pod into a full
+``max_pods``-shaped batch and dispatched a ``max_pods x N`` kernel.
+This bench quantifies the fix (api/extender._ScoreBatcher):
+
+- ``seq_qps``          one-at-a-time requests through the batcher
+                       (demand-sized 8-pod kernels);
+- ``seq_maxpods_qps``  the round-1 shape, for comparison: one pod in a
+                       ``max_pods``-padded batch per dispatch;
+- ``conc_qps``         many client threads — natural batching
+                       coalesces them into shared dispatches.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+
+import numpy as np
+
+from kubernetesnetawarescheduler_tpu.api.extender import ExtenderHandlers
+from kubernetesnetawarescheduler_tpu.bench.fakecluster import (
+    ClusterSpec,
+    build_fake_cluster,
+    feed_metrics,
+)
+from kubernetesnetawarescheduler_tpu.config import SchedulerConfig
+from kubernetesnetawarescheduler_tpu.core.loop import SchedulerLoop
+from kubernetesnetawarescheduler_tpu.core.pallas_score import score_pods_auto
+from kubernetesnetawarescheduler_tpu.core.state import round_up
+
+
+@dataclasses.dataclass
+class QpsResult:
+    num_nodes: int
+    max_pods: int
+    seq_qps: float
+    seq_maxpods_qps: float
+    conc_qps: float
+    conc_clients: int
+    mean_batch: float  # pods per kernel dispatch under concurrency
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+def _prioritize_args(i: int) -> dict:
+    return {
+        "pod": {
+            "metadata": {"name": f"qps-pod-{i}", "uid": f"qps-{i}"},
+            "spec": {
+                "schedulerName": "netAwareScheduler",
+                "containers": [{"resources": {"requests": {
+                    "cpu": "500m", "memory": "1Gi"}}}],
+            },
+        },
+        "nodenames": [f"node-{j:04d}" for j in range(0, 64)],
+    }
+
+
+def run_qps(num_nodes: int = 5120, max_pods: int = 256,
+            seq_requests: int = 32, conc_clients: int = 16,
+            conc_requests: int = 128, seed: int = 0) -> QpsResult:
+    cfg = SchedulerConfig(max_nodes=round_up(num_nodes, 128),
+                          max_pods=max_pods, max_peers=4)
+    cluster, lat, bw = build_fake_cluster(
+        ClusterSpec(num_nodes=num_nodes, seed=seed))
+    loop = SchedulerLoop(cluster, cfg, method="parallel")
+    loop.encoder.set_network(lat, bw)
+    feed_metrics(cluster, loop.encoder, np.random.default_rng(seed + 1))
+    handlers = ExtenderHandlers(loop)
+
+    # Warm both compile shapes outside the timed windows.
+    handlers.prioritize(_prioritize_args(0))
+    enc = loop.encoder.encode_pods([_pod_for_maxpods()],
+                                   node_of=loop._peer_node, lenient=True)
+    np.asarray(score_pods_auto(loop.encoder.snapshot(), enc, cfg))
+
+    start = time.perf_counter()
+    for i in range(seq_requests):
+        handlers.prioritize(_prioritize_args(i))
+    seq_qps = seq_requests / (time.perf_counter() - start)
+
+    # Round-1 shape: a max_pods-padded batch per request.
+    start = time.perf_counter()
+    for i in range(seq_requests):
+        b = loop.encoder.encode_pods([_pod_for_maxpods()],
+                                     node_of=loop._peer_node, lenient=True)
+        np.asarray(score_pods_auto(loop.encoder.snapshot(), b, cfg))
+    seq_maxpods_qps = seq_requests / (time.perf_counter() - start)
+
+    # Concurrency: natural batching across client threads.
+    dispatches_before = _dispatch_count(handlers)
+    done = []
+    lock = threading.Lock()
+
+    def client(base: int) -> None:
+        for i in range(conc_requests // conc_clients):
+            handlers.prioritize(_prioritize_args(base * 1000 + i))
+            with lock:
+                done.append(1)
+
+    threads = [threading.Thread(target=client, args=(c,))
+               for c in range(conc_clients)]
+    start = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    conc_wall = time.perf_counter() - start
+    conc_qps = len(done) / conc_wall
+    dispatches = _dispatch_count(handlers) - dispatches_before
+    mean_batch = len(done) / dispatches if dispatches else 0.0
+    return QpsResult(
+        num_nodes=num_nodes, max_pods=max_pods,
+        seq_qps=round(seq_qps, 1),
+        seq_maxpods_qps=round(seq_maxpods_qps, 1),
+        conc_qps=round(conc_qps, 1),
+        conc_clients=conc_clients,
+        mean_batch=round(mean_batch, 2),
+    )
+
+
+def _pod_for_maxpods():
+    from kubernetesnetawarescheduler_tpu.k8s.types import Pod
+    return Pod(name="qps-ref", requests={"cpu": 0.5, "mem": 1.0})
+
+
+def _dispatch_count(handlers: ExtenderHandlers) -> int:
+    return handlers._batcher.dispatches
+
+
+def main() -> None:
+    import json
+
+    res = run_qps()
+    print(json.dumps(res.to_dict()))
+
+
+if __name__ == "__main__":
+    main()

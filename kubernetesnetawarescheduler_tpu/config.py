@@ -191,6 +191,14 @@ class SchedulerConfig:
     # interpreted off-TPU).
     score_backend: str = "xla"
 
+    # Extender webhook micro-batching: a fixed coalescing window in
+    # seconds for /filter//prioritize scoring requests.  0 (default) =
+    # natural batching only — requests queued while a dispatch is in
+    # flight ride the next one, no added latency when idle.  A small
+    # positive window (1-5 ms) trades per-request latency for larger
+    # shared dispatches on latency-insensitive deployments.
+    extender_batch_window_s: float = 0.0
+
     # Priority preemption: when a pod is unschedulable, evict the
     # cheapest set of strictly-lower-priority pods from the best node
     # and requeue it (core/preempt.py).  Off by default — eviction is
@@ -224,6 +232,8 @@ class SchedulerConfig:
             raise ValueError(
                 f"score_backend must be 'xla' or 'pallas', "
                 f"got {self.score_backend!r}")
+        if self.extender_batch_window_s < 0:
+            raise ValueError("extender_batch_window_s must be >= 0")
 
 
 # ---------------------------------------------------------------------------

@@ -237,6 +237,18 @@ class Encoder:
         self._dirty = {"metrics": True, "net": True, "alloc": True,
                        "topo": True}
         self._cache: dict[str, jnp.ndarray] = {}
+        # Monotonic counter of static-score-input rebuilds (metrics/
+        # net/topo snapshot groups); see snapshot() and
+        # static_version.
+        self._static_version = 0
+
+    @property
+    def static_version(self) -> int:
+        """Monotonic version of the batch-invariant score inputs
+        (metrics, lat/bw, node validity/labels/taints).  Serving paths
+        may cache derived static scores as long as this is unchanged;
+        placement commits (the ``alloc`` group) do NOT bump it."""
+        return self._static_version
 
     # -- nodes --------------------------------------------------------
 
@@ -732,6 +744,14 @@ class Encoder:
         dirty groups (double-buffering: the returned pytree is
         immutable, later updates build a new one)."""
         with self._lock:
+            # Version the static-score inputs (metrics/net/topo): any
+            # rebuild of those cache groups invalidates cached
+            # batch-invariant score prep held by serving paths (the
+            # extender batcher keys on this counter — an explicit
+            # contract, not reliance on array-object reuse).
+            if (self._dirty["metrics"] or self._dirty["net"]
+                    or self._dirty["topo"]):
+                self._static_version += 1
             if self._dirty["metrics"]:
                 self._cache["metrics"] = jnp.asarray(self._metrics)
                 self._cache["metrics_age"] = jnp.asarray(self._metrics_age)
@@ -823,7 +843,8 @@ class Encoder:
 
     def encode_pods(self, pods: Sequence[Pod],
                     node_of: Callable[[str], str],
-                    lenient: bool = False) -> PodBatch:
+                    lenient: bool = False,
+                    pad_to: int | None = None) -> PodBatch:
         """Build a :class:`PodBatch` for up to ``cfg.max_pods`` pods.
 
         ``node_of`` resolves a peer pod name to its node name ("" if
@@ -831,12 +852,19 @@ class Encoder:
         home yet cannot pull the placement anywhere).  ``lenient``
         governs interner overflow (see :class:`Interner`): pass True
         for request-driven paths fed by untrusted manifests.
+
+        ``pad_to`` overrides the batch's padded pod-axis extent
+        (default ``cfg.max_pods``): request-driven paths like the
+        extender webhook batch to the actual demand so a lone request
+        does not pay a ``max_pods``-shaped kernel.  Each distinct value
+        is a separate XLA compilation — callers should quantize.
         """
         cfg = self.cfg
-        p, k, r = cfg.max_pods, cfg.max_peers, cfg.num_resources
+        p, k, r = pad_to or cfg.max_pods, cfg.max_peers, cfg.num_resources
         w = cfg.mask_words
         if len(pods) > p:
-            raise ValueError(f"batch of {len(pods)} exceeds max_pods={p}")
+            raise ValueError(f"batch of {len(pods)} exceeds "
+                             f"{'pad_to' if pad_to else 'max_pods'}={p}")
         req = np.zeros((p, r), np.float32)
         peers = np.full((p, k), -1, np.int32)
         traffic = np.zeros((p, k), np.float32)

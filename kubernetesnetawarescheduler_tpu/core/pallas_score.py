@@ -167,11 +167,27 @@ def _kernel(params_ref, t_ref, bw_ref, lat_ref, validk_ref, nodef_ref,
             jnp.float32(float(NEG_INF)))
 
 
+def static_tile_inputs(state: ClusterState, cfg: SchedulerConfig):
+    """The tiled kernel's batch-invariant prep: the per-node metric
+    vote and the global bw/lat normalizers.  Analogous to
+    :func:`~.score.static_node_scores` but WITHOUT the ``C.T``
+    materialization (the whole point of the tiled kernel is that ``C``
+    never exists in HBM); serving paths cache this across requests."""
+    base = score_lib.metric_scores(state, cfg)
+    pair_valid = state.node_valid[:, None] & state.node_valid[None, :]
+    bw_max = jnp.maximum(jnp.max(jnp.where(pair_valid, state.bw, 0.0)),
+                         _EPS)
+    lat_max = jnp.maximum(jnp.max(jnp.where(pair_valid, state.lat, 0.0)),
+                          _EPS)
+    return base, bw_max, lat_max
+
+
 @functools.partial(
     jax.jit,
     static_argnames=("cfg", "block_p", "block_n", "block_k", "interpret"))
 def score_pods_tiled(state: ClusterState, pods: PodBatch,
-                     cfg: SchedulerConfig, *, block_p: int = 128,
+                     cfg: SchedulerConfig, static=None, *,
+                     block_p: int = 128,
                      block_n: int = 128, block_k: int = 128,
                      interpret: bool = False) -> jax.Array:
     """Masked score matrix ``f32[P, N]``, tiled-Pallas implementation.
@@ -180,6 +196,7 @@ def score_pods_tiled(state: ClusterState, pods: PodBatch,
     ``(P/bp, N/bn, N/bk)`` with the contraction axis innermost; VMEM
     residency per step is ``O(bp·bk + 2·bn·bk + bp·bn)`` floats, so node
     count is bounded by HBM (the ``N×N`` lat/bw state), not VMEM.
+    ``static`` is an optional precomputed :func:`static_tile_inputs`.
     """
     import math
 
@@ -216,11 +233,9 @@ def score_pods_tiled(state: ClusterState, pods: PodBatch,
     # traffic matrix, the pod-independent metric vote, and the global
     # normalizers of the desirability tile.
     t = pad(score_lib.peer_traffic_matrix(pods, n_real), p_pad, n_pad)
-    base = score_lib.metric_scores(state, cfg)
-    pair_valid = state.node_valid[:, None] & state.node_valid[None, :]
-    bw_max = jnp.maximum(jnp.max(jnp.where(pair_valid, state.bw, 0.0)), _EPS)
-    lat_max = jnp.maximum(jnp.max(jnp.where(pair_valid, state.lat, 0.0)),
-                          _EPS)
+    if static is None:
+        static = static_tile_inputs(state, cfg)
+    base, bw_max, lat_max = static
     params = jnp.stack([
         jnp.float32(cfg.weights.peer_bw), jnp.float32(cfg.weights.peer_lat),
         1.0 / bw_max, 1.0 / lat_max,
@@ -296,11 +311,30 @@ def score_pods_tiled(state: ClusterState, pods: PodBatch,
     return out[:p_real, :n_real]
 
 
+def compute_static(state: ClusterState, cfg: SchedulerConfig):
+    """Backend-appropriate batch-invariant prep for
+    :func:`score_pods_auto` — cacheable by serving paths (depends only
+    on metrics/network/validity, never on placements)."""
+    if cfg.score_backend == "pallas":
+        return static_tile_inputs(state, cfg)
+    return score_lib.static_node_scores(state, cfg)
+
+
+# Jitted entry for the dense path: serving callers hit this once per
+# webhook dispatch, where eager op-by-op tracing from Python would be
+# the bottleneck (GIL-bound) — unlike the replay/assign paths, which
+# call score_pods inside their own jit.
+_score_pods_jit = functools.partial(
+    jax.jit, static_argnames=("cfg",))(score_lib.score_pods)
+
+
 def score_pods_auto(state: ClusterState, pods: PodBatch,
-                    cfg: SchedulerConfig) -> jax.Array:
+                    cfg: SchedulerConfig, static=None) -> jax.Array:
     """Dispatch on ``cfg.score_backend``: the dense XLA kernel or the
-    tiled Pallas kernel (interpreted off-TPU so CPU CI still runs it)."""
+    tiled Pallas kernel (interpreted off-TPU so CPU CI still runs it).
+    ``static`` is an optional precomputed :func:`compute_static`."""
     if cfg.score_backend == "pallas":
         interpret = jax.default_backend() != "tpu"
-        return score_pods_tiled(state, pods, cfg, interpret=interpret)
-    return score_lib.score_pods(state, pods, cfg)
+        return score_pods_tiled(state, pods, cfg, static,
+                                interpret=interpret)
+    return _score_pods_jit(state, pods, cfg, static)

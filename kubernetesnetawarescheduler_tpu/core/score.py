@@ -274,12 +274,20 @@ def feasibility_mask(state: ClusterState, pods: PodBatch) -> jax.Array:
 
 
 def score_pods(state: ClusterState, pods: PodBatch,
-               cfg: SchedulerConfig) -> jax.Array:
-    """Full masked score matrix ``f32[P, N]``; -inf marks infeasible."""
-    base = metric_scores(state, cfg)[None, :]
-    net = network_scores(state, pods, cfg)
+               cfg: SchedulerConfig, static=None) -> jax.Array:
+    """Full masked score matrix ``f32[P, N]``; -inf marks infeasible.
+
+    ``static``, if given, is a precomputed :func:`static_node_scores`
+    pair — serving paths (the extender webhook batcher) cache it across
+    requests so a dispatch does not re-derive the O(N²) normalization
+    work per call; it depends only on metrics/network/validity state,
+    never on placements."""
+    if static is None:
+        static = static_node_scores(state, cfg)
+    base, ct = static
+    net = network_scores(state, pods, cfg, ct=ct)
     soft = soft_affinity_scores(state, pods, cfg)
     bal = cfg.weights.balance * balance_penalty(state, pods)
-    raw = base + net + soft - bal
+    raw = base[None, :] + net + soft - bal
     ok = feasibility_mask(state, pods)
     return jnp.where(ok, raw, NEG_INF)

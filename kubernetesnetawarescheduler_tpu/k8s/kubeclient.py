@@ -174,8 +174,11 @@ def _preferred_group_terms(spec: Mapping, ann: Mapping) -> tuple:
     if ANN_SOFT_AFFINITY in ann:
         try:
             raw = json.loads(ann[ANN_SOFT_AFFINITY])
-            out.extend((str(g), float(v)) for g, v in raw.items()
-                       if float(v))  # weight-0 entries are no-ops
+            # Built fully before extending: a malformed entry rejects
+            # the WHOLE annotation (score-neutral), never half of it.
+            parsed = [(str(g), float(v)) for g, v in raw.items()
+                      if float(v)]  # weight-0 entries are no-ops
+            out.extend(parsed)
         except (ValueError, TypeError, AttributeError):
             pass  # malformed annotation degrades score-neutrally
     aff = spec.get("affinity") or {}
@@ -186,8 +189,17 @@ def _preferred_group_terms(spec: Mapping, ann: Mapping) -> tuple:
                 weight = float(term.get("weight", 0) or 0)
             except (TypeError, ValueError):
                 continue
-            match = ((term.get("podAffinityTerm") or {})
-                     .get("labelSelector") or {}).get("matchLabels") or {}
+            pat = term.get("podAffinityTerm") or {}
+            # Group co-residency here is node-scoped (the
+            # hostname-topology reduction the hard masks use): a
+            # zone/rack topologyKey means "co-locate/spread at zone
+            # granularity", which a node-level term would actively
+            # misscore (full spread bonus for a different node in the
+            # SAME zone) — skip those, per the module contract that
+            # unrepresentable soft shapes degrade score-neutrally.
+            if pat.get("topologyKey") != "kubernetes.io/hostname":
+                continue
+            match = (pat.get("labelSelector") or {}).get("matchLabels") or {}
             if not weight or not match:
                 continue
             group = ",".join(f"{k}={v}" for k, v in sorted(match.items()))

@@ -153,7 +153,8 @@ def main(argv=None) -> int:
     from kubernetesnetawarescheduler_tpu.api.server import ScorerServer
 
     os.makedirs(os.path.dirname(args.uds) or ".", exist_ok=True)
-    handlers = ExtenderHandlers(loop)
+    handlers = ExtenderHandlers(
+        loop, batch_window_s=cfg.extender_batch_window_s)
     uds = ScorerServer(handlers, args.uds)
     uds.start()
     print(f"scorer serving on uds://{args.uds}", file=sys.stderr)
