@@ -191,13 +191,11 @@ class _ScoreBatcher:
                 enc = loop.encoder.encode_pods(
                     pods, node_of=loop._peer_node, lenient=True,
                     pad_to=min(_round8(len(pods)), max_pods))
-                # Version read BEFORE the snapshot: if another thread's
-                # snapshot bumps it in between, our stored version is
-                # already stale relative to our (newer) state, so the
-                # next request recomputes — over-recompute is the safe
-                # direction, stale-static never happens.
-                version = loop.encoder.static_version
-                state = loop.encoder.snapshot()
+                # Atomic (state, version) pair: the version bumps
+                # lazily inside the flush, so a separate read on
+                # either side of snapshot() can mispair them and
+                # serve stale statics against fresh state.
+                state, version = loop.encoder.snapshot_versioned()
                 static = self._static_for(state, version)
                 self.dispatches += 1
                 rows = np.asarray(

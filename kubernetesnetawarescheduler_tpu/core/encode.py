@@ -743,6 +743,18 @@ class Encoder:
         """Device view of the current staging state; transfers only
         dirty groups (double-buffering: the returned pytree is
         immutable, later updates build a new one)."""
+        return self.snapshot_versioned()[0]
+
+    def snapshot_versioned(self) -> tuple[ClusterState, int]:
+        """:meth:`snapshot` plus the matching :attr:`static_version`,
+        read atomically under the encoder lock.
+
+        The pairing matters for static-score caching: the version
+        bumps lazily inside the flush, so reading it in a separate
+        call before OR after the snapshot can mispair it with the
+        state (a dirty flag pending at the pre-read, or a concurrent
+        thread's flush after it) and serve stale static scores against
+        fresh state."""
         with self._lock:
             # Version the static-score inputs (metrics/net/topo): any
             # rebuild of those cache groups invalidates cached
@@ -775,7 +787,7 @@ class Encoder:
                 self._cache["taint_bits"] = jnp.asarray(self._taint_bits)
             for key in self._dirty:
                 self._dirty[key] = False
-            return ClusterState(**self._cache)
+            return ClusterState(**self._cache), self._static_version
 
     # -- pods ---------------------------------------------------------
 

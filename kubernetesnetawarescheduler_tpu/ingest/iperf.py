@@ -94,7 +94,13 @@ def parse_iperf_json(text: str | bytes) -> IperfResult:
     ``end`` section) — the failure mode the reference hits as a nil
     pointer after ``println``-ing the open error (scheduler.go:512-525).
     """
-    doc = json.loads(text)
+    return iperf_result_from_doc(json.loads(text))
+
+
+def iperf_result_from_doc(doc: Mapping[str, Any]) -> IperfResult:
+    """:func:`parse_iperf_json` for an already-decoded document (the
+    probe agent returns iperf3's JSON embedded in its own response —
+    no reason to re-serialize it just to re-parse)."""
     end = doc.get("end")
     if not isinstance(end, dict):
         raise ValueError("iperf3 document has no 'end' section")

@@ -63,10 +63,16 @@ class FakeProber:
 
 
 class Iperf3Prober:
-    """Real iperf3 probe: runs ``iperf3 -c <target> -J`` (the flags the
-    reference uses at run.sh:12, minus the ``kubectl exec`` transport)
-    and a TCP-connect latency estimate.  Gated: requires iperf3
-    servers running on the fleet."""
+    """LOCAL iperf3 probe: runs ``iperf3 -c <host_of[b]> -J`` from
+    *this process* (the flags the reference uses at run.sh:12, minus
+    the ``kubectl exec`` transport).
+
+    Vantage caveat: because the client runs wherever the orchestrator
+    runs, this measures the orchestrator→b path, NOT a↔b — fine for a
+    single-host lab or when the orchestrator is on the only traffic
+    source, wrong for a pairwise fleet matrix.  Real deployments use
+    :class:`AgentProber`, which delegates the client role to node a's
+    probe agent (run.sh's client-side semantics, without kubectl)."""
 
     def __init__(self, host_of: dict[str, str], duration_s: int = 2) -> None:
         self._host_of = host_of
@@ -82,6 +88,66 @@ class Iperf3Prober:
         # iperf3 has no latency figure: return None so a ping-based
         # prober's latency for the pair is preserved, not zeroed.
         return None, result.bandwidth_bps
+
+
+def _bracketed(host: str) -> str:
+    """IPv6 literals need brackets in a URL netloc."""
+    if ":" in host and not host.startswith("["):
+        return f"[{host}]"
+    return host
+
+
+class AgentProber:
+    """Honest pairwise probe via the per-node probe agent
+    (:mod:`~.probe_agent`, deployed by deploy/probes.yaml).
+
+    ``probe(a, b)`` asks node **a**'s agent to run iperf3 against node
+    **b**'s iperf3 server and to measure TCP-connect latency — so the
+    recorded ``lat[a, b]``/``bw[a, b]`` is the actual a↔b path, the
+    client-side vantage the reference got from ``kubectl exec`` into
+    per-node client pods (run.sh:12-14), without exec or file drops.
+
+    ``token``, when set, is sent as the ``X-Netaware-Token`` header the
+    agent's ``--token`` mode requires (the auth replacing kubectl
+    exec's RBAC gate)."""
+
+    def __init__(self, host_of: dict[str, str],
+                 agent_port: int = 9798, iperf_port: int = 5201,
+                 duration_s: int = 2, timeout_s: float | None = None,
+                 token: str = "") -> None:
+        self._host_of = host_of
+        self._agent_port = agent_port
+        self._iperf_port = iperf_port
+        self._duration = duration_s
+        self._timeout = timeout_s if timeout_s is not None \
+            else duration_s + 15.0
+        self._token = token
+
+    def probe(self, a: str, b: str) -> tuple[float | None, float]:
+        import json as _json
+        import urllib.parse
+        import urllib.request
+
+        from kubernetesnetawarescheduler_tpu.ingest.iperf import (
+            iperf_result_from_doc,
+        )
+
+        host_a, host_b = self._host_of[a], self._host_of[b]
+        query = urllib.parse.urlencode({
+            "target": host_b, "duration": self._duration,
+            "port": self._iperf_port})
+        url = (f"http://{_bracketed(host_a)}:{self._agent_port}"
+               f"/probe?{query}")
+        req = urllib.request.Request(url)
+        if self._token:
+            req.add_header("X-Netaware-Token", self._token)
+        with urllib.request.urlopen(req, timeout=self._timeout) as resp:
+            doc = _json.load(resp)
+        if "error" in doc:
+            raise RuntimeError(f"agent {a} probing {b}: {doc['error']}")
+        bw = iperf_result_from_doc(doc["iperf"]).bandwidth_bps
+        lat = doc.get("latency_ms")
+        return (float(lat) if lat is not None else None), bw
 
 
 class ProbeOrchestrator:
@@ -119,8 +185,19 @@ class ProbeOrchestrator:
             a, b = self._names[i], self._names[j]
             try:
                 lat_ms, bw_bps = self._prober.probe(a, b)
-            except Exception:
+            except Exception as exc:
                 self.failures += 1
+                if self.failures == 1:
+                    # First failure EVER gets a log line with the
+                    # actual error — a misconfigured fleet (no agents,
+                    # wrong port) otherwise looks like quietly-stale
+                    # matrices; later failures only count (a pair
+                    # staying stale is the designed degradation).
+                    import sys
+
+                    print(f"WARNING: first probe failure {a}->{b}: "
+                          f"{exc!r} (further failures counted "
+                          "silently)", file=sys.stderr)
                 continue
             self._encoder.update_link(a, b, lat_ms=lat_ms, bw_bps=bw_bps)
             self._last_probe[(i, j)] = self._clock

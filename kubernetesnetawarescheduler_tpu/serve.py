@@ -38,6 +38,21 @@ from kubernetesnetawarescheduler_tpu.config import (
 )
 
 
+def _agent_reachable(host: str, port: int, timeout_s: float = 3.0) -> bool:
+    """One /healthz round-trip to a probe agent."""
+    import urllib.request
+
+    from kubernetesnetawarescheduler_tpu.ingest.probe import _bracketed
+
+    try:
+        with urllib.request.urlopen(
+                f"http://{_bracketed(host)}:{port}/healthz",
+                timeout=timeout_s) as resp:
+            return bool(json.load(resp).get("ok"))
+    except (OSError, ValueError):
+        return False
+
+
 def build_fake(num_nodes: int, seed: int, cfg: SchedulerConfig):
     from kubernetesnetawarescheduler_tpu.bench.fakecluster import (
         ClusterSpec,
@@ -88,6 +103,11 @@ def main(argv=None) -> int:
                     help="JSON file {node name: iperf3 host} enabling "
                          "real pairwise probing on kube/incluster "
                          "clusters (the reference's netperfScript role)")
+    ap.add_argument("--probe-agent-port", type=int, default=9798,
+                    help="per-node probe-agent port (deploy/probes.yaml "
+                         "DaemonSet): probes run FROM node a's agent "
+                         "for honest a<->b pairs; 0 = probe from this "
+                         "process instead (scorer->node vantage)")
     ap.add_argument("--checkpoint-dir", default="",
                     help="restore on start, save on SIGTERM")
     ap.add_argument("--decision-log", default="",
@@ -193,13 +213,38 @@ def main(argv=None) -> int:
         prober = FakeProber(names, lat_truth, bw_truth, seed=args.seed)
     elif args.probe_targets:
         from kubernetesnetawarescheduler_tpu.ingest.probe import (
+            AgentProber,
             Iperf3Prober,
         )
 
         with open(args.probe_targets, encoding="utf-8") as fh:
             host_of = json.load(fh)
         names = [n for n in loop.encoder._node_names if n in host_of]
-        prober = Iperf3Prober(host_of)
+        # AgentProber (default): node a's probe agent runs the iperf3
+        # client against b, so lat/bw[a, b] is the real a<->b path —
+        # the reference's client-side vantage (run.sh:12-14) without
+        # kubectl.  --probe-agent-port 0 falls back to probing from
+        # this process (only honest when the scorer IS the traffic
+        # source).
+        if args.probe_agent_port:
+            prober = AgentProber(
+                host_of, agent_port=args.probe_agent_port,
+                token=os.environ.get("NETAWARE_PROBE_TOKEN", ""))
+            # Startup reachability check: probe failures are counted
+            # silently per-cycle (a pair just stays stale), so a fleet
+            # with NO agents (e.g. probes.yaml not redeployed after an
+            # upgrade) must be called out loudly here, not discovered
+            # via forever-empty lat/bw matrices.
+            if names and not _agent_reachable(
+                    host_of[names[0]], args.probe_agent_port):
+                print(f"WARNING: probe agent on {names[0]} "
+                      f"({host_of[names[0]]}:{args.probe_agent_port}) "
+                      "unreachable — deploy deploy/probes.yaml's "
+                      "DaemonSet, or pass --probe-agent-port 0 for "
+                      "the legacy scorer-side iperf3 vantage",
+                      file=sys.stderr)
+        else:
+            prober = Iperf3Prober(host_of)
     else:
         print("WARNING: no probe source (--probe-targets unset on a "
               "real cluster): lat/bw matrices stay empty and scoring "

@@ -290,3 +290,42 @@ def test_batcher_coalesces_concurrent_requests():
     # Coalescing actually happened: the 10 ms window guarantees many
     # requests ride shared dispatches.
     assert handlers._batcher.dispatches <= 12
+
+
+def test_batcher_static_cache_tracks_metric_updates():
+    """Regression: a metrics update between webhook dispatches must be
+    reflected in the next dispatch's scores (the static-score cache
+    keys on the encoder's (state, version) pair read atomically —
+    reading the version on either side of snapshot() served stale
+    statics, because the version bump happens lazily inside the
+    flush)."""
+    import numpy as np
+
+    cluster, loop = make_loop(num_nodes=8)
+    handlers = ExtenderHandlers(loop)
+    names = [n.name for n in cluster.list_nodes()]
+    out1 = {e["host"]: e["score"]
+            for e in handlers.prioritize(extender_args(names))}
+    # Make one node overwhelmingly attractive on every channel and
+    # everything else terrible, then re-ask: the cache must miss.
+    from kubernetesnetawarescheduler_tpu.bench.fakecluster import (
+        sample_metrics,
+    )
+    rng = np.random.default_rng(5)
+    best = names[3]
+    for name in names:
+        m = sample_metrics(rng)
+        m["cpu_freq"] = 2.4e9 if name == best else 6e8
+        m["mem_pct"] = 1.0 if name == best else 99.0
+        m["bandwidth"] = 1e10 if name == best else 1e8
+        m["net_tx"] = m["net_rx"] = 1e4 if name == best else 1e7
+        m["disk_io"] = 0.0 if name == best else 15.0
+        loop.encoder.update_metrics(name, m, age_s=0.0)
+    args2 = extender_args(names)
+    args2["pod"]["metadata"]["name"] = "after-update"
+    args2["pod"]["metadata"]["uid"] = "after-update"
+    out2 = {e["host"]: e["score"]
+            for e in handlers.prioritize(args2)}
+    assert out2[best] == max(out2.values())
+    assert out2[best] == 10  # top of the 0..10 extender scale
+    assert out1 != out2
