@@ -90,37 +90,73 @@ def main() -> None:
 
     import contextlib
 
+    import jax
+
+    # Score-kernel backend comparison (dense XLA vs tiled Pallas):
+    # "both" runs the full workload under each and headlines the
+    # winner — the measured basis for deploy configs' score_backend.
+    # Pallas off-TPU only has the interpreter (orders of magnitude
+    # slow at N=5120), so the CPU fallback pins to xla.
+    on_tpu = jax.default_backend() == "tpu"
+    backend_env = os.environ.get("BENCH_SCORE_BACKEND",
+                                 "both" if on_tpu else "xla")
+    backends = (["xla", "pallas"] if backend_env == "both"
+                else [backend_env])
+
     profile_dir = os.environ.get("BENCH_PROFILE", "")
     if profile_dir:
         # JAX profiler trace of the measured window (SURVEY.md §5
         # tracing row): view with tensorboard or xprof.
-        import jax
-
         trace_cm = jax.profiler.trace(profile_dir)
     else:
         trace_cm = contextlib.nullcontext()
+    results = {}
+    errors = {}
     with trace_cm:
-        res = run_density(num_nodes=num_nodes, num_pods=num_pods,
-                          batch_size=batch, method=method, mode=mode,
-                          chunk_batches=chunk_batches)
+        for backend in backends:
+            try:
+                results[backend] = run_density(
+                    num_nodes=num_nodes, num_pods=num_pods,
+                    batch_size=batch, method=method, mode=mode,
+                    chunk_batches=chunk_batches, score_backend=backend)
+            except Exception as exc:  # noqa: BLE001 — a failing
+                # backend (e.g. a Mosaic lowering error on new
+                # hardware) must not discard the other backend's
+                # completed measurement: the headline line is the
+                # driver's only artifact.
+                errors[backend] = f"{type(exc).__name__}: {exc}"
+                print(f"WARNING: {backend} backend bench failed: "
+                      f"{errors[backend]}", file=sys.stderr)
+    if not results:
+        raise SystemExit(f"all score backends failed: {errors}")
+    best = max(results, key=lambda b: results[b].pods_per_sec)
+    res = results[best]
+    detail = {
+        "pods_bound": res.pods_bound,
+        "pods_unschedulable": res.pods_unschedulable,
+        "score_p50_ms": round(res.score_p50_ms, 2),
+        "score_p99_ms": round(res.score_p99_ms, 2),
+        "encode_p99_ms": round(res.encode_p99_ms, 2),
+        "bind_p99_ms": round(res.bind_p99_ms, 2),
+        "score_samples": res.score_samples,
+        "batch_size": batch,
+        "method": method,
+        "mode": mode,
+        "backend": jax.default_backend(),
+        "score_backend": best,
+    }
+    for backend, r in results.items():
+        if backend != best:
+            detail[f"{backend}_pods_per_sec"] = round(r.pods_per_sec, 1)
+            detail[f"{backend}_score_p50_ms"] = round(r.score_p50_ms, 2)
+    for backend, err in errors.items():
+        detail[f"{backend}_error"] = err
     print(json.dumps({
         "metric": f"density_pods_per_sec_n{num_nodes}",
         "value": round(res.pods_per_sec, 1),
         "unit": "pods/s",
         "vs_baseline": round(res.pods_per_sec / REFERENCE_PODS_PER_SEC, 2),
-        "detail": {
-            "pods_bound": res.pods_bound,
-            "pods_unschedulable": res.pods_unschedulable,
-            "score_p50_ms": round(res.score_p50_ms, 2),
-            "score_p99_ms": round(res.score_p99_ms, 2),
-            "encode_p99_ms": round(res.encode_p99_ms, 2),
-            "bind_p99_ms": round(res.bind_p99_ms, 2),
-            "score_samples": res.score_samples,
-            "batch_size": batch,
-            "method": method,
-            "mode": mode,
-            "backend": __import__("jax").default_backend(),
-        },
+        "detail": detail,
     }))
 
 

@@ -82,6 +82,7 @@ def run_density(num_nodes: int = 100, num_pods: int = 300,
                 metric_drop_fraction: float = 0.0,
                 mode: str = "host",
                 chunk_batches: int = 2,
+                score_backend: str = "xla",
                 sampler=None) -> DensityResult:
     """Schedule ``num_pods`` generated pods onto a ``num_nodes`` fake
     cluster; returns throughput/latency stats (compile excluded via a
@@ -104,6 +105,7 @@ def run_density(num_nodes: int = 100, num_pods: int = 300,
             max_pods=batch_size,
             max_peers=4,
             queue_capacity=max(300, num_pods + batch_size),
+            score_backend=score_backend,
         )
     cluster, lat, bw = build_fake_cluster(ClusterSpec(num_nodes=num_nodes,
                                                       seed=seed))

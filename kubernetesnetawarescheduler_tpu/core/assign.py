@@ -53,9 +53,29 @@ def _static_parts(state: ClusterState, pods: PodBatch, cfg: SchedulerConfig,
     """Batch-invariant pieces: base+network score and the static mask
     (taints, node selectors, validity) that placements can't change.
 
-    ``static``, if given, is the ``(base[N], C.T prepared)`` pair from
-    :func:`~.score.static_node_scores` — precomputed once per replay so
-    the N×N normalization/transpose work is not re-done every batch."""
+    ``static`` is the backend's precomputed batch-invariant prep
+    (:func:`~.pallas_score.compute_assign_static`): for the dense
+    backend the ``(base[N], C.T prepared)`` pair, for the Pallas
+    backend the :func:`~.pallas_score.static_replay_pack` arrays —
+    precomputed once per replay so the N×N normalization/pad work is
+    not re-done every batch.
+
+    Backend dispatch happens HERE because this is the dense-C seam:
+    with ``cfg.score_backend == "pallas"`` the raw score and static
+    mask come from the tiled kernel (lat/bw streamed through VMEM,
+    ``C[N, N]`` never materialized in HBM), and ``static_node_scores``
+    — whose ``prep_net_matrix`` writes that 100 MB matrix — is never
+    called.  The per-round dynamic work (capacity, groups, balance)
+    stays in XLA either way: it mutates every conflict round.
+    """
+    if cfg.score_backend == "pallas":
+        from kubernetesnetawarescheduler_tpu.core import pallas_score
+
+        if static is None:
+            static = pallas_score.static_replay_pack(state, cfg)
+        interpret = jax.default_backend() != "tpu"
+        return pallas_score.static_scores_tiled(state, pods, cfg, static,
+                                                interpret=interpret)
     if static is None:
         static = score_lib.static_node_scores(state, cfg)
     base, ct = static

@@ -54,13 +54,20 @@ _PARAMS = 8  # wbw, wlat, inv_bwmax, inv_latmax, wbal, eps, wsoft, pad
 from kubernetesnetawarescheduler_tpu.core.state import round_up as _round_up
 
 
-def _kernel(params_ref, t_ref, bw_ref, lat_ref, validk_ref, nodef_ref,
-            nodei_ref, podf_ref, podi_ref, out_ref, acc_ref, *,
-            block_n: int, block_k: int, num_resources: int,
-            mask_words: int, soft_terms: int, use_bfloat16: bool):
+def _net_accum(params_ref, t_ref, bw_ref, lat_ref, validk_ref, acc_ref,
+               *, block_n: int, block_k: int, use_bfloat16: bool) -> None:
+    """Shared per-grid-step net-score accumulation (both kernels).
+
+    Builds the network-desirability tile C[j_tile, k_tile] in VMEM from
+    the raw lat/bw tiles (C is never materialized in HBM — the point of
+    the tiled path), diagonal pinned to the loopback optimum wbw (see
+    score.net_cost_matrix), invalid peer columns zeroed (their T
+    entries are zero too — belt & braces), then contracts the peer-node
+    axis on the MXU into the accumulator.  bf16 inputs / f32
+    accumulation is the standard MXU recipe; the exact path asks for
+    HIGHEST so f32 isn't silently truncated to bf16 passes."""
     j = pl.program_id(1)
     k = pl.program_id(2)
-    nk = pl.num_programs(2)
 
     @pl.when(k == 0)
     def _zero():
@@ -71,10 +78,6 @@ def _kernel(params_ref, t_ref, bw_ref, lat_ref, validk_ref, nodef_ref,
     inv_bw = params_ref[2]
     inv_lat = params_ref[3]
 
-    # Network-desirability tile C[j_tile, k_tile], built in VMEM from the
-    # raw lat/bw tiles (never materialized in HBM).  Diagonal pinned to
-    # the loopback optimum wbw (see score.net_cost_matrix); invalid peer
-    # columns zeroed (their T entries are zero too — belt & braces).
     c = wbw * bw_ref[:] * inv_bw - wlat * lat_ref[:] * inv_lat
     rows = j * block_n + jax.lax.broadcasted_iota(
         jnp.int32, (block_n, block_k), 0)
@@ -83,9 +86,6 @@ def _kernel(params_ref, t_ref, bw_ref, lat_ref, validk_ref, nodef_ref,
     c = jnp.where(rows == cols, wbw, c)
     c = c * validk_ref[:]
 
-    # MXU: contract the peer-node axis of this k tile.  bf16 inputs /
-    # f32 accumulation is the standard MXU recipe; the exact path asks
-    # for HIGHEST so f32 isn't silently truncated to bf16 passes.
     t_blk = t_ref[:]
     if use_bfloat16:
         t_blk, c = t_blk.astype(jnp.bfloat16), c.astype(jnp.bfloat16)
@@ -95,6 +95,42 @@ def _kernel(params_ref, t_ref, bw_ref, lat_ref, validk_ref, nodef_ref,
     acc_ref[:] += jax.lax.dot_general(
         t_blk, c, (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32, precision=precision)
+
+
+def _soft_bonus(label_at, group_at, podf_ref, podi_ref, like, *,
+                r_res: int, mw: int, soft_terms: int):
+    """Shared soft-affinity epilogue term (score.soft_affinity_scores
+    semantics; packers zero the weights of empty-bit terms).
+    ``label_at(w)``/``group_at(w)`` abstract the two kernels' different
+    node-side layouts; returns the UNscaled weighted sum."""
+    soft = jnp.zeros_like(like)
+    for t in range(soft_terms):
+        sel_match = jnp.full(like.shape, True)
+        grp_hit = jnp.full(like.shape, False)
+        for w in range(mw):
+            sbits = podi_ref[:, (5 + t) * mw + w:(5 + t) * mw + w + 1]
+            gbits = podi_ref[
+                :, (5 + soft_terms + t) * mw + w:
+                (5 + soft_terms + t) * mw + w + 1]
+            sel_match = sel_match & ((label_at(w) & sbits) == sbits)
+            grp_hit = grp_hit | ((group_at(w) & gbits) != 0)
+        wsel = podf_ref[:, r_res + 1 + t:r_res + 2 + t]
+        wgrp = podf_ref[:, r_res + 1 + soft_terms + t:
+                        r_res + 2 + soft_terms + t]
+        soft += (jnp.where(sel_match, wsel, 0.0)
+                 + jnp.where(grp_hit, wgrp, 0.0))
+    return soft
+
+
+def _kernel(params_ref, t_ref, bw_ref, lat_ref, validk_ref, nodef_ref,
+            nodei_ref, podf_ref, podi_ref, out_ref, acc_ref, *,
+            block_n: int, block_k: int, num_resources: int,
+            mask_words: int, soft_terms: int, use_bfloat16: bool):
+    k = pl.program_id(2)
+    nk = pl.num_programs(2)
+    _net_accum(params_ref, t_ref, bw_ref, lat_ref, validk_ref, acc_ref,
+               block_n=block_n, block_k=block_k,
+               use_bfloat16=use_bfloat16)
 
     @pl.when(k == nk - 1)
     def _epilogue():
@@ -140,31 +176,177 @@ def _kernel(params_ref, t_ref, bw_ref, lat_ref, validk_ref, nodef_ref,
         ok = ok & (aff_zero | aff_hit)
 
         # Soft (preferred) affinity: weighted bonuses, fused into the
-        # same tile write (score.soft_affinity_scores semantics; the
-        # packer zeroed weights of empty-bit terms).
-        wsoft = params_ref[6]
-        soft = jnp.zeros_like(acc_ref)
-        for t in range(soft_terms):
-            sel_match = jnp.ones_like(fits)
-            grp_hit = jnp.zeros_like(fits)
-            for w in range(mw):
-                label = nodei_ref[mw + w:mw + w + 1, :]
-                group = nodei_ref[2 * mw + w:2 * mw + w + 1, :]
-                sbits = podi_ref[:, (5 + t) * mw + w:(5 + t) * mw + w + 1]
-                gbits = podi_ref[
-                    :, (5 + soft_terms + t) * mw + w:
-                    (5 + soft_terms + t) * mw + w + 1]
-                sel_match = sel_match & ((label & sbits) == sbits)
-                grp_hit = grp_hit | ((group & gbits) != 0)
-            wsel = podf_ref[:, r_res + 1 + t:r_res + 2 + t]
-            wgrp = podf_ref[:, r_res + 1 + soft_terms + t:
-                            r_res + 2 + soft_terms + t]
-            soft += (jnp.where(sel_match, wsel, 0.0)
-                     + jnp.where(grp_hit, wgrp, 0.0))
+        # same tile write.
+        soft = _soft_bonus(
+            lambda w: nodei_ref[mw + w:mw + w + 1, :],
+            lambda w: nodei_ref[2 * mw + w:2 * mw + w + 1, :],
+            podf_ref, podi_ref, acc_ref[:],
+            r_res=r_res, mw=mw, soft_terms=soft_terms)
 
         out_ref[:] = jnp.where(
-            ok, acc_ref[:] + base + wsoft * soft - wbal * bal,
+            ok, acc_ref[:] + base + params_ref[6] * soft - wbal * bal,
             jnp.float32(float(NEG_INF)))
+
+
+def _static_kernel(params_ref, t_ref, bw_ref, lat_ref, validk_ref,
+                   nodes_ref, nodei_ref, groups_ref, podf_ref, podi_ref,
+                   raw_ref, ok_ref, acc_ref, *,
+                   block_n: int, block_k: int, num_resources: int,
+                   mask_words: int, soft_terms: int, use_bfloat16: bool):
+    """Batch-invariant slice of :func:`_kernel` for the assign/replay
+    seam (assign._static_parts): raw score = net(T@C) + base + soft,
+    plus the placement-independent feasibility mask (validity, taints,
+    node selectors).  Capacity fit, group (anti-)affinity and the
+    balance penalty stay OUTSIDE — they mutate per conflict-resolution
+    round, so the round loop recomputes them against this raw.
+
+    Node-side layouts (packed by :func:`static_replay_pack`, compact —
+    no used/cap/resident_anti rows, this kernel never reads them):
+    ``nodes_ref`` rows 0=base, 1=valid; ``nodei_ref`` rows
+    taint[0..W), label[W..2W).  ``groups_ref`` (rows group_bits[W]) is
+    the one PER-BATCH node-side input: the soft group term scores
+    against batch-entry residency, which prior batches' commits move.
+    """
+    k = pl.program_id(2)
+    nk = pl.num_programs(2)
+    _net_accum(params_ref, t_ref, bw_ref, lat_ref, validk_ref, acc_ref,
+               block_n=block_n, block_k=block_k,
+               use_bfloat16=use_bfloat16)
+
+    @pl.when(k == nk - 1)
+    def _epilogue():
+        r_res = num_resources
+        base = nodes_ref[0:1, :]
+        nvalid = nodes_ref[1:2, :] > 0.5
+        pvalid = podf_ref[:, r_res:r_res + 1] > 0.5
+
+        mw = mask_words
+        ok = nvalid & pvalid
+        for w in range(mw):
+            taint = nodei_ref[w:w + 1, :]
+            label = nodei_ref[mw + w:mw + w + 1, :]
+            tol = podi_ref[:, w:w + 1]
+            sel = podi_ref[:, mw + w:mw + w + 1]
+            ok = ok & ((taint & ~tol) == 0)
+            ok = ok & ((label & sel) == sel)
+
+        soft = _soft_bonus(
+            lambda w: nodei_ref[mw + w:mw + w + 1, :],
+            lambda w: groups_ref[w:w + 1, :],
+            podf_ref, podi_ref, acc_ref[:],
+            r_res=r_res, mw=mw, soft_terms=soft_terms)
+
+        raw_ref[:] = acc_ref[:] + base + params_ref[6] * soft
+        ok_ref[:] = ok.astype(jnp.float32)
+
+
+def static_replay_pack(state: ClusterState, cfg: SchedulerConfig,
+                       block_n: int = 128, block_k: int = 128):
+    """Batch-invariant device arrays for :func:`static_scores_tiled`,
+    computed ONCE per replay/serving window: params (weights + global
+    normalizers), padded bw/lat (the O(N²) copies that must NOT happen
+    per scan step), the valid-row, and the compact static node arrays.
+    Everything placements can change is excluded — per batch only the
+    pod-side arrays and the group-bits rows are packed."""
+    import math
+
+    n_real = state.num_nodes
+    base, bw_max, lat_max = static_tile_inputs(state, cfg)
+    n_pad = _round_up(n_real, math.lcm(block_n, block_k))
+    mw = cfg.mask_words
+
+    def pad2(x):
+        return jnp.pad(x, ((0, n_pad - x.shape[0]),
+                           (0, n_pad - x.shape[1])))
+
+    params = jnp.stack([
+        jnp.float32(cfg.weights.peer_bw), jnp.float32(cfg.weights.peer_lat),
+        1.0 / bw_max, 1.0 / lat_max,
+        jnp.float32(cfg.weights.balance), jnp.float32(_EPS),
+        jnp.float32(cfg.weights.soft_affinity / 100.0), jnp.float32(0)])
+    bw = pad2(state.bw)
+    lat = pad2(state.lat)
+    validf = state.node_valid.astype(jnp.float32)
+    validk = jnp.pad(validf[None, :], ((0, 0), (0, n_pad - n_real)))
+    nodes = jnp.zeros((8, n_pad), jnp.float32)
+    nodes = nodes.at[0, :n_real].set(base)
+    nodes = nodes.at[1, :n_real].set(validf)
+    nodei = jnp.zeros((_round_up(2 * mw, 8), n_pad), jnp.int32)
+    nodei = nodei.at[0:mw, :n_real].set(state.taint_bits.astype(jnp.int32).T)
+    nodei = nodei.at[mw:2 * mw, :n_real].set(
+        state.label_bits.astype(jnp.int32).T)
+    return params, bw, lat, validk, nodes, nodei
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("cfg", "block_p", "block_n", "block_k", "interpret"))
+def static_scores_tiled(state: ClusterState, pods: PodBatch,
+                        cfg: SchedulerConfig, static=None, *,
+                        block_p: int = 128, block_n: int = 128,
+                        block_k: int = 128, interpret: bool = False
+                        ) -> tuple[jax.Array, jax.Array]:
+    """``(raw f32[P, N], static_ok bool[P, N])`` for
+    :func:`~.assign._static_parts` — the tiled-Pallas replacement for
+    the dense path's ``base + T @ C.T + soft`` (which materializes
+    ``C[N, N]`` in HBM).  ``static`` is a :func:`static_replay_pack`
+    (packed with the SAME block sizes); the per-batch packing here is
+    pod-sized plus one N×W group-bits transpose — no O(N²) work.
+    Dynamic constraints (capacity, groups, balance) are intentionally
+    absent — the conflict loop recomputes them per round."""
+    p_real, n_real = pods.num_pods, state.num_nodes
+    r_res = state.num_resources
+    bp = min(block_p, _round_up(p_real, 8))
+    p_pad = _round_up(p_real, bp)
+    nb, kb = block_n, block_k
+    mw = cfg.mask_words
+    t_soft = cfg.max_soft_terms
+    pf_cols = _round_up(r_res + 1 + 2 * t_soft, 8)
+    pi_cols = _round_up((5 + 2 * t_soft) * mw, 8)
+
+    if static is None:
+        static = static_replay_pack(state, cfg, nb, kb)
+    params, bw, lat, validk, nodes, nodei = static
+    n_pad = bw.shape[0]
+    ni_rows = nodei.shape[0]
+
+    t = score_lib.peer_traffic_matrix(pods, n_real)
+    t = jnp.pad(t, ((0, p_pad - p_real), (0, n_pad - n_real)))
+    groups = jnp.zeros((8 * ((mw + 7) // 8), n_pad), jnp.int32)
+    groups = groups.at[0:mw, :n_real].set(
+        state.group_bits.astype(jnp.int32).T)
+    podf, podi = _pack_pod_inputs(pods, p_real, p_pad, r_res, mw,
+                                  t_soft, pf_cols, pi_cols)
+    g_rows = groups.shape[0]
+
+    grid = (p_pad // bp, n_pad // nb, n_pad // kb)
+    kernel = functools.partial(_static_kernel, block_n=nb, block_k=kb,
+                               num_resources=r_res, mask_words=mw,
+                               soft_terms=t_soft,
+                               use_bfloat16=cfg.use_bfloat16)
+    raw, ok = pl.pallas_call(
+        kernel,
+        out_shape=[jax.ShapeDtypeStruct((p_pad, n_pad), jnp.float32),
+                   jax.ShapeDtypeStruct((p_pad, n_pad), jnp.float32)],
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),                 # params
+            pl.BlockSpec((bp, kb), lambda i, j, k: (i, k)),        # T
+            pl.BlockSpec((nb, kb), lambda i, j, k: (j, k)),        # bw
+            pl.BlockSpec((nb, kb), lambda i, j, k: (j, k)),        # lat
+            pl.BlockSpec((1, kb), lambda i, j, k: (0, k)),         # validk
+            pl.BlockSpec((8, nb), lambda i, j, k: (0, j)),         # nodes
+            pl.BlockSpec((ni_rows, nb), lambda i, j, k: (0, j)),   # nodei
+            pl.BlockSpec((g_rows, nb), lambda i, j, k: (0, j)),    # groups
+            pl.BlockSpec((bp, pf_cols), lambda i, j, k: (i, 0)),   # podf
+            pl.BlockSpec((bp, pi_cols), lambda i, j, k: (i, 0)),   # podi
+        ],
+        out_specs=[pl.BlockSpec((bp, nb), lambda i, j, k: (i, j)),
+                   pl.BlockSpec((bp, nb), lambda i, j, k: (i, j))],
+        scratch_shapes=[pltpu.VMEM((bp, nb), jnp.float32)],
+        interpret=interpret,
+    )(params, t, bw, lat, validk, nodes, nodei, groups, podf, podi)
+    return raw[:p_real, :n_real], ok[:p_real, :n_real] > 0.5
 
 
 def static_tile_inputs(state: ClusterState, cfg: SchedulerConfig):
@@ -223,6 +405,47 @@ def score_pods_tiled(state: ClusterState, pods: PodBatch,
     ni_rows = _round_up(4 * mw, 8)
     pi_cols = _round_up((5 + 2 * t_soft) * mw, 8)
 
+    if static is None:
+        static = static_tile_inputs(state, cfg)
+    args = _pack_inputs(state, pods, cfg, static, p_real, n_real, p_pad,
+                        n_pad, r_res, mw, t_soft, nf_rows, pf_cols,
+                        ni_rows, pi_cols)
+    grid = (p_pad // bp, n_pad // nb, n_pad // kb)
+    kernel = functools.partial(_kernel, block_n=nb, block_k=kb,
+                               num_resources=r_res, mask_words=mw,
+                               soft_terms=t_soft,
+                               use_bfloat16=cfg.use_bfloat16)
+    out = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((p_pad, n_pad), jnp.float32),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),                 # params
+            pl.BlockSpec((bp, kb), lambda i, j, k: (i, k)),        # T
+            pl.BlockSpec((nb, kb), lambda i, j, k: (j, k)),        # bw
+            pl.BlockSpec((nb, kb), lambda i, j, k: (j, k)),        # lat
+            pl.BlockSpec((1, kb), lambda i, j, k: (0, k)),         # validk
+            pl.BlockSpec((nf_rows, nb), lambda i, j, k: (0, j)),   # nodef
+            pl.BlockSpec((ni_rows, nb), lambda i, j, k: (0, j)),   # nodei
+            pl.BlockSpec((bp, pf_cols), lambda i, j, k: (i, 0)),   # podf
+            pl.BlockSpec((bp, pi_cols), lambda i, j, k: (i, 0)),   # podi
+        ],
+        out_specs=pl.BlockSpec((bp, nb), lambda i, j, k: (i, j)),
+        scratch_shapes=[pltpu.VMEM((bp, nb), jnp.float32)],
+        interpret=interpret,
+    )(*args)
+    return out[:p_real, :n_real]
+
+
+def _pack_inputs(state: ClusterState, pods: PodBatch,
+                 cfg: SchedulerConfig, static, p_real: int, n_real: int,
+                 p_pad: int, n_pad: int, r_res: int, mw: int,
+                 t_soft: int, nf_rows: int, pf_cols: int, ni_rows: int,
+                 pi_cols: int):
+    """Shared input packing for the tiled kernels (layouts documented
+    at module top): params SMEM vector, padded T/bw/lat/validk, and
+    the packed nodef/nodei/podf/podi arrays."""
+
     def pad(x, rows, cols=None):
         pr = rows - x.shape[0]
         if cols is None:
@@ -233,8 +456,6 @@ def score_pods_tiled(state: ClusterState, pods: PodBatch,
     # traffic matrix, the pod-independent metric vote, and the global
     # normalizers of the desirability tile.
     t = pad(score_lib.peer_traffic_matrix(pods, n_real), p_pad, n_pad)
-    if static is None:
-        static = static_tile_inputs(state, cfg)
     base, bw_max, lat_max = static
     params = jnp.stack([
         jnp.float32(cfg.weights.peer_bw), jnp.float32(cfg.weights.peer_lat),
@@ -260,6 +481,16 @@ def score_pods_tiled(state: ClusterState, pods: PodBatch,
         nodei = nodei.at[f * mw:(f + 1) * mw, :n_real].set(
             bits.astype(jnp.int32).T)
 
+    podf, podi = _pack_pod_inputs(pods, p_real, p_pad, r_res, mw,
+                                  t_soft, pf_cols, pi_cols)
+    return params, t, bw, lat, validk, nodef, nodei, podf, podi
+
+
+def _pack_pod_inputs(pods: PodBatch, p_real: int, p_pad: int, r_res: int,
+                     mw: int, t_soft: int, pf_cols: int, pi_cols: int):
+    """Pod-side packed arrays (layouts at module top), shared by both
+    tiled kernels — O(P) work, the only per-batch packing the replay
+    path pays."""
     podf = jnp.zeros((p_pad, pf_cols), jnp.float32)
     podf = podf.at[:p_real, 0:r_res].set(pods.req)
     podf = podf.at[:p_real, r_res].set(pods.pod_valid.astype(jnp.float32))
@@ -283,32 +514,7 @@ def score_pods_tiled(state: ClusterState, pods: PodBatch,
         pods.soft_sel_bits.astype(jnp.int32).reshape(p_real, -1))
     podi = podi.at[:p_real, (5 + t_soft) * mw:(5 + 2 * t_soft) * mw].set(
         pods.soft_grp_bits.astype(jnp.int32).reshape(p_real, -1))
-
-    grid = (p_pad // bp, n_pad // nb, n_pad // kb)
-    kernel = functools.partial(_kernel, block_n=nb, block_k=kb,
-                               num_resources=r_res, mask_words=mw,
-                               soft_terms=t_soft,
-                               use_bfloat16=cfg.use_bfloat16)
-    out = pl.pallas_call(
-        kernel,
-        out_shape=jax.ShapeDtypeStruct((p_pad, n_pad), jnp.float32),
-        grid=grid,
-        in_specs=[
-            pl.BlockSpec(memory_space=pltpu.SMEM),                 # params
-            pl.BlockSpec((bp, kb), lambda i, j, k: (i, k)),        # T
-            pl.BlockSpec((nb, kb), lambda i, j, k: (j, k)),        # bw
-            pl.BlockSpec((nb, kb), lambda i, j, k: (j, k)),        # lat
-            pl.BlockSpec((1, kb), lambda i, j, k: (0, k)),         # validk
-            pl.BlockSpec((nf_rows, nb), lambda i, j, k: (0, j)),   # nodef
-            pl.BlockSpec((ni_rows, nb), lambda i, j, k: (0, j)),   # nodei
-            pl.BlockSpec((bp, pf_cols), lambda i, j, k: (i, 0)),   # podf
-            pl.BlockSpec((bp, pi_cols), lambda i, j, k: (i, 0)),   # podi
-        ],
-        out_specs=pl.BlockSpec((bp, nb), lambda i, j, k: (i, j)),
-        scratch_shapes=[pltpu.VMEM((bp, nb), jnp.float32)],
-        interpret=interpret,
-    )(params, t, bw, lat, validk, nodef, nodei, podf, podi)
-    return out[:p_real, :n_real]
+    return podf, podi
 
 
 def compute_static(state: ClusterState, cfg: SchedulerConfig):
@@ -317,6 +523,17 @@ def compute_static(state: ClusterState, cfg: SchedulerConfig):
     on metrics/network/validity, never on placements)."""
     if cfg.score_backend == "pallas":
         return static_tile_inputs(state, cfg)
+    return score_lib.static_node_scores(state, cfg)
+
+
+def compute_assign_static(state: ClusterState, cfg: SchedulerConfig):
+    """Backend-appropriate batch-invariant prep for the assign/replay
+    seam (:func:`~.assign._static_parts`): the dense ``(base, C.T)``
+    pair, or the Pallas :func:`static_replay_pack` (which prepays the
+    O(N²) pad/pack work the scan body must not repeat per step).
+    Same invariance contract as :func:`compute_static`."""
+    if cfg.score_backend == "pallas":
+        return static_replay_pack(state, cfg)
     return score_lib.static_node_scores(state, cfg)
 
 

@@ -34,7 +34,9 @@ from kubernetesnetawarescheduler_tpu.core.assign import (
     assign_greedy,
     assign_parallel,
 )
-from kubernetesnetawarescheduler_tpu.core.score import static_node_scores
+from kubernetesnetawarescheduler_tpu.core.pallas_score import (
+    compute_assign_static,
+)
 from kubernetesnetawarescheduler_tpu.core.state import (
     ClusterState,
     PodBatch,
@@ -147,11 +149,13 @@ def replay_folded(state: ClusterState, folded, cfg: SchedulerConfig,
     nb = jax.tree_util.tree_leaves(folded)[0].shape[0]
     batch = cfg.max_pods
     s_total = nb * batch
-    # Batch-invariant node scores (metric vote + N×N net-desirability):
+    # Batch-invariant node scores (metric vote + net normalizers):
     # computed ONCE here, closed over by the scan body, instead of
     # re-normalizing the N×N matrices inside every step (don't rely on
     # XLA's loop-invariant code motion for ~100 MB intermediates).
-    static = static_node_scores(state, cfg)
+    # Backend-shaped: (base, C.T) for dense, (base, bw_max, lat_max)
+    # for the Pallas tiled path (which never materializes C).
+    static = compute_assign_static(state, cfg)
     step = _make_step(state, cfg, method, s_total, static)
     xs = (jnp.arange(nb, dtype=jnp.int32), folded)
     init = (state.used, state.group_bits, state.resident_anti,
@@ -217,7 +221,7 @@ def replay_stream_pipelined(state: ClusterState, stream: PodStream,
     batches ahead — far more than it needs to never go idle.
     The final short chunk falls back to :func:`_replay_chunk` with a
     smaller static ``chunk_batches`` (one extra compile, cached)."""
-    static = static_node_scores(state, cfg)
+    static = compute_assign_static(state, cfg)
     s_total = stream.num_pods
     batch = cfg.max_pods
     if s_total % batch != 0:

@@ -103,6 +103,7 @@ def sharded_schedule_step(cfg: SchedulerConfig, mesh: Mesh,
     Returns ``step(state, pods) -> (assignment, new_state)``.
     """
     assign = {"greedy": assign_greedy, "parallel": assign_parallel}[method]
+    cfg = _force_dense(cfg)
 
     def _step(state: ClusterState, pods: PodBatch):
         assignment = assign(state, pods, cfg)
@@ -117,6 +118,25 @@ def sharded_schedule_step(cfg: SchedulerConfig, mesh: Mesh,
 
 def replicated(mesh: Mesh):
     return NamedSharding(mesh, P())
+
+
+def _force_dense(cfg: SchedulerConfig) -> SchedulerConfig:
+    """Mesh-sharded paths always use the dense XLA score backend: a
+    ``pallas_call`` inside GSPMD-partitioned code needs an explicit
+    ``shard_map`` wrapping (plain pjit would all-gather its operands,
+    defeating the tp sharding of the N×N matrices).  Dense-under-GSPMD
+    is the measured multi-chip recipe; a shard_mapped tiled kernel is
+    the future upgrade path."""
+    if cfg.score_backend == "pallas":
+        import dataclasses
+        import warnings
+
+        warnings.warn(
+            "score_backend='pallas' is not yet supported on mesh-sharded "
+            "paths; running the dense XLA kernel instead",
+            RuntimeWarning, stacklevel=3)
+        return dataclasses.replace(cfg, score_backend="xla")
+    return cfg
 
 
 def sharded_replay_stream(state, stream, cfg: SchedulerConfig, mesh: Mesh,
@@ -144,6 +164,7 @@ def sharded_replay_stream(state, stream, cfg: SchedulerConfig, mesh: Mesh,
         extra = (None,) * (x.ndim - 2)
         return NamedSharding(mesh, P(None, "dp", *extra))
 
+    cfg = _force_dense(cfg)
     folded = fold_stream(stream, cfg)
     folded = jax.device_put(
         folded, jax.tree_util.tree_map(fold_spec, folded))

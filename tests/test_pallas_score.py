@@ -105,3 +105,73 @@ def test_auto_dispatch():
 def test_invalid_backend_rejected():
     with pytest.raises(ValueError):
         SchedulerConfig(score_backend="cuda")
+
+
+def test_assign_matches_across_backends():
+    """The tiled-Pallas static path wired into assign._static_parts
+    must yield identical assignments to the dense XLA path — the whole
+    batch pipeline (raw + static mask from the kernel, dynamic
+    masks/balance in XLA), not just the score matrix."""
+    import dataclasses
+
+    from kubernetesnetawarescheduler_tpu.core.assign import (
+        assign_greedy,
+        assign_parallel,
+    )
+
+    for seed in (0, 1):
+        rng = np.random.default_rng(seed)
+        state_np, pods_np = gen.random_instance(rng, CFG, n_nodes=12,
+                                                n_pods=6)
+        state, pods = gen.to_pytrees(CFG, state_np, pods_np)
+        cfg_pallas = dataclasses.replace(CFG, score_backend="pallas")
+        for fn in (assign_parallel, assign_greedy):
+            dense = np.asarray(fn(state, pods, CFG))
+            tiled = np.asarray(fn(state, pods, cfg_pallas))
+            np.testing.assert_array_equal(dense, tiled)
+
+
+def test_replay_matches_across_backends():
+    """Whole-stream replay (the throughput path that produces the
+    headline bench number) must agree between score backends."""
+    import dataclasses
+
+    import jax.numpy as jnp
+
+    from kubernetesnetawarescheduler_tpu.core.replay import (
+        pad_stream,
+        replay_stream,
+    )
+    from kubernetesnetawarescheduler_tpu.bench.fakecluster import (
+        ClusterSpec,
+        WorkloadSpec,
+        build_fake_cluster,
+        feed_metrics,
+        generate_workload,
+    )
+    from kubernetesnetawarescheduler_tpu.config import SchedulerConfig
+    from kubernetesnetawarescheduler_tpu.core.loop import SchedulerLoop
+
+    cfg = SchedulerConfig(max_nodes=128, max_pods=16, max_peers=4,
+                          queue_capacity=200, use_bfloat16=False)
+    cluster, lat, bw = build_fake_cluster(ClusterSpec(num_nodes=48,
+                                                      seed=3))
+    loop = SchedulerLoop(cluster, cfg, method="parallel")
+    loop.encoder.set_network(lat, bw)
+    feed_metrics(cluster, loop.encoder, np.random.default_rng(4))
+    pods = generate_workload(
+        WorkloadSpec(num_pods=64, soft_zone_fraction=0.3, seed=3),
+        scheduler_name=cfg.scheduler_name)
+    cluster.add_pods(pods)
+    queued = loop.queue.pop_batch(len(pods), timeout=0.0)
+    stream = pad_stream(
+        loop.encoder.encode_stream(queued, node_of=lambda n: ""),
+        cfg.max_pods)
+    state = loop.encoder.snapshot()
+    a_dense, s_dense = replay_stream(state, stream, cfg, "parallel")
+    cfg_p = dataclasses.replace(cfg, score_backend="pallas")
+    a_tiled, s_tiled = replay_stream(state, stream, cfg_p, "parallel")
+    np.testing.assert_array_equal(np.asarray(a_dense),
+                                  np.asarray(a_tiled))
+    np.testing.assert_allclose(np.asarray(s_dense.used),
+                               np.asarray(s_tiled.used), atol=1e-4)
