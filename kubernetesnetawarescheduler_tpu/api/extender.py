@@ -332,4 +332,8 @@ class ExtenderHandlers:
             pod = Pod(name=pod_name, namespace=namespace,
                       requests={r: 0.0 for r in Resource.NAMES})
         self._loop.encoder.commit(pod, node)
+        # Surface any interner-overflow degradation this bind (or a
+        # preceding webhook score) recorded — in extender-only
+        # deployments no watch cycle runs to drain it.
+        self._loop._emit_degraded_events()
         return {"error": ""}

@@ -75,14 +75,16 @@ class Interner:
     """Stable string -> bit-position mapping over ``32 * words - 1``
     assignable bits.
 
-    Strict interning (trusted paths: node registration, the main
-    scheduling loop) raises when the slot space is exhausted.
-    Untrusted request paths (the extender webhook) pass
-    ``lenient=True``: an unknown-when-full key yields
-    ``on_overflow`` — callers choose the conservative direction for
-    their constraint (``self.unknown`` for must-match requirements,
-    0 for grants like tolerations) — so one exotic manifest degrades
-    only its own request instead of wedging scheduling for everyone."""
+    Strict interning (trusted, self-inflicted paths: node
+    registration) raises when the slot space is exhausted.  Paths fed
+    by untrusted manifests — the watch-driven scheduling loop, the
+    extender webhook, and the bind-time commit — pass ``lenient=True``:
+    an unknown-when-full key yields ``on_overflow`` — callers choose
+    the conservative direction for their constraint (``self.unknown``
+    for must-match requirements, 0 for grants like tolerations) — so
+    one exotic manifest degrades only its own request (recorded per
+    pod for a ConstraintDegraded event) instead of raising and taking
+    the whole batch's cycle down with it."""
 
     def __init__(self, kind: str, words: int = 1) -> None:
         self._kind = kind
@@ -241,6 +243,25 @@ class Encoder:
         # net/topo snapshot groups); see snapshot() and
         # static_version.
         self._static_version = 0
+        # Pods whose constraints were degraded by interner overflow
+        # ((namespace, name, dropped_count) tuples, bounded), drained
+        # by the loop into per-pod Warning events.  ``_degraded_seen``
+        # dedupes per pod identity: dropped keys are never cached by
+        # the Interner, so the same pod re-drops at commit and on
+        # every retry cycle — without the guard that is one Warning
+        # event per cycle forever.
+        from collections import deque
+        self._degraded_pods: deque = deque(maxlen=256)
+        self._degraded_seen: set[tuple[str, str]] = set()
+
+    def pop_degraded(self) -> list[tuple[str, str, int]]:
+        """Drain the constraint-degradation records
+        (``(namespace, name, dropped_count)``) accumulated since the
+        last call — see :meth:`_constraint_bits`."""
+        with self._lock:
+            out = list(self._degraded_pods)
+            self._degraded_pods.clear()
+        return out
 
     @property
     def static_version(self) -> int:
@@ -543,15 +564,25 @@ class Encoder:
             _fill_requests_row(reqs[i], pod.requests, res_names)
         with self._lock:
             # Intern the group bits FIRST, before any state mutation
-            # (under the lock — the Interner itself is unsynchronized):
-            # a strict interner overflow must raise with the ledger and
-            # usage arrays untouched, never between the two (a ledger
-            # entry whose usage was never added would corrupt
-            # accounting on its eventual release).
-            bits = [((self.groups.bit(pod.group) if pod.group else 0),
-                     (self.groups.mask(pod.anti_groups)
-                      if pod.anti_groups else 0))
-                    for pod in pods]
+            # (under the lock — the Interner itself is unsynchronized),
+            # and LENIENTLY: the pod was already scored with degraded
+            # bits if the interner is full, so the commit must land the
+            # SAME (possibly reduced) bits rather than raise mid-batch
+            # with usage accounting half-applied.  Any drop that first
+            # happens here (extender-path binds commit pods this
+            # encoder never scored) is recorded for the per-pod
+            # ConstraintDegraded event like every other drop.
+            bits = []
+            for pod in pods:
+                before = self.groups.overflow_drops
+                bits.append((
+                    (self.groups.bit(pod.group, lenient=True)
+                     if pod.group else 0),
+                    (self.groups.mask(pod.anti_groups, lenient=True)
+                     if pod.anti_groups else 0)))
+                if self.groups.overflow_drops > before:
+                    self._record_degraded(
+                        pod, self.groups.overflow_drops - before)
             keep = np.ones(len(pods), bool)
             for i, pod in enumerate(pods):
                 if pod.uid in self._committed:
@@ -802,8 +833,17 @@ class Encoder:
         must-match selector or required-affinity key degrades to the
         UNKNOWN sentinel (infeasible) rather than silently matching
         anywhere.
+
+        Any lenient-mode drop records the pod in ``_degraded_pods`` so
+        the loop can emit a per-pod Warning event — an operator must be
+        able to tell WHICH pods lost constraints, not just read an
+        aggregate overflow counter (the anti-affinity drop in
+        particular silently stops being enforced).
         """
-        return (
+        drops_before = (self.taints.overflow_drops
+                        + self.labels.overflow_drops
+                        + self.groups.overflow_drops)
+        bits = (
             self.taints.mask(pod.tolerations, lenient),
             self._selector_mask(pod.node_selector, lenient),
             self.groups.mask(pod.affinity_groups, lenient,
@@ -812,6 +852,26 @@ class Encoder:
             (self.groups.bit(pod.group, lenient)
              if pod.group else 0),
         )
+        drops_after = (self.taints.overflow_drops
+                       + self.labels.overflow_drops
+                       + self.groups.overflow_drops)
+        if drops_after > drops_before:
+            self._record_degraded(pod, drops_after - drops_before)
+        return bits
+
+    def _record_degraded(self, pod: Pod, count: int) -> None:
+        """Queue one ConstraintDegraded record per pod identity
+        (caller holds the lock); repeat drops for the same pod (commit
+        after encode, retry cycles) are not re-recorded."""
+        key = (pod.namespace, pod.name)
+        if key in self._degraded_seen:
+            return
+        if len(self._degraded_seen) >= 4096:
+            # Bounded: on a pathological fleet, prefer occasional
+            # duplicate events over unbounded growth.
+            self._degraded_seen.clear()
+        self._degraded_seen.add(key)
+        self._degraded_pods.append((pod.namespace, pod.name, count))
 
     def _soft_rows(self, pod: Pod, sel_bits_row: np.ndarray,
                    sel_w_row: np.ndarray, grp_bits_row: np.ndarray,

@@ -135,13 +135,23 @@ class SchedulerLoop:
         Returns the number of pods bound."""
         pods = self.queue.pop_batch(self.cfg.max_pods, timeout)
         if not pods:
+            # Still drain degradation records: in extender-only
+            # deployments the watch queue stays empty while the
+            # webhook/bind paths keep encoding (and possibly
+            # degrading) pods.
+            self._emit_degraded_events()
             return 0
         return self.schedule_pods(pods)
 
     def schedule_pods(self, pods: Sequence[Pod]) -> int:
         with self.timer.phase("encode"):
+            # Lenient: pods arrive from the watch (untrusted
+            # manifests), and one pod with un-internable constraints
+            # must degrade ITSELF (conservative bit directions +
+            # a ConstraintDegraded event), not raise and take the
+            # whole batch's cycle down with it.
             batch = self.encoder.encode_pods(
-                pods, node_of=self._peer_node)
+                pods, node_of=self._peer_node, lenient=True)
             state = self.encoder.snapshot()
             # Name/generation table captured WITH the snapshot: the
             # bind path resolves indices against this table, so a slot
@@ -149,12 +159,34 @@ class SchedulerLoop:
             # rejected upstream — instead of silently landing on the
             # slot's new tenant.
             node_table = self.encoder.node_table()
+        self._emit_degraded_events()
         with self.timer.phase("score_assign"):
             assignment = np.asarray(
                 jax_block(self._assign(state, batch, self.cfg)))
         with self.timer.phase("bind"):
             bound = self._bind_all(pods, assignment, node_table)
         return bound
+
+    def _emit_degraded_events(self) -> None:
+        """Per-pod Warning events for constraint degradation on
+        interner overflow (encode.Encoder._constraint_bits): the
+        aggregate overflow counter says it happened; these say to WHOM
+        — in particular a dropped anti-affinity group silently stops
+        being enforced for that pod."""
+        degraded = self.encoder.pop_degraded()
+        if not degraded:
+            return
+        from kubernetesnetawarescheduler_tpu.k8s.types import Event
+
+        self.client.create_events([
+            Event(
+                message=(f"{count} constraint key(s) dropped: interner "
+                         "capacity exhausted (mask_words); affinity/"
+                         "anti-affinity may not be fully enforced"),
+                reason="ConstraintDegraded", involved_pod=name,
+                namespace=namespace,
+                component=self.cfg.scheduler_name, type="Warning")
+            for namespace, name, count in degraded])
 
     def _peer_node(self, pod_name: str) -> str:
         try:

@@ -153,8 +153,9 @@ def replay_folded(state: ClusterState, folded, cfg: SchedulerConfig,
     # computed ONCE here, closed over by the scan body, instead of
     # re-normalizing the N×N matrices inside every step (don't rely on
     # XLA's loop-invariant code motion for ~100 MB intermediates).
-    # Backend-shaped: (base, C.T) for dense, (base, bw_max, lat_max)
-    # for the Pallas tiled path (which never materializes C).
+    # Backend-shaped: (base, C.T) for dense, the static_replay_pack
+    # arrays (params, padded bw/lat, validk, nodes, nodei) for the
+    # Pallas tiled path (which never materializes C).
     static = compute_assign_static(state, cfg)
     step = _make_step(state, cfg, method, s_total, static)
     xs = (jnp.arange(nb, dtype=jnp.int32), folded)

@@ -145,3 +145,42 @@ def test_interner_overflow_still_guarded():
         enc.groups.bit(f"g{g}")
     with pytest.raises(ValueError, match="mask_words"):
         enc.groups.bit("one-too-many")
+
+
+def test_overflow_emits_per_pod_degradation_events():
+    """Lenient-mode interner overflow must name the affected pods via
+    ConstraintDegraded Warning events — an operator can then tell
+    WHICH pods lost (anti-)affinity enforcement, not just that some
+    aggregate counter moved."""
+    from kubernetesnetawarescheduler_tpu.bench.fakecluster import (
+        FakeCluster,
+        sample_metrics,
+    )
+    from kubernetesnetawarescheduler_tpu.core.loop import SchedulerLoop
+
+    cfg = SchedulerConfig(max_nodes=8, max_pods=4, max_peers=2,
+                          mask_words=1, queue_capacity=300)
+    cluster = FakeCluster()
+    for i in range(4):
+        cluster.add_node(Node(name=f"n{i}", capacity={"cpu": 16.0}))
+    loop = SchedulerLoop(cluster, cfg, method="greedy")
+    rng = np.random.default_rng(0)
+    for i in range(4):
+        loop.encoder.update_metrics(f"n{i}", sample_metrics(rng),
+                                    age_s=0.0)
+    # 31 assignable group bits per word-1 mask; the 40-group pod
+    # overflows mid-encode.
+    exotic = Pod(name="exotic", requests={"cpu": 0.1},
+                 anti_groups=frozenset(f"g-{j}" for j in range(40)),
+                 scheduler_name=cfg.scheduler_name)
+    plain = Pod(name="plain", requests={"cpu": 0.1},
+                scheduler_name=cfg.scheduler_name)
+    cluster.add_pods([exotic, plain])
+    loop.run_once()
+    degraded = [e for e in cluster.events
+                if e.reason == "ConstraintDegraded"]
+    assert [e.involved_pod for e in degraded] == ["exotic"]
+    assert "anti-affinity" in degraded[0].message
+    assert degraded[0].type == "Warning"
+    # Both pods still scheduled (lenient mode degrades, not rejects).
+    assert cluster.node_of("exotic") and cluster.node_of("plain")
