@@ -134,7 +134,7 @@ def _force_dense(cfg: SchedulerConfig) -> SchedulerConfig:
         warnings.warn(
             "score_backend='pallas' is not yet supported on mesh-sharded "
             "paths; running the dense XLA kernel instead",
-            RuntimeWarning, stacklevel=3)
+            RuntimeWarning, stacklevel=2)
         return dataclasses.replace(cfg, score_backend="xla")
     return cfg
 
@@ -160,24 +160,44 @@ def sharded_replay_stream(state, stream, cfg: SchedulerConfig, mesh: Mesh,
     # Pre-fold host-side to [NB, batch, ...] and shard the batch axis
     # on dp (the scan walks the leading NB axis; replay_folded keeps
     # the folded layout so the dp sharding survives the whole scan).
-    def fold_spec(x):
-        extra = (None,) * (x.ndim - 2)
-        return NamedSharding(mesh, P(None, "dp", *extra))
-
-    cfg = _force_dense(cfg)
     folded = fold_stream(stream, cfg)
     folded = jax.device_put(
-        folded, jax.tree_util.tree_map(fold_spec, folded))
+        folded, jax.tree_util.tree_map(_fold_spec(mesh), folded))
     state = jax.device_put(state, state_sharding(mesh))
+    return sharded_replay_fn(cfg, mesh, method, folded)(state, folded)
 
-    fn = jax.jit(
-        partial(replay_folded, cfg=cfg, method=method),
+
+def _fold_spec(mesh: Mesh):
+    """Sharding for a folded ``[NB, batch, ...]`` stream leaf: batch
+    axis on dp.  ONE definition shared by the device_put in
+    :func:`sharded_replay_stream` and the jit in_shardings in
+    :func:`sharded_replay_fn` — if these disagreed, jax would reshard
+    silently at the jit boundary and the compile-only GSPMD test
+    would no longer describe what execution does."""
+    def spec(x):
+        extra = (None,) * (x.ndim - 2)
+        return NamedSharding(mesh, P(None, "dp", *extra))
+    return spec
+
+
+def sharded_replay_fn(cfg: SchedulerConfig, mesh: Mesh, method: str,
+                      folded):
+    """The jitted mesh-sharded replay callable (state, folded) ->
+    (assignment, final_state).  Exposed separately from
+    :func:`sharded_replay_stream` so tests can ``.lower().compile()``
+    it and inspect the GSPMD partitioning (e.g. assert the tp-sharded
+    ``N×N`` matrices are never all-gathered whole) without executing
+    at scale."""
+    from kubernetesnetawarescheduler_tpu.core.replay import replay_folded
+
+    return jax.jit(
+        partial(replay_folded, cfg=_force_dense(cfg), method=method),
         in_shardings=(state_sharding(mesh),
-                      jax.tree_util.tree_map(fold_spec, folded)),
+                      jax.tree_util.tree_map(_fold_spec(mesh), folded)),
         out_shardings=(replicated(mesh), state_sharding(mesh)),
     )
-    return fn(state, folded)
 
 
 __all__ = ["make_mesh", "state_sharding", "pods_sharding", "place",
-           "sharded_schedule_step", "sharded_replay_stream", "replicated"]
+           "sharded_schedule_step", "sharded_replay_stream",
+           "sharded_replay_fn", "replicated"]

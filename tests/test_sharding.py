@@ -113,3 +113,86 @@ def test_sharded_replay_matches_single_device():
                                   np.asarray(want_assign))
     np.testing.assert_allclose(np.asarray(got_state.used),
                                np.asarray(want_state.used), atol=1e-4)
+
+
+def test_sharded_replay_never_gathers_full_nxn():
+    """GSPMD sanity at realistic width (VERDICT weak #7): with the
+    N×N lat/bw matrices row-sharded on tp, the compiled replay must
+    never materialize a FULL N×N array on one device — the desirability
+    matrix stays sharded through the transpose/matmul (each device
+    holds ct[:, shard] and produces net[:, shard]), and only O(P·N)
+    tensors may cross devices.  Compile-only, so N can be wide."""
+    import re
+
+    import jax.numpy as jnp
+
+    from kubernetesnetawarescheduler_tpu.config import SchedulerConfig
+    from kubernetesnetawarescheduler_tpu.core.replay import (
+        PodStream,
+        fold_stream,
+        pad_stream,
+    )
+    from kubernetesnetawarescheduler_tpu.parallel.sharding import (
+        sharded_replay_fn,
+    )
+    from kubernetesnetawarescheduler_tpu.core.state import (
+        init_cluster_state,
+    )
+
+    n = 1024
+    cfg = SchedulerConfig(max_nodes=n, max_pods=64, max_peers=4,
+                          queue_capacity=300, use_bfloat16=False)
+    rng = np.random.default_rng(0)
+    state = init_cluster_state(
+        cfg,
+        node_valid=jnp.ones((n,), bool),
+        cap=jnp.asarray(rng.uniform(8, 64, (n, 3)).astype(np.float32)),
+        lat=jnp.asarray(rng.uniform(0.05, 5, (n, n)).astype(np.float32)),
+        bw=jnp.asarray(rng.uniform(1e9, 2e10, (n, n)).astype(np.float32)),
+        metrics=jnp.asarray(
+            rng.uniform(0, 100, (n, cfg.num_metrics)).astype(np.float32)),
+    )
+    s = cfg.max_pods * 2
+    w, t_soft = cfg.mask_words, cfg.max_soft_terms
+    stream = pad_stream(PodStream(
+        req=jnp.asarray(rng.uniform(0.1, 2, (s, 3)).astype(np.float32)),
+        peer_pods=jnp.full((s, 4), -1, jnp.int32),
+        peer_nodes=jnp.asarray(
+            rng.integers(-1, n, (s, 4)).astype(np.int32)),
+        peer_traffic=jnp.asarray(
+            rng.uniform(0, 3, (s, 4)).astype(np.float32)),
+        tol_bits=jnp.zeros((s, w), jnp.uint32),
+        sel_bits=jnp.zeros((s, w), jnp.uint32),
+        affinity_bits=jnp.zeros((s, w), jnp.uint32),
+        anti_bits=jnp.zeros((s, w), jnp.uint32),
+        group_bit=jnp.zeros((s, w), jnp.uint32),
+        priority=jnp.asarray(rng.uniform(0, 5, (s,)).astype(np.float32)),
+        pod_valid=jnp.ones((s,), bool),
+        soft_sel_bits=jnp.zeros((s, t_soft, w), jnp.uint32),
+        soft_sel_w=jnp.zeros((s, t_soft), jnp.float32),
+        soft_grp_bits=jnp.zeros((s, t_soft, w), jnp.uint32),
+        soft_grp_w=jnp.zeros((s, t_soft), jnp.float32),
+    ), cfg.max_pods)
+    mesh = make_mesh(2, 4)
+    folded = fold_stream(stream, cfg)
+    compiled = sharded_replay_fn(cfg, mesh, "parallel", folded).lower(
+        jax.tree_util.tree_map(
+            lambda sh: jax.ShapeDtypeStruct(sh.shape, sh.dtype), state),
+        jax.tree_util.tree_map(
+            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), folded),
+    ).compile()
+    hlo = compiled.as_text()
+    # Positive check first, so the negative one cannot pass vacuously:
+    # under SPMD the per-device HLO must actually CARRY the tp shard
+    # shape of the N×N matrices ([N/tp, N] = [256, 1024] on the 2x4
+    # mesh) — if state_sharding ever regressed to replication, there
+    # would be no shard-shaped values (and no collectives) at all.
+    assert re.search(r"f32\[256,1024\]", hlo), \
+        "no [N/tp, N] shard shapes in HLO — matrices not tp-sharded?"
+    # And no op anywhere may produce a full N×N per-device tensor
+    # (computed ops OR parameters): materializing f32[1024,1024] means
+    # GSPMD replicated/gathered 4 MB of matrix per device per step.
+    bad = [ln for ln in hlo.splitlines()
+           if re.search(r"= f32\[1024,1024\]", ln)]
+    assert not bad, "full N×N materialized per device:\n" + \
+        "\n".join(bad[:5])
