@@ -146,6 +146,7 @@ class _ScoreBatcher:
         self._dispatch_lock = threading.Lock()  # one kernel at a time
         self._queue: list[list] = []  # entries: [pod, event, row|exc]
         self.dispatches = 0  # kernel dispatch count (observability)
+        self.requests = 0    # score requests served (observability)
         # Static-score cache: the O(N^2) batch-invariant prep (metric
         # vote + net normalization) depends only on metrics/network/
         # validity — NOT on placements — so binds between requests do
@@ -158,6 +159,7 @@ class _ScoreBatcher:
         """Full masked score row ``f32[N]`` for one pod (blocking)."""
         entry = [pod, threading.Event(), None]
         with self._lock:
+            self.requests += 1  # under the lock: threaded servers
             self._queue.append(entry)
         if self._window:
             time.sleep(self._window)
@@ -233,6 +235,9 @@ class ExtenderHandlers:
                  batch_window_s: float = 0.0) -> None:
         self._loop = loop
         self._batcher = _ScoreBatcher(loop, window_s=batch_window_s)
+        # Surfaced on the loop so /metrics (utils/selfmetrics) can
+        # report the coalescing rate.
+        loop._extender_batcher = self._batcher
 
     # -- ops ----------------------------------------------------------
 

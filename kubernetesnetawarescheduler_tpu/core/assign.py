@@ -68,6 +68,13 @@ def _static_parts(state: ClusterState, pods: PodBatch, cfg: SchedulerConfig,
     called.  The per-round dynamic work (capacity, groups, balance)
     stays in XLA either way: it mutates every conflict round.
     """
+    if isinstance(static, dict):
+        # Precomputed by the caller — the shard_map'd multi-chip
+        # Pallas path evaluates the kernel per batch OUTSIDE assign
+        # (a pallas_call must be wrapped in shard_map, which needs the
+        # mesh; see parallel.sharding.pallas_static_builder) and hands
+        # the result through as {"raw": ..., "ok": ...}.
+        return static["raw"], static["ok"]
     if cfg.score_backend == "pallas":
         from kubernetesnetawarescheduler_tpu.core import pallas_score
 

@@ -253,6 +253,7 @@ class Encoder:
         from collections import deque
         self._degraded_pods: deque = deque(maxlen=256)
         self._degraded_seen: set[tuple[str, str]] = set()
+        self.degraded_total = 0  # distinct pods degraded (self-metrics)
 
     def pop_degraded(self) -> list[tuple[str, str, int]]:
         """Drain the constraint-degradation records
@@ -871,6 +872,7 @@ class Encoder:
             # duplicate events over unbounded growth.
             self._degraded_seen.clear()
         self._degraded_seen.add(key)
+        self.degraded_total += 1
         self._degraded_pods.append((pod.namespace, pod.name, count))
 
     def _soft_rows(self, pod: Pod, sel_bits_row: np.ndarray,

@@ -49,7 +49,9 @@ from kubernetesnetawarescheduler_tpu.core.state import ClusterState, PodBatch
 #                      soft_sel_bits[T*W], soft_grp_bits[T*W], pad
 # Row layout of the packed per-node int array ``nodei[>=4W, N]``:
 #   taint_bits[W], label_bits[W], group_bits[W], resident_anti[W], pad.
-_PARAMS = 8  # wbw, wlat, inv_bwmax, inv_latmax, wbal, eps, wsoft, pad
+_PARAMS = 8  # wbw, wlat, inv_bwmax, inv_latmax, wbal, eps, wsoft,
+# row_offset (global node index of output row 0 — nonzero only inside
+# the shard_map'd tp path, where each device owns a row shard)
 
 from kubernetesnetawarescheduler_tpu.core.state import round_up as _round_up
 
@@ -79,7 +81,12 @@ def _net_accum(params_ref, t_ref, bw_ref, lat_ref, validk_ref, acc_ref,
     inv_lat = params_ref[3]
 
     c = wbw * bw_ref[:] * inv_bw - wlat * lat_ref[:] * inv_lat
-    rows = j * block_n + jax.lax.broadcasted_iota(
+    # The diagonal pin compares GLOBAL node indices: row_offset shifts
+    # output rows when this kernel instance owns only a tp shard of
+    # the node axis (params[7] is 0 on the single-device path; node
+    # counts stay far below f32's 2^24 exact-integer ceiling).
+    row_offset = params_ref[7].astype(jnp.int32)
+    rows = row_offset + j * block_n + jax.lax.broadcasted_iota(
         jnp.int32, (block_n, block_k), 0)
     cols = k * block_k + jax.lax.broadcasted_iota(
         jnp.int32, (block_n, block_k), 1)
@@ -312,22 +319,54 @@ def static_scores_tiled(state: ClusterState, pods: PodBatch,
 
     t = score_lib.peer_traffic_matrix(pods, n_real)
     t = jnp.pad(t, ((0, p_pad - p_real), (0, n_pad - n_real)))
-    groups = jnp.zeros((8 * ((mw + 7) // 8), n_pad), jnp.int32)
-    groups = groups.at[0:mw, :n_real].set(
-        state.group_bits.astype(jnp.int32).T)
+    groups = pack_group_rows(state.group_bits, n_pad, mw)
     podf, podi = _pack_pod_inputs(pods, p_real, p_pad, r_res, mw,
                                   t_soft, pf_cols, pi_cols)
-    g_rows = groups.shape[0]
+    raw, ok = _static_pallas_call(
+        params, t, bw, lat, validk, nodes, nodei, groups, podf, podi,
+        cfg=cfg, bp=bp, nb=nb, kb=kb, interpret=interpret)
+    return raw[:p_real, :n_real], ok[:p_real, :n_real] > 0.5
 
-    grid = (p_pad // bp, n_pad // nb, n_pad // kb)
+
+def pack_group_rows(group_bits: jax.Array, n_pad: int,
+                    mw: int) -> jax.Array:
+    """Current node group-bits as kernel rows ``i32[~W, n_pad]`` — the
+    one per-batch node-side input of the static kernel (soft group
+    terms score against batch-entry residency)."""
+    n_real = group_bits.shape[0]
+    groups = jnp.zeros((8 * ((mw + 7) // 8), n_pad), jnp.int32)
+    return groups.at[0:mw, :n_real].set(group_bits.astype(jnp.int32).T)
+
+
+def _static_pallas_call(params, t, bw, lat, validk, nodes, nodei,
+                        groups, podf, podi, *, cfg: SchedulerConfig,
+                        bp: int, nb: int, kb: int, interpret: bool):
+    """The raw static-kernel dispatch over already-packed arrays.
+
+    Shapes may be non-square: ``bw``/``lat`` are
+    ``[n_out_pad, n_k_pad]`` — the OUTPUT node axis (rows) can be one
+    tp shard while the contraction axis (columns, the peer side) stays
+    full, which is exactly the row-sharded layout the shard_map'd
+    multi-chip path hands each device (params[7] then carries the
+    shard's global row offset for the diagonal pin)."""
+    p_pad = t.shape[0]
+    n_out, n_k = bw.shape
+    r_res = cfg.num_resources
+    mw = cfg.mask_words
+    t_soft = cfg.max_soft_terms
+    pf_cols = podf.shape[1]
+    pi_cols = podi.shape[1]
+    ni_rows = nodei.shape[0]
+    g_rows = groups.shape[0]
+    grid = (p_pad // bp, n_out // nb, n_k // kb)
     kernel = functools.partial(_static_kernel, block_n=nb, block_k=kb,
                                num_resources=r_res, mask_words=mw,
                                soft_terms=t_soft,
                                use_bfloat16=cfg.use_bfloat16)
-    raw, ok = pl.pallas_call(
+    return pl.pallas_call(
         kernel,
-        out_shape=[jax.ShapeDtypeStruct((p_pad, n_pad), jnp.float32),
-                   jax.ShapeDtypeStruct((p_pad, n_pad), jnp.float32)],
+        out_shape=[jax.ShapeDtypeStruct((p_pad, n_out), jnp.float32),
+                   jax.ShapeDtypeStruct((p_pad, n_out), jnp.float32)],
         grid=grid,
         in_specs=[
             pl.BlockSpec(memory_space=pltpu.SMEM),                 # params
@@ -346,7 +385,6 @@ def static_scores_tiled(state: ClusterState, pods: PodBatch,
         scratch_shapes=[pltpu.VMEM((bp, nb), jnp.float32)],
         interpret=interpret,
     )(params, t, bw, lat, validk, nodes, nodei, groups, podf, podi)
-    return raw[:p_real, :n_real], ok[:p_real, :n_real] > 0.5
 
 
 def static_tile_inputs(state: ClusterState, cfg: SchedulerConfig):

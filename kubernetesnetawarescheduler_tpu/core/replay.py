@@ -111,7 +111,15 @@ def _make_step(state: ClusterState, cfg: SchedulerConfig, method: str,
             pod_valid=sl.pod_valid,
             soft_sel_bits=sl.soft_sel_bits, soft_sel_w=sl.soft_sel_w,
             soft_grp_bits=sl.soft_grp_bits, soft_grp_w=sl.soft_grp_w)
-        assignment = assign_fn(st, pods, cfg, static)
+        if callable(static):
+            # Mesh Pallas path: the per-batch static scores are
+            # computed here (shard_map'd kernel) and passed into
+            # assign precomputed — see assign._static_parts.
+            raw, ok = static(st, pods)
+            batch_static = {"raw": raw, "ok": ok}
+        else:
+            batch_static = static
+        assignment = assign_fn(st, pods, cfg, batch_static)
         st = commit_assignments(st, pods, assignment)
         node_of_pod = jax.lax.dynamic_update_slice_in_dim(
             node_of_pod, assignment, i * batch, 0)
@@ -140,12 +148,18 @@ def fold_stream(stream: PodStream, cfg: SchedulerConfig):
 
 
 def replay_folded(state: ClusterState, folded, cfg: SchedulerConfig,
-                  method: str = "parallel"
+                  method: str = "parallel", static_builder=None
                   ) -> tuple[jax.Array, ClusterState]:
     """Scan over a pre-folded ``[NB, batch, ...]`` stream pytree.
     Traceable core of :func:`replay_stream`; also jitted directly by
     the mesh-sharded replay (which must keep the folded layout — a
-    flat reshape of a dp-sharded batch axis would force a reshard)."""
+    flat reshape of a dp-sharded batch axis would force a reshard).
+
+    ``static_builder``, if given, replaces the default per-replay
+    static-score prep: called once with the full state, it returns a
+    per-batch callable ``(st, pods) -> (raw, static_ok)`` (the
+    shard_map'd multi-chip Pallas path,
+    parallel.sharding.pallas_static_builder)."""
     nb = jax.tree_util.tree_leaves(folded)[0].shape[0]
     batch = cfg.max_pods
     s_total = nb * batch
@@ -156,7 +170,10 @@ def replay_folded(state: ClusterState, folded, cfg: SchedulerConfig,
     # Backend-shaped: (base, C.T) for dense, the static_replay_pack
     # arrays (params, padded bw/lat, validk, nodes, nodei) for the
     # Pallas tiled path (which never materializes C).
-    static = compute_assign_static(state, cfg)
+    if static_builder is not None:
+        static = static_builder(state)
+    else:
+        static = compute_assign_static(state, cfg)
     step = _make_step(state, cfg, method, s_total, static)
     xs = (jnp.arange(nb, dtype=jnp.int32), folded)
     init = (state.used, state.group_bits, state.resident_anti,

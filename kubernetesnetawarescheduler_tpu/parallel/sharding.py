@@ -20,6 +20,7 @@ from __future__ import annotations
 from functools import partial
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
@@ -103,7 +104,20 @@ def sharded_schedule_step(cfg: SchedulerConfig, mesh: Mesh,
     Returns ``step(state, pods) -> (assignment, new_state)``.
     """
     assign = {"greedy": assign_greedy, "parallel": assign_parallel}[method]
-    cfg = _force_dense(cfg)
+    if cfg.score_backend == "pallas":
+        # The single-batch step path has no shard_map wrapping (only
+        # the replay does, via pallas_static_builder) — its own
+        # message, so users with tiling shapes don't chase a shape
+        # problem that isn't one.
+        import dataclasses
+        import warnings
+
+        warnings.warn(
+            "score_backend='pallas' is not supported on the "
+            "sharded_schedule_step path (use the sharded replay); "
+            "running the dense XLA kernel instead",
+            RuntimeWarning, stacklevel=2)
+        cfg = dataclasses.replace(cfg, score_backend="xla")
 
     def _step(state: ClusterState, pods: PodBatch):
         assignment = assign(state, pods, cfg)
@@ -121,22 +135,108 @@ def replicated(mesh: Mesh):
 
 
 def _force_dense(cfg: SchedulerConfig) -> SchedulerConfig:
-    """Mesh-sharded paths always use the dense XLA score backend: a
-    ``pallas_call`` inside GSPMD-partitioned code needs an explicit
-    ``shard_map`` wrapping (plain pjit would all-gather its operands,
-    defeating the tp sharding of the N×N matrices).  Dense-under-GSPMD
-    is the measured multi-chip recipe; a shard_mapped tiled kernel is
-    the future upgrade path."""
+    """Coerce to the dense XLA score backend: a ``pallas_call`` inside
+    GSPMD-partitioned code without a ``shard_map`` wrapping would make
+    pjit all-gather its operands, defeating the tp sharding of the N×N
+    matrices.  The replay path has the shard_map wrapping
+    (:func:`pallas_static_builder`) and only falls back here when the
+    shapes don't tile across the mesh."""
     if cfg.score_backend == "pallas":
         import dataclasses
         import warnings
 
         warnings.warn(
-            "score_backend='pallas' is not yet supported on mesh-sharded "
-            "paths; running the dense XLA kernel instead",
+            "score_backend='pallas' requires max_nodes % (tp*128) == 0 "
+            "and max_pods % (dp*8) == 0 on mesh-sharded paths; running "
+            "the dense XLA kernel instead",
             RuntimeWarning, stacklevel=2)
         return dataclasses.replace(cfg, score_backend="xla")
     return cfg
+
+
+def pallas_static_builder(cfg: SchedulerConfig, mesh: Mesh):
+    """The multi-chip tiled-Pallas static-score path: a
+    ``static_builder`` for :func:`~..core.replay.replay_folded`.
+
+    Communication-free by construction — the row-sharded ``lat``/``bw``
+    layout gives every device full contraction columns for its own
+    output rows: device d computes ``raw[:, shard_d]`` from
+    ``bw[shard_d, :]`` / ``lat[shard_d, :]`` with the replicated
+    ``T[P, N]``, so the kernel needs NO collectives (the scoring-time
+    analog of ring-attention's "my KV shard, everyone's Q" locality,
+    minus the ring: the peer axis is resident, not rotated).  Only the
+    small global normalizers (``bw_max``/``lat_max``/metric vote) are
+    GSPMD reductions outside the shard_map.
+
+    Returns ``None`` when the shapes don't tile across the mesh
+    (callers fall back to :func:`_force_dense`): needs
+    ``max_nodes % (tp * 128) == 0`` and ``max_pods % dp == 0`` with an
+    8-aligned per-device pod count.
+    """
+    from jax.experimental.shard_map import shard_map
+
+    from kubernetesnetawarescheduler_tpu.core import pallas_score
+    from kubernetesnetawarescheduler_tpu.core.score import (
+        peer_traffic_matrix,
+    )
+
+    dp = mesh.shape["dp"]
+    tp = mesh.shape["tp"]
+    n, p = cfg.max_nodes, cfg.max_pods
+    if n % (tp * 128) != 0 or p % dp != 0 or (p // dp) % 8 != 0:
+        return None
+    p_local = p // dp
+    bp = min(128, p_local)
+    if p_local % bp != 0:
+        # The per-shard grid would drop pod rows beyond
+        # bp * (p_local // bp) (the single-device path pads; shards
+        # cannot without resharding) — e.g. p_local=136 with bp=128.
+        return None
+    interpret = jax.default_backend() != "tpu"
+
+    def kernel_body(params, t, bw, lat, validk, nodes, nodei, groups,
+                    podf, podi):
+        n_shard = bw.shape[0]
+        offset = jax.lax.axis_index("tp") * n_shard
+        params = params.at[7].set(offset.astype(jnp.float32))
+        return pallas_score._static_pallas_call(
+            params, t, bw, lat, validk, nodes, nodei, groups, podf,
+            podi, cfg=cfg, bp=bp, nb=128, kb=128, interpret=interpret)
+
+    sharded_kernel = shard_map(
+        kernel_body, mesh=mesh,
+        in_specs=(P(), P("dp", None), P("tp", None), P("tp", None),
+                  P(None, None), P(None, "tp"), P(None, "tp"),
+                  P(None, "tp"), P("dp", None), P("dp", None)),
+        out_specs=(P("dp", "tp"), P("dp", "tp")),
+        check_rep=False)
+
+    def builder(state):
+        from kubernetesnetawarescheduler_tpu.core.state import round_up
+
+        # The gate guarantees n % 128 == 0, so static_replay_pack's
+        # n_pad == n: the mesh path reuses the single-device pack
+        # verbatim (ONE definition of the kernel's array contract).
+        mw = cfg.mask_words
+        t_soft = cfg.max_soft_terms
+        r_res = cfg.num_resources
+        params0, bw_m, lat_m, validk, nodes, nodei = \
+            pallas_score.static_replay_pack(state, cfg)
+        pf_cols = round_up(r_res + 1 + 2 * t_soft, 8)
+        pi_cols = round_up((5 + 2 * t_soft) * mw, 8)
+
+        def static_fn(st, pods):
+            t = peer_traffic_matrix(pods, n)
+            groups = pallas_score.pack_group_rows(st.group_bits, n, mw)
+            podf, podi = pallas_score._pack_pod_inputs(
+                pods, p, p, r_res, mw, t_soft, pf_cols, pi_cols)
+            raw, ok = sharded_kernel(params0, t, bw_m, lat_m, validk,
+                                     nodes, nodei, groups, podf, podi)
+            return raw, ok > 0.5
+
+        return static_fn
+
+    return builder
 
 
 def sharded_replay_stream(state, stream, cfg: SchedulerConfig, mesh: Mesh,
@@ -190,8 +290,14 @@ def sharded_replay_fn(cfg: SchedulerConfig, mesh: Mesh, method: str,
     at scale."""
     from kubernetesnetawarescheduler_tpu.core.replay import replay_folded
 
+    static_builder = None
+    if cfg.score_backend == "pallas":
+        static_builder = pallas_static_builder(cfg, mesh)
+        if static_builder is None:  # shapes don't tile: dense fallback
+            cfg = _force_dense(cfg)
     return jax.jit(
-        partial(replay_folded, cfg=_force_dense(cfg), method=method),
+        partial(replay_folded, cfg=cfg, method=method,
+                static_builder=static_builder),
         in_shardings=(state_sharding(mesh),
                       jax.tree_util.tree_map(_fold_spec(mesh), folded)),
         out_shardings=(replicated(mesh), state_sharding(mesh)),

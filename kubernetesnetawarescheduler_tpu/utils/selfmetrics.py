@@ -72,6 +72,22 @@ def render_metrics(loop) -> str:
           "Nodes known to the encoder")
     counter("netaware_intern_overflow_total", float(overflow),
             "Constraint keys dropped by lenient interning")
+    counter("netaware_constraint_degraded_pods_total",
+            float(getattr(enc, "degraded_total", 0)),
+            "Pods that lost constraint keys to interner overflow "
+            "(each also gets a ConstraintDegraded event)")
+
+    # Extender webhook micro-batcher (api/extender._ScoreBatcher):
+    # dispatch count exposes the coalescing rate (requests served /
+    # dispatches = mean batch).
+    batcher = getattr(loop, "_extender_batcher", None)
+    if batcher is not None:
+        counter("netaware_extender_dispatches_total",
+                float(batcher.dispatches),
+                "Score-kernel dispatches serving webhook requests")
+        counter("netaware_extender_requests_total",
+                float(batcher.requests),
+                "Webhook score requests (filter+prioritize)")
 
     # Metric staleness distribution over ready nodes — the quantity the
     # exp(-age/tau) decay consumes.

@@ -80,3 +80,36 @@ def test_metrics_served_over_uds(tmp_path):
     parsed = parse_prometheus_text(body.decode())
     assert "netaware_pods_scheduled_total" in parsed
     assert "netaware_phase_latency_seconds" in parsed
+
+
+def test_batcher_and_degradation_metrics_exposed(tmp_path):
+    """The webhook micro-batcher's coalescing rate and the per-pod
+    constraint-degradation counter appear once an ExtenderHandlers is
+    attached and requests flow."""
+    from kubernetesnetawarescheduler_tpu.api.extender import (
+        ExtenderHandlers,
+    )
+    from kubernetesnetawarescheduler_tpu.api.server import (
+        ScorerServer,
+        call_uds,
+    )
+
+    loop = _run_loop(num_pods=8, seed=5)
+    handlers = ExtenderHandlers(loop)
+    names = [n.name for n in loop.client.list_nodes()][:4]
+    handlers.prioritize({
+        "pod": {"metadata": {"name": "m-1", "uid": "m-1"},
+                "spec": {"containers": []}},
+        "nodenames": names})
+    server = ScorerServer(handlers, str(tmp_path / "s.sock"))
+    server.start()
+    try:
+        body = call_uds(server.uds_path, "/metrics", b"")
+    finally:
+        server.stop()
+    parsed = parse_prometheus_text(body.decode())
+    assert next(iter(
+        parsed["netaware_extender_requests_total"].values())) >= 1
+    assert next(iter(
+        parsed["netaware_extender_dispatches_total"].values())) >= 1
+    assert "netaware_constraint_degraded_pods_total" in parsed
