@@ -1,4 +1,5 @@
-"""The five BASELINE.json benchmark configs, runnable as one suite.
+"""The five BASELINE.json benchmark configs (+ soft-affinity audit),
+runnable as one suite.
 
 The reference published its evaluation as committed artifacts only —
 ``datasets/customNetworkBenchmark/*.data`` (5-line timing files,
