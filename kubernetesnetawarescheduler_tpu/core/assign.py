@@ -38,9 +38,7 @@ from kubernetesnetawarescheduler_tpu.core.score import NEG_INF, _EPS
 from kubernetesnetawarescheduler_tpu.core.state import (
     ClusterState,
     PodBatch,
-    bit_planes,
     commit_assignments,
-    planes_to_words,
 )
 
 # np scalar, not jnp — see core/score.py NEG_INF: module-level jnp
@@ -202,16 +200,14 @@ def assign_parallel(state: ClusterState, pods: PodBatch,
     w_bal = jnp.float32(cfg.weights.balance)
     pod_ids = jnp.arange(p, dtype=jnp.int32)
 
-    # Loop-invariant bitplane decomposition of the two per-pod bit
-    # fields (each u32[P, W]), stacked [P, 2*W*32] so the per-round
-    # "which bits landed on which node" reduction is ONE
-    # [N, P] x [P, 2*W*32] matmul on the MXU (counts > 0 ⇔ bit
-    # present) instead of a [P, N, 2*W*32] any-reduce on the VPU — the
-    # dominant cost of a round at N ≥ 1k.
-    plane_cols = pods.group_bit.shape[1] * 32
-    pod_planes = jnp.concatenate(
-        [bit_planes(pods.group_bit), bit_planes(pods.anti_bits)],
-        axis=1)  # [P, 2*W*32] of exact 0/1
+    # Loop-invariant tie-break rank: position in (priority desc, index
+    # asc) order.  Lets each round pick per-node winners with ONE
+    # O(P log P) sort over composite keys instead of O(P*N) one-hot
+    # reductions — at P=128, N=5k that removes ~5 full [P, N] passes
+    # plus an [N, 2*W*32] matmul from every conflict round (the
+    # dominant round cost after the mask recompute).
+    order = jnp.argsort(-pods.priority, stable=True)
+    rank = jnp.zeros((p,), jnp.int32).at[order].set(pod_ids)
 
     def masked_scores(used, group_bits, resident_anti, assignment):
         dyn = _dynamic_mask(pods, used, state.cap, group_bits, resident_anti)
@@ -230,30 +226,33 @@ def assign_parallel(state: ClusterState, pods: PodBatch,
         choice = jnp.argmax(s, axis=1).astype(jnp.int32)
         feasible = jnp.take_along_axis(
             s, choice[:, None], axis=1)[:, 0] > NEG_INF * 0.5
-        # Contenders: one-hot of each feasible pod's chosen node.
-        onehot = feasible[:, None] & (choice[:, None] == jnp.arange(n)[None, :])
-        # Per contested node: best priority, then lowest pod index.
-        prio = jnp.where(onehot, pods.priority[:, None], -jnp.inf)
-        best_prio = jnp.max(prio, axis=0)
-        cand = onehot & (pods.priority[:, None] == best_prio[None, :])
-        idx = jnp.where(cand, pod_ids[:, None], p)
-        best_idx = jnp.min(idx, axis=0)
-        winner = feasible & (best_idx[choice] == pod_ids)
+        # Winner per contested node (best priority, then lowest pod
+        # index): sort unique composite keys ``choice * P + rank``
+        # (infeasible pods keyed past every node) and keep the first
+        # key of each node group.
+        key = jnp.where(feasible, choice * p + rank, n * p + rank)
+        perm = jnp.argsort(key)
+        group_id = key[perm] // p
+        first = jnp.concatenate(
+            [jnp.ones((1,), bool), group_id[1:] != group_id[:-1]])
+        winner = jnp.zeros((p,), bool).at[perm].set(
+            first & (group_id < n))
 
         new_assignment = jnp.where(winner, choice, assignment)
         safe = jnp.where(winner, choice, 0)
         add = jnp.where(winner[:, None], pods.req, 0.0)
         new_used = used.at[safe].add(add, mode="drop")
-        w_onehot = onehot & winner[:, None]  # winner implies feasible
         progress = jnp.any(winner)
-        # [N, 2*W*32] win-count per (node, bitplane) via the MXU; 0/1
-        # bf16 inputs with f32 accumulation are exact for any P.
-        counts = jax.lax.dot_general(
-            w_onehot.astype(jnp.bfloat16), pod_planes,
-            (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
-        present = counts > 0.5  # [N, 2*W*32]
-        new_group = group_bits | planes_to_words(present[:, :plane_cols])
-        new_anti = resident_anti | planes_to_words(present[:, plane_cols:])
+        # Winner nodes are unique (one winner per node), so the group
+        # bit-field updates are P gather-OR-scatters, not an N-wide
+        # reduction.  Losers scatter to index n -> dropped.
+        cols = jnp.where(winner, choice, n)
+        new_group = group_bits.at[cols].set(
+            group_bits[jnp.clip(cols, 0, n - 1)] | pods.group_bit,
+            mode="drop")
+        new_anti = resident_anti.at[cols].set(
+            resident_anti[jnp.clip(cols, 0, n - 1)] | pods.anti_bits,
+            mode="drop")
         new_s = masked_scores(new_used, new_group, new_anti, new_assignment)
         return (new_s, new_used, new_group, new_anti, new_assignment,
                 progress)
