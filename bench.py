@@ -58,13 +58,67 @@ def _tpu_reachable_with_retries() -> bool:
     return False
 
 
+def _run_backend_subprocess(backend: str, force_cpu: bool,
+                            timeout_s: float | None = None):
+    """Re-invoke this script pinned to one score backend and parse its
+    headline JSON back into a result-shaped object.
+
+    In the backend-comparison mode EVERY leg runs this way and the
+    parent never initializes a JAX backend at all: the TPU is a
+    single-owner device, so an in-process parent leg would hold the
+    chip and make the second leg's PJRT init fail or hang for the
+    whole timeout."""
+    timeout_s = timeout_s if timeout_s is not None else float(
+        os.environ.get("BENCH_BACKEND_TIMEOUT_S", "900"))
+    env = dict(os.environ)
+    env["BENCH_SCORE_BACKEND"] = backend
+    env["BENCH_SKIP_TPU_PROBE"] = "1"  # parent already probed
+    if force_cpu:
+        env["BENCH_FORCE_CPU"] = "1"
+    proc = subprocess.run([sys.executable, __file__],
+                          capture_output=True, timeout=timeout_s,
+                          env=env)
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"subprocess rc={proc.returncode}: "
+            f"{proc.stderr.decode(errors='replace')[-300:]}")
+    line = proc.stdout.decode().strip().splitlines()[-1]
+    doc = json.loads(line)
+
+    class _Sub:  # duck-typed slice of DensityResult the report reads
+        pods_per_sec = float(doc["value"])
+        pods_bound = int(doc["detail"]["pods_bound"])
+        pods_unschedulable = int(doc["detail"]["pods_unschedulable"])
+        score_p50_ms = float(doc["detail"]["score_p50_ms"])
+        score_p99_ms = float(doc["detail"]["score_p99_ms"])
+        encode_p99_ms = float(doc["detail"]["encode_p99_ms"])
+        bind_p99_ms = float(doc["detail"]["bind_p99_ms"])
+        score_samples = int(doc["detail"]["score_samples"])
+        executed_backend = str(doc["detail"]["backend"])
+
+    return _Sub()
+
+
 def main() -> None:
-    if os.environ.get("BENCH_SKIP_TPU_PROBE", "") != "1" \
+    tpu_ok = True
+    force_cpu = os.environ.get("BENCH_FORCE_CPU", "") == "1"
+    if force_cpu:
+        # Set for backend-subprocesses of a CPU-fallback parent: the
+        # axon sitecustomize overrides JAX_PLATFORMS, so without this
+        # the child would hang on the same wedged-tunnel init the
+        # parent already dodged.
+        tpu_ok = False
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    elif os.environ.get("BENCH_SKIP_TPU_PROBE", "") != "1" \
             and not _tpu_reachable_with_retries():
         # Degrade to CPU instead of hanging the driver: the JSON line
         # still appears, flagged via detail.backend (reported from
         # jax.default_backend() after the run, so it is always the
         # backend that actually executed).
+        tpu_ok = False
+        force_cpu = True
         import jax
 
         jax.config.update("jax_platforms", "cpu")
@@ -86,47 +140,65 @@ def main() -> None:
     mode = os.environ.get("BENCH_MODE", "pipeline")
     chunk_batches = int(os.environ.get("BENCH_CHUNK_BATCHES", "16"))
 
-    from kubernetesnetawarescheduler_tpu.bench.density import run_density
-
-    import contextlib
-
-    import jax
-
     # Score-kernel backend comparison (dense XLA vs tiled Pallas):
     # "both" runs the full workload under each and headlines the
     # winner — the measured basis for deploy configs' score_backend.
     # Pallas off-TPU only has the interpreter (orders of magnitude
-    # slow at N=5120), so the CPU fallback pins to xla.
-    on_tpu = jax.default_backend() == "tpu"
+    # slow at N=5120), so the CPU fallback pins to xla.  tpu_ok comes
+    # from the subprocess PROBE, not jax.default_backend(): in
+    # comparison mode the parent must never initialize a backend — the
+    # TPU is single-owner, and a parent holding it would wedge every
+    # child leg's PJRT init.
     backend_env = os.environ.get("BENCH_SCORE_BACKEND",
-                                 "both" if on_tpu else "xla")
+                                 "both" if tpu_ok else "xla")
     backends = (["xla", "pallas"] if backend_env == "both"
                 else [backend_env])
 
-    profile_dir = os.environ.get("BENCH_PROFILE", "")
-    if profile_dir:
-        # JAX profiler trace of the measured window (SURVEY.md §5
-        # tracing row): view with tensorboard or xprof.
-        trace_cm = jax.profiler.trace(profile_dir)
-    else:
-        trace_cm = contextlib.nullcontext()
     results = {}
     errors = {}
-    with trace_cm:
+    executed_backend = ""
+    if len(backends) > 1:
+        # Comparison mode: EVERY leg in its own killable subprocess
+        # (sequential, so each owns the chip in turn); a hung compile
+        # (e.g. first-ever Mosaic lowering on new hardware) costs one
+        # timeout, not the other leg's measurement.
         for backend in backends:
             try:
+                results[backend] = _run_backend_subprocess(
+                    backend, force_cpu=force_cpu)
+                executed_backend = results[backend].executed_backend
+            except Exception as exc:  # noqa: BLE001 — a failing
+                # backend must not discard the other's measurement:
+                # the headline line is the driver's only artifact.
+                errors[backend] = f"{type(exc).__name__}: {exc}"
+                print(f"WARNING: {backend} backend bench failed: "
+                      f"{errors[backend]}", file=sys.stderr)
+    else:
+        from kubernetesnetawarescheduler_tpu.bench.density import (
+            run_density,
+        )
+
+        import contextlib
+
+        import jax
+
+        profile_dir = os.environ.get("BENCH_PROFILE", "")
+        if profile_dir:
+            # JAX profiler trace of the measured window (SURVEY.md §5
+            # tracing row): view with tensorboard or xprof.
+            trace_cm = jax.profiler.trace(profile_dir)
+        else:
+            trace_cm = contextlib.nullcontext()
+        backend = backends[0]
+        try:
+            with trace_cm:
                 results[backend] = run_density(
                     num_nodes=num_nodes, num_pods=num_pods,
                     batch_size=batch, method=method, mode=mode,
                     chunk_batches=chunk_batches, score_backend=backend)
-            except Exception as exc:  # noqa: BLE001 — a failing
-                # backend (e.g. a Mosaic lowering error on new
-                # hardware) must not discard the other backend's
-                # completed measurement: the headline line is the
-                # driver's only artifact.
-                errors[backend] = f"{type(exc).__name__}: {exc}"
-                print(f"WARNING: {backend} backend bench failed: "
-                      f"{errors[backend]}", file=sys.stderr)
+        except Exception as exc:  # noqa: BLE001
+            errors[backend] = f"{type(exc).__name__}: {exc}"
+        executed_backend = jax.default_backend()
     if not results:
         raise SystemExit(f"all score backends failed: {errors}")
     best = max(results, key=lambda b: results[b].pods_per_sec)
@@ -142,7 +214,7 @@ def main() -> None:
         "batch_size": batch,
         "method": method,
         "mode": mode,
-        "backend": jax.default_backend(),
+        "backend": executed_backend,
         "score_backend": best,
     }
     for backend, r in results.items():

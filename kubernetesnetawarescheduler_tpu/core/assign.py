@@ -208,6 +208,13 @@ def assign_parallel(state: ClusterState, pods: PodBatch,
     # dominant round cost after the mask recompute).
     order = jnp.argsort(-pods.priority, stable=True)
     rank = jnp.zeros((p,), jnp.int32).at[order].set(pod_ids)
+    if (n + 1) * p > np.iinfo(np.int32).max:
+        # The composite key below would wrap and silently corrupt
+        # winner selection; int64 needs jax_enable_x64.  (~16M nodes
+        # at P=128 — far past the design envelope, so fail loudly.)
+        raise ValueError(
+            f"max_nodes*max_pods={n}*{p} overflows the int32 "
+            "winner-selection key; reduce the batch or node padding")
 
     def masked_scores(used, group_bits, resident_anti, assignment):
         dyn = _dynamic_mask(pods, used, state.cap, group_bits, resident_anti)
