@@ -101,6 +101,11 @@ class ScoreWeights:
     # the pod (k8s weight scale, 1-100); this scales them into the
     # normalized-score units of the vote/net terms (100 -> 1.0).
     soft_affinity: float = 1.0
+    # Penalty per unit of zone skew for soft topology spread
+    # (``whenUnsatisfiable: ScheduleAnyway``): nodes in zones already
+    # crowded with the pod's group score lower by
+    # ``spread * (count[zone] + 1 - min_count)``.
+    spread: float = 0.5
 
     def metric_vector(self) -> tuple[float, ...]:
         """Per-channel weights aligned with :class:`Metric` order."""
@@ -143,6 +148,11 @@ class SchedulerConfig:
     # terms).  Terms beyond this are dropped in declaration order —
     # soft constraints degrade score-neutrally, unlike hard ones.
     max_soft_terms: int = 2
+    # Topology domains for topologySpreadConstraints (zone-level:
+    # ``topology.kubernetes.io/zone``).  Zones intern on first sight;
+    # nodes past the budget fall into an untracked -1 domain where
+    # spread constraints cannot see them (degrades, never crashes).
+    max_zones: int = 32
 
     num_metrics: int = Metric.COUNT
     num_resources: int = Resource.COUNT

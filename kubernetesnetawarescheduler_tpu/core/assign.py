@@ -38,6 +38,7 @@ from kubernetesnetawarescheduler_tpu.core.score import NEG_INF, _EPS
 from kubernetesnetawarescheduler_tpu.core.state import (
     ClusterState,
     PodBatch,
+    add_zone_counts,
     commit_assignments,
 )
 
@@ -142,8 +143,16 @@ def assign_greedy(state: ClusterState, pods: PodBatch,
     # Stable order: priority descending, index ascending.
     order = jnp.argsort(-pods.priority, stable=True)
 
+    gmax, zmax = state.gz_counts.shape
+    # Zone validity (zones holding >= 1 valid node) is loop-invariant.
+    nz = jnp.where(state.node_valid & (state.node_zone >= 0),
+                   state.node_zone, zmax)
+    zone_valid = jnp.zeros((zmax,), bool).at[nz].set(True, mode="drop")
+    has_zone = state.node_zone >= 0
+    w_spread = jnp.float32(cfg.weights.spread)
+
     def step(carry, pod_idx):
-        used, group_bits, resident_anti = carry
+        used, group_bits, resident_anti, gz = carry
         # Gather this pod's scalars first so the step does O(N*R) work,
         # not O(P*N*R) (computing the full batch tensors and indexing
         # one row would defeat the scan).
@@ -159,8 +168,24 @@ def assign_greedy(state: ClusterState, pods: PodBatch,
         sym = jnp.all(
             (resident_anti & pods.group_bit[pod_idx][None, :]) == 0,
             axis=-1)
-        ok = static_ok[pod_idx] & fits & affinity & anti & sym
-        row = jnp.where(ok, raw[pod_idx] - w_bal * bal_row, NEG_INF)
+        # Topology spread vs the CURRENT counts (score.spread_terms,
+        # single-pod row form).
+        gi = pods.group_idx[pod_idx]
+        cz = gz[jnp.clip(gi, 0, gmax - 1)]             # [Z]
+        min_c = jnp.min(jnp.where(zone_valid, cz, jnp.int32(2**30)))
+        cnt = cz[jnp.clip(state.node_zone, 0, zmax - 1)]
+        skew_after = cnt + 1 - min_c
+        s_active = (pods.spread_maxskew[pod_idx] > 0) & (gi >= 0)
+        violates = (s_active & has_zone
+                    & (skew_after > pods.spread_maxskew[pod_idx]))
+        spread_ok = ~(violates & pods.spread_hard[pod_idx])
+        excess = jnp.maximum(
+            skew_after - pods.spread_maxskew[pod_idx], 0
+        ).astype(jnp.float32)
+        pen = jnp.where(violates & ~pods.spread_hard[pod_idx],
+                        w_spread * excess, 0.0)
+        ok = static_ok[pod_idx] & fits & affinity & anti & sym & spread_ok
+        row = jnp.where(ok, raw[pod_idx] - w_bal * bal_row - pen, NEG_INF)
         choice = jnp.argmax(row).astype(jnp.int32)  # first-max: deterministic
         feasible = row[choice] > NEG_INF * 0.5
         node = jnp.where(feasible, choice, UNASSIGNED)
@@ -174,10 +199,14 @@ def assign_greedy(state: ClusterState, pods: PodBatch,
         abit = jnp.where(placed, pods.anti_bits[pod_idx], jnp.uint32(0))
         resident_anti = resident_anti.at[idx].set(resident_anti[idx] | abit,
                                                   mode="drop")
-        return (used, group_bits, resident_anti), node
+        pzone = state.node_zone[idx]
+        gz = gz.at[jnp.where(placed & (gi >= 0) & (pzone >= 0), gi, gmax),
+                   jnp.where(pzone >= 0, pzone, zmax)].add(1, mode="drop")
+        return (used, group_bits, resident_anti, gz), node
 
-    (_, _, _), nodes_sorted = jax.lax.scan(
-        step, (state.used, state.group_bits, state.resident_anti), order)
+    (_, _, _, _), nodes_sorted = jax.lax.scan(
+        step, (state.used, state.group_bits, state.resident_anti,
+               state.gz_counts), order)
     # Un-permute back to original pod order.
     assignment = jnp.zeros((p,), jnp.int32).at[order].set(nodes_sorted)
     return jnp.where(pods.pod_valid, assignment, UNASSIGNED)
@@ -216,10 +245,13 @@ def assign_parallel(state: ClusterState, pods: PodBatch,
             f"max_nodes*max_pods={n}*{p} overflows the int32 "
             "winner-selection key; reduce the batch or node padding")
 
-    def masked_scores(used, group_bits, resident_anti, assignment):
+    def masked_scores(used, group_bits, resident_anti, gz, assignment):
         dyn = _dynamic_mask(pods, used, state.cap, group_bits, resident_anti)
-        ok = static_ok & dyn & (assignment == UNASSIGNED)[:, None]
-        rows = raw - w_bal * _balance(pods, used, state.cap)
+        spread_pen, spread_ok = score_lib.spread_terms(state, pods, cfg,
+                                                       gz_counts=gz)
+        ok = (static_ok & dyn & spread_ok
+              & (assignment == UNASSIGNED)[:, None])
+        rows = raw - w_bal * _balance(pods, used, state.cap) - spread_pen
         return jnp.where(ok, rows, NEG_INF)
 
     # The score matrix is carried across rounds so it is computed once
@@ -229,7 +261,7 @@ def assign_parallel(state: ClusterState, pods: PodBatch,
         return jnp.any(s > NEG_INF * 0.5) & progress
 
     def body(carry):
-        s, used, group_bits, resident_anti, assignment, _ = carry
+        s, used, group_bits, resident_anti, gz, assignment, _ = carry
         choice = jnp.argmax(s, axis=1).astype(jnp.int32)
         feasible = jnp.take_along_axis(
             s, choice[:, None], axis=1)[:, 0] > NEG_INF * 0.5
@@ -244,6 +276,28 @@ def assign_parallel(state: ClusterState, pods: PodBatch,
             [jnp.ones((1,), bool), group_id[1:] != group_id[:-1]])
         winner = jnp.zeros((p,), bool).at[perm].set(
             first & (group_id < n))
+
+        # Topology-spread round cap: the per-winner skew check above
+        # ran against ROUND-ENTRY counts, so two same-group winners on
+        # DISTINCT nodes of one zone would together overshoot maxSkew.
+        # Demote all but the best-ranked spread-active winner per
+        # (group, zone) — each accepted winner's +1 was individually
+        # checked, and the demoted pods re-pick next round against
+        # updated counts (conservative: never more rounds than pods).
+        zone_of = state.node_zone[jnp.clip(choice, 0, n - 1)]
+        s_active = (winner & (pods.spread_maxskew > 0)
+                    & (pods.group_idx >= 0) & (zone_of >= 0))
+        gzmax = state.gz_counts.shape[0] * state.gz_counts.shape[1]
+        gz_id = jnp.where(
+            s_active,
+            pods.group_idx * state.gz_counts.shape[1] + zone_of,
+            gzmax + rank)  # inert pods: unique singleton groups
+        key2 = gz_id * p + rank
+        perm2 = jnp.argsort(key2)
+        gid2 = key2[perm2] // p
+        first2 = jnp.concatenate(
+            [jnp.ones((1,), bool), gid2[1:] != gid2[:-1]])
+        winner = winner & jnp.zeros((p,), bool).at[perm2].set(first2)
 
         new_assignment = jnp.where(winner, choice, assignment)
         safe = jnp.where(winner, choice, 0)
@@ -260,16 +314,19 @@ def assign_parallel(state: ClusterState, pods: PodBatch,
         new_anti = resident_anti.at[cols].set(
             resident_anti[jnp.clip(cols, 0, n - 1)] | pods.anti_bits,
             mode="drop")
-        new_s = masked_scores(new_used, new_group, new_anti, new_assignment)
-        return (new_s, new_used, new_group, new_anti, new_assignment,
-                progress)
+        new_gz = add_zone_counts(gz, state.node_zone, pods.group_idx,
+                                 choice, winner)
+        new_s = masked_scores(new_used, new_group, new_anti, new_gz,
+                              new_assignment)
+        return (new_s, new_used, new_group, new_anti, new_gz,
+                new_assignment, progress)
 
     init_assignment = jnp.full((p,), UNASSIGNED, jnp.int32)
     init = (masked_scores(state.used, state.group_bits, state.resident_anti,
-                          init_assignment),
+                          state.gz_counts, init_assignment),
             state.used, state.group_bits, state.resident_anti,
-            init_assignment, jnp.bool_(True))
-    _, _, _, _, assignment, _ = jax.lax.while_loop(cond, body, init)
+            state.gz_counts, init_assignment, jnp.bool_(True))
+    _, _, _, _, _, assignment, _ = jax.lax.while_loop(cond, body, init)
     return jnp.where(pods.pod_valid, assignment, UNASSIGNED)
 
 

@@ -46,13 +46,17 @@ from kubernetesnetawarescheduler_tpu.core.encode import (
 _STATE_ARRAYS = (
     "_metrics", "_metrics_age", "_lat", "_bw", "_cap", "_used",
     "_node_valid", "_label_bits", "_taint_bits", "_group_bits",
-    "_resident_anti",
+    "_resident_anti", "_node_zone", "_gz_counts",
 )
 
 # v2: constraint bitmask arrays widened to u32[N, mask_words]; raw
 # node-label sets persisted (lazy label interning needs them to
 # rebuild the reverse map on restore).
-FORMAT_VERSION = 2
+# v3: topology-spread state (_node_zone/_gz_counts arrays, the zone
+# interner table, and per-record group_slot/zone).  v2 checkpoints
+# restore with empty spread state (counts rebuild as pods churn).
+FORMAT_VERSION = 3
+_ACCEPTED_VERSIONS = (2, 3)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -151,9 +155,12 @@ def save_checkpoint(path: str, encoder: Encoder) -> None:
                 uid: [rec.node, [float(x) for x in rec.req],
                       rec.priority, rec.namespace, rec.name,
                       int(rec.group_bit), int(rec.anti_bits),
-                      int(rec.pdb_min)]
+                      int(rec.pdb_min), int(rec.group_slot),
+                      int(rec.zone)]
                 for uid, rec in encoder._committed.items()
             },
+            # Zone interner (topology-spread domains).
+            "zones": dict(encoder._zone_index),
         }
     np.savez_compressed(os.path.join(path, "state.npz"), **arrays)
     tmp = os.path.join(path, "meta.json.tmp")
@@ -169,7 +176,7 @@ def load_checkpoint(path: str,
     match the stored arrays)."""
     with open(os.path.join(path, "meta.json"), encoding="utf-8") as fh:
         meta = json.load(fh)
-    if meta.get("format_version") != FORMAT_VERSION:
+    if meta.get("format_version") not in _ACCEPTED_VERSIONS:
         raise ValueError(
             f"unsupported checkpoint format {meta.get('format_version')}")
     stored_cfg = config_from_dict(meta["config"])
@@ -185,6 +192,8 @@ def load_checkpoint(path: str,
     enc = Encoder(cfg)
     with np.load(os.path.join(path, "state.npz")) as data:
         for name in _STATE_ARRAYS:
+            if name.lstrip("_") not in data:
+                continue  # array added after this checkpoint's version
             stored = data[name.lstrip("_")]
             target = getattr(enc, name)
             if stored.shape != target.shape:
@@ -203,6 +212,8 @@ def load_checkpoint(path: str,
     enc._node_stamp = [0.0] * len(enc._node_names)
     for attr, table in meta["interners"].items():
         getattr(enc, attr)._bits = {k: int(v) for k, v in table.items()}
+    enc._zone_index = {k: int(v)
+                       for k, v in meta.get("zones", {}).items()}
     for idx_s, labels in meta.get("node_labels", {}).items():
         idx = int(idx_s)
         enc._node_labels[idx] = frozenset(labels)
@@ -218,8 +229,11 @@ def load_checkpoint(path: str,
         gbit = int(entry[5]) if len(entry) > 5 else 0
         abits = int(entry[6]) if len(entry) > 6 else 0
         pdb = int(entry[7]) if len(entry) > 7 else 0
+        gslot = int(entry[8]) if len(entry) > 8 else -1
+        zone = int(entry[9]) if len(entry) > 9 else -1
         return CommitRecord(int(idx), np.asarray(req, np.float32), 0.0,
-                            prio, ns, name, gbit, abits, pdb)
+                            prio, ns, name, gbit, abits, pdb,
+                            group_slot=gslot, zone=zone)
 
     enc._committed = {uid: _rec(entry)
                       for uid, entry in meta.get("committed", {}).items()}

@@ -155,6 +155,12 @@ class CommitRecord(NamedTuple):
     # Annotation-level PDB: minimum live members of this pod's group
     # (0 = unprotected).  Preemption planning consumes this.
     pdb_min: int = 0
+    # Topology-spread accounting (AFTER pdb_min: several callers build
+    # records positionally): the group's bit-slot index and the node's
+    # zone AT COMMIT TIME (node slots can be reused; the zone recorded
+    # here is the one the count was added under).
+    group_slot: int = -1
+    zone: int = -1
 
 
 class Encoder:
@@ -204,6 +210,13 @@ class Encoder:
         self._taint_bits = np.zeros((n, w), np.uint32)
         self._group_bits = np.zeros((n, w), np.uint32)
         self._resident_anti = np.zeros((n, w), np.uint32)
+        # Topology spread: interned zone per node (-1 unknown) and the
+        # per-(group bit-slot, zone) scheduled-pod counts — the
+        # resident state behind topologySpreadConstraints.
+        self._node_zone = np.full((n,), -1, np.int32)
+        self._zone_index: dict[str, int] = {}
+        self._gz_counts = np.zeros((32 * w, self.cfg.max_zones),
+                                   np.int32)
         # Per-(node, bit) member counts behind _group_bits /
         # _resident_anti: a bit clears only when its count hits zero
         # (precise release; see release()).
@@ -322,9 +335,31 @@ class Encoder:
             # is exactly what a fresh bit is until granted).
             _fill_words(self._taint_bits[idx],
                         self.taints.mask(node.taints))
+            self._node_zone[idx] = self._intern_zone(node)
             self._dirty["topo"] = True
             self._dirty["alloc"] = True
             return idx
+
+    def _intern_zone(self, node: Node) -> int:
+        """Topology domain id for a node (caller holds the lock):
+        ``Node.zone`` or its ``topology.kubernetes.io/zone=`` label.
+        -1 when absent or past ``max_zones`` — such nodes are invisible
+        to spread constraints (degrades open, never crashes)."""
+        zone = node.zone
+        if not zone:
+            for s in node.labels:
+                if s.startswith("topology.kubernetes.io/zone="):
+                    zone = s.split("=", 1)[1]
+                    break
+        if not zone:
+            return -1
+        zi = self._zone_index.get(zone)
+        if zi is None:
+            if len(self._zone_index) >= self.cfg.max_zones:
+                return -1
+            zi = len(self._zone_index)
+            self._zone_index[zone] = zi
+        return zi
 
     def _set_node_labels(self, idx: int, labels: Iterable[str]) -> None:
         """Record a node's raw label set and rebuild its bit row from
@@ -405,6 +440,7 @@ class Encoder:
             # order moot, but the refcount arrays must agree).
             for uid in [u for u, rec in self._committed.items()
                         if rec.node == idx]:
+                self._gz_sub(self._committed[uid])
                 del self._committed[uid]
                 self._terminating.discard(uid)
             for uid in [u for u, (i, _, _) in self._nominations.items()
@@ -427,6 +463,7 @@ class Encoder:
             self._resident_anti[idx] = 0
             self._group_refs[idx] = 0
             self._anti_refs[idx] = 0
+            self._node_zone[idx] = -1
             self._node_names[idx] = ""
             self._node_gen[idx] += 1
             self._free_slots.append(idx)
@@ -597,11 +634,19 @@ class Encoder:
                     del self._early_releases[pod.uid]
                     keep[i] = False
                     continue
+                gbit = bits[i][0]
+                # Single-bit group mask -> its slot index; the UNKNOWN
+                # sentinel counts nothing (its gz row never matches).
+                gslot = gbit.bit_length() - 1 if gbit else -1
+                zone = int(self._node_zone[int(idx[i])])
                 self._committed[pod.uid] = CommitRecord(
                     int(idx[i]), reqs[i].copy(), time.monotonic(),
                     float(pod.priority), pod.namespace, pod.name,
                     bits[i][0], bits[i][1],
-                    int(getattr(pod, "pdb_min_available", 0)))
+                    int(getattr(pod, "pdb_min_available", 0)),
+                    group_slot=gslot, zone=zone)
+                if gslot >= 0 and zone >= 0:
+                    self._gz_counts[gslot, zone] += 1
                 self._drop_nomination(pod.uid)
             np.add.at(self._used, idx[keep], reqs[keep])
             w = self.cfg.mask_words
@@ -665,6 +710,15 @@ class Encoder:
                                     rec.anti_bits)
             self._resident_anti[rec.node] &= np.invert(
                 int_to_words(cleared, w))
+        self._gz_sub(rec)
+
+    def _gz_sub(self, rec: CommitRecord) -> None:
+        """Reverse one record's topology-spread count (caller holds
+        the lock)."""
+        if rec.group_slot >= 0 and rec.zone >= 0:
+            self._gz_counts[rec.group_slot, rec.zone] = max(
+                0, self._gz_counts[rec.group_slot, rec.zone] - 1)
+            self._dirty["alloc"] = True
 
     @staticmethod
     def _ref_add(refs: np.ndarray, node: int, bits: int) -> None:
@@ -813,10 +867,12 @@ class Encoder:
                     if self._nominations else self._used)
                 self._cache["group_bits"] = jnp.asarray(self._group_bits)
                 self._cache["resident_anti"] = jnp.asarray(self._resident_anti)
+                self._cache["gz_counts"] = jnp.asarray(self._gz_counts)
             if self._dirty["topo"]:
                 self._cache["node_valid"] = jnp.asarray(self._node_valid)
                 self._cache["label_bits"] = jnp.asarray(self._label_bits)
                 self._cache["taint_bits"] = jnp.asarray(self._taint_bits)
+                self._cache["node_zone"] = jnp.asarray(self._node_zone)
             for key in self._dirty:
                 self._dirty[key] = False
             return ClusterState(**self._cache), self._static_version
@@ -954,6 +1010,9 @@ class Encoder:
         ssel_w = np.zeros((p, t_soft), np.float32)
         sgrp = np.zeros((p, t_soft, w), np.uint32)
         sgrp_w = np.zeros((p, t_soft), np.float32)
+        gidx = np.full((p,), -1, np.int32)
+        sp_skew = np.zeros((p,), np.int32)
+        sp_hard = np.zeros((p,), bool)
         with self._lock:
             for i, pod in enumerate(pods):
                 # A nominated preemptor entering scoring: its own
@@ -980,6 +1039,16 @@ class Encoder:
                     _fill_words(row[i], val)
                 self._soft_rows(pod, ssel[i], ssel_w[i],
                                 sgrp[i], sgrp_w[i])
+                gmask = bits[4]
+                gidx[i] = gmask.bit_length() - 1 if gmask else -1
+                sp_skew[i] = int(getattr(pod, "spread_maxskew", 0))
+                sp_hard[i] = bool(getattr(pod, "spread_hard", True))
+                if sp_skew[i] > 0 and gidx[i] < 0:
+                    # A spread constraint with no countable group is
+                    # inert — a DoNotSchedule pod would silently
+                    # schedule anywhere.  Flag it like every other
+                    # constraint degradation.
+                    self._record_degraded(pod, 1)
                 prio[i] = pod.priority
                 valid[i] = True
         return PodBatch(
@@ -989,7 +1058,10 @@ class Encoder:
             anti_bits=jnp.asarray(anti), group_bit=jnp.asarray(gbit),
             priority=jnp.asarray(prio), pod_valid=jnp.asarray(valid),
             soft_sel_bits=jnp.asarray(ssel), soft_sel_w=jnp.asarray(ssel_w),
-            soft_grp_bits=jnp.asarray(sgrp), soft_grp_w=jnp.asarray(sgrp_w))
+            soft_grp_bits=jnp.asarray(sgrp), soft_grp_w=jnp.asarray(sgrp_w),
+            group_idx=jnp.asarray(gidx),
+            spread_maxskew=jnp.asarray(sp_skew),
+            spread_hard=jnp.asarray(sp_hard))
 
     def encode_stream(self, pods: Sequence[Pod],
                       node_of: Callable[[str], str],
@@ -1039,6 +1111,9 @@ class Encoder:
         ssel_w = np.zeros((s, t_soft), np.float32)
         sgrp = np.zeros((s, t_soft, w), np.uint32)
         sgrp_w = np.zeros((s, t_soft), np.float32)
+        gidx = np.full((s,), -1, np.int32)
+        sp_skew = np.zeros((s,), np.int32)
+        sp_hard = np.zeros((s,), bool)
         batch = self.cfg.max_pods
         res_names = _res_names(r)
         with self._lock:
@@ -1070,6 +1145,16 @@ class Encoder:
                     _fill_words(row[i], val)
                 self._soft_rows(pod, ssel[i], ssel_w[i],
                                 sgrp[i], sgrp_w[i])
+                gmask = bits[4]
+                gidx[i] = gmask.bit_length() - 1 if gmask else -1
+                sp_skew[i] = int(getattr(pod, "spread_maxskew", 0))
+                sp_hard[i] = bool(getattr(pod, "spread_hard", True))
+                if sp_skew[i] > 0 and gidx[i] < 0:
+                    # A spread constraint with no countable group is
+                    # inert — a DoNotSchedule pod would silently
+                    # schedule anywhere.  Flag it like every other
+                    # constraint degradation.
+                    self._record_degraded(pod, 1)
                 prio[i] = pod.priority
                 valid[i] = True
         return PodStream(
@@ -1080,4 +1165,7 @@ class Encoder:
             anti_bits=jnp.asarray(anti), group_bit=jnp.asarray(gbit),
             priority=jnp.asarray(prio), pod_valid=jnp.asarray(valid),
             soft_sel_bits=jnp.asarray(ssel), soft_sel_w=jnp.asarray(ssel_w),
-            soft_grp_bits=jnp.asarray(sgrp), soft_grp_w=jnp.asarray(sgrp_w))
+            soft_grp_bits=jnp.asarray(sgrp), soft_grp_w=jnp.asarray(sgrp_w),
+            group_idx=jnp.asarray(gidx),
+            spread_maxskew=jnp.asarray(sp_skew),
+            spread_hard=jnp.asarray(sp_hard))

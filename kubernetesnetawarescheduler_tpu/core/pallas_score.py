@@ -472,7 +472,14 @@ def score_pods_tiled(state: ClusterState, pods: PodBatch,
         scratch_shapes=[pltpu.VMEM((bp, nb), jnp.float32)],
         interpret=interpret,
     )(*args)
-    return out[:p_real, :n_real]
+    out = out[:p_real, :n_real]
+    # Topology spread joins OUTSIDE the tile kernel: it is an O(P*N)
+    # gather over the small [G, Z] count matrix (no N×N streaming to
+    # fuse), and keeping it in XLA keeps one implementation shared
+    # with the dense path and the assign round loop.
+    spread_pen, spread_ok = score_lib.spread_terms(state, pods, cfg)
+    return jnp.where(spread_ok, out - spread_pen,
+                     jnp.float32(float(NEG_INF)))
 
 
 def _pack_inputs(state: ClusterState, pods: PodBatch,

@@ -125,6 +125,17 @@ def plan_preemption(encoder: Encoder, pod: Pod) -> PreemptionPlan | None:
             encoder._constraint_bits(pod, lenient=True)
         taints = encoder._taint_bits[:n_real].copy()
         labels = encoder._label_bits[:n_real].copy()
+        # Topology spread (hard mode only — soft never blocks): the
+        # preemptor's zone-count row and the zone map, so a plan is
+        # never made for a node the spread filter would still mask
+        # after the victims leave.
+        node_zone = encoder._node_zone[:n_real].copy()
+        gslot = gbit_i.bit_length() - 1 if gbit_i else -1
+        spread_skew = int(getattr(pod, "spread_maxskew", 0))
+        spread_gate = (spread_skew > 0 and gslot >= 0
+                       and bool(getattr(pod, "spread_hard", True)))
+        counts0 = (encoder._gz_counts[gslot].copy() if spread_gate
+                   else None)
         # Victim candidates per node: strictly lower priority only.
         # PDB accounting (annotation-level): per group bit, how many
         # members are live cluster-wide and the strictest min-available
@@ -249,6 +260,23 @@ def plan_preemption(encoder: Encoder, pod: Pod) -> PreemptionPlan | None:
                 group_refs[node],
                 [rec.group_bit for _, rec in chosen_recs])
             if not (rem_group & aff_i):
+                continue
+
+        # Hard topology spread must pass AFTER the chosen set leaves
+        # (victims of the preemptor's own group lower their recorded
+        # zone's count); otherwise the eviction would be wasted on a
+        # node the spread filter still masks.  Unknown-zone nodes
+        # degrade open, matching score.spread_terms.
+        if spread_gate and node_zone[node] >= 0:
+            counts = counts0.copy()
+            for _, rec in chosen_recs:
+                if rec.group_slot == gslot and rec.zone >= 0:
+                    counts[rec.zone] = max(0, counts[rec.zone] - 1)
+            valid_zone_counts = [
+                int(counts[z]) for z in range(counts.shape[0])
+                if np.any(valid & (node_zone == z))]
+            min_c = min(valid_zone_counts) if valid_zone_counts else 0
+            if int(counts[node_zone[node]]) + 1 - min_c > spread_skew:
                 continue
 
         chosen = [Victim(uid, rec.namespace, rec.name, rec.priority,

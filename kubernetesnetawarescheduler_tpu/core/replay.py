@@ -70,6 +70,9 @@ class PodStream:
     soft_sel_w: jax.Array     # f32[S, T]
     soft_grp_bits: jax.Array  # u32[S, T, W]
     soft_grp_w: jax.Array     # f32[S, T]
+    group_idx: jax.Array       # i32[S]
+    spread_maxskew: jax.Array  # i32[S]
+    spread_hard: jax.Array     # bool[S]
 
     @property
     def num_pods(self) -> int:
@@ -92,10 +95,11 @@ def _make_step(state: ClusterState, cfg: SchedulerConfig, method: str,
     batch = cfg.max_pods
 
     def step(carry, x):
-        used, group_bits, resident_anti, node_of_pod = carry
+        used, group_bits, resident_anti, gz_counts, node_of_pod = carry
         i, sl = x
         st = state.replace(used=used, group_bits=group_bits,
-                           resident_anti=resident_anti)
+                           resident_anti=resident_anti,
+                           gz_counts=gz_counts)
         # Resolve in-stream peers against assignments made so far; a
         # peer that is still unplaced (or unschedulable) stays -1 and
         # the scoring kernel drops it — traffic to a homeless pod
@@ -110,7 +114,9 @@ def _make_step(state: ClusterState, cfg: SchedulerConfig, method: str,
             group_bit=sl.group_bit, priority=sl.priority,
             pod_valid=sl.pod_valid,
             soft_sel_bits=sl.soft_sel_bits, soft_sel_w=sl.soft_sel_w,
-            soft_grp_bits=sl.soft_grp_bits, soft_grp_w=sl.soft_grp_w)
+            soft_grp_bits=sl.soft_grp_bits, soft_grp_w=sl.soft_grp_w,
+            group_idx=sl.group_idx, spread_maxskew=sl.spread_maxskew,
+            spread_hard=sl.spread_hard)
         if callable(static):
             # Mesh Pallas path: the per-batch static scores are
             # computed here (shard_map'd kernel) and passed into
@@ -123,7 +129,7 @@ def _make_step(state: ClusterState, cfg: SchedulerConfig, method: str,
         st = commit_assignments(st, pods, assignment)
         node_of_pod = jax.lax.dynamic_update_slice_in_dim(
             node_of_pod, assignment, i * batch, 0)
-        return (st.used, st.group_bits, st.resident_anti,
+        return (st.used, st.group_bits, st.resident_anti, st.gz_counts,
                 node_of_pod), assignment
 
     return step
@@ -177,11 +183,13 @@ def replay_folded(state: ClusterState, folded, cfg: SchedulerConfig,
     step = _make_step(state, cfg, method, s_total, static)
     xs = (jnp.arange(nb, dtype=jnp.int32), folded)
     init = (state.used, state.group_bits, state.resident_anti,
+            state.gz_counts,
             jnp.full((s_total,), UNASSIGNED, jnp.int32))
-    (used, group_bits, resident_anti, _), assignments = jax.lax.scan(
-        step, init, xs)
+    (used, group_bits, resident_anti, gz_counts, _), assignments = \
+        jax.lax.scan(step, init, xs)
     final_state = state.replace(used=used, group_bits=group_bits,
-                                resident_anti=resident_anti)
+                                resident_anti=resident_anti,
+                                gz_counts=gz_counts)
     return assignments.reshape(-1), final_state
 
 
@@ -251,6 +259,7 @@ def replay_stream_pipelined(state: ClusterState, stream: PodStream,
         lambda x: jax.device_put(
             jnp.asarray(x).reshape((nb, batch) + x.shape[1:])), stream)
     carry = (state.used, state.group_bits, state.resident_anti,
+             state.gz_counts,
              jnp.full((s_total,), UNASSIGNED, jnp.int32))
 
     from collections import deque
@@ -305,4 +314,7 @@ def pad_stream(stream: PodStream, multiple: int) -> PodStream:
         soft_sel_w=pd(stream.soft_sel_w, 0.0),
         soft_grp_bits=pd(stream.soft_grp_bits, 0),
         soft_grp_w=pd(stream.soft_grp_w, 0.0),
+        group_idx=pd(stream.group_idx, -1),
+        spread_maxskew=pd(stream.spread_maxskew, 0),
+        spread_hard=pd(stream.spread_hard, False),
     )

@@ -224,6 +224,54 @@ def soft_affinity_scores(state: ClusterState, pods: PodBatch,
     return scale * (label_term + group_term)
 
 
+def spread_terms(state: ClusterState, pods: PodBatch,
+                 cfg: SchedulerConfig,
+                 gz_counts: jax.Array | None = None
+                 ) -> tuple[jax.Array, jax.Array]:
+    """Topology-spread penalty and mask, ``(f32[P, N], bool[P, N])``.
+
+    ``topologySpreadConstraints`` at zone granularity: for a pod whose
+    group has ``count[z]`` members in zone ``z``, placing on a node of
+    zone ``z`` is allowed iff ``count[z] + 1 - min(count) <= maxSkew``
+    (kube-scheduler's PodTopologySpread filter formula).  Hard
+    constraints (``whenUnsatisfiable: DoNotSchedule``) mask; soft ones
+    (``ScheduleAnyway``) pay ``weights.spread`` per unit of excess
+    skew.  The counts are DYNAMIC state (placements move them): the
+    conflict loop passes its current ``gz_counts`` carry.
+
+    Documented deviations from kube-scheduler: the counted pod set is
+    the pod's own ``group`` (the same hostname-topology reduction the
+    affinity masks use) rather than an arbitrary labelSelector, and
+    nodes with no interned zone (missing label or zone-interner
+    overflow) are neither masked nor counted — the constraint degrades
+    open on them instead of making whole nodes unschedulable on a
+    bookkeeping boundary.
+    """
+    gz = state.gz_counts if gz_counts is None else gz_counts
+    g, z = gz.shape
+    n = state.num_nodes
+    cpz = gz[jnp.clip(pods.group_idx, 0, g - 1)]        # [P, Z]
+    # Zones that exist: >= 1 valid node interned into them.
+    nz = jnp.where(state.node_valid & (state.node_zone >= 0),
+                   state.node_zone, z)
+    zone_valid = jnp.zeros((z,), bool).at[nz].set(True, mode="drop")
+    big = jnp.int32(2**30)
+    min_c = jnp.min(jnp.where(zone_valid[None, :], cpz, big), axis=1)
+    has_zone = state.node_zone >= 0
+    cnt = cpz[:, jnp.clip(state.node_zone, 0, z - 1)]   # [P, N]
+    skew_after = cnt + 1 - min_c[:, None]
+    active = ((pods.spread_maxskew > 0) & (pods.group_idx >= 0)
+              & pods.pod_valid)
+    violates = (active[:, None] & has_zone[None, :]
+                & (skew_after > pods.spread_maxskew[:, None]))
+    ok = ~(violates & pods.spread_hard[:, None])
+    excess = jnp.maximum(
+        skew_after - pods.spread_maxskew[:, None], 0).astype(jnp.float32)
+    penalty = jnp.where(violates & ~pods.spread_hard[:, None],
+                        jnp.float32(cfg.weights.spread) * excess, 0.0)
+    return penalty, ok
+
+
 def balance_penalty(state: ClusterState, pods: PodBatch) -> jax.Array:
     """Worst-fit fractional utilization after placement, ``f32[P, N]``:
     ``max_r (used[n,r] + req[p,r]) / cap[n,r]``.  Soft bin-packing
@@ -288,6 +336,7 @@ def score_pods(state: ClusterState, pods: PodBatch,
     net = network_scores(state, pods, cfg, ct=ct)
     soft = soft_affinity_scores(state, pods, cfg)
     bal = cfg.weights.balance * balance_penalty(state, pods)
-    raw = base[None, :] + net + soft - bal
-    ok = feasibility_mask(state, pods)
+    spread_pen, spread_ok = spread_terms(state, pods, cfg)
+    raw = base[None, :] + net + soft - bal - spread_pen
+    ok = feasibility_mask(state, pods) & spread_ok
     return jnp.where(ok, raw, NEG_INF)

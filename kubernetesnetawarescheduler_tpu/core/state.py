@@ -62,6 +62,16 @@ class ClusterState:
                                    anti-affinity (a group-G pod may not
                                    join a node hosting a pod that
                                    declared anti-affinity to G)
+    - ``node_zone``    i32[N]      topology domain id per node
+                                   (interned zone label; -1 unknown —
+                                   spread constraints cannot see such
+                                   nodes)
+    - ``gz_counts``    i32[G, Z]   scheduled pods per (group bit-slot,
+                                   zone): the resident state behind
+                                   topologySpreadConstraints
+                                   (``G = 32 * W``, ``Z = max_zones``
+                                   — a few KB, updated on device per
+                                   placement)
     """
 
     metrics: jax.Array
@@ -75,6 +85,8 @@ class ClusterState:
     taint_bits: jax.Array
     group_bits: jax.Array
     resident_anti: jax.Array
+    node_zone: jax.Array
+    gz_counts: jax.Array
 
     @property
     def num_nodes(self) -> int:
@@ -131,6 +143,13 @@ class PodBatch:
     soft_sel_w: jax.Array      # f32[P, T]    signed term weight
     soft_grp_bits: jax.Array   # u32[P, T, W] resident groups (ANY overlap)
     soft_grp_w: jax.Array      # f32[P, T]    signed term weight
+    # Topology spread (zone-level topologySpreadConstraints): the
+    # pod's own group's bit-slot index (-1 = no group), the skew bound
+    # (0 = no constraint), and whether violating it masks
+    # (DoNotSchedule) or only penalizes (ScheduleAnyway).
+    group_idx: jax.Array       # i32[P]
+    spread_maxskew: jax.Array  # i32[P]
+    spread_hard: jax.Array     # bool[P]
 
     @property
     def num_pods(self) -> int:
@@ -157,6 +176,8 @@ def init_cluster_state(cfg: SchedulerConfig, **overrides: Any) -> ClusterState:
         taint_bits=jnp.zeros((n, w), jnp.uint32),
         group_bits=jnp.zeros((n, w), jnp.uint32),
         resident_anti=jnp.zeros((n, w), jnp.uint32),
+        node_zone=jnp.full((n,), -1, jnp.int32),
+        gz_counts=jnp.zeros((32 * w, cfg.max_zones), jnp.int32),
     )
     fields.update(overrides)
     return ClusterState(**fields)
@@ -181,6 +202,9 @@ def init_pod_batch(cfg: SchedulerConfig, **overrides: Any) -> PodBatch:
         soft_sel_w=jnp.zeros((p, cfg.max_soft_terms), jnp.float32),
         soft_grp_bits=jnp.zeros((p, cfg.max_soft_terms, w), jnp.uint32),
         soft_grp_w=jnp.zeros((p, cfg.max_soft_terms), jnp.float32),
+        group_idx=jnp.full((p,), -1, jnp.int32),
+        spread_maxskew=jnp.zeros((p,), jnp.int32),
+        spread_hard=jnp.zeros((p,), jnp.bool_),
     )
     fields.update(overrides)
     return PodBatch(**fields)
@@ -247,7 +271,25 @@ def commit_assignments(state: ClusterState, pods: PodBatch,
         group_bits=state.group_bits | scatter_or_onehot(onehot,
                                                         pods.group_bit),
         resident_anti=state.resident_anti | scatter_or_onehot(
-            onehot, pods.anti_bits))
+            onehot, pods.anti_bits),
+        gz_counts=add_zone_counts(state.gz_counts, state.node_zone,
+                                  pods.group_idx, assignment, placed))
+
+
+def add_zone_counts(gz_counts: jax.Array, node_zone: jax.Array,
+                    group_idx: jax.Array, assignment: jax.Array,
+                    placed: jax.Array) -> jax.Array:
+    """Scatter-add placed pods into the per-(group, zone) count matrix
+    (the topologySpreadConstraints resident state).  Pods without a
+    group slot or landing on a zone-less node scatter out of range and
+    drop."""
+    g = gz_counts.shape[0]
+    z = gz_counts.shape[1]
+    zone = node_zone[jnp.clip(assignment, 0, node_zone.shape[0] - 1)]
+    gi = jnp.where(placed & (group_idx >= 0) & (zone >= 0),
+                   group_idx, g)  # g/z out of range -> dropped
+    zi = jnp.where(zone >= 0, zone, z)
+    return gz_counts.at[gi, zi].add(1, mode="drop")
 
 
 def round_up(x: int, mult: int) -> int:

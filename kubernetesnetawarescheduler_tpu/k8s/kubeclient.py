@@ -207,6 +207,31 @@ def _preferred_group_terms(spec: Mapping, ann: Mapping) -> tuple:
     return tuple(out)
 
 
+def _spread_constraint(spec: Mapping) -> tuple[int, bool]:
+    """First zone-level ``topologySpreadConstraint`` as
+    ``(maxSkew, hard)``; (0, True) = none.
+
+    Scope notes: only ``topology.kubernetes.io/zone`` constraints are
+    representable (hostname-level spreading is anti-affinity's job in
+    this framework), and the counted pod set is the pod's OWN group
+    (``netaware.io/group``) — the labelSelector is not evaluated, per
+    the same hostname-topology reduction every other constraint uses.
+    Unrepresentable constraints are skipped (degrade open)."""
+    for c in spec.get("topologySpreadConstraints") or []:
+        if c.get("topologyKey") != "topology.kubernetes.io/zone":
+            continue
+        try:
+            skew = int(c.get("maxSkew", 0) or 0)
+        except (TypeError, ValueError):
+            continue
+        if skew <= 0:
+            continue
+        hard = c.get("whenUnsatisfiable",
+                     "DoNotSchedule") != "ScheduleAnyway"
+        return skew, hard
+    return 0, True
+
+
 def pod_from_json(obj: Mapping) -> Pod:
     """Map a v1.Pod JSON object to the framework :class:`Pod`."""
     meta = obj.get("metadata", {})
@@ -245,6 +270,7 @@ def pod_from_json(obj: Mapping) -> Pod:
         v = ann.get(key, "")
         return frozenset(x.strip() for x in v.split(",") if x.strip())
 
+    spread_skew, spread_hard = _spread_constraint(spec)
     namespace = meta.get("namespace", "default")
     # Qualify peer references with the pod's own namespace (unless the
     # annotation already says "ns/name"): the pod cache and node_of()
@@ -268,6 +294,8 @@ def pod_from_json(obj: Mapping) -> Pod:
         anti_groups=_csv(ANN_ANTI),
         soft_node_affinity=_preferred_node_terms(spec),
         soft_group_affinity=_preferred_group_terms(spec, ann),
+        spread_maxskew=spread_skew,
+        spread_hard=spread_hard,
         priority=float(spec.get("priority", 0) or 0),
         pdb_min_available=int(ann.get(ANN_PDB, 0) or 0),
     )

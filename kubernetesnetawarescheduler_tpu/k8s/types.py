@@ -80,6 +80,12 @@ class Pod:
     #   weight = preferred spreading).
     soft_node_affinity: tuple = ()
     soft_group_affinity: tuple = ()
+    # Zone-level topologySpreadConstraints (the counted pod set is the
+    # pod's own ``group``): ``spread_maxskew`` 0 disables;
+    # ``spread_hard`` True = whenUnsatisfiable: DoNotSchedule (mask),
+    # False = ScheduleAnyway (score penalty per unit of excess skew).
+    spread_maxskew: int = 0
+    spread_hard: bool = True
     priority: float = 0.0
     # Annotation-level PodDisruptionBudget: at least this many members
     # of the pod's ``group`` must stay up — preemption may not disrupt

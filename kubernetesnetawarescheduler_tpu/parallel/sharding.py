@@ -63,6 +63,10 @@ def state_sharding(mesh: Mesh) -> ClusterState:
         taint_bits=s("tp", None),
         group_bits=s("tp", None),
         resident_anti=s("tp", None),
+        node_zone=s("tp"),
+        # Small [G, Z] count matrix: replicated (every device's assign
+        # round reads arbitrary rows of it).
+        gz_counts=s(None, None),
     )
 
 
@@ -85,6 +89,9 @@ def pods_sharding(mesh: Mesh) -> PodBatch:
         soft_sel_w=s("dp", None),
         soft_grp_bits=s("dp", None, None),
         soft_grp_w=s("dp", None),
+        group_idx=s("dp"),
+        spread_maxskew=s("dp"),
+        spread_hard=s("dp"),
     )
 
 

@@ -65,7 +65,15 @@ def random_instance(rng: np.random.Generator, cfg: SchedulerConfig,
         resident_anti=bits_col(
             rng.integers(0, 4, (n_total,)).astype(np.uint32)
             * np.uint32(1 if with_constraints else 0)),
+        # 3 topology zones over the valid nodes; padding nodes stay -1.
+        node_zone=np.where(node_valid, np.arange(n_total) % 3,
+                           -1).astype(np.int32),
+        gz_counts=np.zeros((32 * w, cfg.max_zones), np.int32),
     )
+    # Seed some resident spread counts so batch-entry skew is nonzero.
+    if with_constraints:
+        state["gz_counts"][32 * (w - 1):32 * (w - 1) + 2, :3] = \
+            rng.integers(0, 3, (2, 3))
 
     pod_valid = np.zeros((p_total,), bool)
     pod_valid[:p] = True
@@ -121,6 +129,23 @@ def random_instance(rng: np.random.Generator, cfg: SchedulerConfig,
         soft_grp_bits=np.stack([bits_col(sgrp[:, t])
                                 for t in range(t_soft)], axis=1),
         soft_grp_w=sgrp_w,
+    )
+    # Topology spread: group_idx derived from the generated group_bit
+    # (single bit in the LAST word), ~1/3 of pods constrained, mixed
+    # hard/soft modes.
+    gb = pods["group_bit"][:, w - 1]
+    group_idx = np.where(
+        gb != 0, 32 * (w - 1) + np.int64(np.log2(
+            np.maximum(gb, 1))), -1).astype(np.int32)
+    has_spread = ((rng.random(p_total) < 0.33) & (group_idx >= 0)
+                  & bool(with_constraints))
+    pods.update(
+        group_idx=group_idx,
+        spread_maxskew=np.where(has_spread,
+                                rng.integers(1, 3, p_total),
+                                0).astype(np.int32),
+        spread_hard=np.asarray(has_spread
+                               & (rng.random(p_total) < 0.5), bool),
     )
     return state, pods
 

@@ -149,6 +149,43 @@ def oracle_soft(state, pods, cfg: SchedulerConfig):
     return out
 
 
+def oracle_spread(state, pods, cfg: SchedulerConfig, gz=None):
+    """Topology-spread (penalty, ok) against the given counts —
+    kube-scheduler's ``count[z] + 1 - min(count) <= maxSkew`` filter
+    formula, soft mode paying weights.spread per unit of excess."""
+    gz = state["gz_counts"] if gz is None else gz
+    g_max, z_max = gz.shape
+    p = pods["req"].shape[0]
+    n = state["cap"].shape[0]
+    zone_valid = [False] * z_max
+    for i in range(n):
+        z = int(state["node_zone"][i])
+        if state["node_valid"][i] and z >= 0:
+            zone_valid[z] = True
+    pen = np.zeros((p, n), np.float32)
+    ok = np.ones((p, n), bool)
+    for i in range(p):
+        gi = int(pods["group_idx"][i])
+        skew_max = int(pods["spread_maxskew"][i])
+        if skew_max <= 0 or gi < 0 or not pods["pod_valid"][i]:
+            continue
+        counts = [int(gz[gi, z]) for z in range(z_max)]
+        valid_counts = [c for z, c in enumerate(counts) if zone_valid[z]]
+        min_c = min(valid_counts) if valid_counts else 0
+        for j in range(n):
+            z = int(state["node_zone"][j])
+            if z < 0:
+                continue  # unknown-zone nodes degrade open
+            skew_after = counts[z] + 1 - min_c
+            if skew_after > skew_max:
+                if pods["spread_hard"][i]:
+                    ok[i, j] = False
+                else:
+                    pen[i, j] = (cfg.weights.spread
+                                 * (skew_after - skew_max))
+    return pen, ok
+
+
 def oracle_balance(state, pods, used=None):
     used = state["used"] if used is None else used
     p = pods["req"].shape[0]
@@ -169,8 +206,9 @@ def oracle_scores(state, pods, cfg: SchedulerConfig):
     net = t @ c.T
     soft = oracle_soft(state, pods, cfg)
     bal = cfg.weights.balance * oracle_balance(state, pods)
-    ok = oracle_feasible(state, pods)
-    raw = base[None, :] + net + soft - bal
+    spread_pen, spread_ok = oracle_spread(state, pods, cfg)
+    ok = oracle_feasible(state, pods) & spread_ok
+    raw = base[None, :] + net + soft - bal - spread_pen
     return np.where(ok, raw, NEG_INF).astype(np.float32)
 
 
@@ -185,6 +223,7 @@ def oracle_assign_greedy(state, pods, cfg: SchedulerConfig):
     used = state["used"].copy()
     group = state["group_bits"].copy()
     res_anti = state["resident_anti"].copy()
+    gz = state["gz_counts"].copy()
     # priority desc, index asc
     order = sorted(range(p), key=lambda i: (-pods["priority"][i], i))
     out = np.full((p,), -1, np.int32)
@@ -193,7 +232,10 @@ def oracle_assign_greedy(state, pods, cfg: SchedulerConfig):
             continue
         ok = oracle_feasible(state, pods, used, group, res_anti)[i]
         bal = cfg.weights.balance * oracle_balance(state, pods, used)[i]
-        row = np.where(ok, base + net[i] + soft[i] - bal, NEG_INF)
+        spread_pen, spread_ok = oracle_spread(state, pods, cfg, gz)
+        ok = ok & spread_ok[i]
+        row = np.where(ok, base + net[i] + soft[i] - bal - spread_pen[i],
+                       NEG_INF)
         j = int(np.argmax(row))
         if row[j] <= NEG_INF * 0.5:
             continue
@@ -201,4 +243,7 @@ def oracle_assign_greedy(state, pods, cfg: SchedulerConfig):
         used[j] += pods["req"][i]
         group[j] |= pods["group_bit"][i]
         res_anti[j] |= pods["anti_bits"][i]
+        gi, z = int(pods["group_idx"][i]), int(state["node_zone"][j])
+        if gi >= 0 and z >= 0:
+            gz[gi, z] += 1
     return out

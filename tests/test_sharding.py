@@ -104,6 +104,9 @@ def test_sharded_replay_matches_single_device():
         soft_grp_bits=jnp.zeros((s, CFG.max_soft_terms, CFG.mask_words),
                                 jnp.uint32),
         soft_grp_w=jnp.zeros((s, CFG.max_soft_terms), jnp.float32),
+        group_idx=jnp.full((s,), -1, jnp.int32),
+        spread_maxskew=jnp.zeros((s,), jnp.int32),
+        spread_hard=jnp.zeros((s,), jnp.bool_),
     )
     want_assign, want_state = replay_stream(state, stream, CFG, "parallel")
     mesh = make_mesh(2, 4)
@@ -172,6 +175,9 @@ def test_sharded_replay_never_gathers_full_nxn():
         soft_sel_w=jnp.zeros((s, t_soft), jnp.float32),
         soft_grp_bits=jnp.zeros((s, t_soft, w), jnp.uint32),
         soft_grp_w=jnp.zeros((s, t_soft), jnp.float32),
+        group_idx=jnp.full((s,), -1, jnp.int32),
+        spread_maxskew=jnp.zeros((s,), jnp.int32),
+        spread_hard=jnp.zeros((s,), jnp.bool_),
     ), cfg.max_pods)
     mesh = make_mesh(2, 4)
     folded = fold_stream(stream, cfg)
@@ -257,7 +263,10 @@ def test_sharded_pallas_replay_matches_dense():
         soft_sel_bits=jnp.asarray(ssel),
         soft_sel_w=jnp.asarray(ssel_w),
         soft_grp_bits=jnp.zeros((s, t, w), jnp.uint32),
-        soft_grp_w=jnp.zeros((s, t), jnp.float32)), cfg.max_pods)
+        soft_grp_w=jnp.zeros((s, t), jnp.float32),
+        group_idx=jnp.full((s,), -1, jnp.int32),
+        spread_maxskew=jnp.zeros((s,), jnp.int32),
+        spread_hard=jnp.zeros((s,), jnp.bool_)), cfg.max_pods)
     cfg_dense = dataclasses.replace(cfg, score_backend="xla")
     want, _ = replay_stream(state, stream, cfg_dense, "parallel")
     mesh = make_mesh(2, 4)
