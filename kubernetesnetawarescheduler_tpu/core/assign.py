@@ -91,15 +91,7 @@ def _static_parts(state: ClusterState, pods: PodBatch, cfg: SchedulerConfig,
     # scoring against committed state (score.soft_affinity_scores).
     soft = score_lib.soft_affinity_scores(state, pods, cfg)
     raw = base[None, :] + net + soft
-    tol = jnp.all(
-        (state.taint_bits[None, :, :] & ~pods.tol_bits[:, None, :]) == 0,
-        axis=-1)
-    sel = jnp.all(
-        (state.label_bits[None, :, :] & pods.sel_bits[:, None, :])
-        == pods.sel_bits[:, None, :], axis=-1)
-    static_ok = (tol & sel & state.node_valid[None, :]
-                 & pods.pod_valid[:, None])
-    return raw, static_ok
+    return raw, score_lib.static_feasibility(state, pods)
 
 
 def _dynamic_mask(pods: PodBatch, used: jax.Array, cap: jax.Array,
@@ -144,10 +136,6 @@ def assign_greedy(state: ClusterState, pods: PodBatch,
     order = jnp.argsort(-pods.priority, stable=True)
 
     gmax, zmax = state.gz_counts.shape
-    # Zone validity (zones holding >= 1 valid node) is loop-invariant.
-    nz = jnp.where(state.node_valid & (state.node_zone >= 0),
-                   state.node_zone, zmax)
-    zone_valid = jnp.zeros((zmax,), bool).at[nz].set(True, mode="drop")
     has_zone = state.node_zone >= 0
     w_spread = jnp.float32(cfg.weights.spread)
 
@@ -169,11 +157,13 @@ def assign_greedy(state: ClusterState, pods: PodBatch,
             (resident_anti & pods.group_bit[pod_idx][None, :]) == 0,
             axis=-1)
         # Topology spread vs the CURRENT counts (score.spread_terms,
-        # single-pod row form).
+        # single-pod row form; Honor-policy min over the pod's
+        # eligible domains via its static mask row).
         gi = pods.group_idx[pod_idx]
         cz = gz[jnp.clip(gi, 0, gmax - 1)]             # [Z]
-        min_c = jnp.min(jnp.where(zone_valid, cz, jnp.int32(2**30)))
         cnt = cz[jnp.clip(state.node_zone, 0, zmax - 1)]
+        elig = static_ok[pod_idx] & has_zone
+        min_c = jnp.min(jnp.where(elig, cnt, jnp.int32(2**30)))
         skew_after = cnt + 1 - min_c
         s_active = (pods.spread_maxskew[pod_idx] > 0) & (gi >= 0)
         violates = (s_active & has_zone
@@ -247,8 +237,8 @@ def assign_parallel(state: ClusterState, pods: PodBatch,
 
     def masked_scores(used, group_bits, resident_anti, gz, assignment):
         dyn = _dynamic_mask(pods, used, state.cap, group_bits, resident_anti)
-        spread_pen, spread_ok = score_lib.spread_terms(state, pods, cfg,
-                                                       gz_counts=gz)
+        spread_pen, spread_ok = score_lib.spread_terms(
+            state, pods, cfg, gz_counts=gz, static_ok=static_ok)
         ok = (static_ok & dyn & spread_ok
               & (assignment == UNASSIGNED)[:, None])
         rows = raw - w_bal * _balance(pods, used, state.cap) - spread_pen

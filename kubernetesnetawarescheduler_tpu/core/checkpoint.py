@@ -193,7 +193,15 @@ def load_checkpoint(path: str,
     with np.load(os.path.join(path, "state.npz")) as data:
         for name in _STATE_ARRAYS:
             if name.lstrip("_") not in data:
-                continue  # array added after this checkpoint's version
+                # Only a v2 checkpoint may legitimately lack the v3
+                # spread arrays; a v3 file missing them is corrupt and
+                # must fail loudly, not restore hard constraints
+                # against silently-empty counts.
+                if meta.get("format_version") == 2 and name in (
+                        "_node_zone", "_gz_counts"):
+                    continue
+                raise ValueError(
+                    f"checkpoint state.npz is missing array {name!r}")
             stored = data[name.lstrip("_")]
             target = getattr(enc, name)
             if stored.shape != target.shape:

@@ -477,7 +477,9 @@ def score_pods_tiled(state: ClusterState, pods: PodBatch,
     # gather over the small [G, Z] count matrix (no N×N streaming to
     # fuse), and keeping it in XLA keeps one implementation shared
     # with the dense path and the assign round loop.
-    spread_pen, spread_ok = score_lib.spread_terms(state, pods, cfg)
+    spread_pen, spread_ok = score_lib.spread_terms(
+        state, pods, cfg,
+        static_ok=score_lib.static_feasibility(state, pods))
     return jnp.where(spread_ok, out - spread_pen,
                      jnp.float32(float(NEG_INF)))
 

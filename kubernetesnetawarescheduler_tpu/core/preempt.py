@@ -136,6 +136,18 @@ def plan_preemption(encoder: Encoder, pod: Pod) -> PreemptionPlan | None:
                        and bool(getattr(pod, "spread_hard", True)))
         counts0 = (encoder._gz_counts[gslot].copy() if spread_gate
                    else None)
+        # Eligible domains for the spread min (Honor policy, matching
+        # score.spread_terms): zones holding >= 1 valid node that
+        # passes the POD's taints/selector — loop-invariant, computed
+        # once (not per candidate node).
+        elig_zones: list[int] = []
+        if spread_gate:
+            tol_w = int_to_words(tol_i, w)
+            sel_w = int_to_words(sel_i, w)
+            tol_ok = ((taints & ~tol_w) == 0).all(axis=1)
+            sel_ok = ((labels & sel_w) == sel_w).all(axis=1)
+            elig_nodes = valid & tol_ok & sel_ok & (node_zone >= 0)
+            elig_zones = sorted({int(z) for z in node_zone[elig_nodes]})
         # Victim candidates per node: strictly lower priority only.
         # PDB accounting (annotation-level): per group bit, how many
         # members are live cluster-wide and the strictest min-available
@@ -272,10 +284,8 @@ def plan_preemption(encoder: Encoder, pod: Pod) -> PreemptionPlan | None:
             for _, rec in chosen_recs:
                 if rec.group_slot == gslot and rec.zone >= 0:
                     counts[rec.zone] = max(0, counts[rec.zone] - 1)
-            valid_zone_counts = [
-                int(counts[z]) for z in range(counts.shape[0])
-                if np.any(valid & (node_zone == z))]
-            min_c = min(valid_zone_counts) if valid_zone_counts else 0
+            min_c = (min(int(counts[z]) for z in elig_zones)
+                     if elig_zones else 0)
             if int(counts[node_zone[node]]) + 1 - min_c > spread_skew:
                 continue
 

@@ -157,11 +157,6 @@ def oracle_spread(state, pods, cfg: SchedulerConfig, gz=None):
     g_max, z_max = gz.shape
     p = pods["req"].shape[0]
     n = state["cap"].shape[0]
-    zone_valid = [False] * z_max
-    for i in range(n):
-        z = int(state["node_zone"][i])
-        if state["node_valid"][i] and z >= 0:
-            zone_valid[z] = True
     pen = np.zeros((p, n), np.float32)
     ok = np.ones((p, n), bool)
     for i in range(p):
@@ -170,8 +165,22 @@ def oracle_spread(state, pods, cfg: SchedulerConfig, gz=None):
         if skew_max <= 0 or gi < 0 or not pods["pod_valid"][i]:
             continue
         counts = [int(gz[gi, z]) for z in range(z_max)]
-        valid_counts = [c for z, c in enumerate(counts) if zone_valid[z]]
-        min_c = min(valid_counts) if valid_counts else 0
+        # Honor policy: min over the POD's eligible domains — zones
+        # with >= 1 valid node passing its taints/selector.
+        elig_zone = [False] * z_max
+        for j in range(n):
+            z = int(state["node_zone"][j])
+            if z < 0 or not state["node_valid"][j]:
+                continue
+            tol = (as_int(state["taint_bits"][j])
+                   & ~as_int(pods["tol_bits"][i])) == 0
+            sel = (as_int(state["label_bits"][j])
+                   & as_int(pods["sel_bits"][i])) \
+                == as_int(pods["sel_bits"][i])
+            if tol and sel:
+                elig_zone[z] = True
+        valid_counts = [c for z, c in enumerate(counts) if elig_zone[z]]
+        min_c = min(valid_counts) if valid_counts else 2**30
         for j in range(n):
             z = int(state["node_zone"][j])
             if z < 0:

@@ -276,3 +276,41 @@ def test_preemption_respects_hard_spread():
         min_c = min(int(gz[slot, 0]), int(gz[slot, 1]))
         assert int(gz[slot, z]) + 1 - min_c <= 1, (
             plan.node_name, gz[slot][:2])
+
+
+def test_spread_min_ignores_ineligible_zones():
+    """Honor policy (review finding): a zone the pod cannot land in
+    (selector mismatch) must not drag min(count) to 0 and mask every
+    reachable zone.  gpu zones az-0/az-1 hold 4 group-g pods each;
+    az-2 has only non-gpu nodes and count 0 — a gpu pod with maxSkew=1
+    must still schedule (skew over ELIGIBLE zones is 1)."""
+    import jax.numpy as jnp
+
+    from kubernetesnetawarescheduler_tpu.core.assign import (
+        assign_greedy,
+        assign_parallel,
+    )
+    from kubernetesnetawarescheduler_tpu.core.encode import Encoder
+
+    cfg = SchedulerConfig(max_nodes=8, max_pods=2, max_peers=2,
+                          queue_capacity=300)
+    enc = Encoder(cfg)
+    for i, az in enumerate(("az-0", "az-1", "az-2")):
+        labels = {"gpu=true"} if az != "az-2" else set()
+        enc.upsert_node(Node(name=f"n{i}", capacity={"cpu": 8.0},
+                             zone=az, labels=frozenset(labels)))
+    rng = np.random.default_rng(0)
+    for i in range(3):
+        enc.update_metrics(f"n{i}", sample_metrics(rng), age_s=0.0)
+    # 4 group-g pods resident in each gpu zone.
+    for i in range(8):
+        enc.commit(Pod(name=f"old-{i}", uid=f"old-{i}", group="g",
+                       requests={"cpu": 0.1}), f"n{i % 2}")
+    newpod = Pod(name="new", uid="new", group="g", requests={"cpu": 0.1},
+                 node_selector=frozenset({"gpu=true"}),
+                 spread_maxskew=1, spread_hard=True)
+    batch = enc.encode_pods([newpod], node_of=lambda n: "")
+    state = enc.snapshot()
+    for fn in (assign_parallel, assign_greedy):
+        a = np.asarray(fn(state, batch, cfg))
+        assert a[0] in (0, 1), (fn.__name__, a)  # schedulable on gpu zones
