@@ -74,6 +74,11 @@ class WorkloadSpec:
     # service's group (negative ``soft_group_affinity``).
     soft_zone_fraction: float = 0.0
     soft_spread_fraction: float = 0.0
+    # Topology spread: fraction of pods carrying a zone-level
+    # topologySpreadConstraint on their service's group (maxSkew 1-2;
+    # hard_fraction of those are DoNotSchedule, rest ScheduleAnyway).
+    spread_fraction: float = 0.0
+    spread_hard_fraction: float = 0.5
     zones: int = 2  # must match the ClusterSpec the workload runs on
     seed: int = 0
     cpu_range: tuple[float, float] = (0.1, 4.0)
@@ -254,6 +259,9 @@ def generate_workload(spec: WorkloadSpec,
     pods: list[Pod] = []
     service_of = rng.integers(0, spec.services, spec.num_pods)
     by_service: dict[int, list[str]] = {}
+    # Spread constraints are per-SERVICE (a Deployment template carries
+    # them uniformly), decided on first sight of each service.
+    svc_spread: dict[str, tuple[int, bool]] = {}
     for i in range(spec.num_pods):
         svc = int(service_of[i])
         name = f"pod-{svc:03d}-{i:05d}"
@@ -278,6 +286,14 @@ def generate_workload(spec: WorkloadSpec,
         soft_group = ()
         if rng.random() < spec.soft_spread_fraction:
             soft_group = ((group, -float(rng.uniform(40.0, 100.0))),)
+        if group not in svc_spread:
+            if rng.random() < spec.spread_fraction:
+                svc_spread[group] = (
+                    int(rng.integers(1, 3)),
+                    bool(rng.random() < spec.spread_hard_fraction))
+            else:
+                svc_spread[group] = (0, True)
+        spread_skew, spread_hard = svc_spread[group]
         pods.append(Pod(
             name=name,
             scheduler_name=scheduler_name,
@@ -295,6 +311,8 @@ def generate_workload(spec: WorkloadSpec,
             anti_groups=anti,
             soft_node_affinity=soft_node,
             soft_group_affinity=soft_group,
+            spread_maxskew=spread_skew,
+            spread_hard=spread_hard,
             priority=float(rng.uniform(0, 10)),
         ))
         earlier.append(name)

@@ -473,15 +473,25 @@ def score_pods_tiled(state: ClusterState, pods: PodBatch,
         interpret=interpret,
     )(*args)
     out = out[:p_real, :n_real]
+
     # Topology spread joins OUTSIDE the tile kernel: it is an O(P*N)
     # gather over the small [G, Z] count matrix (no N×N streaming to
     # fuse), and keeping it in XLA keeps one implementation shared
-    # with the dense path and the assign round loop.
-    spread_pen, spread_ok = score_lib.spread_terms(
-        state, pods, cfg,
-        static_ok=score_lib.static_feasibility(state, pods))
-    return jnp.where(spread_ok, out - spread_pen,
-                     jnp.float32(float(NEG_INF)))
+    # with the dense path and the assign round loop.  The whole block
+    # — including the static-eligibility recompute it needs for the
+    # Honor-policy min, which the kernel cannot export — is gated on
+    # any pod actually carrying a constraint, so spread-free batches
+    # pay nothing on the large-N path this kernel exists for.
+    def with_spread(scores):
+        spread_pen, spread_ok = score_lib.spread_terms(
+            state, pods, cfg,
+            static_ok=score_lib.static_feasibility(state, pods))
+        return jnp.where(spread_ok, scores - spread_pen,
+                         jnp.float32(float(NEG_INF)))
+
+    active = ((pods.spread_maxskew > 0) & (pods.group_idx >= 0)
+              & pods.pod_valid)
+    return jax.lax.cond(jnp.any(active), with_spread, lambda s: s, out)
 
 
 def _pack_inputs(state: ClusterState, pods: PodBatch,

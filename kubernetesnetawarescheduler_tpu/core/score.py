@@ -252,11 +252,15 @@ def spread_terms(state: ClusterState, pods: PodBatch,
 
     Documented deviations from kube-scheduler: the counted pod set is
     the pod's own ``group`` (the same hostname-topology reduction the
-    affinity masks use) rather than an arbitrary labelSelector, and
-    nodes with no interned zone (missing label or zone-interner
-    overflow) are neither masked nor counted — the constraint degrades
-    open on them instead of making whole nodes unschedulable on a
-    bookkeeping boundary.
+    affinity masks use) rather than an arbitrary labelSelector; nodes
+    with no interned zone (missing label or zone-interner overflow)
+    are neither masked nor counted — the constraint degrades open on
+    them instead of making whole nodes unschedulable on a bookkeeping
+    boundary; and domain eligibility honors BOTH the selector and the
+    pod's taint tolerations (kube's ``nodeAffinityPolicy: Honor`` +
+    ``nodeTaintsPolicy: Honor`` — kube defaults taints to Ignore, so
+    a fully-tainted zone here drops out of the min instead of
+    blocking the pod everywhere).
     """
     gz = state.gz_counts if gz_counts is None else gz_counts
     g, z = gz.shape
@@ -328,7 +332,8 @@ def static_feasibility(state: ClusterState, pods: PodBatch) -> jax.Array:
             & pods.pod_valid[:, None])
 
 
-def feasibility_mask(state: ClusterState, pods: PodBatch) -> jax.Array:
+def feasibility_mask(state: ClusterState, pods: PodBatch,
+                     static_ok: jax.Array | None = None) -> jax.Array:
     """Hard constraints as a batched ``bool[P, N]`` mask.
 
     Covers what the reference delegated to stock Kubernetes for its own
@@ -357,7 +362,9 @@ def feasibility_mask(state: ClusterState, pods: PodBatch) -> jax.Array:
     sym = jnp.all(
         (state.resident_anti[None, :, :] & pods.group_bit[:, None, :]) == 0,
         axis=-1)
-    return static_feasibility(state, pods) & fits & affinity & anti & sym
+    if static_ok is None:
+        static_ok = static_feasibility(state, pods)
+    return static_ok & fits & affinity & anti & sym
 
 
 def score_pods(state: ClusterState, pods: PodBatch,
@@ -375,8 +382,8 @@ def score_pods(state: ClusterState, pods: PodBatch,
     net = network_scores(state, pods, cfg, ct=ct)
     soft = soft_affinity_scores(state, pods, cfg)
     bal = cfg.weights.balance * balance_penalty(state, pods)
-    spread_pen, spread_ok = spread_terms(
-        state, pods, cfg, static_ok=static_feasibility(state, pods))
+    sok = static_feasibility(state, pods)  # one compute, both uses
+    spread_pen, spread_ok = spread_terms(state, pods, cfg, static_ok=sok)
     raw = base[None, :] + net + soft - bal - spread_pen
-    ok = feasibility_mask(state, pods) & spread_ok
+    ok = feasibility_mask(state, pods, static_ok=sok) & spread_ok
     return jnp.where(ok, raw, NEG_INF)

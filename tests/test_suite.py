@@ -94,3 +94,11 @@ def test_soft_affinity_config_biases_without_violating():
     # Soft push: spread-preferring pods co-locate less than the
     # control run with the term disabled.
     assert m["spread_colocation"] <= m["spread_colocation_control"]
+
+
+def test_spread_config_no_skew_violations():
+    res = suite.run_spread_config(**suite.SMALL["spread"])
+    m = res.metrics
+    assert m["pods_bound"] > 0
+    assert m["hard_spread_groups"] > 0
+    assert m["skew_violations"] == 0
