@@ -204,27 +204,33 @@ def _run_density_device(cluster, loop: SchedulerLoop, pods, cfg,
     queued = loop.queue.pop_batch(len(pods), timeout=0.0)
     num_batches = _round_up(len(queued), cfg.max_pods) // cfg.max_pods
 
-    if warmup:
-        # Compile against a throwaway cluster with identical shapes
-        # (including its own encode pass, so the measured encode is
-        # warm Python, not first-touch imports).
-        wloop = _throwaway_loop(num_nodes, seed, cfg, method)
-        wstream = pad_stream(
-            wloop.encoder.encode_stream(queued, node_of=lambda name: ""),
-            cfg.max_pods)
-        wstate = wloop.encoder.snapshot()
-        if pipeline:
-            for _ in replay_stream_pipelined(wstate, wstream, cfg,
-                                             method, chunk_batches):
-                pass
-        else:
-            wassign, _ = replay_stream(wstate, wstream, cfg, method)
-            np.asarray(wassign)
-
+    # The measured state is uploaded BEFORE the warmup so compilation
+    # reuses the same device buffers: a second throwaway-cluster
+    # snapshot would re-upload another ~2·N²·4 B of lat/bw (~210 MB at
+    # N=5120) — minutes of wall-clock on a tunneled chip for arrays
+    # whose only job is to carry compile shapes the measured state
+    # already has.  The upload sits outside the timed window either
+    # way (a live deployment pays it once at startup).
     state = loop.encoder.snapshot()
     import jax
 
     jax.block_until_ready(state)
+
+    if warmup:
+        # Warm the host encode path against a throwaway ENCODER (so
+        # the measured encode is warm Python, not first-touch
+        # imports), but compile the replay on the measured state.
+        wloop = _throwaway_loop(num_nodes, seed, cfg, method)
+        wstream = pad_stream(
+            wloop.encoder.encode_stream(queued, node_of=lambda name: ""),
+            cfg.max_pods)
+        if pipeline:
+            for _ in replay_stream_pipelined(state, wstream, cfg,
+                                             method, chunk_batches):
+                pass
+        else:
+            wassign, _ = replay_stream(state, wstream, cfg, method)
+            np.asarray(wassign)
     if sampler is not None:
         sampler.start()
 

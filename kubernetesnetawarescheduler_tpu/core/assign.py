@@ -138,6 +138,7 @@ def assign_greedy(state: ClusterState, pods: PodBatch,
     gmax, zmax = state.gz_counts.shape
     has_zone = state.node_zone >= 0
     w_spread = jnp.float32(cfg.weights.spread)
+    sact = score_lib.spread_active(pods)  # [P], loop-invariant
 
     def step(carry, pod_idx):
         used, group_bits, resident_anti, gz = carry
@@ -165,7 +166,7 @@ def assign_greedy(state: ClusterState, pods: PodBatch,
         elig = static_ok[pod_idx] & has_zone
         min_c = jnp.min(jnp.where(elig, cnt, jnp.int32(2**30)))
         skew_after = cnt + 1 - min_c
-        s_active = (pods.spread_maxskew[pod_idx] > 0) & (gi >= 0)
+        s_active = sact[pod_idx]
         violates = (s_active & has_zone
                     & (skew_after > pods.spread_maxskew[pod_idx]))
         spread_ok = ~(violates & pods.spread_hard[pod_idx])
@@ -275,8 +276,7 @@ def assign_parallel(state: ClusterState, pods: PodBatch,
         # checked, and the demoted pods re-pick next round against
         # updated counts (conservative: never more rounds than pods).
         zone_of = state.node_zone[jnp.clip(choice, 0, n - 1)]
-        s_active = (winner & (pods.spread_maxskew > 0)
-                    & (pods.group_idx >= 0) & (zone_of >= 0))
+        s_active = winner & score_lib.spread_active(pods) & (zone_of >= 0)
         gzmax = state.gz_counts.shape[0] * state.gz_counts.shape[1]
         gz_id = jnp.where(
             s_active,

@@ -489,8 +489,7 @@ def score_pods_tiled(state: ClusterState, pods: PodBatch,
         return jnp.where(spread_ok, scores - spread_pen,
                          jnp.float32(float(NEG_INF)))
 
-    active = ((pods.spread_maxskew > 0) & (pods.group_idx >= 0)
-              & pods.pod_valid)
+    active = score_lib.spread_active(pods)
     return jax.lax.cond(jnp.any(active), with_spread, lambda s: s, out)
 
 

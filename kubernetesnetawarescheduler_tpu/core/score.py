@@ -224,6 +224,17 @@ def soft_affinity_scores(state: ClusterState, pods: PodBatch,
     return scale * (label_term + group_term)
 
 
+def spread_active(pods: PodBatch) -> jax.Array:
+    """``bool[P]``: which pods carry a live topology-spread
+    constraint.  The single source of truth for gating the spread
+    block off the hot path — :func:`spread_terms` and the tiled
+    Pallas join (pallas_score.py) must agree on this predicate or the
+    tiled path would silently skip spread for batches the dense path
+    treats as active."""
+    return ((pods.spread_maxskew > 0) & (pods.group_idx >= 0)
+            & pods.pod_valid)
+
+
 def spread_terms(state: ClusterState, pods: PodBatch,
                  cfg: SchedulerConfig,
                  gz_counts: jax.Array | None = None,
@@ -266,8 +277,7 @@ def spread_terms(state: ClusterState, pods: PodBatch,
     g, z = gz.shape
     n = state.num_nodes
     p = pods.num_pods
-    active = ((pods.spread_maxskew > 0) & (pods.group_idx >= 0)
-              & pods.pod_valid)
+    active = spread_active(pods)
 
     def live(_):
         cpz = gz[jnp.clip(pods.group_idx, 0, g - 1)]        # [P, Z]
