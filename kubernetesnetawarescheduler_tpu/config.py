@@ -148,6 +148,14 @@ class SchedulerConfig:
     # terms).  Terms beyond this are dropped in declaration order —
     # soft constraints degrade score-neutrally, unlike hard ones.
     max_soft_terms: int = 2
+    # Hard ``requiredDuringSchedulingIgnoredDuringExecution``
+    # nodeAffinity: up to ``max_ns_terms`` OR'd nodeSelectorTerms per
+    # pod, each AND-ing up to ``max_ns_exprs`` matchExpressions
+    # (In/NotIn/Exists/DoesNotExist).  Hard constraints degrade CLOSED
+    # on overflow (an unrepresentable term/expr can only make the pod
+    # harder to place, never easier) — see Encoder._ns_rows.
+    max_ns_terms: int = 2
+    max_ns_exprs: int = 4
     # Topology domains for topologySpreadConstraints (zone-level:
     # ``topology.kubernetes.io/zone``).  Zones intern on first sight;
     # nodes past the budget fall into an untracked -1 domain where
@@ -238,6 +246,9 @@ class SchedulerConfig:
                 f"need at least {Metric.COUNT} metric channels for parity")
         if self.mask_words <= 0:
             raise ValueError("mask_words must be positive")
+        if self.max_ns_terms <= 0 or self.max_ns_exprs <= 0:
+            raise ValueError("nodeAffinity term/expr budgets must be "
+                             "positive")
         if self.score_backend not in ("xla", "pallas"):
             raise ValueError(
                 f"score_backend must be 'xla' or 'pallas', "

@@ -197,6 +197,11 @@ class Encoder:
         # column when a selector first references an existing label.
         self._node_labels: dict[int, frozenset[str]] = {}
         self._label_nodes: dict[str, set[int]] = {}
+        # Key-presence reverse map for nodeAffinity Exists /
+        # DoesNotExist: label KEY -> nodes carrying any value of it.
+        # Presence bits intern in the same label table under the bare
+        # key (collision-free: full label strings always contain '=').
+        self._label_keys: dict[str, set[int]] = {}
 
         # Staging (host) arrays — mirror of ClusterState fields.
         self._metrics = np.zeros((n, m), np.float32)
@@ -375,6 +380,16 @@ class Encoder:
                         del self._label_nodes[s]
             for s in new - old:
                 self._label_nodes.setdefault(s, set()).add(idx)
+            old_keys = {s.split("=", 1)[0] for s in old}
+            new_keys = {s.split("=", 1)[0] for s in new}
+            for key in old_keys - new_keys:
+                nodes = self._label_keys.get(key)
+                if nodes is not None:
+                    nodes.discard(idx)
+                    if not nodes:
+                        del self._label_keys[key]
+            for key in new_keys - old_keys:
+                self._label_keys.setdefault(key, set()).add(idx)
             self._node_labels[idx] = new
         table = self.labels._bits
         bits = 0
@@ -382,6 +397,11 @@ class Encoder:
             b = table.get(s)
             if b is not None:
                 bits |= 1 << b
+            # Presence bit (Exists/DoesNotExist): interned under the
+            # bare key, set whenever the node carries ANY value of it.
+            kb = table.get(s.split("=", 1)[0])
+            if kb is not None:
+                bits |= 1 << kb
         _fill_words(self._label_bits[idx], bits)
 
     def _selector_mask(self, keys: Iterable[str], lenient: bool) -> int:
@@ -399,6 +419,28 @@ class Encoder:
             out |= b
             if not known and key in table:
                 carriers = self._label_nodes.get(key)
+                if carriers:
+                    word, pos = divmod(table[key], 32)
+                    for idx in carriers:
+                        self._label_bits[idx, word] |= np.uint32(1 << pos)
+                    self._dirty["topo"] = True
+        return out
+
+    def _presence_mask(self, keys: Iterable[str], lenient: bool) -> int:
+        """Intern label-KEY presence bits (nodeAffinity Exists /
+        DoesNotExist), backfilling a newly-interned key onto every node
+        that already carries any value of it (caller holds the lock).
+        Same overflow direction as :meth:`_selector_mask`: UNKNOWN, so
+        an unrepresentable presence requirement matches nowhere."""
+        table = self.labels._bits
+        out = 0
+        for key in keys:
+            known = key in table
+            b = self.labels.bit(key, lenient,
+                                on_overflow=self.labels.unknown)
+            out |= b
+            if not known and key in table:
+                carriers = self._label_keys.get(key)
                 if carriers:
                     word, pos = divmod(table[key], 32)
                     for idx in carriers:
@@ -971,6 +1013,95 @@ class Encoder:
                 _fill_words(grp_bits_row[t], bit)
                 grp_w_row[t] = weight
 
+    def _ns_rows(self, pod: Pod, anyof_row: np.ndarray,
+                 forbid_row: np.ndarray, used_row: np.ndarray,
+                 lenient: bool, record: bool = True) -> None:
+        """Fill one pod's hard-nodeAffinity rows from
+        ``pod.required_node_affinity`` (caller holds the lock).
+
+        Rows are ``anyof u32[T2, E, W]`` / ``forbid u32[T2, W]`` /
+        ``used bool[T2]`` slices.  Ops map to bits as: In -> any-of
+        over the interned ``key=value`` strings; Exists -> any-of over
+        the key-presence bit; NotIn/DoesNotExist -> the term's forbid
+        mask.  Hard constraints degrade CLOSED: terms beyond the
+        budget are dropped (fewer OR branches = stricter), an
+        over-budget or unrepresentable expression marks its term
+        unsatisfiable via the UNKNOWN sentinel (no node carries it),
+        and a pod whose every term degrades away keeps one
+        unsatisfiable term rather than silently losing the constraint.
+        Strict mode raises instead.  Every lenient degradation is
+        recorded for the per-pod ConstraintDegraded event unless
+        ``record=False`` (read-only callers like the preemption
+        planner, which re-encodes a pod the scoring path already
+        recorded).
+        """
+        terms = tuple(getattr(pod, "required_node_affinity", ()) or ())
+        if not terms:
+            return
+        t2, e_max = anyof_row.shape[0], anyof_row.shape[1]
+        unknown = self.labels.unknown
+        degraded = 0
+        if len(terms) > t2:
+            if not lenient:
+                raise ValueError(
+                    f"pod {pod.name}: {len(terms)} nodeSelectorTerms "
+                    f"exceed max_ns_terms={t2}")
+            degraded += len(terms) - t2
+            terms = terms[:t2]
+        for t, term in enumerate(terms):
+            used_row[t] = True
+            anyofs: list[int] = []
+            forbid = 0
+            unsat = False
+            for expr in term:
+                op, key, values = expr[0], expr[1], tuple(expr[2])
+                if op == "In":
+                    if not values:
+                        unsat = True  # k8s validation forbids; closed
+                        continue
+                    anyofs.append(self._selector_mask(
+                        (f"{key}={v}" for v in values), lenient))
+                elif op == "Exists":
+                    anyofs.append(self._presence_mask((key,), lenient))
+                elif op == "NotIn":
+                    m = self._selector_mask(
+                        (f"{key}={v}" for v in values), lenient)
+                    if m & unknown:
+                        # A forbidden value we cannot track: nodes
+                        # carrying it are indistinguishable — closed.
+                        unsat = True
+                    forbid |= m & ~unknown
+                elif op == "DoesNotExist":
+                    m = self._presence_mask((key,), lenient)
+                    if m & unknown:
+                        unsat = True
+                    forbid |= m & ~unknown
+                else:
+                    if not lenient:
+                        raise ValueError(
+                            f"pod {pod.name}: unsupported nodeAffinity "
+                            f"operator {op!r}")
+                    degraded += 1
+                    unsat = True
+            if len(anyofs) > e_max:
+                if not lenient:
+                    raise ValueError(
+                        f"pod {pod.name}: {len(anyofs)} matchExpressions "
+                        f"exceed max_ns_exprs={e_max}")
+                degraded += len(anyofs) - e_max
+                unsat = True
+            if unsat:
+                anyof_row[t].fill(0)
+                _fill_words(anyof_row[t, 0], unknown)
+                forbid_row[t].fill(0)
+                degraded += 1
+            else:
+                for e, m in enumerate(anyofs):
+                    _fill_words(anyof_row[t, e], m)
+                _fill_words(forbid_row[t], forbid)
+        if degraded and record:
+            self._record_degraded(pod, degraded)
+
     def encode_pods(self, pods: Sequence[Pod],
                     node_of: Callable[[str], str],
                     lenient: bool = False,
@@ -1013,6 +1144,10 @@ class Encoder:
         gidx = np.full((p,), -1, np.int32)
         sp_skew = np.zeros((p,), np.int32)
         sp_hard = np.zeros((p,), bool)
+        t2, e_ns = cfg.max_ns_terms, cfg.max_ns_exprs
+        ns_any = np.zeros((p, t2, e_ns, w), np.uint32)
+        ns_forb = np.zeros((p, t2, w), np.uint32)
+        ns_used = np.zeros((p, t2), bool)
         with self._lock:
             for i, pod in enumerate(pods):
                 # A nominated preemptor entering scoring: its own
@@ -1039,6 +1174,8 @@ class Encoder:
                     _fill_words(row[i], val)
                 self._soft_rows(pod, ssel[i], ssel_w[i],
                                 sgrp[i], sgrp_w[i])
+                self._ns_rows(pod, ns_any[i], ns_forb[i], ns_used[i],
+                              lenient)
                 gmask = bits[4]
                 gidx[i] = gmask.bit_length() - 1 if gmask else -1
                 sp_skew[i] = int(getattr(pod, "spread_maxskew", 0))
@@ -1061,7 +1198,10 @@ class Encoder:
             soft_grp_bits=jnp.asarray(sgrp), soft_grp_w=jnp.asarray(sgrp_w),
             group_idx=jnp.asarray(gidx),
             spread_maxskew=jnp.asarray(sp_skew),
-            spread_hard=jnp.asarray(sp_hard))
+            spread_hard=jnp.asarray(sp_hard),
+            ns_anyof=jnp.asarray(ns_any),
+            ns_forbid=jnp.asarray(ns_forb),
+            ns_term_used=jnp.asarray(ns_used))
 
     def encode_stream(self, pods: Sequence[Pod],
                       node_of: Callable[[str], str],
@@ -1114,6 +1254,10 @@ class Encoder:
         gidx = np.full((s,), -1, np.int32)
         sp_skew = np.zeros((s,), np.int32)
         sp_hard = np.zeros((s,), bool)
+        t2, e_ns = cfg.max_ns_terms, cfg.max_ns_exprs
+        ns_any = np.zeros((s, t2, e_ns, w), np.uint32)
+        ns_forb = np.zeros((s, t2, w), np.uint32)
+        ns_used = np.zeros((s, t2), bool)
         batch = self.cfg.max_pods
         res_names = _res_names(r)
         with self._lock:
@@ -1145,6 +1289,8 @@ class Encoder:
                     _fill_words(row[i], val)
                 self._soft_rows(pod, ssel[i], ssel_w[i],
                                 sgrp[i], sgrp_w[i])
+                self._ns_rows(pod, ns_any[i], ns_forb[i], ns_used[i],
+                              lenient)
                 gmask = bits[4]
                 gidx[i] = gmask.bit_length() - 1 if gmask else -1
                 sp_skew[i] = int(getattr(pod, "spread_maxskew", 0))
@@ -1168,4 +1314,7 @@ class Encoder:
             soft_grp_bits=jnp.asarray(sgrp), soft_grp_w=jnp.asarray(sgrp_w),
             group_idx=jnp.asarray(gidx),
             spread_maxskew=jnp.asarray(sp_skew),
-            spread_hard=jnp.asarray(sp_hard))
+            spread_hard=jnp.asarray(sp_hard),
+            ns_anyof=jnp.asarray(ns_any),
+            ns_forbid=jnp.asarray(ns_forb),
+            ns_term_used=jnp.asarray(ns_used))

@@ -325,7 +325,14 @@ def static_scores_tiled(state: ClusterState, pods: PodBatch,
     raw, ok = _static_pallas_call(
         params, t, bw, lat, validk, nodes, nodei, groups, podf, podi,
         cfg=cfg, bp=bp, nb=nb, kb=kb, interpret=interpret)
-    return raw[:p_real, :n_real], ok[:p_real, :n_real] > 0.5
+    # Hard nodeAffinity matchExpressions join OUTSIDE the tile kernel
+    # (like the spread join in score_pods_tiled): the [P, T2, E, W]
+    # any-of banks don't stream over N, and ns_affinity_ok self-gates
+    # on any term being present, so matchExpressions-free batches pay
+    # nothing on this path.
+    return (raw[:p_real, :n_real],
+            (ok[:p_real, :n_real] > 0.5)
+            & score_lib.ns_affinity_ok(state, pods))
 
 
 def pack_group_rows(group_bits: jax.Array, n_pad: int,
@@ -473,6 +480,12 @@ def score_pods_tiled(state: ClusterState, pods: PodBatch,
         interpret=interpret,
     )(*args)
     out = out[:p_real, :n_real]
+
+    # Hard nodeAffinity matchExpressions joins OUTSIDE the tile kernel
+    # (its [P, T2, E, W] banks don't stream over N); ns_affinity_ok
+    # self-gates on any term being present, same as static_scores_tiled.
+    out = jnp.where(score_lib.ns_affinity_ok(state, pods), out,
+                    jnp.float32(float(NEG_INF)))
 
     # Topology spread joins OUTSIDE the tile kernel: it is an O(P*N)
     # gather over the small [G, Z] count matrix (no N×N streaming to

@@ -83,6 +83,22 @@ def _refs_after(refs_row: np.ndarray, evicted_bits: list[int]) -> int:
     return out
 
 
+def _ns_ok_nodes(labels: np.ndarray, ns_any: np.ndarray,
+                 ns_forb: np.ndarray, ns_used: np.ndarray) -> np.ndarray:
+    """Host mirror of the kernel's hard-nodeAffinity mask
+    (score.ns_affinity_ok), ``bool[N]`` over label-bit rows — same
+    bit rows the device sees, so the plan can never target a node the
+    scoring kernel still rejects on matchExpressions."""
+    if not ns_used.any():
+        return np.ones(labels.shape[0], bool)
+    expr_unused = (ns_any == 0).all(axis=-1)                   # [T2, E]
+    hit = ((labels[:, None, None, :] & ns_any[None]) != 0).any(axis=-1)
+    expr_ok = expr_unused[None] | hit                          # [N, T2, E]
+    clean = ((labels[:, None, :] & ns_forb[None]) == 0).all(axis=-1)
+    term_ok = expr_ok.all(axis=2) & clean & ns_used[None]      # [N, T2]
+    return term_ok.any(axis=1)
+
+
 def plan_preemption(encoder: Encoder, pod: Pod) -> PreemptionPlan | None:
     """Find the cheapest eviction set that makes ``pod`` fit somewhere.
 
@@ -123,8 +139,19 @@ def plan_preemption(encoder: Encoder, pod: Pod) -> PreemptionPlan | None:
         # so the label/taint snapshots are taken AFTER it runs.
         tol_i, sel_i, aff_i, anti_i, gbit_i = \
             encoder._constraint_bits(pod, lenient=True)
+        # Hard nodeAffinity matchExpressions: encoded through the SAME
+        # _ns_rows the kernel encode uses (interning + lazy backfill),
+        # so the label snapshot below already carries any bits this
+        # pod's terms just interned.
+        ns_any = np.zeros((cfg.max_ns_terms, cfg.max_ns_exprs, w),
+                          np.uint32)
+        ns_forb = np.zeros((cfg.max_ns_terms, w), np.uint32)
+        ns_used = np.zeros((cfg.max_ns_terms,), bool)
+        encoder._ns_rows(pod, ns_any, ns_forb, ns_used, lenient=True,
+                         record=False)
         taints = encoder._taint_bits[:n_real].copy()
         labels = encoder._label_bits[:n_real].copy()
+        ns_ok = _ns_ok_nodes(labels, ns_any, ns_forb, ns_used)
         # Topology spread (hard mode only — soft never blocks): the
         # preemptor's zone-count row and the zone map, so a plan is
         # never made for a node the spread filter would still mask
@@ -146,7 +173,8 @@ def plan_preemption(encoder: Encoder, pod: Pod) -> PreemptionPlan | None:
             sel_w = int_to_words(sel_i, w)
             tol_ok = ((taints & ~tol_w) == 0).all(axis=1)
             sel_ok = ((labels & sel_w) == sel_w).all(axis=1)
-            elig_nodes = valid & tol_ok & sel_ok & (node_zone >= 0)
+            elig_nodes = (valid & tol_ok & sel_ok & ns_ok
+                          & (node_zone >= 0))
             elig_zones = sorted({int(z) for z in node_zone[elig_nodes]})
         # Victim candidates per node: strictly lower priority only.
         # PDB accounting (annotation-level): per group bit, how many
@@ -183,7 +211,8 @@ def plan_preemption(encoder: Encoder, pod: Pod) -> PreemptionPlan | None:
     sel_w = int_to_words(sel_i, w)
     static_ok = (valid
                  & np.all((taints & ~tol_w) == 0, axis=-1)
-                 & np.all((labels & sel_w) == sel_w, axis=-1))
+                 & np.all((labels & sel_w) == sel_w, axis=-1)
+                 & ns_ok)
 
     best: tuple[float, int, int] | None = None  # (max_vprio, count, node)
     best_set: list[Victim] = []

@@ -73,6 +73,9 @@ class PodStream:
     group_idx: jax.Array       # i32[S]
     spread_maxskew: jax.Array  # i32[S]
     spread_hard: jax.Array     # bool[S]
+    ns_anyof: jax.Array        # u32[S, T2, E, W]
+    ns_forbid: jax.Array       # u32[S, T2, W]
+    ns_term_used: jax.Array    # bool[S, T2]
 
     @property
     def num_pods(self) -> int:
@@ -116,7 +119,8 @@ def _make_step(state: ClusterState, cfg: SchedulerConfig, method: str,
             soft_sel_bits=sl.soft_sel_bits, soft_sel_w=sl.soft_sel_w,
             soft_grp_bits=sl.soft_grp_bits, soft_grp_w=sl.soft_grp_w,
             group_idx=sl.group_idx, spread_maxskew=sl.spread_maxskew,
-            spread_hard=sl.spread_hard)
+            spread_hard=sl.spread_hard, ns_anyof=sl.ns_anyof,
+            ns_forbid=sl.ns_forbid, ns_term_used=sl.ns_term_used)
         if callable(static):
             # Mesh Pallas path: the per-batch static scores are
             # computed here (shard_map'd kernel) and passed into
@@ -317,4 +321,7 @@ def pad_stream(stream: PodStream, multiple: int) -> PodStream:
         group_idx=pd(stream.group_idx, -1),
         spread_maxskew=pd(stream.spread_maxskew, 0),
         spread_hard=pd(stream.spread_hard, False),
+        ns_anyof=pd(stream.ns_anyof, 0),
+        ns_forbid=pd(stream.ns_forbid, 0),
+        ns_term_used=pd(stream.ns_term_used, False),
     )

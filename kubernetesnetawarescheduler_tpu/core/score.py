@@ -327,11 +327,54 @@ def balance_penalty(state: ClusterState, pods: PodBatch) -> jax.Array:
     return jnp.max(frac, axis=-1)
 
 
+def ns_affinity_ok(state: ClusterState, pods: PodBatch) -> jax.Array:
+    """Hard nodeAffinity matchExpressions mask, ``bool[P, N]``.
+
+    A pod passes a node when ANY of its OR'd ``nodeSelectorTerms``
+    passes; a term passes when ALL its any-of expressions hit at least
+    one node label bit (all-zero expr slot = unused = pass) AND the
+    node carries none of the term's forbid bits (NotIn/DoesNotExist).
+    Pods with no terms pass everywhere.  Gated behind a ``lax.cond``
+    on any term being present, so batches without matchExpressions —
+    the common case — skip the ``[P, T2, E, N]`` reduction entirely
+    (same pattern as the spread gate).
+
+    Kubernetes semantics source: ``requiredDuringSchedulingIgnored
+    DuringExecution`` — the *hard* sibling of the preferred stanza the
+    reference's own probe Deployment used
+    (netperfScript/deployment.yaml:17-26); the reference delegated
+    both to stock kube-scheduler.
+    """
+    p = pods.pod_valid.shape[0]
+    n = state.node_valid.shape[0]
+
+    def live(_):
+        labels = state.label_bits                          # u32[N, W]
+        anyof = pods.ns_anyof                              # [P,T2,E,W]
+        expr_unused = jnp.all(anyof == 0, axis=-1)         # [P,T2,E]
+        hit = jnp.any(
+            (anyof[:, :, :, None, :] & labels[None, None, None, :, :])
+            != 0, axis=-1)                                 # [P,T2,E,N]
+        expr_ok = expr_unused[..., None] | hit
+        clean = jnp.all(
+            (pods.ns_forbid[:, :, None, :] & labels[None, None, :, :])
+            == 0, axis=-1)                                 # [P,T2,N]
+        term_ok = (jnp.all(expr_ok, axis=2) & clean
+                   & pods.ns_term_used[:, :, None])
+        no_constraint = ~jnp.any(pods.ns_term_used, axis=1)
+        return no_constraint[:, None] | jnp.any(term_ok, axis=1)
+
+    return jax.lax.cond(jnp.any(pods.ns_term_used), live,
+                        lambda _: jnp.ones((p, n), bool), None)
+
+
 def static_feasibility(state: ClusterState, pods: PodBatch) -> jax.Array:
     """The placement-independent slice of the feasibility mask,
     ``bool[P, N]``: validity, taints ⊆ tolerations, required node
-    labels.  Shared by :func:`feasibility_mask`, the assign seam, and
-    spread's Honor-policy domain eligibility."""
+    labels, hard nodeAffinity matchExpressions.  Shared by
+    :func:`feasibility_mask`, the assign seam, and spread's
+    Honor-policy domain eligibility (nodeAffinity participates in
+    Honor eligibility, matching kube-scheduler)."""
     tol = jnp.all(
         (state.taint_bits[None, :, :] & ~pods.tol_bits[:, None, :]) == 0,
         axis=-1)
@@ -339,7 +382,7 @@ def static_feasibility(state: ClusterState, pods: PodBatch) -> jax.Array:
         (state.label_bits[None, :, :] & pods.sel_bits[:, None, :])
         == pods.sel_bits[:, None, :], axis=-1)
     return (tol & sel & state.node_valid[None, :]
-            & pods.pod_valid[:, None])
+            & pods.pod_valid[:, None] & ns_affinity_ok(state, pods))
 
 
 def feasibility_mask(state: ClusterState, pods: PodBatch,

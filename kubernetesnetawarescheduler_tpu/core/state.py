@@ -150,6 +150,15 @@ class PodBatch:
     group_idx: jax.Array       # i32[P]
     spread_maxskew: jax.Array  # i32[P]
     spread_hard: jax.Array     # bool[P]
+    # Hard nodeAffinity matchExpressions (``T2 = cfg.max_ns_terms``
+    # OR'd terms, ``E = cfg.max_ns_exprs`` AND'd expressions each):
+    # an expression passes when the node carries ANY ``ns_anyof`` bit
+    # (all-zero expr slot = unused = pass); a term additionally
+    # requires NO ``ns_forbid`` bit on the node (NotIn/DoesNotExist,
+    # merged per term).  ``ns_term_used`` all-False = no constraint.
+    ns_anyof: jax.Array        # u32[P, T2, E, W]
+    ns_forbid: jax.Array       # u32[P, T2, W]
+    ns_term_used: jax.Array    # bool[P, T2]
 
     @property
     def num_pods(self) -> int:
@@ -205,6 +214,10 @@ def init_pod_batch(cfg: SchedulerConfig, **overrides: Any) -> PodBatch:
         group_idx=jnp.full((p,), -1, jnp.int32),
         spread_maxskew=jnp.zeros((p,), jnp.int32),
         spread_hard=jnp.zeros((p,), jnp.bool_),
+        ns_anyof=jnp.zeros((p, cfg.max_ns_terms, cfg.max_ns_exprs, w),
+                           jnp.uint32),
+        ns_forbid=jnp.zeros((p, cfg.max_ns_terms, w), jnp.uint32),
+        ns_term_used=jnp.zeros((p, cfg.max_ns_terms), jnp.bool_),
     )
     fields.update(overrides)
     return PodBatch(**fields)

@@ -159,6 +159,58 @@ def _preferred_node_terms(spec: Mapping) -> tuple:
     return tuple(out)
 
 
+_NS_OPS = frozenset({"In", "NotIn", "Exists", "DoesNotExist"})
+
+
+def _required_node_terms(spec: Mapping) -> tuple:
+    """``requiredDuringSchedulingIgnoredDuringExecution`` nodeAffinity
+    as ``((("In", key, (v, ...)), ...), ...)`` — OR'd nodeSelectorTerms
+    of AND'd matchExpressions, the HARD sibling of
+    :func:`_preferred_node_terms` (types.Pod.required_node_affinity).
+
+    Hard semantics, so unrepresentable input degrades CLOSED: an
+    expression with an operator outside In/NotIn/Exists/DoesNotExist
+    (Gt/Lt compare numerically, which bit interning cannot) or a
+    malformed shape makes its TERM unsatisfiable (``("In", key, ())``
+    — the encoder maps empty-values In to the UNKNOWN sentinel) rather
+    than being skipped, which would silently widen where the pod may
+    land.  ``matchFields`` (metadata.name matching) is likewise
+    unrepresentable."""
+    na = (spec.get("affinity") or {}).get("nodeAffinity") or {}
+    req = (na.get("requiredDuringSchedulingIgnoredDuringExecution")
+           or {})
+    out = []
+    for term in req.get("nodeSelectorTerms") or []:
+        exprs = []
+        bad = False
+        if term.get("matchFields"):
+            bad = True
+        for e in term.get("matchExpressions") or []:
+            op = e.get("operator")
+            key = e.get("key")
+            values = tuple(str(v) for v in e.get("values") or ())
+            if (op not in _NS_OPS or not key
+                    or (op in ("In", "NotIn") and not values)
+                    or (op in ("Exists", "DoesNotExist") and values)):
+                bad = True
+                continue
+            exprs.append((op, key, values))
+        if bad:
+            out.append((("In", "", ()),))  # unsatisfiable term
+        elif exprs:
+            out.append(tuple(exprs))
+        # A term with no expressions at all matches nothing in k8s
+        # (empty nodeSelectorTerm selects no objects) — dropping it is
+        # OR-equivalent ONLY while another term survives; the
+        # all-terms-empty case is handled below.
+    if not out and (req.get("nodeSelectorTerms") or []):
+        # Every term was empty: k8s semantics are "matches nowhere"
+        # (the pod stays Pending), not "no constraint" — returning ()
+        # here would degrade a hard constraint OPEN.
+        out.append((("In", "", ()),))
+    return tuple(out)
+
+
 def _preferred_group_terms(spec: Mapping, ann: Mapping) -> tuple:
     """Soft pod-(anti-)affinity as ``(("group", weight), ...)``.
 
@@ -289,6 +341,7 @@ def pod_from_json(obj: Mapping) -> Pod:
         peers=peers,
         tolerations=tolerations,
         node_selector=_flatten(spec.get("nodeSelector")),
+        required_node_affinity=_required_node_terms(spec),
         group=ann.get(ANN_GROUP, ""),
         affinity_groups=_csv(ANN_AFFINITY),
         anti_groups=_csv(ANN_ANTI),

@@ -86,6 +86,16 @@ class Pod:
     # False = ScheduleAnyway (score penalty per unit of excess skew).
     spread_maxskew: int = 0
     spread_hard: bool = True
+    # Hard ``requiredDuringSchedulingIgnoredDuringExecution``
+    # nodeAffinity (the matchExpressions form the reference's probe
+    # Deployment used only in its *preferred* stanza,
+    # netperfScript/deployment.yaml:17-26): a tuple of
+    # nodeSelectorTerms, OR'd; each term a tuple of expressions,
+    # AND'd; each expression ``(op, key, values)`` with op one of
+    # "In" / "NotIn" / "Exists" / "DoesNotExist" (Gt/Lt are not
+    # supported and are rejected at parse time).  ``node_selector``
+    # (the map form) ANDs with this, matching Kubernetes.
+    required_node_affinity: tuple = ()
     priority: float = 0.0
     # Annotation-level PodDisruptionBudget: at least this many members
     # of the pod's ``group`` must stay up — preemption may not disrupt

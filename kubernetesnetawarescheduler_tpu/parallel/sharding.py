@@ -92,6 +92,9 @@ def pods_sharding(mesh: Mesh) -> PodBatch:
         group_idx=s("dp"),
         spread_maxskew=s("dp"),
         spread_hard=s("dp"),
+        ns_anyof=s("dp", None, None, None),
+        ns_forbid=s("dp", None, None),
+        ns_term_used=s("dp", None),
     )
 
 
@@ -239,7 +242,14 @@ def pallas_static_builder(cfg: SchedulerConfig, mesh: Mesh):
                 pods, p, p, r_res, mw, t_soft, pf_cols, pi_cols)
             raw, ok = sharded_kernel(params0, t, bw_m, lat_m, validk,
                                      nodes, nodei, groups, podf, podi)
-            return raw, ok > 0.5
+            # nodeAffinity matchExpressions join outside the shard_map
+            # (plain GSPMD ops; self-gated on any term being present),
+            # mirroring the single-device static_scores_tiled.
+            from kubernetesnetawarescheduler_tpu.core.score import (
+                ns_affinity_ok,
+            )
+
+            return raw, (ok > 0.5) & ns_affinity_ok(st, pods)
 
         return static_fn
 

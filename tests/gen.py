@@ -147,6 +147,23 @@ def random_instance(rng: np.random.Generator, cfg: SchedulerConfig,
         spread_hard=np.asarray(has_spread
                                & (rng.random(p_total) < 0.5), bool),
     )
+    # Hard nodeAffinity matchExpressions: ~1/4 of pods carry 1..T2
+    # OR'd terms, each with 1-2 any-of expressions and sometimes a
+    # forbid mask, drawn from the same 3-bit label space as
+    # label_bits (LAST word, exercising multi-word handling).
+    t2, e2 = cfg.max_ns_terms, cfg.max_ns_exprs
+    ns_any = np.zeros((p_total, t2, e2, w), np.uint32)
+    ns_forb = np.zeros((p_total, t2, w), np.uint32)
+    ns_used = np.zeros((p_total, t2), bool)
+    if with_constraints:
+        for i in np.nonzero(rng.random(p_total) < 0.25)[0]:
+            for t in range(int(rng.integers(1, t2 + 1))):
+                ns_used[i, t] = True
+                for e in range(int(rng.integers(1, min(e2, 2) + 1))):
+                    ns_any[i, t, e, w - 1] = np.uint32(rng.integers(1, 8))
+                if rng.random() < 0.5:
+                    ns_forb[i, t, w - 1] = np.uint32(rng.integers(1, 8))
+    pods.update(ns_anyof=ns_any, ns_forbid=ns_forb, ns_term_used=ns_used)
     return state, pods
 
 

@@ -109,10 +109,13 @@ def oracle_feasible(state, pods, used=None, group_bits=None,
                      else resident_anti)
     p = pods["req"].shape[0]
     n = state["cap"].shape[0]
+    ns_ok = oracle_ns_ok(state, pods)
     ok = np.zeros((p, n), bool)
     for i in range(p):
         for j in range(n):
             if not (pods["pod_valid"][i] and state["node_valid"][j]):
+                continue
+            if not ns_ok[i, j]:
                 continue
             fits = all(pods["req"][i, r] <= state["cap"][j, r] - used[j, r] + EPS
                        for r in range(state["cap"].shape[1]))
@@ -125,6 +128,36 @@ def oracle_feasible(state, pods, used=None, group_bits=None,
             anti = (as_int(group_bits[j]) & as_int(pods["anti_bits"][i])) == 0
             sym = (as_int(resident_anti[j]) & as_int(pods["group_bit"][i])) == 0
             ok[i, j] = fits and tol and sel and aff and anti and sym
+    return ok
+
+
+def oracle_ns_ok(state, pods):
+    """Hard nodeAffinity matchExpressions mask (score.ns_affinity_ok
+    mirror): any OR'd term passes when every used any-of expression
+    hits >= 1 node label bit and no forbid bit is present."""
+    p = pods["req"].shape[0]
+    n = state["cap"].shape[0]
+    ok = np.ones((p, n), bool)
+    if "ns_term_used" not in pods:
+        return ok
+    t2, e2 = pods["ns_anyof"].shape[1], pods["ns_anyof"].shape[2]
+    for i in range(p):
+        if not pods["ns_term_used"][i].any():
+            continue
+        for j in range(n):
+            lab = as_int(state["label_bits"][j])
+            any_term = False
+            for t in range(t2):
+                if not pods["ns_term_used"][i, t]:
+                    continue
+                good = (lab & as_int(pods["ns_forbid"][i, t])) == 0
+                for e in range(e2):
+                    a = as_int(pods["ns_anyof"][i, t, e])
+                    if a and (lab & a) == 0:
+                        good = False
+                if good:
+                    any_term = True
+            ok[i, j] = any_term
     return ok
 
 
@@ -157,6 +190,7 @@ def oracle_spread(state, pods, cfg: SchedulerConfig, gz=None):
     g_max, z_max = gz.shape
     p = pods["req"].shape[0]
     n = state["cap"].shape[0]
+    ns_ok = oracle_ns_ok(state, pods)
     pen = np.zeros((p, n), np.float32)
     ok = np.ones((p, n), bool)
     for i in range(p):
@@ -177,7 +211,7 @@ def oracle_spread(state, pods, cfg: SchedulerConfig, gz=None):
             sel = (as_int(state["label_bits"][j])
                    & as_int(pods["sel_bits"][i])) \
                 == as_int(pods["sel_bits"][i])
-            if tol and sel:
+            if tol and sel and ns_ok[i, j]:
                 elig_zone[z] = True
         valid_counts = [c for z, c in enumerate(counts) if elig_zone[z]]
         min_c = min(valid_counts) if valid_counts else 2**30

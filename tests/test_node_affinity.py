@@ -1,0 +1,276 @@
+"""Hard nodeAffinity matchExpressions
+(``requiredDuringSchedulingIgnoredDuringExecution``).
+
+The reference's probe Deployment used only the *preferred* stanza
+(netperfScript/deployment.yaml:17-26) and delegated hard affinity to
+stock kube-scheduler; this framework represents the hard form natively:
+OR'd nodeSelectorTerms of AND'd In/NotIn/Exists/DoesNotExist
+expressions, encoded as any-of/forbid bit banks (core/encode._ns_rows)
+and evaluated in the fused kernel (core/score.ns_affinity_ok).  Hard
+constraints degrade CLOSED on overflow.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from kubernetesnetawarescheduler_tpu.config import SchedulerConfig
+from kubernetesnetawarescheduler_tpu.core.assign import (
+    assign_greedy,
+    assign_parallel,
+)
+from kubernetesnetawarescheduler_tpu.core.encode import Encoder
+from kubernetesnetawarescheduler_tpu.k8s.kubeclient import pod_from_json
+from kubernetesnetawarescheduler_tpu.k8s.types import Node, Pod
+
+
+def _cluster(cfg, labels_by_node: dict[str, set[str]]) -> Encoder:
+    enc = Encoder(cfg)
+    for name, labels in labels_by_node.items():
+        enc.upsert_node(Node(name=name,
+                             capacity={"cpu": 16.0, "mem": 64.0},
+                             labels=frozenset(labels)))
+    return enc
+
+
+def _place(enc: Encoder, pod: Pod, method=assign_parallel) -> int:
+    batch = enc.encode_pods([pod], node_of=lambda s: "", lenient=True)
+    return int(np.asarray(method(enc.snapshot(), batch, enc.cfg))[0])
+
+
+CFG = SchedulerConfig(max_nodes=8, max_pods=4, max_peers=2)
+
+
+def test_in_operator_multi_value():
+    enc = _cluster(CFG, {
+        "a": {"disk=ssd"}, "b": {"disk=hdd"}, "c": {"disk=nvme"}})
+    pod = Pod(name="p", requests={"cpu": 1.0},
+              required_node_affinity=(
+                  (("In", "disk", ("ssd", "nvme")),),))
+    assert enc.node_name(_place(enc, pod)) in ("a", "c")
+    # And the excluded value is truly infeasible: restrict to hdd-only.
+    pod2 = Pod(name="q", requests={"cpu": 1.0},
+               required_node_affinity=((("In", "disk", ("hdd",)),),))
+    assert enc.node_name(_place(enc, pod2)) == "b"
+
+
+def test_terms_are_or_exprs_are_and():
+    enc = _cluster(CFG, {
+        "a": {"disk=ssd", "gpu=yes"},
+        "b": {"disk=ssd"},
+        "c": {"arch=arm"}})
+    # (ssd AND gpu) OR arm -> a or c, never b.
+    pod = Pod(name="p", requests={"cpu": 1.0},
+              required_node_affinity=(
+                  (("In", "disk", ("ssd",)), ("In", "gpu", ("yes",))),
+                  (("In", "arch", ("arm",)),)))
+    for method in (assign_parallel, assign_greedy):
+        got = enc.node_name(_place(enc, pod, method))
+        assert got in ("a", "c")
+    pod_b_only = Pod(name="q", requests={"cpu": 1.0},
+                     required_node_affinity=(
+                         (("In", "disk", ("ssd",)),
+                          ("In", "gpu", ("no",)),),))
+    assert _place(enc, pod_b_only) == -1  # no node has gpu=no
+
+
+def test_notin_excludes_value_carriers():
+    enc = _cluster(CFG, {"a": {"tier=spot"}, "b": {"tier=dedicated"},
+                         "c": set()})
+    pod = Pod(name="p", requests={"cpu": 1.0},
+              required_node_affinity=(
+                  (("NotIn", "tier", ("spot",)),),))
+    # b (different value) and c (no such key) both pass; a never.
+    for _ in range(3):
+        assert enc.node_name(_place(enc, pod)) in ("b", "c")
+
+
+def test_exists_and_doesnotexist():
+    enc = _cluster(CFG, {"a": {"gpu=a100"}, "b": {"gpu=h100"},
+                         "c": {"disk=ssd"}})
+    pod = Pod(name="p", requests={"cpu": 1.0},
+              required_node_affinity=((("Exists", "gpu", ()),),))
+    assert enc.node_name(_place(enc, pod)) in ("a", "b")
+    pod2 = Pod(name="q", requests={"cpu": 1.0},
+               required_node_affinity=(
+                   (("DoesNotExist", "gpu", ()),),))
+    assert enc.node_name(_place(enc, pod2)) == "c"
+
+
+def test_presence_bit_backfills_onto_late_nodes():
+    """A node registered AFTER the presence key was interned still
+    gets the bit (the _label_keys path in _set_node_labels)."""
+    enc = _cluster(CFG, {"a": {"disk=ssd"}})
+    pod = Pod(name="p", requests={"cpu": 1.0},
+              required_node_affinity=((("Exists", "gpu", ()),),))
+    assert _place(enc, pod) == -1  # nobody has the key yet
+    enc.upsert_node(Node(name="late", capacity={"cpu": 16.0, "mem": 64.0},
+                         labels=frozenset({"gpu=l4"})))
+    assert enc.node_name(_place(enc, pod)) == "late"
+
+
+def test_term_overflow_degrades_closed_and_records():
+    cfg = SchedulerConfig(max_nodes=8, max_pods=4, max_peers=2,
+                          max_ns_terms=1)
+    enc = _cluster(cfg, {"a": {"disk=ssd"}, "b": {"arch=arm"}})
+    # Two OR branches with budget 1: the second (arm) is dropped —
+    # stricter, so only "a" remains feasible — and the pod is recorded
+    # as degraded.
+    pod = Pod(name="p", requests={"cpu": 1.0},
+              required_node_affinity=(
+                  (("In", "disk", ("ssd",)),),
+                  (("In", "arch", ("arm",)),)))
+    assert enc.node_name(_place(enc, pod)) == "a"
+    assert ("default", "p", 1) in enc.pop_degraded()
+    # Strict mode refuses instead of silently narrowing.
+    with pytest.raises(ValueError):
+        enc.encode_pods([pod], node_of=lambda s: "", lenient=False)
+
+
+def test_expr_overflow_marks_term_unsatisfiable():
+    cfg = SchedulerConfig(max_nodes=8, max_pods=4, max_peers=2,
+                          max_ns_exprs=1)
+    enc = _cluster(cfg, {"a": {"disk=ssd", "gpu=yes"}, "b": {"arch=arm"}})
+    # Term 1 needs 2 expr slots (budget 1) -> unsatisfiable; term 2
+    # still matches b.
+    pod = Pod(name="p", requests={"cpu": 1.0},
+              required_node_affinity=(
+                  (("In", "disk", ("ssd",)), ("In", "gpu", ("yes",))),
+                  (("In", "arch", ("arm",)),)))
+    assert enc.node_name(_place(enc, pod)) == "b"
+    assert enc.pop_degraded()
+
+
+def test_unsupported_operator_degrades_closed():
+    enc = _cluster(CFG, {"a": {"cpus=8"}, "b": {"arch=arm"}})
+    pod = Pod(name="p", requests={"cpu": 1.0},
+              required_node_affinity=(
+                  (("Gt", "cpus", ("4",)),),
+                  (("In", "arch", ("arm",)),)))
+    # The Gt term cannot be represented -> that OR branch is
+    # unsatisfiable, the other still works.
+    assert enc.node_name(_place(enc, pod)) == "b"
+    assert enc.pop_degraded()
+
+
+def test_kubeclient_parses_required_stanza():
+    obj = {
+        "metadata": {"name": "p", "uid": "u1"},
+        "spec": {
+            "schedulerName": "netAwareScheduler",
+            "containers": [{"resources": {"requests": {"cpu": "1"}}}],
+            "affinity": {"nodeAffinity": {
+                "requiredDuringSchedulingIgnoredDuringExecution": {
+                    "nodeSelectorTerms": [
+                        {"matchExpressions": [
+                            {"key": "disk", "operator": "In",
+                             "values": ["ssd", "nvme"]},
+                            {"key": "tier", "operator": "NotIn",
+                             "values": ["spot"]}]},
+                        {"matchExpressions": [
+                            {"key": "gpu", "operator": "Exists"}]},
+                        {"matchExpressions": [
+                            {"key": "cpus", "operator": "Gt",
+                             "values": ["4"]}]},
+                    ]}}},
+        },
+    }
+    pod = pod_from_json(obj)
+    assert pod.required_node_affinity == (
+        (("In", "disk", ("ssd", "nvme")), ("NotIn", "tier", ("spot",))),
+        (("Exists", "gpu", ()),),
+        (("In", "", ()),),  # Gt: unrepresentable -> unsatisfiable term
+    )
+
+
+def test_kubeclient_ignores_absent_stanza():
+    obj = {"metadata": {"name": "p"}, "spec": {"containers": []}}
+    assert pod_from_json(obj).required_node_affinity == ()
+
+
+def test_kubeclient_all_empty_terms_degrade_closed():
+    """``nodeSelectorTerms: [{}]`` matches nowhere in k8s (empty term
+    selects no objects); it must NOT parse to 'no constraint'."""
+    obj = {"metadata": {"name": "p"}, "spec": {
+        "containers": [],
+        "affinity": {"nodeAffinity": {
+            "requiredDuringSchedulingIgnoredDuringExecution": {
+                "nodeSelectorTerms": [{}]}}}}}
+    pod = pod_from_json(obj)
+    assert pod.required_node_affinity == ((("In", "", ()),),)
+    enc = _cluster(CFG, {"a": {"disk=ssd"}})
+    assert _place(enc, pod) == -1
+
+
+def test_preemption_honors_node_affinity():
+    """The planner must not evict victims from a node the kernel's
+    matchExpressions mask still rejects (the advisor's round-1 class
+    of bug, extended to the new constraint)."""
+    from kubernetesnetawarescheduler_tpu.core.preempt import (
+        plan_preemption,
+    )
+
+    cfg = SchedulerConfig(max_nodes=4, max_pods=4, max_peers=2)
+    enc = Encoder(cfg)
+    for name, labels in (("a", {"disk=ssd"}), ("b", {"disk=hdd"})):
+        enc.upsert_node(Node(name=name,
+                             capacity={"cpu": 4.0, "mem": 8.0},
+                             labels=frozenset(labels)))
+    # Fill BOTH nodes with low-priority pods.
+    for i, node in enumerate(("a", "b")):
+        enc.commit(Pod(name=f"low-{i}", uid=f"low-{i}", priority=1.0,
+                       requests={"cpu": 4.0, "mem": 8.0}), node)
+    pod = Pod(name="pre", uid="pre", priority=9.0,
+              requests={"cpu": 2.0, "mem": 1.0},
+              required_node_affinity=((("In", "disk", ("hdd",)),),))
+    plan = plan_preemption(enc, pod)
+    assert plan is not None and plan.node_name == "b"
+    # And when no feasible node exists even with eviction: no plan.
+    pod2 = Pod(name="pre2", uid="pre2", priority=9.0,
+               requests={"cpu": 2.0, "mem": 1.0},
+               required_node_affinity=((("In", "disk", ("tape",)),),))
+    assert plan_preemption(enc, pod2) is None
+
+
+def test_replay_stream_carries_ns_terms():
+    from kubernetesnetawarescheduler_tpu.core.replay import (
+        pad_stream,
+        replay_stream,
+    )
+
+    enc = _cluster(CFG, {"a": {"disk=ssd"}, "b": {"disk=hdd"}})
+    pods = [Pod(name=f"p{i}", requests={"cpu": 1.0},
+                required_node_affinity=((("In", "disk", ("hdd",)),),))
+            for i in range(3)]
+    stream = pad_stream(
+        enc.encode_stream(pods, node_of=lambda s: "", lenient=True),
+        CFG.max_pods)
+    assignment, _ = replay_stream(enc.snapshot(), stream, CFG, "parallel")
+    got = np.asarray(assignment)[:3]
+    assert all(enc.node_name(int(x)) == "b" for x in got)
+
+
+def test_pallas_tiled_matches_dense_with_ns():
+    import dataclasses
+
+    from kubernetesnetawarescheduler_tpu.core.pallas_score import (
+        score_pods_tiled,
+    )
+    from kubernetesnetawarescheduler_tpu.core.score import score_pods
+
+    cfg = dataclasses.replace(CFG, max_nodes=128, use_bfloat16=False)
+    enc = _cluster(cfg, {
+        f"n{i}": {f"disk={'ssd' if i % 2 else 'hdd'}"} for i in range(6)})
+    pod = Pod(name="p", requests={"cpu": 1.0},
+              required_node_affinity=((("In", "disk", ("ssd",)),),))
+    batch = enc.encode_pods([pod], node_of=lambda s: "", lenient=True)
+    state = enc.snapshot()
+    dense = np.asarray(score_pods(state, batch, cfg))
+    tiled = np.asarray(score_pods_tiled(state, batch, cfg,
+                                        interpret=True))
+    # Same feasibility pattern (the ns join), same scores where finite.
+    assert ((dense < -1e29) == (tiled < -1e29)).all()
+    finite = dense > -1e29
+    np.testing.assert_allclose(dense[finite], tiled[finite],
+                               rtol=2e-4, atol=2e-4)

@@ -107,6 +107,11 @@ def test_sharded_replay_matches_single_device():
         group_idx=jnp.full((s,), -1, jnp.int32),
         spread_maxskew=jnp.zeros((s,), jnp.int32),
         spread_hard=jnp.zeros((s,), jnp.bool_),
+        ns_anyof=jnp.zeros((s, CFG.max_ns_terms, CFG.max_ns_exprs,
+                            CFG.mask_words), jnp.uint32),
+        ns_forbid=jnp.zeros((s, CFG.max_ns_terms, CFG.mask_words),
+                            jnp.uint32),
+        ns_term_used=jnp.zeros((s, CFG.max_ns_terms), jnp.bool_),
     )
     want_assign, want_state = replay_stream(state, stream, CFG, "parallel")
     mesh = make_mesh(2, 4)
@@ -178,6 +183,10 @@ def test_sharded_replay_never_gathers_full_nxn():
         group_idx=jnp.full((s,), -1, jnp.int32),
         spread_maxskew=jnp.zeros((s,), jnp.int32),
         spread_hard=jnp.zeros((s,), jnp.bool_),
+        ns_anyof=jnp.zeros((s, cfg.max_ns_terms, cfg.max_ns_exprs, w),
+                           jnp.uint32),
+        ns_forbid=jnp.zeros((s, cfg.max_ns_terms, w), jnp.uint32),
+        ns_term_used=jnp.zeros((s, cfg.max_ns_terms), jnp.bool_),
     ), cfg.max_pods)
     mesh = make_mesh(2, 4)
     folded = fold_stream(stream, cfg)
@@ -266,7 +275,12 @@ def test_sharded_pallas_replay_matches_dense():
         soft_grp_w=jnp.zeros((s, t), jnp.float32),
         group_idx=jnp.full((s,), -1, jnp.int32),
         spread_maxskew=jnp.zeros((s,), jnp.int32),
-        spread_hard=jnp.zeros((s,), jnp.bool_)), cfg.max_pods)
+        spread_hard=jnp.zeros((s,), jnp.bool_),
+        ns_anyof=jnp.zeros((s, cfg.max_ns_terms, cfg.max_ns_exprs, w),
+                           jnp.uint32),
+        ns_forbid=jnp.zeros((s, cfg.max_ns_terms, w), jnp.uint32),
+        ns_term_used=jnp.zeros((s, cfg.max_ns_terms), jnp.bool_)),
+        cfg.max_pods)
     cfg_dense = dataclasses.replace(cfg, score_backend="xla")
     want, _ = replay_stream(state, stream, cfg_dense, "parallel")
     mesh = make_mesh(2, 4)
