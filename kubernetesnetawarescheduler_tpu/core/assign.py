@@ -40,6 +40,8 @@ from kubernetesnetawarescheduler_tpu.core.state import (
     PodBatch,
     add_zone_counts,
     commit_assignments,
+    planes_to_words,
+    scatter_or_onehot,
 )
 
 # np scalar, not jnp — see core/score.py NEG_INF: module-level jnp
@@ -141,7 +143,7 @@ def assign_greedy(state: ClusterState, pods: PodBatch,
     sact = score_lib.spread_active(pods)  # [P], loop-invariant
 
     def step(carry, pod_idx):
-        used, group_bits, resident_anti, gz = carry
+        used, group_bits, resident_anti, gz, az = carry
         # Gather this pod's scalars first so the step does O(N*R) work,
         # not O(P*N*R) (computing the full batch tensors and indexing
         # one row would defeat the scan).
@@ -175,7 +177,25 @@ def assign_greedy(state: ClusterState, pods: PodBatch,
         ).astype(jnp.float32)
         pen = jnp.where(violates & ~pods.spread_hard[pod_idx],
                         w_spread * excess, 0.0)
-        ok = static_ok[pod_idx] & fits & affinity & anti & sym & spread_ok
+        # Zone-scoped (anti-)affinity vs the CURRENT carries
+        # (score.zone_affinity_ok, single-pod row form).
+        zwords = planes_to_words((gz > 0).T)            # u32[Z, W]
+        zrow = jnp.clip(state.node_zone, 0, zmax - 1)
+        pres = zwords[zrow]                              # [N, W]
+        azn = az[zrow]                                   # [N, W]
+        zaff_i = pods.zaff_bits[pod_idx]
+        zone_ok = (
+            (jnp.all(zaff_i == 0)
+             | (has_zone & jnp.any((pres & zaff_i[None, :]) != 0,
+                                   axis=-1)))
+            & (~has_zone | jnp.all(
+                (pres & pods.zanti_bits[pod_idx][None, :]) == 0,
+                axis=-1))
+            & (~has_zone | jnp.all(
+                (azn & pods.group_bit[pod_idx][None, :]) == 0,
+                axis=-1)))
+        ok = (static_ok[pod_idx] & fits & affinity & anti & sym
+              & spread_ok & zone_ok)
         row = jnp.where(ok, raw[pod_idx] - w_bal * bal_row - pen, NEG_INF)
         choice = jnp.argmax(row).astype(jnp.int32)  # first-max: deterministic
         feasible = row[choice] > NEG_INF * 0.5
@@ -193,11 +213,15 @@ def assign_greedy(state: ClusterState, pods: PodBatch,
         pzone = state.node_zone[idx]
         gz = gz.at[jnp.where(placed & (gi >= 0) & (pzone >= 0), gi, gmax),
                    jnp.where(pzone >= 0, pzone, zmax)].add(1, mode="drop")
-        return (used, group_bits, resident_anti, gz), node
+        zbits = jnp.where(placed, pods.zanti_bits[pod_idx], jnp.uint32(0))
+        zidx = jnp.where(placed & (pzone >= 0), pzone, zmax)
+        az = az.at[zidx].set(az[jnp.clip(zidx, 0, zmax - 1)] | zbits,
+                             mode="drop")
+        return (used, group_bits, resident_anti, gz, az), node
 
-    (_, _, _, _), nodes_sorted = jax.lax.scan(
+    (_, _, _, _, _), nodes_sorted = jax.lax.scan(
         step, (state.used, state.group_bits, state.resident_anti,
-               state.gz_counts), order)
+               state.gz_counts, state.az_anti), order)
     # Un-permute back to original pod order.
     assignment = jnp.zeros((p,), jnp.int32).at[order].set(nodes_sorted)
     return jnp.where(pods.pod_valid, assignment, UNASSIGNED)
@@ -236,11 +260,13 @@ def assign_parallel(state: ClusterState, pods: PodBatch,
             f"max_nodes*max_pods={n}*{p} overflows the int32 "
             "winner-selection key; reduce the batch or node padding")
 
-    def masked_scores(used, group_bits, resident_anti, gz, assignment):
+    def masked_scores(used, group_bits, resident_anti, gz, az, assignment):
         dyn = _dynamic_mask(pods, used, state.cap, group_bits, resident_anti)
         spread_pen, spread_ok = score_lib.spread_terms(
             state, pods, cfg, gz_counts=gz, static_ok=static_ok)
-        ok = (static_ok & dyn & spread_ok
+        zone_ok = score_lib.zone_affinity_ok(state, pods, gz_counts=gz,
+                                             az_anti=az)
+        ok = (static_ok & dyn & spread_ok & zone_ok
               & (assignment == UNASSIGNED)[:, None])
         rows = raw - w_bal * _balance(pods, used, state.cap) - spread_pen
         return jnp.where(ok, rows, NEG_INF)
@@ -252,7 +278,7 @@ def assign_parallel(state: ClusterState, pods: PodBatch,
         return jnp.any(s > NEG_INF * 0.5) & progress
 
     def body(carry):
-        s, used, group_bits, resident_anti, gz, assignment, _ = carry
+        s, used, group_bits, resident_anti, gz, az, assignment, _ = carry
         choice = jnp.argmax(s, axis=1).astype(jnp.int32)
         feasible = jnp.take_along_axis(
             s, choice[:, None], axis=1)[:, 0] > NEG_INF * 0.5
@@ -289,6 +315,26 @@ def assign_parallel(state: ClusterState, pods: PodBatch,
             [jnp.ones((1,), bool), gid2[1:] != gid2[:-1]])
         winner = winner & jnp.zeros((p,), bool).at[perm2].set(first2)
 
+        # Zone-anti round cap: the per-winner zone checks ran against
+        # ROUND-ENTRY state, so winner A (group g) and winner B
+        # (zone-anti g) landing in ONE zone the same round would
+        # violate what B's next-round check would reject.  Demote any
+        # winner that zone-conflicts with a better-ranked same-zone
+        # winner (pairwise [P, P] masks — tiny next to the [P, N]
+        # score matrix); the demoted pods re-pick next round against
+        # committed counts.
+        zsame = (winner[:, None] & winner[None, :]
+                 & (zone_of[:, None] == zone_of[None, :])
+                 & (zone_of >= 0)[:, None])
+        pair_conflict = (
+            jnp.any(pods.zanti_bits[:, None, :]
+                    & pods.group_bit[None, :, :], axis=-1)
+            | jnp.any(pods.group_bit[:, None, :]
+                      & pods.zanti_bits[None, :, :], axis=-1))
+        better = rank[:, None] < rank[None, :]
+        demote = jnp.any(zsame & pair_conflict & better, axis=0)
+        winner = winner & ~demote
+
         new_assignment = jnp.where(winner, choice, assignment)
         safe = jnp.where(winner, choice, 0)
         add = jnp.where(winner[:, None], pods.req, 0.0)
@@ -306,17 +352,26 @@ def assign_parallel(state: ClusterState, pods: PodBatch,
             mode="drop")
         new_gz = add_zone_counts(gz, state.node_zone, pods.group_idx,
                                  choice, winner)
+        # Winner ZONES are not unique (several nodes share one), so
+        # the zone-anti residency update is a scatter-OR over a
+        # [P, Z] one-hot, not a set.
+        zmax = az.shape[0]
+        zhot = (winner & (zone_of >= 0))[:, None] & (
+            jnp.clip(zone_of, 0, zmax - 1)[:, None]
+            == jnp.arange(zmax)[None, :])
+        new_az = az | scatter_or_onehot(zhot, pods.zanti_bits)
         new_s = masked_scores(new_used, new_group, new_anti, new_gz,
-                              new_assignment)
-        return (new_s, new_used, new_group, new_anti, new_gz,
+                              new_az, new_assignment)
+        return (new_s, new_used, new_group, new_anti, new_gz, new_az,
                 new_assignment, progress)
 
     init_assignment = jnp.full((p,), UNASSIGNED, jnp.int32)
     init = (masked_scores(state.used, state.group_bits, state.resident_anti,
-                          state.gz_counts, init_assignment),
+                          state.gz_counts, state.az_anti, init_assignment),
             state.used, state.group_bits, state.resident_anti,
-            state.gz_counts, init_assignment, jnp.bool_(True))
-    _, _, _, _, _, assignment, _ = jax.lax.while_loop(cond, body, init)
+            state.gz_counts, state.az_anti, init_assignment,
+            jnp.bool_(True))
+    _, _, _, _, _, _, assignment, _ = jax.lax.while_loop(cond, body, init)
     return jnp.where(pods.pod_valid, assignment, UNASSIGNED)
 
 

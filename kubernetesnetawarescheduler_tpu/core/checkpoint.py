@@ -46,7 +46,7 @@ from kubernetesnetawarescheduler_tpu.core.encode import (
 _STATE_ARRAYS = (
     "_metrics", "_metrics_age", "_lat", "_bw", "_cap", "_used",
     "_node_valid", "_label_bits", "_taint_bits", "_group_bits",
-    "_resident_anti", "_node_zone", "_gz_counts",
+    "_resident_anti", "_node_zone", "_gz_counts", "_az_anti",
 )
 
 # v2: constraint bitmask arrays widened to u32[N, mask_words]; raw
@@ -55,8 +55,10 @@ _STATE_ARRAYS = (
 # v3: topology-spread state (_node_zone/_gz_counts arrays, the zone
 # interner table, and per-record group_slot/zone).  v2 checkpoints
 # restore with empty spread state (counts rebuild as pods churn).
-FORMAT_VERSION = 3
-_ACCEPTED_VERSIONS = (2, 3)
+# v4: zone-scoped anti-affinity residency (_az_anti words + per-record
+# zanti_bits).  Older checkpoints restore with it empty.
+FORMAT_VERSION = 4
+_ACCEPTED_VERSIONS = (2, 3, 4)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -156,7 +158,7 @@ def save_checkpoint(path: str, encoder: Encoder) -> None:
                       rec.priority, rec.namespace, rec.name,
                       int(rec.group_bit), int(rec.anti_bits),
                       int(rec.pdb_min), int(rec.group_slot),
-                      int(rec.zone)]
+                      int(rec.zone), int(rec.zanti_bits)]
                 for uid, rec in encoder._committed.items()
             },
             # Zone interner (topology-spread domains).
@@ -200,6 +202,9 @@ def load_checkpoint(path: str,
                 if meta.get("format_version") == 2 and name in (
                         "_node_zone", "_gz_counts"):
                     continue
+                if meta.get("format_version", 0) <= 3 \
+                        and name == "_az_anti":
+                    continue
                 raise ValueError(
                     f"checkpoint state.npz is missing array {name!r}")
             stored = data[name.lstrip("_")]
@@ -239,9 +244,11 @@ def load_checkpoint(path: str,
         pdb = int(entry[7]) if len(entry) > 7 else 0
         gslot = int(entry[8]) if len(entry) > 8 else -1
         zone = int(entry[9]) if len(entry) > 9 else -1
+        zanti = int(entry[10]) if len(entry) > 10 else 0
         return CommitRecord(int(idx), np.asarray(req, np.float32), 0.0,
                             prio, ns, name, gbit, abits, pdb,
-                            group_slot=gslot, zone=zone)
+                            group_slot=gslot, zone=zone,
+                            zanti_bits=zanti)
 
     enc._committed = {uid: _rec(entry)
                       for uid, entry in meta.get("committed", {}).items()}
@@ -251,20 +258,24 @@ def load_checkpoint(path: str,
             enc._ref_add(enc._group_refs, rec.node, rec.group_bit)
         if rec.anti_bits:
             enc._ref_add(enc._anti_refs, rec.node, rec.anti_bits)
+        if rec.zanti_bits and rec.zone >= 0:
+            enc._ref_add(enc._az_anti_refs, rec.zone, rec.zanti_bits)
     # Bits set in the restored arrays with NO ledger member (ledger
     # entries written before group bits were persisted) get a phantom
     # +1 so a later same-group commit+release cycle cannot clear a bit
     # whose pre-upgrade member may still be running — sticky-
     # conservative, exactly the pre-refcount behavior for those bits.
-    for refs, bit_arr in ((enc._group_refs, enc._group_bits),
-                          (enc._anti_refs, enc._resident_anti)):
-        for node in range(len(enc._node_names)):
-            unaccounted = words_to_int(bit_arr[node])
+    for refs, bit_arr, rows in (
+            (enc._group_refs, enc._group_bits, len(enc._node_names)),
+            (enc._anti_refs, enc._resident_anti, len(enc._node_names)),
+            (enc._az_anti_refs, enc._az_anti, enc._az_anti.shape[0])):
+        for row in range(rows):
+            unaccounted = words_to_int(bit_arr[row])
             while unaccounted:
                 b = unaccounted & -unaccounted
                 pos = b.bit_length() - 1
-                if refs[node, pos] == 0:
-                    refs[node, pos] = 1
+                if refs[row, pos] == 0:
+                    refs[row, pos] = 1
                 unaccounted ^= b
     # Everything is freshly loaded: first snapshot() must upload all.
     for key in enc._dirty:

@@ -161,6 +161,9 @@ class CommitRecord(NamedTuple):
     # here is the one the count was added under).
     group_slot: int = -1
     zone: int = -1
+    # Zone-scoped anti-affinity mask this pod declared (symmetric
+    # residency recorded under ``zone``; 0 = none).
+    zanti_bits: int = 0
 
 
 class Encoder:
@@ -227,6 +230,11 @@ class Encoder:
         # (precise release; see release()).
         self._group_refs = np.zeros((n, 32 * w), np.int32)
         self._anti_refs = np.zeros((n, 32 * w), np.int32)
+        # Zone-scoped symmetric anti-affinity residency: per-ZONE OR of
+        # resident pods' zone-anti masks, refcounted like _anti_refs so
+        # a bit clears only when its last declaring member leaves.
+        self._az_anti = np.zeros((cfg.max_zones, w), np.uint32)
+        self._az_anti_refs = np.zeros((cfg.max_zones, 32 * w), np.int32)
 
         # Usage ledger: uid -> CommitRecord; release() reverses exactly
         # what commit recorded (see the allocation section), and the
@@ -659,7 +667,11 @@ class Encoder:
                     (self.groups.bit(pod.group, lenient=True)
                      if pod.group else 0),
                     (self.groups.mask(pod.anti_groups, lenient=True)
-                     if pod.anti_groups else 0)))
+                     if pod.anti_groups else 0),
+                    (self.groups.mask(
+                        getattr(pod, "zone_anti_groups", ()) or (),
+                        lenient=True)
+                     if getattr(pod, "zone_anti_groups", None) else 0)))
                 if self.groups.overflow_drops > before:
                     self._record_degraded(
                         pod, self.groups.overflow_drops - before)
@@ -681,12 +693,20 @@ class Encoder:
                 # sentinel counts nothing (its gz row never matches).
                 gslot = gbit.bit_length() - 1 if gbit else -1
                 zone = int(self._node_zone[int(idx[i])])
+                zanti = bits[i][2]
+                if zanti and zone < 0:
+                    # A zone-anti declaration landing on a zone-less
+                    # node cannot be recorded (the node is its own
+                    # topology domain) — flag the silent non-
+                    # enforcement like every other degradation.
+                    self._record_degraded(pod, 1)
+                    zanti = 0
                 self._committed[pod.uid] = CommitRecord(
                     int(idx[i]), reqs[i].copy(), time.monotonic(),
                     float(pod.priority), pod.namespace, pod.name,
                     bits[i][0], bits[i][1],
                     int(getattr(pod, "pdb_min_available", 0)),
-                    group_slot=gslot, zone=zone)
+                    group_slot=gslot, zone=zone, zanti_bits=zanti)
                 if gslot >= 0 and zone >= 0:
                     self._gz_counts[gslot, zone] += 1
                 self._drop_nomination(pod.uid)
@@ -706,6 +726,11 @@ class Encoder:
                         rec.anti_bits, w)
                     self._ref_add(self._anti_refs, int(idx[i]),
                                   rec.anti_bits)
+                if rec.zanti_bits:
+                    self._az_anti[rec.zone] |= int_to_words(
+                        rec.zanti_bits, w)
+                    self._ref_add(self._az_anti_refs, rec.zone,
+                                  rec.zanti_bits)
             self._dirty["alloc"] = True
 
     def release(self, pod: Pod, node_name: str = "") -> None:
@@ -751,6 +776,11 @@ class Encoder:
             cleared = self._ref_sub(self._anti_refs, rec.node,
                                     rec.anti_bits)
             self._resident_anti[rec.node] &= np.invert(
+                int_to_words(cleared, w))
+        if rec.zanti_bits and rec.zone >= 0:
+            cleared = self._ref_sub(self._az_anti_refs, rec.zone,
+                                    rec.zanti_bits)
+            self._az_anti[rec.zone] &= np.invert(
                 int_to_words(cleared, w))
         self._gz_sub(rec)
 
@@ -910,6 +940,7 @@ class Encoder:
                 self._cache["group_bits"] = jnp.asarray(self._group_bits)
                 self._cache["resident_anti"] = jnp.asarray(self._resident_anti)
                 self._cache["gz_counts"] = jnp.asarray(self._gz_counts)
+                self._cache["az_anti"] = jnp.asarray(self._az_anti)
             if self._dirty["topo"]:
                 self._cache["node_valid"] = jnp.asarray(self._node_valid)
                 self._cache["label_bits"] = jnp.asarray(self._label_bits)
@@ -942,6 +973,11 @@ class Encoder:
         drops_before = (self.taints.overflow_drops
                         + self.labels.overflow_drops
                         + self.groups.overflow_drops)
+        if lenient and getattr(pod, "parse_degraded", 0):
+            # Constraints already lost at PARSE time (kubeclient
+            # dropped an unrepresentable required term): surface them
+            # through the same per-pod event stream as interner drops.
+            self._record_degraded(pod, int(pod.parse_degraded))
         bits = (
             self.taints.mask(pod.tolerations, lenient),
             self._selector_mask(pod.node_selector, lenient),
@@ -957,6 +993,26 @@ class Encoder:
         if drops_after > drops_before:
             self._record_degraded(pod, drops_after - drops_before)
         return bits
+
+    def _zone_bits(self, pod: Pod, lenient: bool,
+                   record: bool = True) -> tuple[int, int]:
+        """Intern one pod's zone-scoped (anti-)affinity groups →
+        (zaff, zanti) masks in the group bit space.  Overflow
+        direction mirrors the hostname pair: a required zone-affinity
+        group degrades to UNKNOWN (present in no zone — infeasible),
+        a zone-anti group drops (untracked, recorded per pod)."""
+        zaff_src = getattr(pod, "zone_affinity_groups", ()) or ()
+        zanti_src = getattr(pod, "zone_anti_groups", ()) or ()
+        if not zaff_src and not zanti_src:
+            return 0, 0
+        before = self.groups.overflow_drops
+        zaff = self.groups.mask(zaff_src, lenient,
+                                on_overflow=self.groups.unknown)
+        zanti = self.groups.mask(zanti_src, lenient)
+        if record and self.groups.overflow_drops > before:
+            self._record_degraded(
+                pod, self.groups.overflow_drops - before)
+        return zaff, zanti
 
     def _record_degraded(self, pod: Pod, count: int) -> None:
         """Queue one ConstraintDegraded record per pod identity
@@ -1148,6 +1204,8 @@ class Encoder:
         ns_any = np.zeros((p, t2, e_ns, w), np.uint32)
         ns_forb = np.zeros((p, t2, w), np.uint32)
         ns_used = np.zeros((p, t2), bool)
+        zaff = np.zeros((p, w), np.uint32)
+        zanti = np.zeros((p, w), np.uint32)
         with self._lock:
             for i, pod in enumerate(pods):
                 # A nominated preemptor entering scoring: its own
@@ -1176,6 +1234,9 @@ class Encoder:
                                 sgrp[i], sgrp_w[i])
                 self._ns_rows(pod, ns_any[i], ns_forb[i], ns_used[i],
                               lenient)
+                zb = self._zone_bits(pod, lenient)
+                _fill_words(zaff[i], zb[0])
+                _fill_words(zanti[i], zb[1])
                 gmask = bits[4]
                 gidx[i] = gmask.bit_length() - 1 if gmask else -1
                 sp_skew[i] = int(getattr(pod, "spread_maxskew", 0))
@@ -1201,7 +1262,9 @@ class Encoder:
             spread_hard=jnp.asarray(sp_hard),
             ns_anyof=jnp.asarray(ns_any),
             ns_forbid=jnp.asarray(ns_forb),
-            ns_term_used=jnp.asarray(ns_used))
+            ns_term_used=jnp.asarray(ns_used),
+            zaff_bits=jnp.asarray(zaff),
+            zanti_bits=jnp.asarray(zanti))
 
     def encode_stream(self, pods: Sequence[Pod],
                       node_of: Callable[[str], str],
@@ -1258,6 +1321,8 @@ class Encoder:
         ns_any = np.zeros((s, t2, e_ns, w), np.uint32)
         ns_forb = np.zeros((s, t2, w), np.uint32)
         ns_used = np.zeros((s, t2), bool)
+        zaff = np.zeros((s, w), np.uint32)
+        zanti = np.zeros((s, w), np.uint32)
         batch = self.cfg.max_pods
         res_names = _res_names(r)
         with self._lock:
@@ -1291,6 +1356,9 @@ class Encoder:
                                 sgrp[i], sgrp_w[i])
                 self._ns_rows(pod, ns_any[i], ns_forb[i], ns_used[i],
                               lenient)
+                zb = self._zone_bits(pod, lenient)
+                _fill_words(zaff[i], zb[0])
+                _fill_words(zanti[i], zb[1])
                 gmask = bits[4]
                 gidx[i] = gmask.bit_length() - 1 if gmask else -1
                 sp_skew[i] = int(getattr(pod, "spread_maxskew", 0))
@@ -1317,4 +1385,6 @@ class Encoder:
             spread_hard=jnp.asarray(sp_hard),
             ns_anyof=jnp.asarray(ns_any),
             ns_forbid=jnp.asarray(ns_forb),
-            ns_term_used=jnp.asarray(ns_used))
+            ns_term_used=jnp.asarray(ns_used),
+            zaff_bits=jnp.asarray(zaff),
+            zanti_bits=jnp.asarray(zanti))

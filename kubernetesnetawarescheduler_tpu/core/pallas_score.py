@@ -481,10 +481,13 @@ def score_pods_tiled(state: ClusterState, pods: PodBatch,
     )(*args)
     out = out[:p_real, :n_real]
 
-    # Hard nodeAffinity matchExpressions joins OUTSIDE the tile kernel
-    # (its [P, T2, E, W] banks don't stream over N); ns_affinity_ok
-    # self-gates on any term being present, same as static_scores_tiled.
+    # Hard nodeAffinity matchExpressions and zone-scoped pod
+    # (anti-)affinity join OUTSIDE the tile kernel (neither streams
+    # over the N×N matrices; both self-gate on their constraints
+    # being present), same as static_scores_tiled / the dense path.
     out = jnp.where(score_lib.ns_affinity_ok(state, pods), out,
+                    jnp.float32(float(NEG_INF)))
+    out = jnp.where(score_lib.zone_affinity_ok(state, pods), out,
                     jnp.float32(float(NEG_INF)))
 
     # Topology spread joins OUTSIDE the tile kernel: it is an O(P*N)

@@ -149,6 +149,10 @@ def plan_preemption(encoder: Encoder, pod: Pod) -> PreemptionPlan | None:
         ns_used = np.zeros((cfg.max_ns_terms,), bool)
         encoder._ns_rows(pod, ns_any, ns_forb, ns_used, lenient=True,
                          record=False)
+        zaff_i, zanti_i = encoder._zone_bits(pod, lenient=True,
+                                             record=False)
+        gz_full = encoder._gz_counts.copy()
+        az_refs = encoder._az_anti_refs.copy()
         taints = encoder._taint_bits[:n_real].copy()
         labels = encoder._label_bits[:n_real].copy()
         ns_ok = _ns_ok_nodes(labels, ns_any, ns_forb, ns_used)
@@ -237,14 +241,18 @@ def plan_preemption(encoder: Encoder, pod: Pod) -> PreemptionPlan | None:
 
         # Mandatory victims: residents whose group conflicts with the
         # pod's anti-affinity, or who declared anti-affinity against
-        # the pod's group (the symmetric direction).  Only committed
-        # (ledgered, strictly-lower-priority) pods are evictable; a
-        # PDB-protected mandatory victim makes the node infeasible.
+        # the pod's group (the symmetric direction) — at host scope
+        # AND zone scope (a zone-conflicting resident ON THIS NODE is
+        # evictable; only cross-node zone residents force the skip in
+        # the zone post-check below).  Only committed (ledgered,
+        # strictly-lower-priority) pods are evictable; a PDB-protected
+        # mandatory victim makes the node infeasible.
         mandatory: list[tuple[str, object]] = []
-        if anti_i or gbit_i:
+        if anti_i or gbit_i or zanti_i:
             mandatory = [
                 (uid, rec) for uid, rec in cands
-                if (rec.group_bit & anti_i) or (rec.anti_bits & gbit_i)]
+                if (rec.group_bit & (anti_i | zanti_i))
+                or ((rec.anti_bits | rec.zanti_bits) & gbit_i)]
         ok_budget = True
         for _, rec in mandatory:
             if not takeable(rec):
@@ -302,6 +310,43 @@ def plan_preemption(encoder: Encoder, pod: Pod) -> PreemptionPlan | None:
                 [rec.group_bit for _, rec in chosen_recs])
             if not (rem_group & aff_i):
                 continue
+
+        # Zone-scoped (anti-)affinity, CONSERVATIVE: victims are only
+        # ever chosen on the candidate node, so a zone conflict held
+        # up by residents on OTHER nodes of the zone makes the node
+        # infeasible (no cross-node victim hunting).  Checks mirror
+        # score.zone_affinity_ok, evaluated on post-eviction counts.
+        if zaff_i or zanti_i or gbit_i:
+            z = int(node_zone[node])
+            if z < 0:
+                if zaff_i:
+                    continue  # empty domain: required zaff unsatisfiable
+            else:
+                def _cnt_after(slot: int) -> int:
+                    c = int(gz_full[slot, z])
+                    c -= sum(1 for _, rec in chosen_recs
+                             if rec.group_slot == slot and rec.zone == z)
+                    return max(0, c)
+
+                def _slots(bits: int):
+                    while bits:
+                        b = bits & -bits
+                        yield b.bit_length() - 1
+                        bits ^= b
+
+                if zaff_i and not any(_cnt_after(s) > 0
+                                      for s in _slots(zaff_i)):
+                    continue
+                if zanti_i and any(_cnt_after(s) > 0
+                                   for s in _slots(zanti_i)):
+                    continue
+                if gbit_i:
+                    rem_az = _refs_after(
+                        az_refs[z],
+                        [rec.zanti_bits for _, rec in chosen_recs
+                         if rec.zone == z])
+                    if rem_az & gbit_i:
+                        continue
 
         # Hard topology spread must pass AFTER the chosen set leaves
         # (victims of the preemptor's own group lower their recorded

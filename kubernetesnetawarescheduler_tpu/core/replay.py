@@ -76,6 +76,8 @@ class PodStream:
     ns_anyof: jax.Array        # u32[S, T2, E, W]
     ns_forbid: jax.Array       # u32[S, T2, W]
     ns_term_used: jax.Array    # bool[S, T2]
+    zaff_bits: jax.Array       # u32[S, W]
+    zanti_bits: jax.Array      # u32[S, W]
 
     @property
     def num_pods(self) -> int:
@@ -98,11 +100,12 @@ def _make_step(state: ClusterState, cfg: SchedulerConfig, method: str,
     batch = cfg.max_pods
 
     def step(carry, x):
-        used, group_bits, resident_anti, gz_counts, node_of_pod = carry
+        (used, group_bits, resident_anti, gz_counts, az_anti,
+         node_of_pod) = carry
         i, sl = x
         st = state.replace(used=used, group_bits=group_bits,
                            resident_anti=resident_anti,
-                           gz_counts=gz_counts)
+                           gz_counts=gz_counts, az_anti=az_anti)
         # Resolve in-stream peers against assignments made so far; a
         # peer that is still unplaced (or unschedulable) stays -1 and
         # the scoring kernel drops it — traffic to a homeless pod
@@ -120,7 +123,8 @@ def _make_step(state: ClusterState, cfg: SchedulerConfig, method: str,
             soft_grp_bits=sl.soft_grp_bits, soft_grp_w=sl.soft_grp_w,
             group_idx=sl.group_idx, spread_maxskew=sl.spread_maxskew,
             spread_hard=sl.spread_hard, ns_anyof=sl.ns_anyof,
-            ns_forbid=sl.ns_forbid, ns_term_used=sl.ns_term_used)
+            ns_forbid=sl.ns_forbid, ns_term_used=sl.ns_term_used,
+            zaff_bits=sl.zaff_bits, zanti_bits=sl.zanti_bits)
         if callable(static):
             # Mesh Pallas path: the per-batch static scores are
             # computed here (shard_map'd kernel) and passed into
@@ -134,7 +138,7 @@ def _make_step(state: ClusterState, cfg: SchedulerConfig, method: str,
         node_of_pod = jax.lax.dynamic_update_slice_in_dim(
             node_of_pod, assignment, i * batch, 0)
         return (st.used, st.group_bits, st.resident_anti, st.gz_counts,
-                node_of_pod), assignment
+                st.az_anti, node_of_pod), assignment
 
     return step
 
@@ -187,13 +191,13 @@ def replay_folded(state: ClusterState, folded, cfg: SchedulerConfig,
     step = _make_step(state, cfg, method, s_total, static)
     xs = (jnp.arange(nb, dtype=jnp.int32), folded)
     init = (state.used, state.group_bits, state.resident_anti,
-            state.gz_counts,
+            state.gz_counts, state.az_anti,
             jnp.full((s_total,), UNASSIGNED, jnp.int32))
-    (used, group_bits, resident_anti, gz_counts, _), assignments = \
-        jax.lax.scan(step, init, xs)
+    (used, group_bits, resident_anti, gz_counts, az_anti, _), \
+        assignments = jax.lax.scan(step, init, xs)
     final_state = state.replace(used=used, group_bits=group_bits,
                                 resident_anti=resident_anti,
-                                gz_counts=gz_counts)
+                                gz_counts=gz_counts, az_anti=az_anti)
     return assignments.reshape(-1), final_state
 
 
@@ -263,7 +267,7 @@ def replay_stream_pipelined(state: ClusterState, stream: PodStream,
         lambda x: jax.device_put(
             jnp.asarray(x).reshape((nb, batch) + x.shape[1:])), stream)
     carry = (state.used, state.group_bits, state.resident_anti,
-             state.gz_counts,
+             state.gz_counts, state.az_anti,
              jnp.full((s_total,), UNASSIGNED, jnp.int32))
 
     from collections import deque
@@ -324,4 +328,6 @@ def pad_stream(stream: PodStream, multiple: int) -> PodStream:
         ns_anyof=pd(stream.ns_anyof, 0),
         ns_forbid=pd(stream.ns_forbid, 0),
         ns_term_used=pd(stream.ns_term_used, False),
+        zaff_bits=pd(stream.zaff_bits, 0),
+        zanti_bits=pd(stream.zanti_bits, 0),
     )

@@ -368,6 +368,59 @@ def ns_affinity_ok(state: ClusterState, pods: PodBatch) -> jax.Array:
                         lambda _: jnp.ones((p, n), bool), None)
 
 
+def zone_affinity_ok(state: ClusterState, pods: PodBatch,
+                     gz_counts: jax.Array | None = None,
+                     az_anti: jax.Array | None = None) -> jax.Array:
+    """Zone-scoped hard pod (anti-)affinity mask, ``bool[P, N]``
+    (``topologyKey: topology.kubernetes.io/zone`` required
+    podAffinity/podAntiAffinity).
+
+    Presence of a group in a zone is ``gz_counts[g, z] > 0`` — the
+    same resident counts topologySpreadConstraints maintain — packed
+    to ``u32[Z, W]`` presence words; the symmetric direction (a
+    resident declared zone-anti-affinity against this pod's group)
+    reads ``az_anti``.  Kubernetes topology-domain semantics for
+    zone-less nodes: such a node is its own empty domain, so required
+    zone AFFINITY fails there (empty domain has no members) while
+    zone ANTI-affinity passes.  ``gz_counts``/``az_anti`` default to
+    the state's but are overridable with the conflict/scan carries —
+    placements move both.  Gated: constraint-free batches on clusters
+    with no zone-anti residents pay one scalar reduction.
+    """
+    gz = state.gz_counts if gz_counts is None else gz_counts
+    az = state.az_anti if az_anti is None else az_anti
+    p = pods.pod_valid.shape[0]
+    n = state.node_valid.shape[0]
+
+    def live(_):
+        from kubernetesnetawarescheduler_tpu.core.state import (
+            planes_to_words,
+        )
+
+        zmax = az.shape[0]
+        zwords = planes_to_words((gz > 0).T)               # u32[Z, W]
+        has_zone = state.node_zone >= 0
+        zrow = jnp.clip(state.node_zone, 0, zmax - 1)
+        pres = zwords[zrow]                                # [N, W]
+        azn = az[zrow]                                     # [N, W]
+        zaff_req = pods.zaff_bits[:, None, :]
+        zaff = jnp.all(zaff_req == 0, axis=-1) | (
+            has_zone[None, :]
+            & jnp.any((pres[None, :, :] & zaff_req) != 0, axis=-1))
+        zanti = ~has_zone[None, :] | jnp.all(
+            (pres[None, :, :] & pods.zanti_bits[:, None, :]) == 0,
+            axis=-1)
+        sym = ~has_zone[None, :] | jnp.all(
+            (azn[None, :, :] & pods.group_bit[:, None, :]) == 0,
+            axis=-1)
+        return zaff & zanti & sym
+
+    pred = (jnp.any(pods.zaff_bits != 0) | jnp.any(pods.zanti_bits != 0)
+            | jnp.any(az != 0))
+    return jax.lax.cond(pred, live, lambda _: jnp.ones((p, n), bool),
+                        None)
+
+
 def static_feasibility(state: ClusterState, pods: PodBatch) -> jax.Array:
     """The placement-independent slice of the feasibility mask,
     ``bool[P, N]``: validity, taints ⊆ tolerations, required node
@@ -401,6 +454,8 @@ def feasibility_mask(state: ClusterState, pods: PodBatch,
     - pod anti-affinity: no forbidden group present on node, and
       symmetrically no resident pod forbids this pod's group (k8s's
       existing-pod-anti-affinity symmetry)
+    - zone (anti-)affinity: the same pair at zone topology
+      (:func:`zone_affinity_ok`)
     """
     free = state.cap - state.used
     fits = jnp.all(pods.req[:, None, :] <= free[None, :, :] + _EPS, axis=-1)
@@ -417,7 +472,8 @@ def feasibility_mask(state: ClusterState, pods: PodBatch,
         axis=-1)
     if static_ok is None:
         static_ok = static_feasibility(state, pods)
-    return static_ok & fits & affinity & anti & sym
+    return (static_ok & fits & affinity & anti & sym
+            & zone_affinity_ok(state, pods))
 
 
 def score_pods(state: ClusterState, pods: PodBatch,

@@ -72,6 +72,14 @@ class ClusterState:
                                    (``G = 32 * W``, ``Z = max_zones``
                                    — a few KB, updated on device per
                                    placement)
+    - ``az_anti``      u32[Z, W]   OR of the ZONE-scoped anti-affinity
+                                   selectors of pods resident in each
+                                   zone — the symmetric direction of
+                                   zone-topologyKey podAntiAffinity
+                                   (the zone analog of
+                                   ``resident_anti``; asymmetric zone
+                                   (anti-)affinity rides ``gz_counts``
+                                   presence instead)
     """
 
     metrics: jax.Array
@@ -87,6 +95,7 @@ class ClusterState:
     resident_anti: jax.Array
     node_zone: jax.Array
     gz_counts: jax.Array
+    az_anti: jax.Array
 
     @property
     def num_nodes(self) -> int:
@@ -159,6 +168,14 @@ class PodBatch:
     ns_anyof: jax.Array        # u32[P, T2, E, W]
     ns_forbid: jax.Array       # u32[P, T2, W]
     ns_term_used: jax.Array    # bool[P, T2]
+    # Zone-scoped (topologyKey: topology.kubernetes.io/zone) hard pod
+    # (anti-)affinity, in the same group bit space as
+    # ``affinity_bits``/``anti_bits``: the pod requires (some member
+    # of any ``zaff_bits`` group) / (no member of any ``zanti_bits``
+    # group) resident in the TARGET NODE'S ZONE.  Presence is read
+    # from ``gz_counts``; the symmetric direction from ``az_anti``.
+    zaff_bits: jax.Array       # u32[P, W]
+    zanti_bits: jax.Array      # u32[P, W]
 
     @property
     def num_pods(self) -> int:
@@ -187,6 +204,7 @@ def init_cluster_state(cfg: SchedulerConfig, **overrides: Any) -> ClusterState:
         resident_anti=jnp.zeros((n, w), jnp.uint32),
         node_zone=jnp.full((n,), -1, jnp.int32),
         gz_counts=jnp.zeros((32 * w, cfg.max_zones), jnp.int32),
+        az_anti=jnp.zeros((cfg.max_zones, w), jnp.uint32),
     )
     fields.update(overrides)
     return ClusterState(**fields)
@@ -218,6 +236,8 @@ def init_pod_batch(cfg: SchedulerConfig, **overrides: Any) -> PodBatch:
                            jnp.uint32),
         ns_forbid=jnp.zeros((p, cfg.max_ns_terms, w), jnp.uint32),
         ns_term_used=jnp.zeros((p, cfg.max_ns_terms), jnp.bool_),
+        zaff_bits=jnp.zeros((p, w), jnp.uint32),
+        zanti_bits=jnp.zeros((p, w), jnp.uint32),
     )
     fields.update(overrides)
     return PodBatch(**fields)
@@ -279,6 +299,18 @@ def commit_assignments(state: ClusterState, pods: PodBatch,
     # one-hot [P, N] mask with bitwise-or instead.
     onehot = placed[:, None] & (
         assignment[:, None] == jnp.arange(state.num_nodes)[None, :])
+    # Zone-scoped symmetric anti-affinity: OR each placed pod's
+    # zanti_bits into its landing ZONE's row.  Several winners can
+    # share a zone (unlike nodes, which take one winner per round), so
+    # this must be an OR-reduction over a [P, Z] one-hot, not a
+    # scatter-set; pods on zone-less nodes drop out (their "zone" is
+    # the node itself — the hostname machinery already covers it).
+    zone_of = state.node_zone[jnp.clip(assignment, 0,
+                                       state.num_nodes - 1)]
+    z = state.az_anti.shape[0]
+    zhot = (placed & (zone_of >= 0))[:, None] & (
+        jnp.clip(zone_of, 0, z - 1)[:, None]
+        == jnp.arange(z)[None, :])
     return state.replace(
         used=used,
         group_bits=state.group_bits | scatter_or_onehot(onehot,
@@ -286,7 +318,9 @@ def commit_assignments(state: ClusterState, pods: PodBatch,
         resident_anti=state.resident_anti | scatter_or_onehot(
             onehot, pods.anti_bits),
         gz_counts=add_zone_counts(state.gz_counts, state.node_zone,
-                                  pods.group_idx, assignment, placed))
+                                  pods.group_idx, assignment, placed),
+        az_anti=state.az_anti | scatter_or_onehot(zhot,
+                                                  pods.zanti_bits))
 
 
 def add_zone_counts(gz_counts: jax.Array, node_zone: jax.Array,

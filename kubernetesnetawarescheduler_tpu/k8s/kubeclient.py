@@ -259,6 +259,81 @@ def _preferred_group_terms(spec: Mapping, ann: Mapping) -> tuple:
     return tuple(out)
 
 
+_ZONE_KEY = "topology.kubernetes.io/zone"
+_HOST_KEY = "kubernetes.io/hostname"
+# Group name no real pod can carry (ANN_GROUP annotations are UTF-8
+# text; a NUL byte never survives the API server): interning it yields
+# a group bit that is present on no node/zone, so a required-affinity
+# term we cannot represent makes the pod unschedulable (degrade
+# CLOSED) instead of silently widening placement.
+UNSAT_GROUP = "\x00unrepresentable"
+
+
+def _required_group_terms(spec: Mapping) -> tuple:
+    """``requiredDuringSchedulingIgnoredDuringExecution`` podAffinity /
+    podAntiAffinity terms → ``(host_aff, host_anti, zone_aff,
+    zone_anti)`` frozensets of group keys (the ``labelSelector
+    .matchLabels`` reduction to the canonical sorted ``k=v[,k=v]``
+    group string, matching ``netaware.io/group``).
+
+    Scope/degradation contract:
+    - ``topologyKey: kubernetes.io/hostname`` terms land in the
+      host-scoped sets, ``topology.kubernetes.io/zone`` in the
+      zone-scoped ones.
+    - AFFINITY terms degrade CLOSED: an unrepresentable term (selector
+      ``matchExpressions``, empty ``matchLabels``, any other
+      topologyKey) contributes :data:`UNSAT_GROUP`, whose bit no
+      resident carries — the pod stays unschedulable exactly where
+      kube-scheduler could not have verified the constraint either.
+      With several affinity terms the kernel's any-of join is WEAKER
+      than kube's all-terms-AND — a documented approximation (one
+      required term, the overwhelmingly common shape, is exact).
+    - ANTI-affinity terms are exact for any term count (every listed
+      group is forbidden); an unrepresentable anti term drops OPEN,
+      mirroring the interner-overflow direction for anti constraints
+      (forbidding *everything* would be far harsher than kube).
+    - Both degradations are counted in the returned ``degraded`` so
+      the encoder emits the per-pod ConstraintDegraded event.
+    - Membership reduction: a selected pod is a member iff it carries
+      the canonical sorted ``k=v[,k=v]`` string in its
+      ``netaware.io/group`` annotation — the same reduction every
+      group surface here uses (see :func:`_preferred_group_terms`).
+      Pods matching the labelSelector by their LABELS alone, without
+      the annotation, are not members; deployments adopting this
+      scheduler opt their pods into groups via the annotation.
+
+    Returns ``(host_aff, host_anti, zone_aff, zone_anti, degraded)``.
+    """
+    aff = spec.get("affinity") or {}
+    host_aff, host_anti = set(), set()
+    zone_aff, zone_anti = set(), set()
+    degraded = 0
+    for kind, is_anti in (("podAffinity", False), ("podAntiAffinity", True)):
+        for term in (aff.get(kind) or {}).get(
+                "requiredDuringSchedulingIgnoredDuringExecution") or []:
+            tk = term.get("topologyKey")
+            sel = term.get("labelSelector") or {}
+            match = sel.get("matchLabels") or {}
+            representable = (tk in (_HOST_KEY, _ZONE_KEY) and match
+                             and not sel.get("matchExpressions"))
+            if not representable:
+                degraded += 1
+                if not is_anti:
+                    (host_aff if tk != _ZONE_KEY else zone_aff).add(
+                        UNSAT_GROUP)
+                continue  # anti: degrade open (counted above)
+            group = ",".join(f"{k}={v}" for k, v in sorted(match.items()))
+            target = {
+                (False, _HOST_KEY): host_aff,
+                (False, _ZONE_KEY): zone_aff,
+                (True, _HOST_KEY): host_anti,
+                (True, _ZONE_KEY): zone_anti,
+            }[(is_anti, tk)]
+            target.add(group)
+    return (frozenset(host_aff), frozenset(host_anti),
+            frozenset(zone_aff), frozenset(zone_anti), degraded)
+
+
 def _spread_constraint(spec: Mapping) -> tuple[int, bool]:
     """First zone-level ``topologySpreadConstraint`` as
     ``(maxSkew, hard)``; (0, True) = none.
@@ -323,6 +398,8 @@ def pod_from_json(obj: Mapping) -> Pod:
         return frozenset(x.strip() for x in v.split(",") if x.strip())
 
     spread_skew, spread_hard = _spread_constraint(spec)
+    host_aff, host_anti, zone_aff, zone_anti, parse_degraded = \
+        _required_group_terms(spec)
     namespace = meta.get("namespace", "default")
     # Qualify peer references with the pod's own namespace (unless the
     # annotation already says "ns/name"): the pod cache and node_of()
@@ -343,14 +420,17 @@ def pod_from_json(obj: Mapping) -> Pod:
         node_selector=_flatten(spec.get("nodeSelector")),
         required_node_affinity=_required_node_terms(spec),
         group=ann.get(ANN_GROUP, ""),
-        affinity_groups=_csv(ANN_AFFINITY),
-        anti_groups=_csv(ANN_ANTI),
+        affinity_groups=_csv(ANN_AFFINITY) | host_aff,
+        anti_groups=_csv(ANN_ANTI) | host_anti,
+        zone_affinity_groups=zone_aff,
+        zone_anti_groups=zone_anti,
         soft_node_affinity=_preferred_node_terms(spec),
         soft_group_affinity=_preferred_group_terms(spec, ann),
         spread_maxskew=spread_skew,
         spread_hard=spread_hard,
         priority=float(spec.get("priority", 0) or 0),
         pdb_min_available=int(ann.get(ANN_PDB, 0) or 0),
+        parse_degraded=parse_degraded,
     )
 
 

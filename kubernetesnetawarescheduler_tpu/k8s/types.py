@@ -66,6 +66,14 @@ class Pod:
     group: str = ""
     affinity_groups: frozenset[str] = frozenset()
     anti_groups: frozenset[str] = frozenset()
+    # Zone-scoped (topologyKey: topology.kubernetes.io/zone) required
+    # pod (anti-)affinity: the pod must land in a zone hosting a
+    # member of some ``zone_affinity_groups`` group / hosting no
+    # member of any ``zone_anti_groups`` group.  The hostname-scoped
+    # pair above stays the node-level machinery; kube's symmetric
+    # anti-affinity holds at zone scope too (ClusterState.az_anti).
+    zone_affinity_groups: frozenset[str] = frozenset()
+    zone_anti_groups: frozenset[str] = frozenset()
     # Preferred (soft) affinity, the weighted score-term counterpart of
     # the hard masks above — ``preferredDuringSchedulingIgnoredDuring
     # Execution`` semantics (the reference's own probe server relied on
@@ -97,6 +105,13 @@ class Pod:
     # (the map form) ANDs with this, matching Kubernetes.
     required_node_affinity: tuple = ()
     priority: float = 0.0
+    # Count of hard constraints lost/narrowed at PARSE time (e.g. a
+    # required anti-affinity term with an unrepresentable selector
+    # dropped open, or an affinity term degraded to the unsatisfiable
+    # sentinel).  The encoder folds this into the same per-pod
+    # ConstraintDegraded event stream as interner-overflow drops, so
+    # parse-time degradation is operator-visible too.
+    parse_degraded: int = 0
     # Annotation-level PodDisruptionBudget: at least this many members
     # of the pod's ``group`` must stay up — preemption may not disrupt
     # below it.  With no group, a nonzero value protects the pod

@@ -67,6 +67,7 @@ def state_sharding(mesh: Mesh) -> ClusterState:
         # Small [G, Z] count matrix: replicated (every device's assign
         # round reads arbitrary rows of it).
         gz_counts=s(None, None),
+        az_anti=s(None, None),  # [Z, W], same reasoning
     )
 
 
@@ -95,6 +96,8 @@ def pods_sharding(mesh: Mesh) -> PodBatch:
         ns_anyof=s("dp", None, None, None),
         ns_forbid=s("dp", None, None),
         ns_term_used=s("dp", None),
+        zaff_bits=s("dp", None),
+        zanti_bits=s("dp", None),
     )
 
 

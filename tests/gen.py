@@ -71,9 +71,15 @@ def random_instance(rng: np.random.Generator, cfg: SchedulerConfig,
         gz_counts=np.zeros((32 * w, cfg.max_zones), np.int32),
     )
     # Seed some resident spread counts so batch-entry skew is nonzero.
+    state["az_anti"] = np.zeros((cfg.max_zones, w), np.uint32)
     if with_constraints:
         state["gz_counts"][32 * (w - 1):32 * (w - 1) + 2, :3] = \
             rng.integers(0, 3, (2, 3))
+        # Resident zone-anti declarations over the same group-slot
+        # space as group_bit (bits 0-1 of the LAST word), so the
+        # symmetric zone check triggers against generated pods.
+        state["az_anti"][:3, w - 1] = rng.integers(0, 4, 3).astype(
+            np.uint32)
 
     pod_valid = np.zeros((p_total,), bool)
     pod_valid[:p] = True
@@ -164,6 +170,22 @@ def random_instance(rng: np.random.Generator, cfg: SchedulerConfig,
                 if rng.random() < 0.5:
                     ns_forb[i, t, w - 1] = np.uint32(rng.integers(1, 8))
     pods.update(ns_anyof=ns_any, ns_forbid=ns_forb, ns_term_used=ns_used)
+    # Zone-scoped pod (anti-)affinity over the seeded group slots:
+    # ~1/8 of pods each way (hard constraints, so kept sparse enough
+    # that instances stay mostly schedulable).
+    zaff_col = np.zeros((p_total,), np.uint32)
+    zanti_col = np.zeros((p_total,), np.uint32)
+    if with_constraints:
+        zaff_col = np.where(rng.random(p_total) < 0.125,
+                            np.uint32(1) << rng.integers(
+                                0, 2, p_total).astype(np.uint32),
+                            0).astype(np.uint32)
+        zanti_col = np.where(rng.random(p_total) < 0.125,
+                             np.uint32(1) << rng.integers(
+                                 0, 2, p_total).astype(np.uint32),
+                             0).astype(np.uint32)
+    pods.update(zaff_bits=bits_col(zaff_col),
+                zanti_bits=bits_col(zanti_col))
     return state, pods
 
 

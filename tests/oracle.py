@@ -101,15 +101,58 @@ def oracle_net_cost(state, cfg: SchedulerConfig):
     return c
 
 
+def oracle_zone_ok(state, pods, gz=None, az=None):
+    """Zone-scoped hard pod (anti-)affinity (score.zone_affinity_ok
+    mirror): zaff needs a member of some required group in the node's
+    zone; zanti forbids members of any listed group there; az (the
+    symmetric direction) forbids the pod's own group where a resident
+    declared zone-anti against it.  Zone-less nodes: empty domain —
+    zaff fails, zanti/sym pass."""
+    gz = state["gz_counts"] if gz is None else gz
+    az = state.get("az_anti") if az is None else az
+    p = pods["req"].shape[0]
+    n = state["cap"].shape[0]
+    ok = np.ones((p, n), bool)
+    if "zaff_bits" not in pods:
+        return ok
+    pres_by_zone = [0] * gz.shape[1]
+    for z in range(gz.shape[1]):
+        for slot in range(gz.shape[0]):
+            if gz[slot, z] > 0:
+                pres_by_zone[z] |= 1 << slot
+    for i in range(p):
+        zaff = as_int(pods["zaff_bits"][i])
+        zanti = as_int(pods["zanti_bits"][i])
+        gbit = as_int(pods["group_bit"][i])
+        if not (zaff or zanti or gbit):
+            continue
+        for j in range(n):
+            z = int(state["node_zone"][j])
+            if z < 0:
+                if zaff:
+                    ok[i, j] = False
+                continue
+            pres = pres_by_zone[z]
+            azb = as_int(az[z]) if az is not None else 0
+            if zaff and not (pres & zaff):
+                ok[i, j] = False
+            if pres & zanti:
+                ok[i, j] = False
+            if azb & gbit:
+                ok[i, j] = False
+    return ok
+
+
 def oracle_feasible(state, pods, used=None, group_bits=None,
-                    resident_anti=None):
+                    resident_anti=None, gz=None, az=None):
     used = state["used"] if used is None else used
     group_bits = state["group_bits"] if group_bits is None else group_bits
     resident_anti = (state["resident_anti"] if resident_anti is None
                      else resident_anti)
     p = pods["req"].shape[0]
     n = state["cap"].shape[0]
-    ns_ok = oracle_ns_ok(state, pods)
+    ns_ok = oracle_ns_ok(state, pods) & oracle_zone_ok(state, pods,
+                                                       gz=gz, az=az)
     ok = np.zeros((p, n), bool)
     for i in range(p):
         for j in range(n):
@@ -267,13 +310,18 @@ def oracle_assign_greedy(state, pods, cfg: SchedulerConfig):
     group = state["group_bits"].copy()
     res_anti = state["resident_anti"].copy()
     gz = state["gz_counts"].copy()
+    az = (state["az_anti"].copy() if "az_anti" in state
+          else np.zeros((gz.shape[1], state["group_bits"].shape[1]),
+                        np.uint32))
+    w = state["group_bits"].shape[1]
     # priority desc, index asc
     order = sorted(range(p), key=lambda i: (-pods["priority"][i], i))
     out = np.full((p,), -1, np.int32)
     for i in order:
         if not pods["pod_valid"][i]:
             continue
-        ok = oracle_feasible(state, pods, used, group, res_anti)[i]
+        ok = oracle_feasible(state, pods, used, group, res_anti,
+                             gz=gz, az=az)[i]
         bal = cfg.weights.balance * oracle_balance(state, pods, used)[i]
         spread_pen, spread_ok = oracle_spread(state, pods, cfg, gz)
         ok = ok & spread_ok[i]
@@ -289,4 +337,9 @@ def oracle_assign_greedy(state, pods, cfg: SchedulerConfig):
         gi, z = int(pods["group_idx"][i]), int(state["node_zone"][j])
         if gi >= 0 and z >= 0:
             gz[gi, z] += 1
+        if z >= 0 and "zanti_bits" in pods:
+            zb = as_int(pods["zanti_bits"][i])
+            for word in range(w):
+                az[z, word] |= np.uint32(
+                    (zb >> (32 * word)) & 0xFFFFFFFF)
     return out

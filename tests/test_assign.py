@@ -46,18 +46,40 @@ def check_assignment_safety(state_np, pods_np, assignment, cfg):
     used = state_np["used"].copy()
     group = state_np["group_bits"].copy()
     res_anti = state_np["resident_anti"].copy()
+    gz = state_np["gz_counts"].copy()
+    az = state_np["az_anti"].copy()
+    w = group.shape[1]
     while remaining:
-        ok = oracle.oracle_feasible(state_np, pods_np, used, group, res_anti)
-        placeable = [i for i in remaining if ok[i, assignment[i]]]
-        assert placeable, (
-            f"no valid serialization: pods {remaining} stuck "
-            f"(assignment {assignment})")
-        for i in placeable:
+        # STRICTLY sequential: each placement re-checks against the
+        # state including every previously-placed pod, so an
+        # intra-batch violation (e.g. a zone-anti pod and its
+        # conflicting group landing in one zone the same round) can
+        # never hide inside a pass the way batch-at-pass-entry checks
+        # would allow.
+        progressed = False
+        for i in list(remaining):
+            ok = oracle.oracle_feasible(state_np, pods_np, used, group,
+                                        res_anti, gz=gz, az=az)
+            if not ok[i, assignment[i]]:
+                continue
             j = assignment[i]
             used[j] += pods_np["req"][i]
             group[j] |= pods_np["group_bit"][i]
             res_anti[j] |= pods_np["anti_bits"][i]
+            gi = int(pods_np["group_idx"][i])
+            z = int(state_np["node_zone"][j])
+            if gi >= 0 and z >= 0:
+                gz[gi, z] += 1
+            if z >= 0:
+                zb = oracle.as_int(pods_np["zanti_bits"][i])
+                for word in range(w):
+                    az[z, word] |= np.uint32(
+                        (zb >> (32 * word)) & 0xFFFFFFFF)
             remaining.remove(i)
+            progressed = True
+        assert progressed, (
+            f"no valid serialization: pods {remaining} stuck "
+            f"(assignment {assignment})")
     assert np.all(used <= state_np["cap"] + 1e-4)
 
 

@@ -112,6 +112,8 @@ def test_sharded_replay_matches_single_device():
         ns_forbid=jnp.zeros((s, CFG.max_ns_terms, CFG.mask_words),
                             jnp.uint32),
         ns_term_used=jnp.zeros((s, CFG.max_ns_terms), jnp.bool_),
+        zaff_bits=jnp.zeros((s, CFG.mask_words), jnp.uint32),
+        zanti_bits=jnp.zeros((s, CFG.mask_words), jnp.uint32),
     )
     want_assign, want_state = replay_stream(state, stream, CFG, "parallel")
     mesh = make_mesh(2, 4)
@@ -187,6 +189,8 @@ def test_sharded_replay_never_gathers_full_nxn():
                            jnp.uint32),
         ns_forbid=jnp.zeros((s, cfg.max_ns_terms, w), jnp.uint32),
         ns_term_used=jnp.zeros((s, cfg.max_ns_terms), jnp.bool_),
+        zaff_bits=jnp.zeros((s, w), jnp.uint32),
+        zanti_bits=jnp.zeros((s, w), jnp.uint32),
     ), cfg.max_pods)
     mesh = make_mesh(2, 4)
     folded = fold_stream(stream, cfg)
@@ -279,7 +283,9 @@ def test_sharded_pallas_replay_matches_dense():
         ns_anyof=jnp.zeros((s, cfg.max_ns_terms, cfg.max_ns_exprs, w),
                            jnp.uint32),
         ns_forbid=jnp.zeros((s, cfg.max_ns_terms, w), jnp.uint32),
-        ns_term_used=jnp.zeros((s, cfg.max_ns_terms), jnp.bool_)),
+        ns_term_used=jnp.zeros((s, cfg.max_ns_terms), jnp.bool_),
+        zaff_bits=jnp.zeros((s, w), jnp.uint32),
+        zanti_bits=jnp.zeros((s, w), jnp.uint32)),
         cfg.max_pods)
     cfg_dense = dataclasses.replace(cfg, score_backend="xla")
     want, _ = replay_stream(state, stream, cfg_dense, "parallel")

@@ -1,0 +1,270 @@
+"""Zone-scoped hard pod (anti-)affinity
+(``topologyKey: topology.kubernetes.io/zone`` required podAffinity /
+podAntiAffinity).
+
+Presence rides the topology-spread ``gz_counts`` resident counts; the
+symmetric direction (kube's existing-pod anti-affinity) is the per-zone
+``az_anti`` residency (core/state.ClusterState.az_anti, refcounted
+host-side like ``resident_anti``).  The reference delegated all of
+inter-pod affinity to stock Kubernetes (its manifests carry none); this
+is the framework-native zone-granular form of SURVEY.md §2's
+constraint-mask plan.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from kubernetesnetawarescheduler_tpu.config import SchedulerConfig
+from kubernetesnetawarescheduler_tpu.core.assign import (
+    assign_greedy,
+    assign_parallel,
+)
+from kubernetesnetawarescheduler_tpu.core.encode import Encoder
+from kubernetesnetawarescheduler_tpu.k8s.kubeclient import pod_from_json
+from kubernetesnetawarescheduler_tpu.k8s.types import Node, Pod
+
+CFG = SchedulerConfig(max_nodes=8, max_pods=4, max_peers=2)
+
+
+def _zoned_cluster(cfg=CFG) -> Encoder:
+    """Two zones, two nodes each: a/b in z0, c/d in z1."""
+    enc = Encoder(cfg)
+    for name, zone in (("a", "z0"), ("b", "z0"), ("c", "z1"),
+                       ("d", "z1")):
+        enc.upsert_node(Node(
+            name=name, capacity={"cpu": 8.0, "mem": 16.0},
+            labels=frozenset({f"topology.kubernetes.io/zone={zone}"})))
+    return enc
+
+
+def _place(enc, pod, method=assign_parallel) -> int:
+    batch = enc.encode_pods([pod], node_of=lambda s: "", lenient=True)
+    return int(np.asarray(method(enc.snapshot(), batch, enc.cfg))[0])
+
+
+def test_zone_affinity_requires_resident_member():
+    enc = _zoned_cluster()
+    # No member anywhere: required zone-affinity is unsatisfiable.
+    pod = Pod(name="p", requests={"cpu": 1.0},
+              zone_affinity_groups=frozenset({"svc-a"}))
+    assert _place(enc, pod) == -1
+    # A member lands in z1 -> both z1 nodes open up, z0 stays closed.
+    enc.commit(Pod(name="m", uid="m", group="svc-a",
+                   requests={"cpu": 1.0}), "c")
+    for method in (assign_parallel, assign_greedy):
+        got = enc.node_name(_place(enc, pod, method))
+        assert got in ("c", "d")
+
+
+def test_zone_anti_excludes_whole_zone():
+    enc = _zoned_cluster()
+    enc.commit(Pod(name="m", uid="m", group="svc-a",
+                   requests={"cpu": 1.0}), "a")
+    pod = Pod(name="p", requests={"cpu": 1.0},
+              zone_anti_groups=frozenset({"svc-a"}))
+    for method in (assign_parallel, assign_greedy):
+        # The member is on node a; BOTH z0 nodes (a and b) are masked.
+        assert enc.node_name(_place(enc, pod, method)) in ("c", "d")
+
+
+def test_zone_anti_symmetry():
+    """A resident that declared zone-anti against group G keeps G pods
+    out of its WHOLE zone (kube's existing-pod anti-affinity)."""
+    enc = _zoned_cluster()
+    enc.commit(Pod(name="guard", uid="g", group="quiet",
+                   zone_anti_groups=frozenset({"noisy"}),
+                   requests={"cpu": 1.0}), "a")
+    pod = Pod(name="p", group="noisy", requests={"cpu": 1.0})
+    for method in (assign_parallel, assign_greedy):
+        assert enc.node_name(_place(enc, pod, method)) in ("c", "d")
+    # Releasing the guard clears the zone residency (refcounted).
+    enc.release(Pod(name="guard", uid="g", group="quiet",
+                    zone_anti_groups=frozenset({"noisy"}),
+                    requests={"cpu": 1.0}))
+    assert enc.node_name(_place(enc, pod)) in ("a", "b", "c", "d")
+
+
+def test_same_round_zone_conflict_resolved():
+    """Two pods in ONE batch: a 'noisy' pod and a pod with zone-anti
+    against 'noisy' must not land in the same zone even when scored
+    in the same conflict round (the zone round cap)."""
+    enc = _zoned_cluster()
+    pods = [Pod(name="n", group="noisy", priority=5.0,
+                requests={"cpu": 1.0}),
+            Pod(name="q", priority=4.0, requests={"cpu": 1.0},
+                zone_anti_groups=frozenset({"noisy"}))]
+    batch = enc.encode_pods(pods, node_of=lambda s: "", lenient=True)
+    a = np.asarray(assign_parallel(enc.snapshot(), batch, enc.cfg))
+    assert a[0] >= 0 and a[1] >= 0
+    zone_of = {0: 0, 1: 0, 2: 1, 3: 1}
+    assert zone_of[int(a[0])] != zone_of[int(a[1])]
+
+
+def test_zoneless_node_is_empty_domain():
+    cfg = CFG
+    enc = Encoder(cfg)
+    enc.upsert_node(Node(name="nz", capacity={"cpu": 8.0, "mem": 16.0}))
+    # Required zone affinity fails on a zone-less node (empty domain)…
+    pod = Pod(name="p", requests={"cpu": 1.0},
+              zone_affinity_groups=frozenset({"svc"}))
+    assert _place(enc, pod) == -1
+    # …while zone-anti passes (no members in an empty domain).
+    pod2 = Pod(name="q", requests={"cpu": 1.0},
+               zone_anti_groups=frozenset({"svc"}))
+    assert _place(enc, pod2) == 0
+
+
+def test_checkpoint_roundtrip_preserves_zone_anti(tmp_path):
+    from kubernetesnetawarescheduler_tpu.core.checkpoint import (
+        load_checkpoint,
+        save_checkpoint,
+    )
+
+    enc = _zoned_cluster()
+    enc.commit(Pod(name="guard", uid="g", group="quiet",
+                   zone_anti_groups=frozenset({"noisy"}),
+                   requests={"cpu": 1.0}), "a")
+    save_checkpoint(str(tmp_path / "ck"), enc)
+    enc2 = load_checkpoint(str(tmp_path / "ck"))
+    pod = Pod(name="p", group="noisy", requests={"cpu": 1.0})
+    assert enc2.node_name(_place(enc2, pod)) in ("c", "d")
+    # The restored residency releases cleanly (refs rebuilt from the
+    # ledger, not phantoms).
+    enc2.release(Pod(name="guard", uid="g", group="quiet",
+                     zone_anti_groups=frozenset({"noisy"}),
+                     requests={"cpu": 1.0}))
+    assert enc2.node_name(_place(enc2, pod)) in ("a", "b", "c", "d")
+
+
+def test_preemption_skips_zone_conflicted_nodes():
+    """Conservative planner contract: a zone conflict held by a
+    resident on ANOTHER node of the zone makes the candidate node
+    infeasible (no cross-node victim hunting)."""
+    from kubernetesnetawarescheduler_tpu.core.preempt import (
+        plan_preemption,
+    )
+
+    cfg = SchedulerConfig(max_nodes=8, max_pods=4, max_peers=2)
+    enc = _zoned_cluster(cfg)
+    # z0 hosts a 'noisy' member on node b (high priority, not a
+    # victim candidate); nodes a and c are FULL of low-prio pods.
+    enc.commit(Pod(name="m", uid="m", group="noisy", priority=9.0,
+                   requests={"cpu": 1.0}), "b")
+    enc.commit(Pod(name="f1", uid="f1", priority=1.0,
+                   requests={"cpu": 7.0, "mem": 16.0}), "a")
+    enc.commit(Pod(name="f2", uid="f2", priority=1.0,
+                   requests={"cpu": 8.0, "mem": 16.0}), "c")
+    enc.commit(Pod(name="f3", uid="f3", priority=1.0,
+                   requests={"cpu": 8.0, "mem": 16.0}), "d")
+    pod = Pod(name="pre", uid="pre", priority=8.0,
+              requests={"cpu": 4.0, "mem": 4.0},
+              zone_anti_groups=frozenset({"noisy"}))
+    plan = plan_preemption(enc, pod)
+    # Node a (z0) has evictable capacity but carries the zone
+    # conflict via node b's resident -> the plan must target z1.
+    assert plan is not None
+    assert plan.node_name in ("c", "d")
+
+
+def test_preemption_evicts_same_node_zone_conflicter():
+    """A zone conflict whose ONLY holder is an evictable resident on
+    the candidate node itself is resolved by eviction, not a skip."""
+    from kubernetesnetawarescheduler_tpu.core.preempt import (
+        plan_preemption,
+    )
+
+    enc = _zoned_cluster()
+    # The lone 'noisy' member sits on node a (low priority, evictable);
+    # z1 is made infeasible statically via taints so the planner must
+    # solve z0.
+    enc.commit(Pod(name="m", uid="m", group="noisy", priority=1.0,
+                   requests={"cpu": 8.0, "mem": 16.0}), "a")
+    enc.commit(Pod(name="f", uid="f", priority=1.0,
+                   requests={"cpu": 8.0, "mem": 16.0}), "b")
+    pod = Pod(name="pre", uid="pre", priority=8.0,
+              requests={"cpu": 4.0, "mem": 4.0},
+              node_selector=frozenset(
+                  {"topology.kubernetes.io/zone=z0"}),
+              zone_anti_groups=frozenset({"noisy"}))
+    plan = plan_preemption(enc, pod)
+    assert plan is not None and plan.node_name == "a"
+    assert {v.uid for v in plan.victims} == {"m"}
+
+
+def test_parse_degradation_surfaces_as_event():
+    """An unrepresentable required anti term drops OPEN but the pod is
+    still flagged in the ConstraintDegraded stream via
+    Pod.parse_degraded."""
+    obj = {
+        "metadata": {"name": "p", "uid": "u"},
+        "spec": {
+            "containers": [],
+            "affinity": {"podAntiAffinity": {
+                "requiredDuringSchedulingIgnoredDuringExecution": [
+                    {"labelSelector": {"matchExpressions": [
+                        {"key": "app", "operator": "In",
+                         "values": ["db"]}]},
+                     "topologyKey": "kubernetes.io/hostname"}]}},
+        },
+    }
+    pod = pod_from_json(obj)
+    assert pod.parse_degraded == 1
+    assert pod.anti_groups == frozenset()  # dropped open
+    enc = _zoned_cluster()
+    enc.encode_pods([pod], node_of=lambda s: "", lenient=True)
+    assert ("default", "p", 1) in enc.pop_degraded()
+
+
+def test_kubeclient_parses_required_pod_affinity():
+    obj = {
+        "metadata": {"name": "p", "uid": "u"},
+        "spec": {
+            "containers": [],
+            "affinity": {
+                "podAffinity": {
+                    "requiredDuringSchedulingIgnoredDuringExecution": [
+                        {"labelSelector": {
+                            "matchLabels": {"app": "db"}},
+                         "topologyKey":
+                             "topology.kubernetes.io/zone"}]},
+                "podAntiAffinity": {
+                    "requiredDuringSchedulingIgnoredDuringExecution": [
+                        {"labelSelector": {
+                            "matchLabels": {"app": "cache"}},
+                         "topologyKey": "kubernetes.io/hostname"},
+                        {"labelSelector": {
+                            "matchLabels": {"app": "noisy"}},
+                         "topologyKey":
+                             "topology.kubernetes.io/zone"}]},
+            },
+        },
+    }
+    pod = pod_from_json(obj)
+    assert pod.zone_affinity_groups == frozenset({"app=db"})
+    assert pod.anti_groups == frozenset({"app=cache"})
+    assert pod.zone_anti_groups == frozenset({"app=noisy"})
+
+
+def test_kubeclient_unrepresentable_affinity_degrades_closed():
+    from kubernetesnetawarescheduler_tpu.k8s.kubeclient import (
+        UNSAT_GROUP,
+    )
+
+    obj = {
+        "metadata": {"name": "p"},
+        "spec": {
+            "containers": [],
+            "affinity": {"podAffinity": {
+                "requiredDuringSchedulingIgnoredDuringExecution": [
+                    {"labelSelector": {"matchExpressions": [
+                        {"key": "app", "operator": "In",
+                         "values": ["db"]}]},
+                     "topologyKey": "kubernetes.io/hostname"}]}},
+        },
+    }
+    pod = pod_from_json(obj)
+    assert UNSAT_GROUP in pod.affinity_groups
+    # And the sentinel group is never resident: the pod cannot place.
+    enc = _zoned_cluster()
+    assert _place(enc, pod) == -1
