@@ -79,6 +79,16 @@ class WorkloadSpec:
     # hard_fraction of those are DoNotSchedule, rest ScheduleAnyway).
     spread_fraction: float = 0.0
     spread_hard_fraction: float = 0.5
+    # Zone-scoped hard pod (anti-)affinity: fraction of pods requiring
+    # co-residency with their OWN service's group at zone granularity
+    # (followers joining an established service), and fraction
+    # declaring zone-anti against a random OTHER service.
+    zone_aff_fraction: float = 0.0
+    zone_anti_fraction: float = 0.0
+    # Hard nodeAffinity matchExpressions: fraction of pods requiring
+    # ``disk In [ssd]`` (half) or ``disk NotIn [hdd]`` (half) — the
+    # fake cluster labels nodes disk=ssd/hdd alternately.
+    ns_fraction: float = 0.0
     zones: int = 2  # must match the ClusterSpec the workload runs on
     seed: int = 0
     cpu_range: tuple[float, float] = (0.1, 4.0)
@@ -106,7 +116,8 @@ def build_fake_cluster(spec: ClusterSpec) -> tuple[FakeCluster, np.ndarray,
                 "mem": float(rng.uniform(*spec.mem_range)),
                 "net_bw": float(rng.uniform(*spec.netbw_range)),
             },
-            labels=frozenset({f"zone={zones[i]}", f"rack={racks[i]}"}),
+            labels=frozenset({f"zone={zones[i]}", f"rack={racks[i]}",
+                              f"disk={'ssd' if i % 2 == 0 else 'hdd'}"}),
             taints=frozenset({"dedicated"}) if tainted else frozenset(),
             zone=f"zone-{zones[i]}",
             rack=f"rack-{zones[i]}-{racks[i]}",
@@ -294,6 +305,21 @@ def generate_workload(spec: WorkloadSpec,
             else:
                 svc_spread[group] = (0, True)
         spread_skew, spread_hard = svc_spread[group]
+        zone_aff = frozenset()
+        if earlier and rng.random() < spec.zone_aff_fraction:
+            # Followers only (an established service has zone members
+            # to join); a first pod with self-affinity would deadlock.
+            zone_aff = frozenset({group})
+        zone_anti = frozenset()
+        if rng.random() < spec.zone_anti_fraction:
+            other = int(rng.integers(0, 28))
+            if f"svc-{other}" != group:
+                zone_anti = frozenset({f"svc-{other}"})
+        req_ns = ()
+        if rng.random() < spec.ns_fraction:
+            req_ns = (((("In", "disk", ("ssd",)),)
+                       if rng.random() < 0.5
+                       else (("NotIn", "disk", ("hdd",)),)),)
         pods.append(Pod(
             name=name,
             scheduler_name=scheduler_name,
@@ -309,6 +335,9 @@ def generate_workload(spec: WorkloadSpec,
             group=group,
             affinity_groups=affinity,
             anti_groups=anti,
+            zone_affinity_groups=zone_aff,
+            zone_anti_groups=zone_anti,
+            required_node_affinity=req_ns,
             soft_node_affinity=soft_node,
             soft_group_affinity=soft_group,
             spread_maxskew=spread_skew,

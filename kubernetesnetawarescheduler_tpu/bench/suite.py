@@ -372,9 +372,63 @@ def check_constraint_violations(loop: SchedulerLoop,
         if node:
             by_node.setdefault(node, []).append(p)
     nodes = {n.name: n for n in client.list_nodes()}
-    viol = {"affinity": 0, "anti": 0, "taint": 0, "capacity": 0}
+    viol = {"affinity": 0, "anti": 0, "taint": 0, "capacity": 0,
+            "zone_affinity": 0, "zone_anti": 0, "node_affinity": 0}
+    # Realized per-(zone, group) member counts (zone-scoped
+    # constraints).  Final-state audit: members never move or
+    # terminate in these workloads, so it never reports FALSE
+    # violations; for zone affinity it can under-detect (a service
+    # mate placed later makes an originally-empty zone look
+    # satisfied) — placement-time exactness is the oracle/property
+    # tests' job, this audit catches the blatant invariant breaks at
+    # bench scale.
+    zone_of = {name: n.zone for name, n in nodes.items()}
+    zg_count: dict[tuple[str, str], int] = {}
+    for node_name, placed in by_node.items():
+        z = zone_of.get(node_name, "")
+        if z:
+            for p in placed:
+                if p.group:
+                    key = (z, p.group)
+                    zg_count[key] = zg_count.get(key, 0) + 1
+
+    def _members(z: str, group: str, exclude_self_of=None) -> int:
+        c = zg_count.get((z, group), 0)
+        if exclude_self_of is not None and exclude_self_of.group == group:
+            c -= 1  # a pod is not its own zone-affinity witness
+        return c
+
+    def _expr_ok(op: str, key: str, vals, labels: dict) -> bool:
+        if op == "In":
+            return labels.get(key) in vals
+        if op == "NotIn":
+            return labels.get(key) not in vals
+        if op == "Exists":
+            return key in labels
+        if op == "DoesNotExist":
+            return key not in labels
+        return False
+
     for node_name, placed in by_node.items():
         node = nodes[node_name]
+        z = zone_of.get(node_name, "")
+        labels = dict(s.split("=", 1) for s in node.labels if "=" in s)
+        for p in placed:
+            if p.zone_affinity_groups and (not z or not any(
+                    _members(z, g, exclude_self_of=p) > 0
+                    for g in p.zone_affinity_groups)):
+                viol["zone_affinity"] += 1
+            if z and any(_members(z, g, exclude_self_of=p) > 0
+                         for g in p.zone_anti_groups):
+                # Self-exclusion: a pod with anti-affinity against its
+                # OWN group (kube's one-per-zone pattern) is not its
+                # own violation witness.
+                viol["zone_anti"] += 1
+            if p.required_node_affinity and not any(
+                    all(_expr_ok(op, key, vals, labels)
+                        for op, key, vals in term)
+                    for term in p.required_node_affinity):
+                viol["node_affinity"] += 1
         for p in placed:
             # Groups of the OTHER residents: required affinity must be
             # satisfied by a co-resident (the kernel checks group_bits
@@ -730,12 +784,56 @@ def run_sidecar_config(out_dir: str | None = None, num_nodes: int = 5120,
 # ---------------------------------------------------------------------------
 
 
+def run_zone_affinity_config(out_dir: str | None = None,
+                             num_nodes: int = 256, num_pods: int = 2048,
+                             batch: int = 128, seed: int = 0
+                             ) -> SuiteResult:
+    """Zone-scoped hard pod (anti-)affinity + nodeAffinity
+    matchExpressions under load: followers join their service's zone,
+    zone-anti pods avoid zones hosting their forbidden service, and
+    disk-constrained pods land only on matching nodes — audited
+    against realized placements (``check_constraint_violations`` zone/
+    node_affinity rows must be zero)."""
+    loop, cfg = _make_loop(num_nodes, seed, ScoreWeights(), batch=batch,
+                           queue=num_pods + batch)
+    pods = generate_workload(
+        WorkloadSpec(num_pods=num_pods, services=24,
+                     zone_aff_fraction=0.15, zone_anti_fraction=0.1,
+                     ns_fraction=0.2, affinity_fraction=0.1,
+                     anti_fraction=0.1, seed=seed),
+        scheduler_name=cfg.scheduler_name)
+    wall = _drain(loop, pods)
+    viol = check_constraint_violations(loop, pods)
+    n_zaff = sum(1 for p in pods if p.zone_affinity_groups)
+    n_zanti = sum(1 for p in pods if p.zone_anti_groups)
+    n_ns = sum(1 for p in pods if p.required_node_affinity)
+    metrics = {
+        "num_nodes": num_nodes,
+        "pods_bound": loop.scheduled,
+        "pods_unschedulable": loop.unschedulable,
+        "pods_per_sec": round(loop.scheduled / wall, 1) if wall else 0.0,
+        "zone_aff_pods": n_zaff,
+        "zone_anti_pods": n_zanti,
+        "node_affinity_pods": n_ns,
+        "violations": viol,
+        "violations_total": sum(viol.values()),
+    }
+    artifacts = []
+    if out_dir:
+        path = os.path.join(out_dir, "zone_affinity_audit.json")
+        with open(path, "w", encoding="utf-8") as fh:
+            json.dump(metrics, fh, indent=2)
+        artifacts.append(path)
+    return SuiteResult("zone_affinity", metrics, artifacts)
+
+
 CONFIGS: dict[str, Callable[..., SuiteResult]] = {
     "density": run_density_config,
     "custom_network": run_custom_network_config,
     "affinity": run_affinity_config,
     "soft_affinity": run_soft_affinity_config,
     "spread": run_spread_config,
+    "zone_affinity": run_zone_affinity_config,
     "binpack": run_binpack_config,
     "sidecar": run_sidecar_config,
 }
@@ -747,6 +845,7 @@ SMALL = {
     "affinity": dict(num_nodes=64, num_pods=128, batch=32),
     "soft_affinity": dict(num_nodes=64, num_pods=256, batch=32),
     "spread": dict(num_nodes=64, num_pods=256, batch=32),
+    "zone_affinity": dict(num_nodes=64, num_pods=256, batch=32),
     "binpack": dict(num_nodes=64, num_pods=256, batch=32),
     "sidecar": dict(num_nodes=128, num_apps=48, batch=32),
 }

@@ -252,6 +252,15 @@ def assign_parallel(state: ClusterState, pods: PodBatch,
     # dominant round cost after the mask recompute).
     order = jnp.argsort(-pods.priority, stable=True)
     rank = jnp.zeros((p,), jnp.int32).at[order].set(pod_ids)
+    # Round-invariant piece of the zone-anti round cap (pair [i, j]
+    # conflicts AND i outranks j): hoisted here because XLA does not
+    # move computations out of while_loop bodies.
+    zpair_conflict = (
+        (jnp.any(pods.zanti_bits[:, None, :]
+                 & pods.group_bit[None, :, :], axis=-1)
+         | jnp.any(pods.group_bit[:, None, :]
+                   & pods.zanti_bits[None, :, :], axis=-1))
+        & (rank[:, None] < rank[None, :]))
     if (n + 1) * p > np.iinfo(np.int32).max:
         # The composite key below would wrap and silently corrupt
         # winner selection; int64 needs jax_enable_x64.  (~16M nodes
@@ -326,13 +335,7 @@ def assign_parallel(state: ClusterState, pods: PodBatch,
         zsame = (winner[:, None] & winner[None, :]
                  & (zone_of[:, None] == zone_of[None, :])
                  & (zone_of >= 0)[:, None])
-        pair_conflict = (
-            jnp.any(pods.zanti_bits[:, None, :]
-                    & pods.group_bit[None, :, :], axis=-1)
-            | jnp.any(pods.group_bit[:, None, :]
-                      & pods.zanti_bits[None, :, :], axis=-1))
-        better = rank[:, None] < rank[None, :]
-        demote = jnp.any(zsame & pair_conflict & better, axis=0)
+        demote = jnp.any(zsame & zpair_conflict, axis=0)
         winner = winner & ~demote
 
         new_assignment = jnp.where(winner, choice, assignment)

@@ -248,11 +248,17 @@ def plan_preemption(encoder: Encoder, pod: Pod) -> PreemptionPlan | None:
         # strictly-lower-priority) pods are evictable; a PDB-protected
         # mandatory victim makes the node infeasible.
         mandatory: list[tuple[str, object]] = []
-        if anti_i or gbit_i or zanti_i:
+        # Zone terms only bind on zoned nodes (a zone-less node is its
+        # own empty domain — the kernel enforces nothing there, so
+        # evicting for a zone conflict would be a wasted eviction).
+        zanti_here = zanti_i if node_zone[node] >= 0 else 0
+        if anti_i or gbit_i or zanti_here:
             mandatory = [
                 (uid, rec) for uid, rec in cands
-                if (rec.group_bit & (anti_i | zanti_i))
-                or ((rec.anti_bits | rec.zanti_bits) & gbit_i)]
+                if (rec.group_bit & (anti_i | zanti_here))
+                or ((rec.anti_bits
+                     | (rec.zanti_bits if node_zone[node] >= 0 else 0))
+                    & gbit_i)]
         ok_budget = True
         for _, rec in mandatory:
             if not takeable(rec):

@@ -102,3 +102,15 @@ def test_spread_config_no_skew_violations():
     assert m["pods_bound"] > 0
     assert m["hard_spread_groups"] > 0
     assert m["skew_violations"] == 0
+
+
+def test_zone_affinity_config_zero_violations():
+    res = suite.run_zone_affinity_config(**suite.SMALL["zone_affinity"])
+    m = res.metrics
+    assert m["pods_bound"] > 0
+    # The workload actually exercises all three constraint families...
+    assert m["zone_aff_pods"] > 0
+    assert m["zone_anti_pods"] > 0
+    assert m["node_affinity_pods"] > 0
+    # ...and realized placements violate none of them.
+    assert m["violations_total"] == 0
