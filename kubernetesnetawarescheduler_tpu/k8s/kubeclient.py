@@ -280,11 +280,17 @@ def _required_group_terms(spec: Mapping) -> tuple:
     - ``topologyKey: kubernetes.io/hostname`` terms land in the
       host-scoped sets, ``topology.kubernetes.io/zone`` in the
       zone-scoped ones.
-    - AFFINITY terms degrade CLOSED: an unrepresentable term (selector
-      ``matchExpressions``, empty ``matchLabels``, any other
-      topologyKey) contributes :data:`UNSAT_GROUP`, whose bit no
-      resident carries — the pod stays unschedulable exactly where
-      kube-scheduler could not have verified the constraint either.
+    - Selector reduction: ``matchLabels`` plus any ``matchExpressions``
+      that are single-value ``In`` (exact label matches, folded in —
+      k8s ANDs both stanzas; a key folded to conflicting values is a
+      never-matches selector and degrades).  Anything richer
+      (multi-value In, NotIn/Exists/DoesNotExist, matchFields) is
+      unrepresentable, as is an empty reduction or a topologyKey other
+      than hostname/zone.
+    - AFFINITY terms degrade CLOSED: an unrepresentable term
+      contributes :data:`UNSAT_GROUP`, whose bit no resident carries —
+      the pod stays unschedulable exactly where kube-scheduler could
+      not have verified the constraint either.
       With several affinity terms the kernel's any-of join is WEAKER
       than kube's all-terms-AND — a documented approximation (one
       required term, the overwhelmingly common shape, is exact).
@@ -313,9 +319,27 @@ def _required_group_terms(spec: Mapping) -> tuple:
                 "requiredDuringSchedulingIgnoredDuringExecution") or []:
             tk = term.get("topologyKey")
             sel = term.get("labelSelector") or {}
-            match = sel.get("matchLabels") or {}
+            match = dict(sel.get("matchLabels") or {})
+            exprs = sel.get("matchExpressions") or []
+            # Single-value In expressions are exact label matches —
+            # fold them into the map (k8s ANDs both stanzas) instead
+            # of degrading; anything richer stays unrepresentable.
+            # A key folded to a DIFFERENT value than matchLabels (or
+            # another expression) already requires is a k8s
+            # never-matches selector — unrepresentable as a group, so
+            # it degrades (closed for affinity) rather than silently
+            # keeping the last value.
+            exprs_exact = all(
+                e.get("operator") == "In" and e.get("key")
+                and len(e.get("values") or []) == 1 for e in exprs)
+            if exprs_exact:
+                for e in exprs:
+                    key, val = e["key"], e["values"][0]
+                    if match.setdefault(key, val) != val:
+                        exprs_exact = False
+                        break
             representable = (tk in (_HOST_KEY, _ZONE_KEY) and match
-                             and not sel.get("matchExpressions"))
+                             and exprs_exact)
             if not representable:
                 degraded += 1
                 if not is_anti:

@@ -204,7 +204,7 @@ def test_parse_degradation_surfaces_as_event():
                 "requiredDuringSchedulingIgnoredDuringExecution": [
                     {"labelSelector": {"matchExpressions": [
                         {"key": "app", "operator": "In",
-                         "values": ["db"]}]},
+                         "values": ["db", "cache"]}]},  # multi-value
                      "topologyKey": "kubernetes.io/hostname"}]}},
         },
     }
@@ -246,6 +246,38 @@ def test_kubeclient_parses_required_pod_affinity():
     assert pod.zone_anti_groups == frozenset({"app=noisy"})
 
 
+def test_kubeclient_folds_single_in_expressions():
+    """labelSelector matchExpressions of single-value In are exact
+    label matches: folded into the group key, not degraded."""
+    obj = {
+        "metadata": {"name": "p"},
+        "spec": {
+            "containers": [],
+            "affinity": {"podAffinity": {
+                "requiredDuringSchedulingIgnoredDuringExecution": [
+                    {"labelSelector": {
+                        "matchLabels": {"app": "db"},
+                        "matchExpressions": [
+                            {"key": "tier", "operator": "In",
+                             "values": ["prod"]}]},
+                     "topologyKey": "topology.kubernetes.io/zone"}]}},
+        },
+    }
+    pod = pod_from_json(obj)
+    assert pod.zone_affinity_groups == frozenset({"app=db,tier=prod"})
+    assert pod.parse_degraded == 0
+    # A key folded to a CONFLICTING value is k8s's never-matches
+    # selector: degrade closed, don't keep the last value.
+    obj["spec"]["affinity"]["podAffinity"][
+        "requiredDuringSchedulingIgnoredDuringExecution"][0][
+        "labelSelector"]["matchExpressions"].append(
+        {"key": "app", "operator": "In", "values": ["cache"]})
+    pod2 = pod_from_json(obj)
+    from kubernetesnetawarescheduler_tpu.k8s.kubeclient import UNSAT_GROUP
+    assert pod2.zone_affinity_groups == frozenset({UNSAT_GROUP})
+    assert pod2.parse_degraded == 1
+
+
 def test_kubeclient_unrepresentable_affinity_degrades_closed():
     from kubernetesnetawarescheduler_tpu.k8s.kubeclient import (
         UNSAT_GROUP,
@@ -258,8 +290,9 @@ def test_kubeclient_unrepresentable_affinity_degrades_closed():
             "affinity": {"podAffinity": {
                 "requiredDuringSchedulingIgnoredDuringExecution": [
                     {"labelSelector": {"matchExpressions": [
-                        {"key": "app", "operator": "In",
-                         "values": ["db"]}]},
+                        {"key": "app", "operator": "NotIn",
+                         "values": ["db"]}]},  # negative selector:
+                     # no exact-label reduction exists
                      "topologyKey": "kubernetes.io/hostname"}]}},
         },
     }
