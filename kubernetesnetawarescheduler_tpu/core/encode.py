@@ -1031,7 +1031,8 @@ class Encoder:
 
     def _soft_rows(self, pod: Pod, sel_bits_row: np.ndarray,
                    sel_w_row: np.ndarray, grp_bits_row: np.ndarray,
-                   grp_w_row: np.ndarray) -> None:
+                   grp_w_row: np.ndarray, zone_bits_row: np.ndarray,
+                   zone_w_row: np.ndarray) -> None:
         """Fill one pod's soft-affinity term rows (caller holds the
         lock; rows are ``u32[T, W]`` / ``f32[T]`` slices).
 
@@ -1068,6 +1069,12 @@ class Encoder:
             if bit:
                 _fill_words(grp_bits_row[t], bit)
                 grp_w_row[t] = weight
+        for t, (grp, weight) in enumerate(
+                top_terms(getattr(pod, "soft_zone_affinity", ()) or ())):
+            bit = self.groups.bit(grp, lenient=True) if grp else 0
+            if bit:
+                _fill_words(zone_bits_row[t], bit)
+                zone_w_row[t] = weight
 
     def _ns_rows(self, pod: Pod, anyof_row: np.ndarray,
                  forbid_row: np.ndarray, used_row: np.ndarray,
@@ -1197,6 +1204,8 @@ class Encoder:
         ssel_w = np.zeros((p, t_soft), np.float32)
         sgrp = np.zeros((p, t_soft, w), np.uint32)
         sgrp_w = np.zeros((p, t_soft), np.float32)
+        szone = np.zeros((p, t_soft, w), np.uint32)
+        szone_w = np.zeros((p, t_soft), np.float32)
         gidx = np.full((p,), -1, np.int32)
         sp_skew = np.zeros((p,), np.int32)
         sp_hard = np.zeros((p,), bool)
@@ -1231,7 +1240,8 @@ class Encoder:
                 for row, val in zip((tol, sel, aff, anti, gbit), bits):
                     _fill_words(row[i], val)
                 self._soft_rows(pod, ssel[i], ssel_w[i],
-                                sgrp[i], sgrp_w[i])
+                                sgrp[i], sgrp_w[i], szone[i],
+                                szone_w[i])
                 self._ns_rows(pod, ns_any[i], ns_forb[i], ns_used[i],
                               lenient)
                 zb = self._zone_bits(pod, lenient)
@@ -1257,6 +1267,8 @@ class Encoder:
             priority=jnp.asarray(prio), pod_valid=jnp.asarray(valid),
             soft_sel_bits=jnp.asarray(ssel), soft_sel_w=jnp.asarray(ssel_w),
             soft_grp_bits=jnp.asarray(sgrp), soft_grp_w=jnp.asarray(sgrp_w),
+            soft_zone_bits=jnp.asarray(szone),
+            soft_zone_w=jnp.asarray(szone_w),
             group_idx=jnp.asarray(gidx),
             spread_maxskew=jnp.asarray(sp_skew),
             spread_hard=jnp.asarray(sp_hard),
@@ -1314,6 +1326,8 @@ class Encoder:
         ssel_w = np.zeros((s, t_soft), np.float32)
         sgrp = np.zeros((s, t_soft, w), np.uint32)
         sgrp_w = np.zeros((s, t_soft), np.float32)
+        szone = np.zeros((s, t_soft, w), np.uint32)
+        szone_w = np.zeros((s, t_soft), np.float32)
         gidx = np.full((s,), -1, np.int32)
         sp_skew = np.zeros((s,), np.int32)
         sp_hard = np.zeros((s,), bool)
@@ -1353,7 +1367,8 @@ class Encoder:
                 for row, val in zip((tol, sel, aff, anti, gbit), bits):
                     _fill_words(row[i], val)
                 self._soft_rows(pod, ssel[i], ssel_w[i],
-                                sgrp[i], sgrp_w[i])
+                                sgrp[i], sgrp_w[i], szone[i],
+                                szone_w[i])
                 self._ns_rows(pod, ns_any[i], ns_forb[i], ns_used[i],
                               lenient)
                 zb = self._zone_bits(pod, lenient)
@@ -1380,6 +1395,8 @@ class Encoder:
             priority=jnp.asarray(prio), pod_valid=jnp.asarray(valid),
             soft_sel_bits=jnp.asarray(ssel), soft_sel_w=jnp.asarray(ssel_w),
             soft_grp_bits=jnp.asarray(sgrp), soft_grp_w=jnp.asarray(sgrp_w),
+            soft_zone_bits=jnp.asarray(szone),
+            soft_zone_w=jnp.asarray(szone_w),
             group_idx=jnp.asarray(gidx),
             spread_maxskew=jnp.asarray(sp_skew),
             spread_hard=jnp.asarray(sp_hard),

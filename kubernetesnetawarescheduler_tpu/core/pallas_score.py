@@ -325,12 +325,13 @@ def static_scores_tiled(state: ClusterState, pods: PodBatch,
     raw, ok = _static_pallas_call(
         params, t, bw, lat, validk, nodes, nodei, groups, podf, podi,
         cfg=cfg, bp=bp, nb=nb, kb=kb, interpret=interpret)
-    # Hard nodeAffinity matchExpressions join OUTSIDE the tile kernel
-    # (like the spread join in score_pods_tiled): the [P, T2, E, W]
-    # any-of banks don't stream over N, and ns_affinity_ok self-gates
-    # on any term being present, so matchExpressions-free batches pay
-    # nothing on this path.
-    return (raw[:p_real, :n_real],
+    # Hard nodeAffinity matchExpressions and the soft zone term join
+    # OUTSIDE the tile kernel (like the spread join in
+    # score_pods_tiled): neither streams over the N×N matrices, and
+    # both self-gate, so batches without them pay nothing on this
+    # path.
+    return (raw[:p_real, :n_real]
+            + score_lib.soft_zone_scores(state, pods, cfg),
             (ok[:p_real, :n_real] > 0.5)
             & score_lib.ns_affinity_ok(state, pods))
 
@@ -481,10 +482,13 @@ def score_pods_tiled(state: ClusterState, pods: PodBatch,
     )(*args)
     out = out[:p_real, :n_real]
 
-    # Hard nodeAffinity matchExpressions and zone-scoped pod
-    # (anti-)affinity join OUTSIDE the tile kernel (neither streams
-    # over the N×N matrices; both self-gate on their constraints
-    # being present), same as static_scores_tiled / the dense path.
+    # Hard nodeAffinity matchExpressions, zone-scoped pod
+    # (anti-)affinity, and the soft zone term join OUTSIDE the tile
+    # kernel (none streams over the N×N matrices; all self-gate on
+    # their constraints being present), same as static_scores_tiled /
+    # the dense path.  The additive soft term cannot resurrect a
+    # masked entry: NEG_INF is -1e30 and weights are O(10).
+    out = out + score_lib.soft_zone_scores(state, pods, cfg)
     out = jnp.where(score_lib.ns_affinity_ok(state, pods), out,
                     jnp.float32(float(NEG_INF)))
     out = jnp.where(score_lib.zone_affinity_ok(state, pods), out,

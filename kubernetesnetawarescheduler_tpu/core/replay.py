@@ -70,6 +70,8 @@ class PodStream:
     soft_sel_w: jax.Array     # f32[S, T]
     soft_grp_bits: jax.Array  # u32[S, T, W]
     soft_grp_w: jax.Array     # f32[S, T]
+    soft_zone_bits: jax.Array  # u32[S, T, W]
+    soft_zone_w: jax.Array     # f32[S, T]
     group_idx: jax.Array       # i32[S]
     spread_maxskew: jax.Array  # i32[S]
     spread_hard: jax.Array     # bool[S]
@@ -121,6 +123,8 @@ def _make_step(state: ClusterState, cfg: SchedulerConfig, method: str,
             pod_valid=sl.pod_valid,
             soft_sel_bits=sl.soft_sel_bits, soft_sel_w=sl.soft_sel_w,
             soft_grp_bits=sl.soft_grp_bits, soft_grp_w=sl.soft_grp_w,
+            soft_zone_bits=sl.soft_zone_bits,
+            soft_zone_w=sl.soft_zone_w,
             group_idx=sl.group_idx, spread_maxskew=sl.spread_maxskew,
             spread_hard=sl.spread_hard, ns_anyof=sl.ns_anyof,
             ns_forbid=sl.ns_forbid, ns_term_used=sl.ns_term_used,
@@ -322,6 +326,8 @@ def pad_stream(stream: PodStream, multiple: int) -> PodStream:
         soft_sel_w=pd(stream.soft_sel_w, 0.0),
         soft_grp_bits=pd(stream.soft_grp_bits, 0),
         soft_grp_w=pd(stream.soft_grp_w, 0.0),
+        soft_zone_bits=pd(stream.soft_zone_bits, 0),
+        soft_zone_w=pd(stream.soft_zone_w, 0.0),
         group_idx=pd(stream.group_idx, -1),
         spread_maxskew=pd(stream.spread_maxskew, 0),
         spread_hard=pd(stream.spread_hard, False),

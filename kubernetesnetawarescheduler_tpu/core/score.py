@@ -221,7 +221,44 @@ def soft_affinity_scores(state: ClusterState, pods: PodBatch,
     group_term = jnp.sum(
         jnp.where(group_match, pods.soft_grp_w[:, :, None], 0.0), axis=1)
     scale = jnp.float32(cfg.weights.soft_affinity / 100.0)
-    return scale * (label_term + group_term)
+    return (scale * (label_term + group_term)
+            + soft_zone_scores(state, pods, cfg))
+
+
+def soft_zone_scores(state: ClusterState, pods: PodBatch,
+                     cfg: SchedulerConfig) -> jax.Array:
+    """Zone-scoped preferred pod (anti-)affinity term, ``f32[P, N]``:
+    bonus ``w_t`` on nodes whose ZONE hosts a member of the term's
+    group (``gz_counts`` presence, like the hard
+    :func:`zone_affinity_ok` but weighted); negative weight =
+    preferred zone spreading.  Zone-less nodes are empty domains —
+    no term matches there.  Exposed separately from
+    :func:`soft_affinity_scores` because the tiled Pallas kernel
+    computes the label/group banks in its epilogue and joins this
+    term outside the tiles; the dense path gets it via
+    ``soft_affinity_scores``.  Gated: batches without zone terms pay
+    one scalar reduction."""
+    p = pods.pod_valid.shape[0]
+    n = state.node_valid.shape[0]
+
+    def live(_):
+        from kubernetesnetawarescheduler_tpu.core.state import (
+            planes_to_words,
+        )
+
+        zmax = state.az_anti.shape[0]
+        zwords = planes_to_words((state.gz_counts > 0).T)   # u32[Z, W]
+        has_zone = state.node_zone >= 0
+        pres = zwords[jnp.clip(state.node_zone, 0, zmax - 1)]  # [N, W]
+        zb = pods.soft_zone_bits[:, :, None, :]             # [P, T, 1, W]
+        zmatch = (jnp.any((pres[None, None, :, :] & zb) != 0, axis=-1)
+                  & has_zone[None, None, :])                # [P, T, N]
+        term = jnp.sum(
+            jnp.where(zmatch, pods.soft_zone_w[:, :, None], 0.0), axis=1)
+        return jnp.float32(cfg.weights.soft_affinity / 100.0) * term
+
+    return jax.lax.cond(jnp.any(pods.soft_zone_bits != 0), live,
+                        lambda _: jnp.zeros((p, n), jnp.float32), None)
 
 
 def spread_active(pods: PodBatch) -> jax.Array:

@@ -152,6 +152,11 @@ class PodBatch:
     soft_sel_w: jax.Array      # f32[P, T]    signed term weight
     soft_grp_bits: jax.Array   # u32[P, T, W] resident groups (ANY overlap)
     soft_grp_w: jax.Array      # f32[P, T]    signed term weight
+    # Zone-scoped preferred pod (anti-)affinity: bonus w_t on nodes
+    # whose ZONE hosts a member of the term's group (gz_counts
+    # presence); negative = preferred zone spreading.
+    soft_zone_bits: jax.Array  # u32[P, T, W] zone-resident groups
+    soft_zone_w: jax.Array     # f32[P, T]    signed term weight
     # Topology spread (zone-level topologySpreadConstraints): the
     # pod's own group's bit-slot index (-1 = no group), the skew bound
     # (0 = no constraint), and whether violating it masks
@@ -229,6 +234,8 @@ def init_pod_batch(cfg: SchedulerConfig, **overrides: Any) -> PodBatch:
         soft_sel_w=jnp.zeros((p, cfg.max_soft_terms), jnp.float32),
         soft_grp_bits=jnp.zeros((p, cfg.max_soft_terms, w), jnp.uint32),
         soft_grp_w=jnp.zeros((p, cfg.max_soft_terms), jnp.float32),
+        soft_zone_bits=jnp.zeros((p, cfg.max_soft_terms, w), jnp.uint32),
+        soft_zone_w=jnp.zeros((p, cfg.max_soft_terms), jnp.float32),
         group_idx=jnp.full((p,), -1, jnp.int32),
         spread_maxskew=jnp.zeros((p,), jnp.int32),
         spread_hard=jnp.zeros((p,), jnp.bool_),

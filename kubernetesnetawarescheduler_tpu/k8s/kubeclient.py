@@ -212,17 +212,24 @@ def _required_node_terms(spec: Mapping) -> tuple:
 
 
 def _preferred_group_terms(spec: Mapping, ann: Mapping) -> tuple:
-    """Soft pod-(anti-)affinity as ``(("group", weight), ...)``.
+    """Soft pod-(anti-)affinity as ``(host_terms, zone_terms)``, each
+    ``(("group", weight), ...)``.
 
-    Two surfaces merge here: the native annotation
+    Two surfaces merge into the host bank: the native annotation
     ``netaware.io/soft-affinity`` (JSON ``{"group": weight}``, negative
     = preferred spreading), and the k8s ``podAffinity``/
-    ``podAntiAffinity`` preferred stanzas, whose ``labelSelector
-    .matchLabels`` reduce to the canonical sorted ``k=v[,k=v...]``
-    group key (matching pods whose ``netaware.io/group`` annotation
-    uses the same convention — the same hostname-topology reduction
-    the hard masks use)."""
+    ``podAntiAffinity`` preferred stanzas with ``topologyKey:
+    kubernetes.io/hostname``.  Zone-topologyKey preferred stanzas land
+    in the zone bank (scored against zone-resident membership,
+    ``score.soft_zone_scores``) — a node-scoped term would actively
+    misscore them (full spread bonus for a different node in the SAME
+    zone).  ``labelSelector.matchLabels`` reduce to the canonical
+    sorted ``k=v[,k=v...]`` group key (matching pods whose
+    ``netaware.io/group`` annotation uses the same convention); other
+    topologyKeys and richer selectors degrade score-neutrally (soft
+    semantics)."""
     out = []
+    zone_out = []
     if ANN_SOFT_AFFINITY in ann:
         try:
             raw = json.loads(ann[ANN_SOFT_AFFINITY])
@@ -242,21 +249,39 @@ def _preferred_group_terms(spec: Mapping, ann: Mapping) -> tuple:
             except (TypeError, ValueError):
                 continue
             pat = term.get("podAffinityTerm") or {}
-            # Group co-residency here is node-scoped (the
-            # hostname-topology reduction the hard masks use): a
-            # zone/rack topologyKey means "co-locate/spread at zone
-            # granularity", which a node-level term would actively
-            # misscore (full spread bonus for a different node in the
-            # SAME zone) — skip those, per the module contract that
-            # unrepresentable soft shapes degrade score-neutrally.
-            if pat.get("topologyKey") != "kubernetes.io/hostname":
+            tk = pat.get("topologyKey")
+            if tk not in (_HOST_KEY, _ZONE_KEY):
                 continue
-            match = (pat.get("labelSelector") or {}).get("matchLabels") or {}
-            if not weight or not match:
+            group = _selector_group(pat.get("labelSelector") or {})
+            if not weight or group is None:
+                # Unrepresentable selector: degrade score-neutrally
+                # (soft semantics) — scoring a DIFFERENT group than
+                # the k8s selector selects would misdirect the bias.
                 continue
-            group = ",".join(f"{k}={v}" for k, v in sorted(match.items()))
-            out.append((group, sign * weight))
-    return tuple(out)
+            (out if tk == _HOST_KEY else zone_out).append(
+                (group, sign * weight))
+    return tuple(out), tuple(zone_out)
+
+
+def _selector_group(sel: Mapping) -> str | None:
+    """Reduce a labelSelector to the canonical group key, or ``None``
+    when unrepresentable — ONE reduction shared by the required and
+    preferred pod-affinity parsers: ``matchLabels`` AND any
+    single-value ``In`` matchExpressions fold together; conflicting
+    values (k8s's never-matches selector), richer operators, or an
+    empty reduction are unrepresentable."""
+    match = dict(sel.get("matchLabels") or {})
+    exprs = sel.get("matchExpressions") or []
+    for e in exprs:
+        if (e.get("operator") != "In" or not e.get("key")
+                or len(e.get("values") or []) != 1):
+            return None
+        key, val = e["key"], e["values"][0]
+        if match.setdefault(key, val) != val:
+            return None
+    if not match:
+        return None
+    return ",".join(f"{k}={v}" for k, v in sorted(match.items()))
 
 
 _ZONE_KEY = "topology.kubernetes.io/zone"
@@ -318,35 +343,16 @@ def _required_group_terms(spec: Mapping) -> tuple:
         for term in (aff.get(kind) or {}).get(
                 "requiredDuringSchedulingIgnoredDuringExecution") or []:
             tk = term.get("topologyKey")
-            sel = term.get("labelSelector") or {}
-            match = dict(sel.get("matchLabels") or {})
-            exprs = sel.get("matchExpressions") or []
-            # Single-value In expressions are exact label matches —
-            # fold them into the map (k8s ANDs both stanzas) instead
-            # of degrading; anything richer stays unrepresentable.
-            # A key folded to a DIFFERENT value than matchLabels (or
-            # another expression) already requires is a k8s
-            # never-matches selector — unrepresentable as a group, so
-            # it degrades (closed for affinity) rather than silently
-            # keeping the last value.
-            exprs_exact = all(
-                e.get("operator") == "In" and e.get("key")
-                and len(e.get("values") or []) == 1 for e in exprs)
-            if exprs_exact:
-                for e in exprs:
-                    key, val = e["key"], e["values"][0]
-                    if match.setdefault(key, val) != val:
-                        exprs_exact = False
-                        break
-            representable = (tk in (_HOST_KEY, _ZONE_KEY) and match
-                             and exprs_exact)
-            if not representable:
+            # The selector reduction (matchLabels + single-value In
+            # fold, conflicts unrepresentable) is shared with the
+            # preferred parser: _selector_group.
+            group = _selector_group(term.get("labelSelector") or {})
+            if tk not in (_HOST_KEY, _ZONE_KEY) or group is None:
                 degraded += 1
                 if not is_anti:
                     (host_aff if tk != _ZONE_KEY else zone_aff).add(
                         UNSAT_GROUP)
                 continue  # anti: degrade open (counted above)
-            group = ",".join(f"{k}={v}" for k, v in sorted(match.items()))
             target = {
                 (False, _HOST_KEY): host_aff,
                 (False, _ZONE_KEY): zone_aff,
@@ -424,6 +430,7 @@ def pod_from_json(obj: Mapping) -> Pod:
     spread_skew, spread_hard = _spread_constraint(spec)
     host_aff, host_anti, zone_aff, zone_anti, parse_degraded = \
         _required_group_terms(spec)
+    soft_host_terms, soft_zone_terms = _preferred_group_terms(spec, ann)
     namespace = meta.get("namespace", "default")
     # Qualify peer references with the pod's own namespace (unless the
     # annotation already says "ns/name"): the pod cache and node_of()
@@ -449,7 +456,8 @@ def pod_from_json(obj: Mapping) -> Pod:
         zone_affinity_groups=zone_aff,
         zone_anti_groups=zone_anti,
         soft_node_affinity=_preferred_node_terms(spec),
-        soft_group_affinity=_preferred_group_terms(spec, ann),
+        soft_group_affinity=soft_host_terms,
+        soft_zone_affinity=soft_zone_terms,
         spread_maxskew=spread_skew,
         spread_hard=spread_hard,
         priority=float(spec.get("priority", 0) or 0),

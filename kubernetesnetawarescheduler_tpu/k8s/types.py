@@ -88,6 +88,11 @@ class Pod:
     #   weight = preferred spreading).
     soft_node_affinity: tuple = ()
     soft_group_affinity: tuple = ()
+    # - ``soft_zone_affinity``: (("group", weight), ...) — score bonus
+    #   on nodes whose ZONE hosts a member of that group (preferred
+    #   podAffinity with topologyKey topology.kubernetes.io/zone);
+    #   negative weight = preferred zone-level spreading.
+    soft_zone_affinity: tuple = ()
     # Zone-level topologySpreadConstraints (the counted pod set is the
     # pod's own ``group``): ``spread_maxskew`` 0 disables;
     # ``spread_hard`` True = whenUnsatisfiable: DoNotSchedule (mask),

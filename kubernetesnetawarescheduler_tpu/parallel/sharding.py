@@ -90,6 +90,8 @@ def pods_sharding(mesh: Mesh) -> PodBatch:
         soft_sel_w=s("dp", None),
         soft_grp_bits=s("dp", None, None),
         soft_grp_w=s("dp", None),
+        soft_zone_bits=s("dp", None, None),
+        soft_zone_w=s("dp", None),
         group_idx=s("dp"),
         spread_maxskew=s("dp"),
         spread_hard=s("dp"),
@@ -245,14 +247,17 @@ def pallas_static_builder(cfg: SchedulerConfig, mesh: Mesh):
                 pods, p, p, r_res, mw, t_soft, pf_cols, pi_cols)
             raw, ok = sharded_kernel(params0, t, bw_m, lat_m, validk,
                                      nodes, nodei, groups, podf, podi)
-            # nodeAffinity matchExpressions join outside the shard_map
-            # (plain GSPMD ops; self-gated on any term being present),
-            # mirroring the single-device static_scores_tiled.
+            # nodeAffinity matchExpressions and the soft zone term
+            # join outside the shard_map (plain GSPMD ops; self-gated
+            # on their constraints being present), mirroring the
+            # single-device static_scores_tiled.
             from kubernetesnetawarescheduler_tpu.core.score import (
                 ns_affinity_ok,
+                soft_zone_scores,
             )
 
-            return raw, (ok > 0.5) & ns_affinity_ok(st, pods)
+            return (raw + soft_zone_scores(st, pods, cfg),
+                    (ok > 0.5) & ns_affinity_ok(st, pods))
 
         return static_fn
 

@@ -128,6 +128,19 @@ def random_instance(rng: np.random.Generator, cfg: SchedulerConfig,
         sgrp_w = np.where(has_grp,
                           rng.uniform(-100.0, 100.0, (p_total, t_soft)),
                           0.0).astype(np.float32)
+    # Soft ZONE terms draw from the same seeded group-slot space as
+    # gz_counts (bits 0-1 of the last word), ~1/5 of pods, signed.
+    szone = np.zeros((p_total, t_soft), np.uint32)
+    szone_w = np.zeros((p_total, t_soft), np.float32)
+    if with_constraints:
+        has_zone_t = rng.random((p_total, t_soft)) < 0.2
+        szone = np.where(has_zone_t,
+                         np.uint32(1) << rng.integers(
+                             0, 2, (p_total, t_soft)).astype(np.uint32),
+                         0).astype(np.uint32)
+        szone_w = np.where(has_zone_t,
+                           rng.uniform(-100.0, 100.0, (p_total, t_soft)),
+                           0.0).astype(np.float32)
     pods.update(
         soft_sel_bits=np.stack([bits_col(ssel[:, t])
                                 for t in range(t_soft)], axis=1),
@@ -135,6 +148,9 @@ def random_instance(rng: np.random.Generator, cfg: SchedulerConfig,
         soft_grp_bits=np.stack([bits_col(sgrp[:, t])
                                 for t in range(t_soft)], axis=1),
         soft_grp_w=sgrp_w,
+        soft_zone_bits=np.stack([bits_col(szone[:, t])
+                                 for t in range(t_soft)], axis=1),
+        soft_zone_w=szone_w,
     )
     # Topology spread: group_idx derived from the generated group_bit
     # (single bit in the LAST word), ~1/3 of pods constrained, mixed

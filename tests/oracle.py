@@ -209,11 +209,18 @@ def oracle_soft(state, pods, cfg: SchedulerConfig):
     design — see core.score.soft_affinity_scores)."""
     p = pods["req"].shape[0]
     n = state["cap"].shape[0]
+    gz = state["gz_counts"]
+    pres_by_zone = [0] * gz.shape[1]
+    for z in range(gz.shape[1]):
+        for slot in range(gz.shape[0]):
+            if gz[slot, z] > 0:
+                pres_by_zone[z] |= 1 << slot
     out = np.zeros((p, n), np.float32)
     t_terms = pods["soft_sel_w"].shape[1]
     for i in range(p):
         for j in range(n):
             s = 0.0
+            zone = int(state["node_zone"][j])
             for t in range(t_terms):
                 bits = as_int(pods["soft_sel_bits"][i, t])
                 if bits and (as_int(state["label_bits"][j]) & bits) == bits:
@@ -221,6 +228,10 @@ def oracle_soft(state, pods, cfg: SchedulerConfig):
                 gbits = as_int(pods["soft_grp_bits"][i, t])
                 if gbits and (as_int(state["group_bits"][j]) & gbits) != 0:
                     s += pods["soft_grp_w"][i, t]
+                if "soft_zone_bits" in pods and zone >= 0:
+                    zbits = as_int(pods["soft_zone_bits"][i, t])
+                    if zbits and (pres_by_zone[zone] & zbits) != 0:
+                        s += pods["soft_zone_w"][i, t]
             out[i, j] = s * cfg.weights.soft_affinity / 100.0
     return out
 

@@ -104,6 +104,9 @@ def test_sharded_replay_matches_single_device():
         soft_grp_bits=jnp.zeros((s, CFG.max_soft_terms, CFG.mask_words),
                                 jnp.uint32),
         soft_grp_w=jnp.zeros((s, CFG.max_soft_terms), jnp.float32),
+        soft_zone_bits=jnp.zeros((s, CFG.max_soft_terms, CFG.mask_words),
+                                 jnp.uint32),
+        soft_zone_w=jnp.zeros((s, CFG.max_soft_terms), jnp.float32),
         group_idx=jnp.full((s,), -1, jnp.int32),
         spread_maxskew=jnp.zeros((s,), jnp.int32),
         spread_hard=jnp.zeros((s,), jnp.bool_),
@@ -182,6 +185,8 @@ def test_sharded_replay_never_gathers_full_nxn():
         soft_sel_w=jnp.zeros((s, t_soft), jnp.float32),
         soft_grp_bits=jnp.zeros((s, t_soft, w), jnp.uint32),
         soft_grp_w=jnp.zeros((s, t_soft), jnp.float32),
+        soft_zone_bits=jnp.zeros((s, t_soft, w), jnp.uint32),
+        soft_zone_w=jnp.zeros((s, t_soft), jnp.float32),
         group_idx=jnp.full((s,), -1, jnp.int32),
         spread_maxskew=jnp.zeros((s,), jnp.int32),
         spread_hard=jnp.zeros((s,), jnp.bool_),
@@ -277,6 +282,8 @@ def test_sharded_pallas_replay_matches_dense():
         soft_sel_w=jnp.asarray(ssel_w),
         soft_grp_bits=jnp.zeros((s, t, w), jnp.uint32),
         soft_grp_w=jnp.zeros((s, t), jnp.float32),
+        soft_zone_bits=jnp.zeros((s, t, w), jnp.uint32),
+        soft_zone_w=jnp.zeros((s, t), jnp.float32),
         group_idx=jnp.full((s,), -1, jnp.int32),
         spread_maxskew=jnp.zeros((s,), jnp.int32),
         spread_hard=jnp.zeros((s,), jnp.bool_),

@@ -246,6 +246,77 @@ def test_kubeclient_parses_required_pod_affinity():
     assert pod.zone_anti_groups == frozenset({"app=noisy"})
 
 
+def test_soft_zone_affinity_pulls_and_spreads():
+    """Preferred zone co-residency biases placement without masking:
+    positive weight pulls toward the member's zone, negative pushes
+    away — and an infeasible preference never forces anything."""
+    enc = _zoned_cluster()
+    enc.commit(Pod(name="m", uid="m", group="svc-a",
+                   requests={"cpu": 1.0}), "c")  # member in z1
+    pull = Pod(name="p", requests={"cpu": 1.0},
+               soft_zone_affinity=(("svc-a", 100.0),))
+    assert enc.node_name(_place(enc, pull)) in ("c", "d")
+    push = Pod(name="q", requests={"cpu": 1.0},
+               soft_zone_affinity=(("svc-a", -100.0),))
+    assert enc.node_name(_place(enc, push)) in ("a", "b")
+
+
+def test_kubeclient_parses_preferred_zone_stanza():
+    obj = {
+        "metadata": {"name": "p"},
+        "spec": {
+            "containers": [],
+            "affinity": {
+                "podAffinity": {
+                    "preferredDuringSchedulingIgnoredDuringExecution": [
+                        {"weight": 80, "podAffinityTerm": {
+                            "labelSelector": {
+                                "matchLabels": {"app": "db"}},
+                            "topologyKey":
+                                "topology.kubernetes.io/zone"}}]},
+                "podAntiAffinity": {
+                    "preferredDuringSchedulingIgnoredDuringExecution": [
+                        {"weight": 60, "podAffinityTerm": {
+                            "labelSelector": {
+                                "matchLabels": {"app": "noisy"}},
+                            "topologyKey":
+                                "topology.kubernetes.io/zone"}}]},
+            },
+        },
+    }
+    pod = pod_from_json(obj)
+    assert pod.soft_zone_affinity == (("app=db", 80.0),
+                                      ("app=noisy", -60.0))
+    assert pod.soft_group_affinity == ()
+
+
+def test_preferred_selector_folds_and_degrades_like_required():
+    """The preferred parser shares the required parser's selector
+    reduction: single-value In folds into the group; richer selectors
+    degrade score-neutrally instead of scoring the wrong group."""
+    base = {"metadata": {"name": "p"}, "spec": {"containers": [],
+            "affinity": {"podAntiAffinity": {
+                "preferredDuringSchedulingIgnoredDuringExecution": [
+                    {"weight": 50, "podAffinityTerm": {
+                        "labelSelector": {
+                            "matchLabels": {"app": "db"},
+                            "matchExpressions": [
+                                {"key": "tier", "operator": "In",
+                                 "values": ["prod"]}]},
+                        "topologyKey":
+                            "topology.kubernetes.io/zone"}}]}}}}
+    pod = pod_from_json(base)
+    assert pod.soft_zone_affinity == (("app=db,tier=prod", -50.0),)
+    # Multi-value In: unrepresentable -> the term vanishes (soft),
+    # never a mislabeled group.
+    base["spec"]["affinity"]["podAntiAffinity"][
+        "preferredDuringSchedulingIgnoredDuringExecution"][0][
+        "podAffinityTerm"]["labelSelector"]["matchExpressions"][0][
+        "values"] = ["prod", "staging"]
+    pod2 = pod_from_json(base)
+    assert pod2.soft_zone_affinity == ()
+
+
 def test_kubeclient_folds_single_in_expressions():
     """labelSelector matchExpressions of single-value In are exact
     label matches: folded into the group key, not degraded."""
