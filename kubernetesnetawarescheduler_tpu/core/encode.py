@@ -1070,7 +1070,7 @@ class Encoder:
                 _fill_words(grp_bits_row[t], bit)
                 grp_w_row[t] = weight
         for t, (grp, weight) in enumerate(
-                top_terms(getattr(pod, "soft_zone_affinity", ()) or ())):
+                top_terms(pod.soft_zone_affinity)):
             bit = self.groups.bit(grp, lenient=True) if grp else 0
             if bit:
                 _fill_words(zone_bits_row[t], bit)
