@@ -1117,7 +1117,20 @@ class Encoder:
             forbid = 0
             unsat = False
             for expr in term:
-                op, key, values = expr[0], expr[1], tuple(expr[2])
+                try:
+                    op, key, values = expr[0], expr[1], tuple(expr[2])
+                except (TypeError, IndexError, KeyError):
+                    # Malformed expression (programmatic Pod with the
+                    # wrong nesting, not kubeclient output): a batch
+                    # encode must not die on one bad pod — closed, per
+                    # the hard-constraint rule.
+                    if not lenient:
+                        raise ValueError(
+                            f"pod {pod.name}: malformed nodeAffinity "
+                            f"expression {expr!r}") from None
+                    degraded += 1
+                    unsat = True
+                    continue
                 if op == "In":
                     if not values:
                         unsat = True  # k8s validation forbids; closed
