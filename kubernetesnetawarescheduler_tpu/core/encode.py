@@ -259,6 +259,14 @@ class Encoder:
         # members for PDB min-available accounting.
         self._terminating: set[str] = set()
 
+        # Constraint-shape cache for the encode hot path (see
+        # _pod_constraint_rows).  _degrade_capture, when not None,
+        # accumulates _record_degraded counts so a cache entry stores
+        # the shape's true per-pod degradation regardless of event
+        # dedup or the bounded record deque.
+        self._shape_cache: dict[tuple, tuple] = {}
+        self._degrade_capture: int | None = None
+
         # Dirty tracking per transfer group, so snapshot() uploads the
         # 100 MB-class N x N matrices only when the probe pipeline
         # actually moved them.
@@ -1018,6 +1026,11 @@ class Encoder:
         """Queue one ConstraintDegraded record per pod identity
         (caller holds the lock); repeat drops for the same pod (commit
         after encode, retry cycles) are not re-recorded."""
+        if self._degrade_capture is not None:
+            # Shape-cache capture (see _pod_constraint_rows): tally
+            # the INTENDED count before identity dedup can suppress
+            # the record itself.
+            self._degrade_capture += count
         key = (pod.namespace, pod.name)
         if key in self._degraded_seen:
             return
@@ -1178,6 +1191,91 @@ class Encoder:
         if degraded and record:
             self._record_degraded(pod, degraded)
 
+    def _pod_constraint_rows(self, pod: Pod, lenient: bool,
+                             rows: tuple) -> tuple:
+        """Fill one pod's 16 constraint-row slices and return its
+        ``_constraint_bits`` tuple — with a SHAPE cache: pods of one
+        service/Deployment share identical constraint sets (same
+        tolerations/selectors/affinities/terms), so the interning and
+        row-building work runs once per distinct shape and later pods
+        memcpy the rows (measured ~2x on the 65k-pod stream encode).
+
+        Cache safety: interned bits are stable once assigned (the
+        tables only grow) and lazy label/presence backfill happens on
+        first intern — both exactly-once effects a later identical
+        shape no longer needs.  Degradation is replayed per pod: the
+        compute's recorded drop count is stored and re-recorded for
+        every cache-hit pod (events are per-pod, identity-keyed).
+        Strict and lenient entries are keyed apart (strict must keep
+        raising); a strict-mode raise caches nothing.  Caller holds
+        the lock.
+        """
+        key: tuple | None = (
+            lenient, pod.tolerations, pod.node_selector,
+            pod.affinity_groups, pod.anti_groups, pod.group,
+            pod.required_node_affinity, pod.zone_affinity_groups,
+            pod.zone_anti_groups, pod.soft_node_affinity,
+            pod.soft_group_affinity, pod.soft_zone_affinity,
+            int(getattr(pod, "parse_degraded", 0)))
+        try:
+            cached = self._shape_cache.get(key)
+        except TypeError:
+            # Programmatic Pods may carry list/set-valued fields (the
+            # dataclass doesn't coerce); they encode fine, they just
+            # can't key the cache — bypass it rather than crash the
+            # lenient batch.
+            key = None
+            cached = None
+        if cached is not None:
+            bits, nonzero, d_delta = cached
+            # Only the rows the compute actually touched are stored
+            # (targets are pre-zeroed): typical pods copy 1-3 small
+            # arrays, not 16 — the copies were otherwise eating the
+            # cache's win.
+            for j, src in nonzero:
+                rows[j][...] = src
+            if d_delta:
+                self._record_degraded(pod, d_delta)
+            return bits
+        (tol_r, sel_r, aff_r, anti_r, gbit_r, ssel_r, ssel_w_r,
+         sgrp_r, sgrp_w_r, szone_r, szone_w_r, ns_any_r, ns_forb_r,
+         ns_used_r, zaff_r, zanti_r) = rows
+        # Capture the compute's INTENDED degradation count through the
+        # explicit accumulator (deque-length arithmetic would read 0
+        # once the bounded _degraded_pods is full, or when this pod's
+        # identity was already recorded).
+        self._degrade_capture = 0
+        try:
+            bits = self._constraint_bits(pod, lenient)
+            for row, val in zip((tol_r, sel_r, aff_r, anti_r, gbit_r),
+                                bits):
+                if val:  # rows are pre-zeroed; most masks are 0
+                    _fill_words(row, val)
+            self._soft_rows(pod, ssel_r, ssel_w_r, sgrp_r, sgrp_w_r,
+                            szone_r, szone_w_r)
+            self._ns_rows(pod, ns_any_r, ns_forb_r, ns_used_r, lenient)
+            zb = self._zone_bits(pod, lenient)
+            if zb[0]:
+                _fill_words(zaff_r, zb[0])
+            if zb[1]:
+                _fill_words(zanti_r, zb[1])
+            d_delta = self._degrade_capture
+        finally:
+            # A strict-mode raise must not leave the accumulator armed
+            # for unrelated later _record_degraded calls.
+            self._degrade_capture = None
+        if key is not None:
+            if len(self._shape_cache) >= 8192:
+                # Bounded: pathological all-distinct fleets fall back
+                # to compute-per-pod, never unbounded memory.
+                self._shape_cache.clear()
+            self._shape_cache[key] = (
+                bits,
+                tuple((j, r.copy())
+                      for j, r in enumerate(rows) if r.any()),
+                d_delta)
+        return bits
+
     def encode_pods(self, pods: Sequence[Pod],
                     node_of: Callable[[str], str],
                     lenient: bool = False,
@@ -1249,17 +1347,11 @@ class Encoder:
                     peers[i, slot] = idx
                     traffic[i, slot] = vol
                     slot += 1
-                bits = self._constraint_bits(pod, lenient)
-                for row, val in zip((tol, sel, aff, anti, gbit), bits):
-                    _fill_words(row[i], val)
-                self._soft_rows(pod, ssel[i], ssel_w[i],
-                                sgrp[i], sgrp_w[i], szone[i],
-                                szone_w[i])
-                self._ns_rows(pod, ns_any[i], ns_forb[i], ns_used[i],
-                              lenient)
-                zb = self._zone_bits(pod, lenient)
-                _fill_words(zaff[i], zb[0])
-                _fill_words(zanti[i], zb[1])
+                bits = self._pod_constraint_rows(pod, lenient, (
+                    tol[i], sel[i], aff[i], anti[i], gbit[i],
+                    ssel[i], ssel_w[i], sgrp[i], sgrp_w[i],
+                    szone[i], szone_w[i], ns_any[i], ns_forb[i],
+                    ns_used[i], zaff[i], zanti[i]))
                 gmask = bits[4]
                 gidx[i] = gmask.bit_length() - 1 if gmask else -1
                 sp_skew[i] = int(getattr(pod, "spread_maxskew", 0))
@@ -1376,17 +1468,11 @@ class Encoder:
                         peer_nodes[i, slot] = idx
                     traffic[i, slot] = vol
                     slot += 1
-                bits = self._constraint_bits(pod, lenient)
-                for row, val in zip((tol, sel, aff, anti, gbit), bits):
-                    _fill_words(row[i], val)
-                self._soft_rows(pod, ssel[i], ssel_w[i],
-                                sgrp[i], sgrp_w[i], szone[i],
-                                szone_w[i])
-                self._ns_rows(pod, ns_any[i], ns_forb[i], ns_used[i],
-                              lenient)
-                zb = self._zone_bits(pod, lenient)
-                _fill_words(zaff[i], zb[0])
-                _fill_words(zanti[i], zb[1])
+                bits = self._pod_constraint_rows(pod, lenient, (
+                    tol[i], sel[i], aff[i], anti[i], gbit[i],
+                    ssel[i], ssel_w[i], sgrp[i], sgrp_w[i],
+                    szone[i], szone_w[i], ns_any[i], ns_forb[i],
+                    ns_used[i], zaff[i], zanti[i]))
                 gmask = bits[4]
                 gidx[i] = gmask.bit_length() - 1 if gmask else -1
                 sp_skew[i] = int(getattr(pod, "spread_maxskew", 0))

@@ -91,9 +91,6 @@ def _check_pod(pod: Pod, node: Node, co_resident: list[Pod],
     for s in pod.node_selector:
         if s not in node.labels:
             out.append(f"selector {s}")
-    for term_idx, term in enumerate(pod.required_node_affinity or ()):
-        # terms OR: overall ok if any term passes
-        pass
     if pod.required_node_affinity:
         def expr_ok(op, key, vals):
             if op == "In":
@@ -198,6 +195,50 @@ def test_malformed_node_affinity_degrades_not_crashes():
     assert enc.pop_degraded()
     with pytest.raises(ValueError, match="malformed"):
         enc.encode_pods([bad], node_of=lambda s: "", lenient=False)
+
+
+def test_unhashable_constraint_fields_bypass_cache():
+    """Programmatic Pods with list/set-valued constraint fields (the
+    dataclass doesn't coerce) must still encode — the shape cache is
+    bypassed, never a crash."""
+    from kubernetesnetawarescheduler_tpu.core.assign import (
+        assign_parallel,
+    )
+    from kubernetesnetawarescheduler_tpu.core.encode import Encoder
+
+    cfg = SchedulerConfig(max_nodes=4, max_pods=4, max_peers=2)
+    enc = Encoder(cfg)
+    enc.upsert_node(Node(name="a", capacity={"cpu": 8.0, "mem": 8.0},
+                         labels=frozenset({"disk=ssd"})))
+    pod = Pod(name="p", requests={"cpu": 1.0},
+              node_selector={"disk=ssd"},            # set, not frozenset
+              required_node_affinity=[[("In", "disk", ["ssd"])]])  # lists
+    batch = enc.encode_pods([pod], node_of=lambda s: "", lenient=True)
+    a = np.asarray(assign_parallel(enc.snapshot(), batch, cfg))
+    assert a[0] == 0
+    assert not enc._shape_cache  # bypassed, not stored
+
+
+def test_degradation_replays_for_every_cache_hit_pod():
+    """Each pod of a degrading shape gets its own ConstraintDegraded
+    record, including pods served from the shape cache."""
+    from kubernetesnetawarescheduler_tpu.core.encode import Encoder
+
+    cfg = SchedulerConfig(max_nodes=4, max_pods=8, max_peers=2,
+                          max_ns_terms=1)
+    enc = Encoder(cfg)
+    enc.upsert_node(Node(name="a", capacity={"cpu": 8.0, "mem": 8.0}))
+    shape = dict(requests={"cpu": 1.0},
+                 required_node_affinity=(
+                     (("In", "d", ("x",)),), (("In", "d", ("y",)),)))
+    pods = [Pod(name=f"deg-{i}", uid=f"deg-{i}", **shape)
+            for i in range(4)]
+    enc.encode_pods(pods, node_of=lambda s: "", lenient=True)
+    recs = enc.pop_degraded()
+    assert {(ns, name) for ns, name, _ in recs} == {
+        ("default", f"deg-{i}") for i in range(4)}
+    # All carry the same (shape-level) dropped-term count.
+    assert len({c for *_ , c in recs}) == 1 and recs[0][2] >= 1
 
 
 def test_unschedulable_pods_are_genuinely_unschedulable():
