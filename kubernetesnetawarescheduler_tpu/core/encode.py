@@ -1028,9 +1028,13 @@ class Encoder:
         after encode, retry cycles) are not re-recorded."""
         if self._degrade_capture is not None:
             # Shape-cache capture (see _pod_constraint_rows): tally
-            # the INTENDED count before identity dedup can suppress
-            # the record itself.
+            # only — the caller records ONE event with the shape's
+            # total afterwards, so miss and hit pods of one shape
+            # report the same count (piecemeal recording here would
+            # give the miss pod only its first source's count, the
+            # identity dedup suppressing the rest).
             self._degrade_capture += count
+            return
         key = (pod.namespace, pod.name)
         if key in self._degraded_seen:
             return
@@ -1264,6 +1268,8 @@ class Encoder:
             # A strict-mode raise must not leave the accumulator armed
             # for unrelated later _record_degraded calls.
             self._degrade_capture = None
+        if d_delta:
+            self._record_degraded(pod, d_delta)
         if key is not None:
             if len(self._shape_cache) >= 8192:
                 # Bounded: pathological all-distinct fleets fall back

@@ -395,11 +395,35 @@ def pod_from_json(obj: Mapping) -> Pod:
     spec = obj.get("spec", {})
     ann = meta.get("annotations") or {}
 
+    # Effective pod request, kube-scheduler semantics:
+    # max(sum(containers), max(initContainers)) + pod overhead.
+    # Init containers run SEQUENTIALLY before the main ones, so the
+    # node must fit whichever phase is larger, not their sum; sidecar
+    # (restartable) init containers count like main containers.
+    # spec.overhead is the RuntimeClass surcharge the scheduler must
+    # reserve (kube adds it to the pod's effective request).
     cpu = mem = 0.0
+    init_cpu = init_mem = 0.0
+    sidecar_cpu = sidecar_mem = 0.0
     for c in spec.get("containers", []) or []:
         req = (c.get("resources") or {}).get("requests") or {}
         cpu += parse_quantity(req.get("cpu", 0))
         mem += parse_quantity(req.get("memory", 0))
+    for c in spec.get("initContainers", []) or []:
+        req = (c.get("resources") or {}).get("requests") or {}
+        c_cpu = parse_quantity(req.get("cpu", 0))
+        c_mem = parse_quantity(req.get("memory", 0))
+        if c.get("restartPolicy") == "Always":  # sidecar: runs forever
+            sidecar_cpu += c_cpu
+            sidecar_mem += c_mem
+        else:
+            init_cpu = max(init_cpu, c_cpu + sidecar_cpu)
+            init_mem = max(init_mem, c_mem + sidecar_mem)
+    cpu = max(cpu + sidecar_cpu, init_cpu)
+    mem = max(mem + sidecar_mem, init_mem)
+    overhead = spec.get("overhead") or {}
+    cpu += parse_quantity(overhead.get("cpu", 0))
+    mem += parse_quantity(overhead.get("memory", 0))
     requests: dict[str, float] = {}
     if cpu:
         requests["cpu"] = cpu

@@ -481,3 +481,48 @@ def test_pod_from_json_preferred_affinity():
     assert ("cache", -15.0) in pod.soft_group_affinity
     assert ("app=db", 30.0) in pod.soft_group_affinity
     assert ("app=web", -20.0) in pod.soft_group_affinity
+
+
+def test_effective_request_init_containers_and_overhead():
+    """kube-scheduler's effective request:
+    max(sum(containers), max(initContainers)) + overhead; sidecar
+    (restartPolicy: Always) init containers add like main ones."""
+    from kubernetesnetawarescheduler_tpu.k8s.kubeclient import (
+        pod_from_json,
+    )
+
+    obj = {
+        "metadata": {"name": "p"},
+        "spec": {
+            "containers": [
+                {"resources": {"requests": {"cpu": "1",
+                                            "memory": "1Gi"}}},
+                {"resources": {"requests": {"cpu": "1"}}},
+            ],
+            "initContainers": [
+                # Big one-shot init: phase max dominates cpu.
+                {"resources": {"requests": {"cpu": "5"}}},
+                # Sidecar: persists, adds to the main phase.
+                {"restartPolicy": "Always",
+                 "resources": {"requests": {"cpu": "500m",
+                                            "memory": "1Gi"}}},
+            ],
+            "overhead": {"cpu": "250m", "memory": "1Gi"},
+        },
+    }
+    pod = pod_from_json(obj)
+    # cpu: max(1+1 + 0.5 sidecar, 5 init) + 0.25 overhead = 5.25
+    assert pod.requests["cpu"] == 5.25
+    # mem: max(1Gi + 1Gi sidecar, 0) + 1Gi overhead = 3 GiB
+    assert pod.requests["mem"] == 3.0
+
+
+def test_effective_request_plain_pods_unchanged():
+    from kubernetesnetawarescheduler_tpu.k8s.kubeclient import (
+        pod_from_json,
+    )
+
+    obj = {"metadata": {"name": "p"}, "spec": {"containers": [
+        {"resources": {"requests": {"cpu": "2", "memory": "2Gi"}}}]}}
+    pod = pod_from_json(obj)
+    assert pod.requests == {"cpu": 2.0, "mem": 2.0}
