@@ -23,3 +23,7 @@ from kubernetesnetawarescheduler_tpu.parallel.sharding import (  # noqa: F401
     sharded_schedule_step,
     state_sharding,
 )
+from kubernetesnetawarescheduler_tpu.parallel.multihost import (  # noqa: F401
+    global_mesh,
+    init_multihost,
+)
