@@ -41,7 +41,7 @@ class SchedulerLoop:
 
     def __init__(self, client: ClusterClient, cfg: SchedulerConfig,
                  method: str = "parallel", decision_log=None,
-                 encoder: Encoder | None = None) -> None:
+                 encoder: Encoder | None = None, mesh=None) -> None:
         self.cfg = cfg
         self.client = client
         self.method = method
@@ -71,8 +71,18 @@ class SchedulerLoop:
         self._awaiting_preemption: dict[
             str, tuple[Pod, set, float]] = {}
         self._preempt_lock = threading.Lock()
-        self._assign = {"greedy": assign_greedy,
-                        "parallel": assign_parallel}[method]
+        if mesh is not None:
+            # Mesh-sharded serving (multi-chip / multi-host): the same
+            # cycle, with score+assign jitted under the canonical
+            # (dp, tp) shardings — see parallel.sharding.
+            from kubernetesnetawarescheduler_tpu.parallel.sharding import (
+                sharded_assign_fn,
+            )
+
+            self._assign = sharded_assign_fn(cfg, mesh, method)
+        else:
+            self._assign = {"greedy": assign_greedy,
+                            "parallel": assign_parallel}[method]
         # is_parked keeps resync/watch re-deliveries of a preemptor
         # that is waiting for victim confirmation out of the queue —
         # scoring it early would drop its reservation and burn its

@@ -40,18 +40,42 @@ def init_multihost(coordinator_address: str | None = None,
     call (e.g. serve.py restart paths re-running init) is a no-op
     instead of an error.
     """
+    is_init = getattr(jax.distributed, "is_initialized", None)
+    if is_init is not None and is_init():
+        return  # idempotent no-op, no fragile message matching
     try:
         jax.distributed.initialize(
             coordinator_address=coordinator_address,
             num_processes=num_processes,
             process_id=process_id)
+    except ValueError as exc:
+        # No cluster environment to auto-detect from AND no explicit
+        # bootstrap args: a single-process run (laptop smoke test,
+        # one-host deployment with --multihost in the manifest) —
+        # proceed single-process; the mesh still covers every local
+        # device.  With explicit args the operator asked for a
+        # specific topology, so a bootstrap failure must surface.
+        if (coordinator_address is None and num_processes is None
+                and process_id is None):
+            import warnings
+
+            warnings.warn(
+                f"multihost init unavailable ({exc}); continuing "
+                "single-process over local devices", RuntimeWarning,
+                stacklevel=2)
+            return
+        raise
     except RuntimeError as exc:
-        # Double-init message is version-dependent: jax 0.9 raises
-        # "distributed.initialize should only be called once."; older
-        # versions said "already initialized".
+        # Fallback for jax versions without is_initialized(): the
+        # double-init message is version-dependent ("should only be
+        # called once." / "already initialized").  Genuine failures
+        # (coordinator unreachable, bad ranks) re-raise — and with
+        # is_initialized() available above, this branch only ever
+        # sees genuine failures.
         msg = str(exc).lower()
-        if "once" not in msg and "already" not in msg:
-            raise
+        if is_init is None and ("once" in msg or "already" in msg):
+            return
+        raise
 
 
 def global_mesh(dp: int | None = None, tp: int | None = None) -> Mesh:

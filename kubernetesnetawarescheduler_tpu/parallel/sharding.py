@@ -145,6 +145,30 @@ def sharded_schedule_step(cfg: SchedulerConfig, mesh: Mesh,
     )
 
 
+def sharded_assign_fn(cfg: SchedulerConfig, mesh: Mesh,
+                      method: str = "parallel"):
+    """A drop-in for the serving loop's assign callable
+    (``(state, pods, cfg) -> assignment``), jitted with the canonical
+    mesh shardings — the piece that makes ``--multihost`` serving
+    real: every process runs the SAME program, GSPMD splits the node
+    axis (and the N×N matrices' HBM) over ``tp`` and the pod axis
+    over ``dp``, and the replicated assignment comes back to each
+    host's binder.  The cfg argument is accepted for signature parity
+    with ``assign_parallel``/``assign_greedy`` but must equal the one
+    compiled in."""
+    assign = {"greedy": assign_greedy, "parallel": assign_parallel}[method]
+    jitted = jax.jit(
+        partial(assign, cfg=_force_dense(cfg)),
+        in_shardings=(state_sharding(mesh), pods_sharding(mesh)),
+        out_shardings=NamedSharding(mesh, P()),
+    )
+
+    def fn(state, pods, cfg_arg=None):
+        return jitted(state, pods)
+
+    return fn
+
+
 def replicated(mesh: Mesh):
     return NamedSharding(mesh, P())
 
@@ -331,4 +355,4 @@ def sharded_replay_fn(cfg: SchedulerConfig, mesh: Mesh, method: str,
 
 __all__ = ["make_mesh", "state_sharding", "pods_sharding", "place",
            "sharded_schedule_step", "sharded_replay_stream",
-           "sharded_replay_fn", "replicated"]
+           "sharded_replay_fn", "sharded_assign_fn", "replicated"]

@@ -53,7 +53,8 @@ def _agent_reachable(host: str, port: int, timeout_s: float = 3.0) -> bool:
         return False
 
 
-def build_fake(num_nodes: int, seed: int, cfg: SchedulerConfig):
+def build_fake(num_nodes: int, seed: int, cfg: SchedulerConfig,
+               mesh=None):
     from kubernetesnetawarescheduler_tpu.bench.fakecluster import (
         ClusterSpec,
         build_fake_cluster,
@@ -65,7 +66,7 @@ def build_fake(num_nodes: int, seed: int, cfg: SchedulerConfig):
 
     cluster, lat, bw = build_fake_cluster(
         ClusterSpec(num_nodes=num_nodes, seed=seed))
-    loop = SchedulerLoop(cluster, cfg)
+    loop = SchedulerLoop(cluster, cfg, mesh=mesh)
     loop.encoder.set_network(lat, bw)
     feed_metrics(cluster, loop.encoder, np.random.default_rng(seed + 1))
     return loop, lat, bw
@@ -116,7 +117,54 @@ def main(argv=None) -> int:
     ap.add_argument("--once", action="store_true",
                     help="serve one readiness cycle then exit "
                          "(smoke-test mode)")
+    ap.add_argument("--multihost", action="store_true",
+                    help="join the multi-process JAX runtime before "
+                         "device init (TPU pods: coordinator "
+                         "auto-detects from the environment), build "
+                         "the (dp, tp) mesh over all hosts, and run "
+                         "the scoring kernels sharded over it — see "
+                         "parallel/multihost.py")
+    ap.add_argument("--coordinator", default="",
+                    help="explicit coordinator address for "
+                         "--multihost on bare-metal DCN clusters "
+                         "(host:port; empty = auto-detect). Needs "
+                         "--num-processes/--process-id too when no "
+                         "cluster environment provides them")
+    ap.add_argument("--num-processes", type=int, default=None,
+                    help="total process count for --multihost "
+                         "bare-metal bootstrap")
+    ap.add_argument("--process-id", type=int, default=None,
+                    help="this process's rank for --multihost "
+                         "bare-metal bootstrap")
     args = ap.parse_args(argv)
+
+    mesh = None
+    if args.multihost:
+        import jax
+
+        from kubernetesnetawarescheduler_tpu.parallel.multihost import (
+            global_mesh,
+            init_multihost,
+        )
+
+        init_multihost(coordinator_address=args.coordinator or None,
+                       num_processes=args.num_processes,
+                       process_id=args.process_id)
+        if jax.process_count() > 1:
+            # SERVING is single-controller: every process would run
+            # its own informer/queue/binder against divergent watch
+            # streams, feeding inconsistent "global" values into the
+            # SPMD kernels and POSTing duplicate Bindings.  The
+            # multi-PROCESS mesh is for the offline replay/bench
+            # paths (sharded_replay_stream — one controller, one
+            # input stream); serving shards over the chips of ONE
+            # process (the v5e-4 north-star shape) via this same
+            # flag.
+            ap.error(
+                "--multihost serving supports one process with many "
+                "local devices; multi-process meshes are for the "
+                "replay/bench paths (parallel.sharded_replay_stream)")
+        mesh = global_mesh()
 
     cfg = load_config(args.config) if args.config else SchedulerConfig()
 
@@ -124,7 +172,8 @@ def main(argv=None) -> int:
     lat_truth = bw_truth = None
     if kind == "fake":
         loop, lat_truth, bw_truth = build_fake(int(param or "128"),
-                                               args.seed, cfg)
+                                               args.seed, cfg,
+                                               mesh=mesh)
     elif kind in ("incluster", "kube"):
         from kubernetesnetawarescheduler_tpu.core.loop import SchedulerLoop
         from kubernetesnetawarescheduler_tpu.k8s.kubeclient import KubeClient
@@ -135,7 +184,7 @@ def main(argv=None) -> int:
         # SchedulerLoop's Informer lists + subscribes nodes itself;
         # resync() recovers pods already pending at startup (the
         # re-list the reference lacked — ADD-only, scheduler.go:165).
-        loop = SchedulerLoop(client, cfg)
+        loop = SchedulerLoop(client, cfg, mesh=mesh)
         loop.informer.resync()
     else:
         ap.error(f"unknown cluster kind {kind!r} "

@@ -53,29 +53,77 @@ def test_explicit_shape_accepted_within_host():
     assert int((np.asarray(assignment) >= 0).sum()) > 0
 
 
+def test_mesh_sharded_serving_loop_matches_unsharded():
+    """SchedulerLoop(mesh=...) — the --multihost serving path — binds
+    the same pods to the same nodes as the single-device loop."""
+    from kubernetesnetawarescheduler_tpu.bench.fakecluster import (
+        ClusterSpec,
+        WorkloadSpec,
+        build_fake_cluster,
+        feed_metrics,
+        generate_workload,
+    )
+    from kubernetesnetawarescheduler_tpu.config import SchedulerConfig
+    from kubernetesnetawarescheduler_tpu.core.loop import SchedulerLoop
+
+    cfg = SchedulerConfig(max_nodes=64, max_pods=16, max_peers=4,
+                          queue_capacity=256, use_bfloat16=False)
+    binds = {}
+    for label, mesh in (("plain", None), ("mesh", global_mesh(2, 4))):
+        cluster, lat, bw = build_fake_cluster(
+            ClusterSpec(num_nodes=48, seed=5))
+        loop = SchedulerLoop(cluster, cfg, mesh=mesh)
+        loop.encoder.set_network(lat, bw)
+        feed_metrics(cluster, loop.encoder, np.random.default_rng(6))
+        pods = generate_workload(WorkloadSpec(num_pods=64, seed=7),
+                                 scheduler_name=cfg.scheduler_name)
+        cluster.add_pods(pods)
+        loop.run_until_drained()
+        binds[label] = {b.pod_name: b.node_name
+                        for b in cluster.bindings}
+    assert binds["plain"] == binds["mesh"]
+    assert binds["plain"]  # non-trivial
+
+
 def test_init_multihost_is_idempotent(monkeypatch):
-    """A second init (serve.py restart path) must be a no-op for the
-    double-call RuntimeError jax actually raises (message verified
-    against jax 0.9: 'distributed.initialize should only be called
-    once.'), while genuine failures re-raise."""
+    """A second init (serve.py restart path) must be a no-op — via
+    jax.distributed.is_initialized() when available, else the
+    double-call RuntimeError fallback — while genuine failures
+    re-raise in both worlds."""
     import kubernetesnetawarescheduler_tpu.parallel.multihost as mh
 
-    def raise_once(**kw):
-        raise RuntimeError(
-            "distributed.initialize should only be called once.")
+    # Modern path: is_initialized() True -> initialize never called.
+    monkeypatch.setattr(jax.distributed, "is_initialized",
+                        lambda: True, raising=False)
+    monkeypatch.setattr(
+        jax.distributed, "initialize",
+        lambda **kw: (_ for _ in ()).throw(AssertionError("called")))
+    mh.init_multihost()
 
-    monkeypatch.setattr(jax.distributed, "initialize", raise_once)
-    mh.init_multihost()  # swallowed
+    # Genuine failure with is_initialized() False: re-raise, even if
+    # the message happens to contain 'already' (port collision).
+    monkeypatch.setattr(jax.distributed, "is_initialized",
+                        lambda: False, raising=False)
 
     def raise_real(**kw):
-        raise RuntimeError("coordinator unreachable")
+        raise RuntimeError("bind failed: Address already in use")
 
     monkeypatch.setattr(jax.distributed, "initialize", raise_real)
-    with pytest.raises(RuntimeError, match="unreachable"):
+    with pytest.raises(RuntimeError, match="in use"):
         mh.init_multihost()
 
+    # Legacy fallback (no is_initialized attribute): double-call
+    # message is swallowed, anything else re-raises.
+    monkeypatch.delattr(jax.distributed, "is_initialized",
+                        raising=False)
+    monkeypatch.setattr(
+        jax.distributed, "initialize",
+        lambda **kw: (_ for _ in ()).throw(RuntimeError(
+            "distributed.initialize should only be called once.")))
+    mh.init_multihost()
 
-def test_tp_cross_process_guard():
+
+def test_tp_cross_process_guard(monkeypatch):
     """The guard must reject a tp row spanning processes (synthetic:
     fake device objects with distinct process_index)."""
 
@@ -88,18 +136,29 @@ def test_tp_cross_process_guard():
     class FakeMesh:
         devices = np.array([[FakeDev(0), FakeDev(1)]])  # 1x2, 2 procs
 
-    real_make_mesh = mh.make_mesh
-    try:
-        mh.make_mesh = lambda dp, tp, devices=None: FakeMesh()
-        fake_devices = [FakeDev(0), FakeDev(1)]
-        real_devices = jax.devices
-        jax.devices = lambda: fake_devices
-        jax.local_devices_orig = jax.local_devices
-        jax.local_devices = lambda: [fake_devices[0]]
-        with pytest.raises(ValueError, match="ride DCN"):
-            mh.global_mesh(dp=1, tp=2)
-    finally:
-        mh.make_mesh = real_make_mesh
-        jax.devices = real_devices
-        jax.local_devices = jax.local_devices_orig
-        del jax.local_devices_orig
+    fake_devices = [FakeDev(0), FakeDev(1)]
+    monkeypatch.setattr(mh, "make_mesh",
+                        lambda dp, tp, devices=None: FakeMesh())
+    monkeypatch.setattr(jax, "devices", lambda: fake_devices)
+    monkeypatch.setattr(jax, "local_devices",
+                        lambda: [fake_devices[0]])
+    with pytest.raises(ValueError, match="ride DCN"):
+        mh.global_mesh(dp=1, tp=2)
+
+
+def test_serving_refuses_multi_process(monkeypatch):
+    """--multihost serving is single-controller by design: N
+    independent informers would POST duplicate Bindings and feed
+    divergent 'global' values into the SPMD kernels.  serve.py must
+    refuse, pointing at the replay paths."""
+    import kubernetesnetawarescheduler_tpu.parallel.multihost as mh
+    from kubernetesnetawarescheduler_tpu import serve as serve_mod
+
+    # The guard under test is the process-count check; runtime join is
+    # stubbed (the real initialize refuses once the backend is up,
+    # which earlier tests' jits already did).
+    monkeypatch.setattr(mh, "init_multihost", lambda **kw: None)
+    monkeypatch.setattr(jax, "process_count", lambda: 2)
+    with pytest.raises(SystemExit):
+        serve_mod.main(["--cluster", "fake:16", "--once",
+                        "--multihost", "--uds", "/tmp/mh-refuse.sock"])
