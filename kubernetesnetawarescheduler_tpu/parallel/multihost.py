@@ -39,6 +39,14 @@ def init_multihost(coordinator_address: str | None = None,
     them explicitly for bare-metal DCN clusters.  Idempotent: a second
     call (e.g. serve.py restart paths re-running init) is a no-op
     instead of an error.
+
+    Bootstrap failures PROPAGATE — including auto-detect finding no
+    cluster environment.  Silently degrading to single-process here
+    would let a transient metadata failure on an N-host pod turn into
+    N independent schedulers (each seeing ``process_count() == 1``,
+    sailing past every multi-writer guard).  A single-host deployment
+    that only wants the local-device mesh should not call this at all
+    (serve.py: ``--mesh`` without ``--multihost``).
     """
     is_init = getattr(jax.distributed, "is_initialized", None)
     if is_init is not None and is_init():
@@ -48,23 +56,6 @@ def init_multihost(coordinator_address: str | None = None,
             coordinator_address=coordinator_address,
             num_processes=num_processes,
             process_id=process_id)
-    except ValueError as exc:
-        # No cluster environment to auto-detect from AND no explicit
-        # bootstrap args: a single-process run (laptop smoke test,
-        # one-host deployment with --multihost in the manifest) —
-        # proceed single-process; the mesh still covers every local
-        # device.  With explicit args the operator asked for a
-        # specific topology, so a bootstrap failure must surface.
-        if (coordinator_address is None and num_processes is None
-                and process_id is None):
-            import warnings
-
-            warnings.warn(
-                f"multihost init unavailable ({exc}); continuing "
-                "single-process over local devices", RuntimeWarning,
-                stacklevel=2)
-            return
-        raise
     except RuntimeError as exc:
         # Fallback for jax versions without is_initialized(): the
         # double-init message is version-dependent ("should only be

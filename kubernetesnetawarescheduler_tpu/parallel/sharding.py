@@ -162,9 +162,31 @@ def sharded_assign_fn(cfg: SchedulerConfig, mesh: Mesh,
         in_shardings=(state_sharding(mesh), pods_sharding(mesh)),
         out_shardings=NamedSharding(mesh, P()),
     )
+    state_shards = jax.tree_util.tree_leaves(state_sharding(mesh))
+    # Per-leaf transfer cache for the STATE: the encoder's snapshot
+    # reuses array objects for clean dirty-groups, so re-placing only
+    # leaves whose identity changed keeps the N×N matrices' ~200 MB
+    # from crossing to the mesh every cycle (the serving-path analog
+    # of replay's one-shot place()).  Keyed by leaf position with a
+    # strong ref to the source object, so id reuse after GC can't
+    # alias.  Pods change every cycle and are small — no caching.
+    placed: dict[int, tuple] = {}
+
+    def _place_state(state):
+        leaves, treedef = jax.tree_util.tree_flatten(state)
+        out = []
+        for i, (leaf, shard) in enumerate(zip(leaves, state_shards)):
+            hit = placed.get(i)
+            if hit is not None and hit[0] is leaf:
+                out.append(hit[1])
+            else:
+                y = jax.device_put(leaf, shard)
+                placed[i] = (leaf, y)
+                out.append(y)
+        return jax.tree_util.tree_unflatten(treedef, out)
 
     def fn(state, pods, cfg_arg=None):
-        return jitted(state, pods)
+        return jitted(_place_state(state), pods)
 
     return fn
 

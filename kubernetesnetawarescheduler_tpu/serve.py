@@ -117,12 +117,17 @@ def main(argv=None) -> int:
     ap.add_argument("--once", action="store_true",
                     help="serve one readiness cycle then exit "
                          "(smoke-test mode)")
+    ap.add_argument("--mesh", action="store_true",
+                    help="shard the watch-loop's score+assign kernels "
+                         "over ALL LOCAL devices via the (dp, tp) "
+                         "mesh (the v5e-4 single-process multi-chip "
+                         "shape; the extender webhook path stays "
+                         "single-device)")
     ap.add_argument("--multihost", action="store_true",
                     help="join the multi-process JAX runtime before "
                          "device init (TPU pods: coordinator "
-                         "auto-detects from the environment), build "
-                         "the (dp, tp) mesh over all hosts, and run "
-                         "the scoring kernels sharded over it — see "
+                         "auto-detects from the environment); implies "
+                         "--mesh. Bootstrap failures are fatal — see "
                          "parallel/multihost.py")
     ap.add_argument("--coordinator", default="",
                     help="explicit coordinator address for "
@@ -139,7 +144,7 @@ def main(argv=None) -> int:
     args = ap.parse_args(argv)
 
     mesh = None
-    if args.multihost:
+    if args.multihost or args.mesh:
         import jax
 
         from kubernetesnetawarescheduler_tpu.parallel.multihost import (
@@ -147,9 +152,11 @@ def main(argv=None) -> int:
             init_multihost,
         )
 
-        init_multihost(coordinator_address=args.coordinator or None,
-                       num_processes=args.num_processes,
-                       process_id=args.process_id)
+        if args.multihost:
+            init_multihost(
+                coordinator_address=args.coordinator or None,
+                num_processes=args.num_processes,
+                process_id=args.process_id)
         if jax.process_count() > 1:
             # SERVING is single-controller: every process would run
             # its own informer/queue/binder against divergent watch
