@@ -266,6 +266,8 @@ class Encoder:
         # dedup or the bounded record deque.
         self._shape_cache: dict[tuple, tuple] = {}
         self._degrade_capture: int | None = None
+        self.shape_cache_hits = 0
+        self.shape_cache_misses = 0
 
         # Dirty tracking per transfer group, so snapshot() uploads the
         # 100 MB-class N x N matrices only when the probe pipeline
@@ -1231,6 +1233,7 @@ class Encoder:
             key = None
             cached = None
         if cached is not None:
+            self.shape_cache_hits += 1
             bits, nonzero, d_delta = cached
             # Only the rows the compute actually touched are stored
             # (targets are pre-zeroed): typical pods copy 1-3 small
@@ -1271,6 +1274,11 @@ class Encoder:
         if d_delta:
             self._record_degraded(pod, d_delta)
         if key is not None:
+            # Counted here — after a successful, hashable compute — so
+            # the metric really is distinct-shape cardinality (the
+            # unhashable bypass and strict-mode raises don't inflate
+            # it).
+            self.shape_cache_misses += 1
             if len(self._shape_cache) >= 8192:
                 # Bounded: pathological all-distinct fleets fall back
                 # to compute-per-pod, never unbounded memory.

@@ -76,6 +76,13 @@ def render_metrics(loop) -> str:
             float(getattr(enc, "degraded_total", 0)),
             "Pods that lost constraint keys to interner overflow "
             "(each also gets a ConstraintDegraded event)")
+    counter("netaware_encode_shape_cache_hits_total",
+            float(getattr(enc, "shape_cache_hits", 0)),
+            "Pods encoded from the constraint-shape cache")
+    counter("netaware_encode_shape_cache_misses_total",
+            float(getattr(enc, "shape_cache_misses", 0)),
+            "Distinct constraint shapes computed (high miss rates "
+            "mean per-pod-unique constraints; the cache is bypassed)")
 
     # Extender webhook micro-batcher (api/extender._ScoreBatcher):
     # dispatch count exposes the coalescing rate (requests served /
