@@ -200,8 +200,17 @@ class _ScoreBatcher:
                 state, version = loop.encoder.snapshot_versioned()
                 static = self._static_for(state, version)
                 self.dispatches += 1
-                rows = np.asarray(
-                    score_pods_auto(state, enc, loop.cfg, static))
+                # Mesh-sharded loops (--mesh/--multihost) carry a
+                # sharded full-score callable: node axis over every
+                # chip, pods replicated; the static pair's transfers
+                # are leaf-identity cached against this batcher's
+                # version-keyed reuse.
+                sharded = getattr(loop, "sharded_score", None)
+                if sharded is not None:
+                    rows = np.asarray(sharded(state, enc, static))
+                else:
+                    rows = np.asarray(
+                        score_pods_auto(state, enc, loop.cfg, static))
                 for i, e in enumerate(chunk):
                     e[2] = rows[i]
                     e[1].set()
@@ -214,7 +223,17 @@ class _ScoreBatcher:
 
     def _static_for(self, state, version: int):
         if self._static_version != version:
-            self._static_val = compute_static(state, self._loop.cfg)
+            cfg = self._loop.cfg
+            if getattr(self._loop, "sharded_score", None) is not None:
+                # The sharded score path is dense-only; its static
+                # must be the dense (base, ct) pair, not the Pallas
+                # tile pack — ONE coercion rule, shared with the
+                # sharded paths themselves.
+                from kubernetesnetawarescheduler_tpu.parallel.sharding \
+                    import _force_dense
+
+                cfg = _force_dense(cfg)
+            self._static_val = compute_static(state, cfg)
             self._static_version = version
         return self._static_val
 

@@ -1274,10 +1274,10 @@ class Encoder:
         if d_delta:
             self._record_degraded(pod, d_delta)
         if key is not None:
-            # Counted here — after a successful, hashable compute — so
-            # the metric really is distinct-shape cardinality (the
-            # unhashable bypass and strict-mode raises don't inflate
-            # it).
+            # Counted here — after a successful, hashable compute —
+            # so the unhashable bypass and strict-mode raises don't
+            # inflate it (the bounded cache's evictions still recount
+            # shapes; the metric is compute COUNT, not cardinality).
             self.shape_cache_misses += 1
             if len(self._shape_cache) >= 8192:
                 # Bounded: pathological all-distinct fleets fall back

@@ -74,13 +74,17 @@ class SchedulerLoop:
         if mesh is not None:
             # Mesh-sharded serving (multi-chip / multi-host): the same
             # cycle, with score+assign jitted under the canonical
-            # (dp, tp) shardings — see parallel.sharding.
+            # (dp, tp) shardings — see parallel.sharding.  The
+            # extender webhook path picks up sharded_score (node axis
+            # over every chip, pods replicated) via the batcher.
             from kubernetesnetawarescheduler_tpu.parallel.sharding import (
-                sharded_assign_fn,
+                serving_fns,
             )
 
-            self._assign = sharded_assign_fn(cfg, mesh, method)
+            self._assign, self.sharded_score = serving_fns(cfg, mesh,
+                                                           method)
         else:
+            self.sharded_score = None
             self._assign = {"greedy": assign_greedy,
                             "parallel": assign_parallel}[method]
         # is_parked keeps resync/watch re-deliveries of a preemptor

@@ -146,7 +146,7 @@ def sharded_schedule_step(cfg: SchedulerConfig, mesh: Mesh,
 
 
 def sharded_assign_fn(cfg: SchedulerConfig, mesh: Mesh,
-                      method: str = "parallel"):
+                      method: str = "parallel", state_placer=None):
     """A drop-in for the serving loop's assign callable
     (``(state, pods, cfg) -> assignment``), jitted with the canonical
     mesh shardings — the piece that makes ``--multihost`` serving
@@ -162,31 +162,98 @@ def sharded_assign_fn(cfg: SchedulerConfig, mesh: Mesh,
         in_shardings=(state_sharding(mesh), pods_sharding(mesh)),
         out_shardings=NamedSharding(mesh, P()),
     )
-    state_shards = jax.tree_util.tree_leaves(state_sharding(mesh))
-    # Per-leaf transfer cache for the STATE: the encoder's snapshot
-    # reuses array objects for clean dirty-groups, so re-placing only
-    # leaves whose identity changed keeps the N×N matrices' ~200 MB
-    # from crossing to the mesh every cycle (the serving-path analog
-    # of replay's one-shot place()).  Keyed by leaf position with a
-    # strong ref to the source object, so id reuse after GC can't
-    # alias.  Pods change every cycle and are small — no caching.
-    placed: dict[int, tuple] = {}
+    place_state = state_placer or _leaf_placer(state_sharding(mesh))
 
-    def _place_state(state):
-        leaves, treedef = jax.tree_util.tree_flatten(state)
+    def fn(state, pods, cfg_arg=None):
+        return jitted(place_state(state), pods)
+
+    return fn
+
+
+def serving_fns(cfg: SchedulerConfig, mesh: Mesh,
+                method: str = "parallel"):
+    """The mesh-sharded serving pair ``(assign_fn, score_fn)`` SHARING
+    one state placer: the loop's cycle and the extender webhook read
+    the same snapshot, and separate placers would transfer (and keep
+    resident) the N×N matrices once per path.  Both paths use the
+    same ``state_sharding(mesh)`` layout — node axis over ``tp``,
+    replicated over ``dp`` — so one placement serves both."""
+    place_state = _leaf_placer(state_sharding(mesh))
+    return (sharded_assign_fn(cfg, mesh, method,
+                              state_placer=place_state),
+            sharded_score_fn(cfg, mesh, state_placer=place_state))
+
+
+def _leaf_placer(shardings):
+    """A tree-placement closure with a per-leaf transfer cache: the
+    encoder's snapshot (and the extender's static cache) reuse array
+    OBJECTS while their dirty-group is clean, so re-placing only
+    leaves whose identity changed keeps the N×N matrices' ~100 MB
+    from crossing to the mesh every call — the serving-path analog of
+    replay's one-shot ``place()``.  Keyed by leaf position with a
+    strong ref to the source object, so id reuse after GC can't
+    alias."""
+    flat_shards = jax.tree_util.tree_leaves(
+        shardings, is_leaf=lambda x: isinstance(x, NamedSharding))
+    cache: dict[int, tuple] = {}
+
+    def place(tree):
+        leaves, treedef = jax.tree_util.tree_flatten(tree)
         out = []
-        for i, (leaf, shard) in enumerate(zip(leaves, state_shards)):
-            hit = placed.get(i)
+        for i, (leaf, shard) in enumerate(zip(leaves, flat_shards)):
+            hit = cache.get(i)
             if hit is not None and hit[0] is leaf:
                 out.append(hit[1])
             else:
                 y = jax.device_put(leaf, shard)
-                placed[i] = (leaf, y)
+                cache[i] = (leaf, y)
                 out.append(y)
         return jax.tree_util.tree_unflatten(treedef, out)
 
-    def fn(state, pods, cfg_arg=None):
-        return jitted(_place_state(state), pods)
+    return place
+
+
+def sharded_score_fn(cfg: SchedulerConfig, mesh: Mesh,
+                     state_placer=None):
+    """Mesh-sharded full-score callable for the extender webhook path:
+    ``fn(state, pods, static) -> scores f32[P, N]``.
+
+    Webhook batches are small (demand-sized, padded to 8) while the
+    node axis is the big one, so pods REPLICATE and the node axis --
+    state columns AND the batch-invariant static pair (``base[N]``,
+    ``ct[N, N]``) -- shards over ``tp`` with the SAME layout the
+    serving loop's assign path uses (``state_sharding(mesh)``), so a
+    shared ``state_placer`` (see :func:`serving_fns`) keeps ONE copy
+    of the N x N matrices on the mesh for both paths.  Static
+    transfers are leaf-identity cached too (the batcher reuses its
+    static tuple until ``static_version`` bumps).  Dense backend only
+    (``_force_dense``): the tiled Pallas kernel's mesh form lives on
+    the replay path via ``pallas_static_builder``.
+    """
+    cfg = _force_dense(cfg)
+    from kubernetesnetawarescheduler_tpu.core import score as score_lib
+
+    rep = NamedSharding(mesh, P())
+    st_shard = state_sharding(mesh)
+    pods_rep = jax.tree_util.tree_map(
+        lambda _: rep, pods_sharding(mesh),
+        is_leaf=lambda x: isinstance(x, NamedSharding))
+    static_shard = (NamedSharding(mesh, P("tp")),       # base[N]
+                    NamedSharding(mesh, P(None, "tp")))  # ct columns
+
+    def _score(state, pods, static):
+        return score_lib.score_pods(state, pods, cfg, static)
+
+    jitted = jax.jit(
+        _score,
+        in_shardings=(st_shard, pods_rep, static_shard),
+        out_shardings=rep,
+    )
+    place_state = state_placer or _leaf_placer(st_shard)
+    place_static = _leaf_placer(static_shard)
+
+    def fn(state, pods, static):
+        return jitted(place_state(state), pods, place_static(static))
 
     return fn
 

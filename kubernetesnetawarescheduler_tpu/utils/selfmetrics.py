@@ -81,8 +81,9 @@ def render_metrics(loop) -> str:
             "Pods encoded from the constraint-shape cache")
     counter("netaware_encode_shape_cache_misses_total",
             float(getattr(enc, "shape_cache_misses", 0)),
-            "Distinct constraint shapes computed (high miss rates "
-            "mean per-pod-unique constraints; the cache is bypassed)")
+            "Constraint-shape computes (cache misses; the cache is "
+            "bounded, so evictions recount shapes — a high and "
+            "growing miss RATE means mostly-unique constraint sets)")
 
     # Extender webhook micro-batcher (api/extender._ScoreBatcher):
     # dispatch count exposes the coalescing rate (requests served /

@@ -85,6 +85,42 @@ def test_mesh_sharded_serving_loop_matches_unsharded():
     assert binds["plain"]  # non-trivial
 
 
+def test_mesh_extender_scoring_matches_unsharded():
+    """The webhook path under --mesh (sharded_score_fn: node axis over
+    every chip, pods replicated) returns the same prioritize scores as
+    the single-device batcher."""
+    from kubernetesnetawarescheduler_tpu.api.extender import (
+        ExtenderHandlers,
+    )
+    from kubernetesnetawarescheduler_tpu.bench.fakecluster import (
+        ClusterSpec,
+        build_fake_cluster,
+        feed_metrics,
+    )
+    from kubernetesnetawarescheduler_tpu.config import SchedulerConfig
+    from kubernetesnetawarescheduler_tpu.core.loop import SchedulerLoop
+
+    cfg = SchedulerConfig(max_nodes=64, max_pods=16, max_peers=4,
+                          use_bfloat16=False)
+    args = {
+        "pod": {"metadata": {"name": "mx", "uid": "mx"},
+                "spec": {"schedulerName": "netAwareScheduler",
+                         "containers": [{"resources": {"requests": {
+                             "cpu": "500m", "memory": "1Gi"}}}]}},
+        "nodenames": [f"node-{j:04d}" for j in range(48)],
+    }
+    got = {}
+    for label, mesh in (("plain", None), ("mesh", global_mesh(2, 4))):
+        cluster, lat, bw = build_fake_cluster(
+            ClusterSpec(num_nodes=48, seed=11))
+        loop = SchedulerLoop(cluster, cfg, mesh=mesh)
+        loop.encoder.set_network(lat, bw)
+        feed_metrics(cluster, loop.encoder, np.random.default_rng(12))
+        got[label] = ExtenderHandlers(loop).prioritize(args)
+    assert got["plain"] == got["mesh"]
+    assert any(h["score"] for h in got["plain"])
+
+
 def test_init_multihost_is_idempotent(monkeypatch):
     """A second init (serve.py restart path) must be a no-op — via
     jax.distributed.is_initialized() when available, else the
