@@ -301,7 +301,12 @@ def pallas_static_builder(cfg: SchedulerConfig, mesh: Mesh):
     ``max_nodes % (tp * 128) == 0`` and ``max_pods % dp == 0`` with an
     8-aligned per-device pod count.
     """
-    from jax.experimental.shard_map import shard_map
+    try:
+        from jax import shard_map  # jax >= 0.8
+        sm_kwargs = {"check_vma": False}  # renamed from check_rep
+    except ImportError:  # older jax
+        from jax.experimental.shard_map import shard_map
+        sm_kwargs = {"check_rep": False}
 
     from kubernetesnetawarescheduler_tpu.core import pallas_score
     from kubernetesnetawarescheduler_tpu.core.score import (
@@ -337,7 +342,7 @@ def pallas_static_builder(cfg: SchedulerConfig, mesh: Mesh):
                   P(None, None), P(None, "tp"), P(None, "tp"),
                   P(None, "tp"), P("dp", None), P("dp", None)),
         out_specs=(P("dp", "tp"), P("dp", "tp")),
-        check_rep=False)
+        **sm_kwargs)
 
     def builder(state):
         from kubernetesnetawarescheduler_tpu.core.state import round_up
