@@ -96,6 +96,8 @@ def _run_backend_subprocess(backend: str, force_cpu: bool,
         bind_p99_ms = float(doc["detail"]["bind_p99_ms"])
         score_samples = int(doc["detail"]["score_samples"])
         executed_backend = str(doc["detail"]["backend"])
+        mesh_desc = str(doc["detail"].get("mesh", ""))
+        mode_str = str(doc["detail"]["mode"])
 
     return _Sub()
 
@@ -112,6 +114,11 @@ def main() -> None:
         import jax
 
         jax.config.update("jax_platforms", "cpu")
+        ndev = os.environ.get("BENCH_CPU_DEVICES", "")
+        if ndev:
+            # Virtual multi-device CPU: exercises the BENCH_MESH path
+            # without hardware (mirrors tests/conftest.py).
+            jax.config.update("jax_num_cpu_devices", int(ndev))
     elif os.environ.get("BENCH_SKIP_TPU_PROBE", "") != "1" \
             and not _tpu_reachable_with_retries():
         # Degrade to CPU instead of hanging the driver: the JSON line
@@ -158,6 +165,8 @@ def main() -> None:
     results = {}
     errors = {}
     executed_backend = ""
+    mesh_desc = ""
+    mesh_error = ""
     if len(backends) > 1:
         # Comparison mode: EVERY leg in its own killable subprocess
         # (sequential, so each owns the chip in turn); a hung compile
@@ -183,6 +192,41 @@ def main() -> None:
 
         import jax
 
+        # Multi-chip: shard the replay's node axis over every visible
+        # device (a real v5e-4 exposes 4; the tunneled dev chip 1, so
+        # "auto" is a no-op there).  BENCH_MESH=off disables;
+        # BENCH_MESH=dp,tp picks an explicit shape.
+        mesh = None
+        mesh_error = ""
+        mesh_env = os.environ.get("BENCH_MESH", "auto")
+        if mesh_env != "off" and mode != "host":
+            # Soft-fail parsing/construction: a bad BENCH_MESH value
+            # must not cost the driver its only artifact (the JSON
+            # line) — run unmeshed and say so in the detail.
+            try:
+                from kubernetesnetawarescheduler_tpu.parallel.sharding \
+                    import make_mesh
+
+                if mesh_env == "auto":
+                    if jax.device_count() > 1:
+                        mesh = make_mesh(1, jax.device_count())
+                else:
+                    dp, tp = (int(x) for x in mesh_env.split(","))
+                    mesh = make_mesh(dp, tp)
+            except Exception as exc:  # noqa: BLE001
+                mesh_error = f"{type(exc).__name__}: {exc}"
+                print(f"WARNING: BENCH_MESH={mesh_env!r} rejected "
+                      f"({mesh_error}); running unmeshed",
+                      file=sys.stderr)
+        if mesh is not None and mode == "pipeline":
+            # The pipelined drain has no mesh variant; the sharded
+            # monolithic replay is the multi-chip throughput path
+            # (run_density raises on pipeline+mesh — the demotion is
+            # decided HERE, where the reported mode label lives).
+            mode = "device"
+        mesh_desc = ("x".join(str(mesh.shape[a]) for a in ("dp", "tp"))
+                     if mesh is not None else "")
+
         profile_dir = os.environ.get("BENCH_PROFILE", "")
         if profile_dir:
             # JAX profiler trace of the measured window (SURVEY.md §5
@@ -196,7 +240,8 @@ def main() -> None:
                 results[backend] = run_density(
                     num_nodes=num_nodes, num_pods=num_pods,
                     batch_size=batch, method=method, mode=mode,
-                    chunk_batches=chunk_batches, score_backend=backend)
+                    chunk_batches=chunk_batches, score_backend=backend,
+                    mesh=mesh)
         except Exception as exc:  # noqa: BLE001
             errors[backend] = f"{type(exc).__name__}: {exc}"
         executed_backend = jax.default_backend()
@@ -236,9 +281,10 @@ def main() -> None:
         "score_samples": res.score_samples,
         "batch_size": batch,
         "method": method,
-        "mode": mode,
+        "mode": getattr(res, "mode_str", mode),
         "backend": executed_backend,
         "score_backend": best,
+        "mesh": getattr(res, "mesh_desc", mesh_desc),
     }
     for backend, r in results.items():
         if backend != best:
@@ -246,6 +292,8 @@ def main() -> None:
             detail[f"{backend}_score_p50_ms"] = round(r.score_p50_ms, 2)
     for backend, err in errors.items():
         detail[f"{backend}_error"] = err
+    if mesh_error:
+        detail["mesh_error"] = mesh_error
     print(json.dumps({
         "metric": f"density_pods_per_sec_n{num_nodes}",
         "value": round(res.pods_per_sec, 1),
