@@ -45,18 +45,29 @@ class DensityResult:
     # (true percentiles).  Monolithic device mode: 1 — the score
     # numbers there are an amortized mean, honestly labeled.
     score_samples: int = 0
+    # Conflict-resolution round distribution of assign_parallel, one
+    # sample per batch (device/pipeline modes; 0s when unavailable):
+    # whether TPU latency is matmul-bound or round-bound is a function
+    # of this (VERDICT.md round 2, weak #1).
+    rounds_p50: float = 0.0
+    rounds_p99: float = 0.0
+    rounds_max: int = 0
 
     def to_dict(self) -> dict:
         return dataclasses.asdict(self)
 
 
-def _percentile_ms(samples, q: float) -> float:
+def _percentile(samples, q: float) -> float:
     ordered = sorted(samples)
     if not ordered:
         return 0.0
     rank = min(len(ordered) - 1,
                max(0, int(round(q / 100.0 * (len(ordered) - 1)))))
-    return ordered[rank] * 1e3
+    return float(ordered[rank])
+
+
+def _percentile_ms(samples, q: float) -> float:
+    return _percentile(samples, q) * 1e3
 
 
 from kubernetesnetawarescheduler_tpu.core.state import round_up as _round_up
@@ -282,7 +293,8 @@ def _run_density_device(cluster, loop: SchedulerLoop, pods, cfg,
             wassign, _ = _mesh_run(wstream)
             np.asarray(wassign)
         else:
-            wassign, _ = replay_stream(state, wstream, cfg, method)
+            wassign, _, _ = replay_stream(state, wstream, cfg, method,
+                                          with_stats=True)
             np.asarray(wassign)
     if sampler is not None:
         sampler.start()
@@ -317,10 +329,12 @@ def _run_density_device(cluster, loop: SchedulerLoop, pods, cfg,
     encode_wall = time.perf_counter() - start
 
     chunk_times: list[float] = []
+    round_samples: list[int] = []
     if pipeline:
         prev = time.perf_counter()
-        for pod_start, assignment in replay_stream_pipelined(
+        for pod_start, assignment, rounds in replay_stream_pipelined(
                 state, stream, cfg, method, chunk_batches):
+            round_samples.extend(int(r) for r in rounds)
             now = time.perf_counter()
             # Host-observed latency of this chunk (blocking fetch),
             # normalized per batch: a true sample, not an average over
@@ -343,8 +357,9 @@ def _run_density_device(cluster, loop: SchedulerLoop, pods, cfg,
         if mesh is not None:
             assignment_dev, _final = _mesh_run(stream)
         else:
-            assignment_dev, _final = replay_stream(state, stream, cfg,
-                                                   method)
+            assignment_dev, _final, rounds_dev = replay_stream(
+                state, stream, cfg, method, with_stats=True)
+            round_samples.extend(int(r) for r in np.asarray(rounds_dev))
         assignment = np.asarray(assignment_dev)[:len(queued)]
         device_span = time.perf_counter() - start - encode_wall
         bound = loop._bind_all(queued, assignment)
@@ -370,4 +385,7 @@ def _run_density_device(cluster, loop: SchedulerLoop, pods, cfg,
         encode_p99_ms=encode_wall / max(num_batches, 1) * 1e3,
         bind_p99_ms=(wall - device_span - encode_wall) * 1e3,
         score_samples=samples,
+        rounds_p50=_percentile(round_samples, 50),
+        rounds_p99=_percentile(round_samples, 99),
+        rounds_max=max(round_samples, default=0),
     )

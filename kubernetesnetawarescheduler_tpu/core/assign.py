@@ -39,6 +39,7 @@ from kubernetesnetawarescheduler_tpu.core.state import (
     ClusterState,
     PodBatch,
     add_zone_counts,
+    bit_planes,
     commit_assignments,
     planes_to_words,
     scatter_or_onehot,
@@ -227,9 +228,10 @@ def assign_greedy(state: ClusterState, pods: PodBatch,
     return jnp.where(pods.pod_valid, assignment, UNASSIGNED)
 
 
-@partial(jax.jit, static_argnames=("cfg",))
+@partial(jax.jit, static_argnames=("cfg", "with_stats"))
 def assign_parallel(state: ClusterState, pods: PodBatch,
-                    cfg: SchedulerConfig, static=None) -> jax.Array:
+                    cfg: SchedulerConfig, static=None, *,
+                    with_stats: bool = False):
     """Batched iterative conflict-resolution assignment, ``i32[P]``.
 
     Each round: every still-unassigned pod argmaxes its masked score
@@ -237,12 +239,41 @@ def assign_parallel(state: ClusterState, pods: PodBatch,
     (priority desc, pod index asc); usage and masks are updated; pods
     that lost re-pick next round.  Terminates when no unassigned pod has
     a feasible node (bounded by P rounds).
+
+    Round cost: a round changes ``used``/``group_bits``/
+    ``resident_anti`` ONLY at the winners' nodes (≤P of N) and retires
+    only the winners' rows, so when no pod in the batch carries a
+    spread or zone-scoped constraint (whose zone-level state can move
+    arbitrary columns) the carried score matrix is updated
+    incrementally — an ``O(P²·(R+W))`` column patch instead of the full
+    ``O(P·N·(R+W))`` mask recompute (~40× less round work at P=128,
+    N=5120).  The full recompute remains the fallback branch and the
+    two are equal whenever the predicate holds (tested).
+
+    ``with_stats=True`` additionally returns the executed
+    conflict-round count (``i32`` scalar) — the observable VERDICT.md
+    round-2 asked for: whether TPU latency will be matmul-bound or
+    round-bound is a function of this distribution.
     """
     p = pods.num_pods
     n = state.num_nodes
     raw, static_ok = _static_parts(state, pods, cfg, static)
     w_bal = jnp.float32(cfg.weights.balance)
     pod_ids = jnp.arange(p, dtype=jnp.int32)
+
+    # Loop-invariant: may the incremental round update be used?  Spread
+    # and zone-scoped constraints touch per-ZONE state (counts /
+    # presence words), so one winner can move columns of every node in
+    # its zone; without them, a round's effects are confined to winner
+    # columns + winner rows.
+    incremental_ok = (~jnp.any(score_lib.spread_active(pods))
+                      & jnp.all(pods.zaff_bits == 0)
+                      & jnp.all(pods.zanti_bits == 0))
+    # Under the predicate, zone_affinity_ok is round-invariant (az
+    # never changes; gz changes touch only the trivially-true terms),
+    # so fold the batch-entry evaluation into the static mask used by
+    # the incremental branch.
+    static2 = static_ok & score_lib.zone_affinity_ok(state, pods)
 
     # Loop-invariant tie-break rank: position in (priority desc, index
     # asc) order.  Lets each round pick per-node winners with ONE
@@ -252,6 +283,12 @@ def assign_parallel(state: ClusterState, pods: PodBatch,
     # dominant round cost after the mask recompute).
     order = jnp.argsort(-pods.priority, stable=True)
     rank = jnp.zeros((p,), jnp.int32).at[order].set(pod_ids)
+    # Loop-invariant bitplanes of the pods' group/anti words (0/1 i32,
+    # ``B = 32 * W`` columns), consumed by the multi-accept prefix's
+    # segmented pairwise checks and the winner bit aggregation below.
+    mask_b = 32 * pods.group_bit.shape[1]
+    gb_planes = bit_planes(pods.group_bit, jnp.int32)
+    ab_planes = bit_planes(pods.anti_bits, jnp.int32)
     # Round-invariant piece of the zone-anti round cap (pair [i, j]
     # conflicts AND i outranks j): hoisted here because XLA does not
     # move computations out of while_loop bodies.
@@ -283,11 +320,12 @@ def assign_parallel(state: ClusterState, pods: PodBatch,
     # The score matrix is carried across rounds so it is computed once
     # per round (in body), not twice (cond + body).
     def cond(carry):
-        s, *_rest, progress = carry
+        s, progress = carry[0], carry[7]
         return jnp.any(s > NEG_INF * 0.5) & progress
 
     def body(carry):
-        s, used, group_bits, resident_anti, gz, az, assignment, _ = carry
+        (s, used, group_bits, resident_anti, gz, az, assignment, _,
+         rounds) = carry
         choice = jnp.argmax(s, axis=1).astype(jnp.int32)
         feasible = jnp.take_along_axis(
             s, choice[:, None], axis=1)[:, 0] > NEG_INF * 0.5
@@ -300,8 +338,59 @@ def assign_parallel(state: ClusterState, pods: PodBatch,
         group_id = key[perm] // p
         first = jnp.concatenate(
             [jnp.ones((1,), bool), group_id[1:] != group_id[:-1]])
+
+        # Multi-accept prefix: beyond its single best contender, a node
+        # also accepts the following contenders (in priority order) as
+        # long as they cumulatively fit the node's free capacity AND no
+        # pairwise group/anti conflict exists with any earlier prefix
+        # member.  Pod-independent metric scores make whole batches of
+        # look-alike pods argmax the same node (the reference's
+        # pathology, scheduler.go:248, reborn as round count: one
+        # winner per round = P rounds); the prefix collapses those to
+        # ~capacity-fill rounds.  Exactness: a same-round contender's
+        # round-entry checks can only be invalidated by capacity (the
+        # segmented cumsum bounds it), host-scoped group state (the
+        # pairwise planes check below), or zone state — and the
+        # spread/zone round caps after winner selection already demote
+        # every same-zone zone-conflicting winner.
+        req_sorted = pods.req[perm]                       # [P, R]
+        csum = jnp.cumsum(req_sorted, axis=0)
+        idx = jnp.arange(p, dtype=jnp.int32)
+        # Segment-relative cumulative request: csum minus the running
+        # csum at each segment's start (cummax works: csum is
+        # monotone, req >= 0).
+        base = jnp.where(first[:, None], csum - req_sorted,
+                         -jnp.inf)
+        seg_csum = csum - jax.lax.cummax(base, axis=0)
+        node_sorted = jnp.clip(group_id, 0, n - 1).astype(jnp.int32)
+        fits_cum = jnp.all(
+            seg_csum <= (state.cap - used)[node_sorted] + _EPS, axis=-1)
+        # Segmented EXCLUSIVE cumulative OR of earlier contenders'
+        # group/anti bitplanes, via the cummax-with-segment-offset
+        # trick (segment ids strictly increase along the sort, so
+        # ``2*seg + plane`` from an earlier segment can never reach the
+        # current segment's offset).  Checking against all earlier
+        # contenders rather than accepted ones is equivalent under
+        # stop-at-first-bad: a rejected earlier entry rejects everyone
+        # after it anyway.
+        seg2 = (group_id * 2).astype(jnp.int32)[:, None]
+        incl_gb = jax.lax.cummax(seg2 + gb_planes[perm], axis=0) - seg2
+        incl_ab = jax.lax.cummax(seg2 + ab_planes[perm], axis=0) - seg2
+        zero_row = jnp.zeros((1, mask_b), jnp.int32)
+        excl_gb = jnp.where(first[:, None], 0,
+                            jnp.concatenate([zero_row, incl_gb[:-1]],
+                                            axis=0)) >= 1
+        excl_ab = jnp.where(first[:, None], 0,
+                            jnp.concatenate([zero_row, incl_ab[:-1]],
+                                            axis=0)) >= 1
+        pair_ok = (~jnp.any(excl_ab & (gb_planes[perm] >= 1), axis=1)
+                   & ~jnp.any(excl_gb & (ab_planes[perm] >= 1), axis=1))
+        good = fits_cum & pair_ok
+        seg_start = jax.lax.cummax(jnp.where(first, idx, -1))
+        last_bad = jax.lax.cummax(jnp.where(~good, idx, -1))
+        prefix_ok = last_bad < seg_start  # all good since segment start
         winner = jnp.zeros((p,), bool).at[perm].set(
-            first & (group_id < n))
+            (first | prefix_ok) & (group_id < n))
 
         # Topology-spread round cap: the per-winner skew check above
         # ran against ROUND-ENTRY counts, so two same-group winners on
@@ -343,16 +432,27 @@ def assign_parallel(state: ClusterState, pods: PodBatch,
         add = jnp.where(winner[:, None], pods.req, 0.0)
         new_used = used.at[safe].add(add, mode="drop")
         progress = jnp.any(winner)
-        # Winner nodes are unique (one winner per node), so the group
-        # bit-field updates are P gather-OR-scatters, not an N-wide
-        # reduction.  Losers scatter to index n -> dropped.
-        cols = jnp.where(winner, choice, n)
-        new_group = group_bits.at[cols].set(
-            group_bits[jnp.clip(cols, 0, n - 1)] | pods.group_bit,
-            mode="drop")
-        new_anti = resident_anti.at[cols].set(
-            resident_anti[jnp.clip(cols, 0, n - 1)] | pods.anti_bits,
-            mode="drop")
+        # Group bit-field updates: one scatter-set per NODE segment
+        # (never colliding), carrying the segmented OR of the FINAL
+        # winners' planes (post-demote — a demoted pod's bits must not
+        # be published).  Re-uses the sorted segment machinery; the
+        # cummax trick again gives the per-segment running OR, read at
+        # each segment's last row.
+        win_sorted = winner[perm][:, None]
+        or_gb = (jax.lax.cummax(seg2 + gb_planes[perm] * win_sorted,
+                                axis=0) - seg2) >= 1
+        or_ab = (jax.lax.cummax(seg2 + ab_planes[perm] * win_sorted,
+                                axis=0) - seg2) >= 1
+        last_of_seg = jnp.concatenate(
+            [first[1:], jnp.ones((1,), bool)])
+        seg_cols = jnp.where(last_of_seg & (group_id < n),
+                             node_sorted, n)
+        new_group = group_bits.at[seg_cols].set(
+            group_bits[jnp.clip(seg_cols, 0, n - 1)]
+            | planes_to_words(or_gb), mode="drop")
+        new_anti = resident_anti.at[seg_cols].set(
+            resident_anti[jnp.clip(seg_cols, 0, n - 1)]
+            | planes_to_words(or_ab), mode="drop")
         new_gz = add_zone_counts(gz, state.node_zone, pods.group_idx,
                                  choice, winner)
         # Winner ZONES are not unique (several nodes share one), so
@@ -363,19 +463,63 @@ def assign_parallel(state: ClusterState, pods: PodBatch,
             jnp.clip(zone_of, 0, zmax - 1)[:, None]
             == jnp.arange(zmax)[None, :])
         new_az = az | scatter_or_onehot(zhot, pods.zanti_bits)
-        new_s = masked_scores(new_used, new_group, new_anti, new_gz,
-                              new_az, new_assignment)
+
+        def full_update(_):
+            return masked_scores(new_used, new_group, new_anti, new_gz,
+                                 new_az, new_assignment)
+
+        def incremental_update(_):
+            # Patch only the winners' columns (losers carry the
+            # sentinel column n -> dropped by the scatter) and retire
+            # assigned rows; everything else is unchanged by this
+            # round under the incremental_ok predicate.  Duplicate
+            # winner columns (a multi-accept prefix) are harmless: each
+            # writes the identical recomputed column.
+            wcols = jnp.where(winner, choice, n)
+            cc = jnp.clip(wcols, 0, n - 1)
+            sub_used = new_used[cc]                       # [P, R]
+            sub_cap = state.cap[cc]
+            fits = jnp.all(
+                pods.req[:, None, :] <= (sub_cap - sub_used)[None, :, :]
+                + _EPS, axis=-1)                          # [P, Pc]
+            gb = new_group[cc]                            # [Pc, W]
+            ra = new_anti[cc]
+            aff_req = pods.affinity_bits[:, None, :]
+            affinity = jnp.all(aff_req == 0, axis=-1) | jnp.any(
+                (gb[None, :, :] & aff_req) != 0, axis=-1)
+            aok = jnp.all(
+                (gb[None, :, :] & pods.anti_bits[:, None, :]) == 0,
+                axis=-1)
+            sym = jnp.all(
+                (ra[None, :, :] & pods.group_bit[:, None, :]) == 0,
+                axis=-1)
+            bal = jnp.max(
+                (sub_used[None, :, :] + pods.req[:, None, :])
+                / jnp.maximum(sub_cap, _EPS)[None, :, :], axis=-1)
+            ok = (static2[:, cc] & fits & affinity & aok & sym
+                  & (new_assignment == UNASSIGNED)[:, None])
+            sub = jnp.where(ok, raw[:, cc] - w_bal * bal, NEG_INF)
+            s2 = s.at[:, wcols].set(sub, mode="drop")
+            return jnp.where((new_assignment != UNASSIGNED)[:, None],
+                             NEG_INF, s2)
+
+        new_s = jax.lax.cond(incremental_ok, incremental_update,
+                             full_update, None)
         return (new_s, new_used, new_group, new_anti, new_gz, new_az,
-                new_assignment, progress)
+                new_assignment, progress, rounds + 1)
 
     init_assignment = jnp.full((p,), UNASSIGNED, jnp.int32)
     init = (masked_scores(state.used, state.group_bits, state.resident_anti,
                           state.gz_counts, state.az_anti, init_assignment),
             state.used, state.group_bits, state.resident_anti,
             state.gz_counts, state.az_anti, init_assignment,
-            jnp.bool_(True))
-    _, _, _, _, _, _, assignment, _ = jax.lax.while_loop(cond, body, init)
-    return jnp.where(pods.pod_valid, assignment, UNASSIGNED)
+            jnp.bool_(True), jnp.int32(0))
+    out = jax.lax.while_loop(cond, body, init)
+    assignment, rounds = out[6], out[8]
+    assignment = jnp.where(pods.pod_valid, assignment, UNASSIGNED)
+    if with_stats:
+        return assignment, rounds
+    return assignment
 
 
 def schedule_batch(state: ClusterState, pods: PodBatch, cfg: SchedulerConfig,

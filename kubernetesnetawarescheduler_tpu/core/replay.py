@@ -96,9 +96,11 @@ def _make_step(state: ClusterState, cfg: SchedulerConfig, method: str,
     lat/bw matrices, metrics, capacities, label/taint bits) is closed
     over, so XLA keeps one HBM copy instead of round-tripping ~200 MB
     of carry per step.  ``x`` is ``(batch_index, stream_slice)``.
+
+    Per-batch ys are ``(assignment i32[batch], rounds i32)`` — the
+    conflict-round count is always collected (one scalar add per round;
+    free) so benchmarks can report its distribution.
     """
-    assign_fn = {"greedy": assign_greedy,
-                 "parallel": assign_parallel}[method]
     batch = cfg.max_pods
 
     def step(carry, x):
@@ -137,12 +139,20 @@ def _make_step(state: ClusterState, cfg: SchedulerConfig, method: str,
             batch_static = {"raw": raw, "ok": ok}
         else:
             batch_static = static
-        assignment = assign_fn(st, pods, cfg, batch_static)
+        if method == "parallel":
+            assignment, rounds = assign_parallel(st, pods, cfg,
+                                                 batch_static,
+                                                 with_stats=True)
+        elif method == "greedy":
+            assignment = assign_greedy(st, pods, cfg, batch_static)
+            rounds = jnp.int32(0)
+        else:
+            raise ValueError(f"unknown method {method!r}")
         st = commit_assignments(st, pods, assignment)
         node_of_pod = jax.lax.dynamic_update_slice_in_dim(
             node_of_pod, assignment, i * batch, 0)
         return (st.used, st.group_bits, st.resident_anti, st.gz_counts,
-                st.az_anti, node_of_pod), assignment
+                st.az_anti, node_of_pod), (assignment, rounds)
 
     return step
 
@@ -166,8 +176,8 @@ def fold_stream(stream: PodStream, cfg: SchedulerConfig):
 
 
 def replay_folded(state: ClusterState, folded, cfg: SchedulerConfig,
-                  method: str = "parallel", static_builder=None
-                  ) -> tuple[jax.Array, ClusterState]:
+                  method: str = "parallel", static_builder=None,
+                  with_stats: bool = False):
     """Scan over a pre-folded ``[NB, batch, ...]`` stream pytree.
     Traceable core of :func:`replay_stream`; also jitted directly by
     the mesh-sharded replay (which must keep the folded layout — a
@@ -198,24 +208,28 @@ def replay_folded(state: ClusterState, folded, cfg: SchedulerConfig,
             state.gz_counts, state.az_anti,
             jnp.full((s_total,), UNASSIGNED, jnp.int32))
     (used, group_bits, resident_anti, gz_counts, az_anti, _), \
-        assignments = jax.lax.scan(step, init, xs)
+        (assignments, rounds) = jax.lax.scan(step, init, xs)
     final_state = state.replace(used=used, group_bits=group_bits,
                                 resident_anti=resident_anti,
                                 gz_counts=gz_counts, az_anti=az_anti)
+    if with_stats:
+        return assignments.reshape(-1), final_state, rounds
     return assignments.reshape(-1), final_state
 
 
-@partial(jax.jit, static_argnames=("cfg", "method"))
+@partial(jax.jit, static_argnames=("cfg", "method", "with_stats"))
 def replay_stream(state: ClusterState, stream: PodStream,
-                  cfg: SchedulerConfig, method: str = "parallel"
-                  ) -> tuple[jax.Array, ClusterState]:
+                  cfg: SchedulerConfig, method: str = "parallel",
+                  with_stats: bool = False):
     """Run the full stream through score→assign→commit on device.
 
-    Returns ``(assignment i32[S], final_state)``; one dispatch, one
-    fetch.  ``stream`` length must be a multiple of ``cfg.max_pods``
-    (pad with invalid pods via :func:`pad_stream`).
+    Returns ``(assignment i32[S], final_state)`` — plus per-batch
+    conflict-round counts ``i32[NB]`` with ``with_stats=True``; one
+    dispatch, one fetch.  ``stream`` length must be a multiple of
+    ``cfg.max_pods`` (pad with invalid pods via :func:`pad_stream`).
     """
-    return replay_folded(state, fold_stream(stream, cfg), cfg, method)
+    return replay_folded(state, fold_stream(stream, cfg), cfg, method,
+                         with_stats=with_stats)
 
 
 @partial(jax.jit, static_argnames=("cfg", "method", "chunk_batches"))
@@ -232,8 +246,9 @@ def _replay_chunk(state: ClusterState, static, carry, folded,
             x, chunk_start, chunk_batches, 0), folded)
     batch_ids = chunk_start + jnp.arange(chunk_batches, dtype=jnp.int32)
     step = _make_step(state, cfg, method, s_total, static)
-    carry, assignments = jax.lax.scan(step, carry, (batch_ids, xs_stream))
-    return carry, assignments.reshape(-1)
+    carry, (assignments, rounds) = jax.lax.scan(step, carry,
+                                                (batch_ids, xs_stream))
+    return carry, assignments.reshape(-1), rounds
 
 
 def replay_stream_pipelined(state: ClusterState, stream: PodStream,
@@ -241,7 +256,9 @@ def replay_stream_pipelined(state: ClusterState, stream: PodStream,
                             chunk_batches: int = 8,
                             dispatch_window: int = 4):
     """Chunked replay for the pipelined drain: yields
-    ``(start_pod_index, assignment np.ndarray)`` per chunk, in order.
+    ``(start_pod_index, assignment np.ndarray, rounds np.ndarray)``
+    per chunk, in order (``rounds`` is the per-batch conflict-round
+    count of the chunk's batches).
 
     Chunks are dispatched ahead of the fetch cursor up to
     ``dispatch_window`` in flight (JAX's async dispatch queues them with
@@ -281,21 +298,21 @@ def replay_stream_pipelined(state: ClusterState, stream: PodStream,
     def dispatch_one():
         nonlocal carry, start
         cb = min(chunk_batches, nb - start)
-        carry, assignment = _replay_chunk(
+        carry, assignment, rounds = _replay_chunk(
             state, static, carry, folded, jnp.int32(start), s_total,
             cfg, method, cb)
-        pending.append((start * batch, assignment))
+        pending.append((start * batch, assignment, rounds))
         start += cb
 
     while start < nb and len(pending) < max(1, dispatch_window):
         dispatch_one()
     while pending:
-        pod_start, assignment = pending.popleft()
+        pod_start, assignment, rounds = pending.popleft()
         if start < nb:
             # Refill the window BEFORE the blocking fetch so the
             # dispatch rides the transport ahead of the fetch request.
             dispatch_one()
-        yield pod_start, np.asarray(assignment)
+        yield pod_start, np.asarray(assignment), np.asarray(rounds)
 
 
 def pad_stream(stream: PodStream, multiple: int) -> PodStream:

@@ -250,14 +250,15 @@ def init_pod_batch(cfg: SchedulerConfig, **overrides: Any) -> PodBatch:
     return PodBatch(**fields)
 
 
-def bit_planes(bits: jax.Array) -> jax.Array:
-    """Decompose ``u32[P, W]`` masks into 0/1 bf16 bitplanes
-    ``[P, W*32]`` (bf16 so the plane reduction can ride the MXU; 0/1
-    inputs with f32 accumulation give exact counts for any P)."""
+def bit_planes(bits: jax.Array, dtype=jnp.bfloat16) -> jax.Array:
+    """Decompose ``u32[P, W]`` masks into 0/1 bitplanes ``[P, W*32]``
+    (default bf16 so the plane reduction can ride the MXU; 0/1 inputs
+    with f32 accumulation give exact counts for any P.  Integer dtypes
+    serve the cummax-based segmented ORs in :mod:`~.assign`)."""
     p, w = bits.shape
     shifts = jnp.arange(32, dtype=jnp.uint32)
     return ((bits[:, :, None] >> shifts) & jnp.uint32(1)) \
-        .reshape(p, w * 32).astype(jnp.bfloat16)
+        .reshape(p, w * 32).astype(dtype)
 
 
 def planes_to_words(present: jax.Array) -> jax.Array:

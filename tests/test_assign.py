@@ -165,6 +165,34 @@ def test_batch_internal_affinity():
         assert a[1] == a[0], f"{fn.__name__}: affinity not honored: {a}"
 
 
+@pytest.mark.parametrize("seed", [0, 1, 2, 3, 4, 5])
+def test_incremental_round_update_matches_full(seed):
+    """assign_parallel's incremental column-patch rounds (taken when no
+    pod carries spread/zone constraints) must equal the full-recompute
+    branch.  The full branch is forced without changing semantics by
+    putting a zanti bit on an INVALID pod row — invalid pods never win
+    a node, so the only effect is flipping the incremental_ok
+    predicate."""
+    state_np, pods_np, _, _ = make(seed)
+    # Strip the zone/spread constraints from every pod so the
+    # incremental predicate holds.
+    for f in ("zaff_bits", "zanti_bits"):
+        pods_np[f][:] = 0
+    pods_np["spread_maxskew"][:] = 0
+    _, pods_incr = gen.to_pytrees(CFG, state_np, pods_np)
+    a_incr, rounds = assign_lib.assign_parallel(state := gen.to_pytrees(
+        CFG, state_np, pods_np)[0], pods_incr, CFG, with_stats=True)
+    a_incr, rounds = np.asarray(a_incr), int(rounds)
+    assert rounds >= 1
+
+    inv = np.nonzero(~pods_np["pod_valid"])[0]
+    assert inv.size, "need an invalid pod row to force the full branch"
+    pods_np["zanti_bits"][inv[0], -1] = 1
+    _, pods_full = gen.to_pytrees(CFG, state_np, pods_np)
+    a_full = np.asarray(assign_lib.assign_parallel(state, pods_full, CFG))
+    np.testing.assert_array_equal(a_incr, a_full)
+
+
 def test_commit_updates_usage_and_groups():
     state_np, pods_np, state, pods = make(3)
     assignment = assign_lib.assign_parallel(state, pods, CFG)

@@ -97,10 +97,11 @@ def test_pipelined_replay_matches_monolithic():
     # 5 batches of 8 with chunk_batches=2 -> chunks of 2, 2, 1 (the
     # final chunk exercises the smaller static shape).
     got = np.full_like(mono, -2)
-    for start, chunk in replay_stream_pipelined(state, stream, cfg,
-                                                "parallel",
-                                                chunk_batches=2):
+    for start, chunk, rounds in replay_stream_pipelined(state, stream, cfg,
+                                                        "parallel",
+                                                        chunk_batches=2):
         got[start:start + len(chunk)] = chunk
+        assert (rounds >= 0).all()
     np.testing.assert_array_equal(mono, got)
 
 
