@@ -67,6 +67,7 @@ def _pod_from_k8s(obj: Mapping[str, Any]) -> Pod:
     tolerations = frozenset(
         str(t.get("key")) for t in spec.get("tolerations") or ()
         if t.get("key"))
+    labels = meta.get("labels") or {}
     return Pod(
         name=meta.get("name", ""),
         namespace=meta.get("namespace", "default"),
@@ -76,6 +77,7 @@ def _pod_from_k8s(obj: Mapping[str, Any]) -> Pod:
         peers=peers,
         tolerations=tolerations,
         node_selector=frozenset(f"{k}={v}" for k, v in selector.items()),
+        labels=frozenset(f"{k}={v}" for k, v in labels.items()),
         group=annotations.get("netaware/group", ""),
         affinity_groups=frozenset(
             g for g in annotations.get("netaware/affinity", "").split(",")

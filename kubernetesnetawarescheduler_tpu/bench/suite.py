@@ -439,15 +439,24 @@ def check_constraint_violations(loop: SchedulerLoop,
             return key not in labels
         return False
 
+    # kube's first-pod waiver: a required self-affinity term with no
+    # member anywhere is waived for ONE pod per (group, scope) — such
+    # orphans are collected and bounded instead of counted as
+    # violations (mirrors tests/test_encode_fuzz.py's checker).
+    orphans: dict[tuple, int] = {}
     for node_name, placed in by_node.items():
         node = nodes[node_name]
         z = zone_of.get(node_name, "")
         labels = dict(s.split("=", 1) for s in node.labels if "=" in s)
         for p in placed:
-            if p.zone_affinity_groups and (not z or not any(
-                    _members(z, g, exclude_self_of=p) > 0
-                    for g in p.zone_affinity_groups)):
-                viol["zone_affinity"] += 1
+            for g in p.zone_affinity_groups:
+                if z and _members(z, g, exclude_self_of=p) > 0:
+                    continue  # term satisfied (zone terms AND)
+                if g == p.group:
+                    orphans[("zone", g)] = orphans.get(("zone", g),
+                                                      0) + 1
+                else:
+                    viol["zone_affinity"] += 1
             if z and any(_members(z, g, exclude_self_of=p) > 0
                          for g in p.zone_anti_groups):
                 # Self-exclusion: a pod with anti-affinity against its
@@ -462,13 +471,20 @@ def check_constraint_violations(loop: SchedulerLoop,
         for p in placed:
             # Groups of the OTHER residents: required affinity must be
             # satisfied by a co-resident (the kernel checks group_bits
-            # *before* the pod lands, so self never satisfies it), and
-            # anti-affinity means no co-resident's group is forbidden —
-            # including the pod's own group (spread semantics), matching
+            # *before* the pod lands, so self never satisfies it) for
+            # EVERY term (terms AND, kube's join), and anti-affinity
+            # means no co-resident's group is forbidden — including
+            # the pod's own group (spread semantics), matching
             # feasibility_mask + the symmetric resident_anti check.
             others = {q.group for q in placed if q is not p and q.group}
-            if p.affinity_groups and not (set(p.affinity_groups) & others):
-                viol["affinity"] += 1
+            for g in p.affinity_groups:
+                if g in others:
+                    continue
+                if g == p.group:
+                    orphans[("host", g)] = orphans.get(("host", g),
+                                                       0) + 1
+                else:
+                    viol["affinity"] += 1
             if set(p.anti_groups) & others:
                 viol["anti"] += 1
             if node.taints - p.tolerations:
@@ -477,6 +493,12 @@ def check_constraint_violations(loop: SchedulerLoop,
             used = sum(p.requests.get(rname, 0.0) for p in placed)
             if used > node.capacity.get(rname, 0.0) + 1e-6:
                 viol["capacity"] += 1
+    # A second memberless self-affine pod per (scope, group) means the
+    # waiver leaked — THAT is a violation.
+    for key, count in orphans.items():
+        if count > 1:
+            viol["affinity" if key[0] == "host"
+                 else "zone_affinity"] += count - 1
     return viol
 
 

@@ -156,6 +156,15 @@ class SchedulerConfig:
     # harder to place, never easier) — see Encoder._ns_rows.
     max_ns_terms: int = 2
     max_ns_exprs: int = 4
+    # Numeric nodeAffinity (Gt/Lt matchExpressions): node label values
+    # for up to ``max_numeric_labels`` distinct KEYS are parsed into a
+    # dense ``f32[N, L]`` table (NaN = label absent/non-numeric, which
+    # fails every comparison — kube's direction), and each
+    # nodeSelectorTerm carries up to ``max_ns_num`` (column, lo, hi)
+    # comparisons AND'd with its other expressions.  Keys beyond the
+    # budget degrade the TERM closed, like every other hard overflow.
+    max_numeric_labels: int = 8
+    max_ns_num: int = 2
     # Topology domains for topologySpreadConstraints (zone-level:
     # ``topology.kubernetes.io/zone``).  Zones intern on first sight;
     # nodes past the budget fall into an untracked -1 domain where

@@ -101,12 +101,14 @@ def _dynamic_mask(pods: PodBatch, used: jax.Array, cap: jax.Array,
                   group_bits: jax.Array,
                   resident_anti: jax.Array) -> jax.Array:
     """Placement-dependent constraints: capacity fit + pod (anti-)affinity
-    (both directions), recomputed against the *current* usage/groups."""
+    (both directions), recomputed against the *current* usage/groups.
+    Required affinity is a SUBSET test — terms AND, matching
+    kube-scheduler (see score.feasibility_mask)."""
     free = cap - used
     fits = jnp.all(pods.req[:, None, :] <= free[None, :, :] + _EPS, axis=-1)
     aff_req = pods.affinity_bits[:, None, :]
-    affinity = jnp.all(aff_req == 0, axis=-1) | jnp.any(
-        (group_bits[None, :, :] & aff_req) != 0, axis=-1)
+    affinity = jnp.all(
+        (group_bits[None, :, :] & aff_req) == aff_req, axis=-1)
     anti = jnp.all(
         (group_bits[None, :, :] & pods.anti_bits[:, None, :]) == 0,
         axis=-1)
@@ -153,8 +155,8 @@ def assign_greedy(state: ClusterState, pods: PodBatch,
         bal_row = jnp.max((used + req[None, :]) / cap, axis=-1)
         fits = jnp.all(req[None, :] <= state.cap - used + _EPS, axis=-1)
         aff_req = pods.affinity_bits[pod_idx]          # [W]
-        affinity = jnp.all(aff_req == 0) | jnp.any(
-            (group_bits & aff_req[None, :]) != 0, axis=-1)
+        affinity = jnp.all(
+            (group_bits & aff_req[None, :]) == aff_req[None, :], axis=-1)
         anti = jnp.all(
             (group_bits & pods.anti_bits[pod_idx][None, :]) == 0, axis=-1)
         sym = jnp.all(
@@ -186,9 +188,10 @@ def assign_greedy(state: ClusterState, pods: PodBatch,
         azn = az[zrow]                                   # [N, W]
         zaff_i = pods.zaff_bits[pod_idx]
         zone_ok = (
-            (jnp.all(zaff_i == 0)
-             | (has_zone & jnp.any((pres & zaff_i[None, :]) != 0,
-                                   axis=-1)))
+            jnp.where(has_zone,
+                      jnp.all((pres & zaff_i[None, :]) == zaff_i[None, :],
+                              axis=-1),
+                      jnp.all(zaff_i == 0))
             & (~has_zone | jnp.all(
                 (pres & pods.zanti_bits[pod_idx][None, :]) == 0,
                 axis=-1))
@@ -212,8 +215,14 @@ def assign_greedy(state: ClusterState, pods: PodBatch,
         resident_anti = resident_anti.at[idx].set(resident_anti[idx] | abit,
                                                   mode="drop")
         pzone = state.node_zone[idx]
-        gz = gz.at[jnp.where(placed & (gi >= 0) & (pzone >= 0), gi, gmax),
-                   jnp.where(pzone >= 0, pzone, zmax)].add(1, mode="drop")
+        # Full membership mask into the zone column (multi-bit
+        # selector-group memberships count everywhere the host ledger
+        # counts them).
+        gplanes = bit_planes(pods.group_bit[pod_idx][None, :],
+                             jnp.int32)[0]                    # [G]
+        zcol = jnp.where(placed & (pzone >= 0), pzone, zmax)
+        gz = gz.at[:, zcol].add(
+            jnp.where(placed & (pzone >= 0), gplanes, 0), mode="drop")
         zbits = jnp.where(placed, pods.zanti_bits[pod_idx], jnp.uint32(0))
         zidx = jnp.where(placed & (pzone >= 0), pzone, zmax)
         az = az.at[zidx].set(az[jnp.clip(zidx, 0, zmax - 1)] | zbits,
@@ -269,6 +278,10 @@ def assign_parallel(state: ClusterState, pods: PodBatch,
     incremental_ok = (~jnp.any(score_lib.spread_active(pods))
                       & jnp.all(pods.zaff_bits == 0)
                       & jnp.all(pods.zanti_bits == 0))
+    # Loop-invariant column ids for the per-round second-best
+    # computation (XLA does not hoist out of while bodies; an iota
+    # materialized per round measurably costs at N=5120).
+    col_ids = jax.lax.broadcasted_iota(jnp.int32, (p, n), 1)
     # Under the predicate, zone_affinity_ok is round-invariant (az
     # never changes; gz changes touch only the trivially-true terms),
     # so fold the batch-entry evaluation into the static mask used by
@@ -318,10 +331,11 @@ def assign_parallel(state: ClusterState, pods: PodBatch,
         return jnp.where(ok, rows, NEG_INF)
 
     # The score matrix is carried across rounds so it is computed once
-    # per round (in body), not twice (cond + body).
+    # per round (in body), not twice (cond + body); the continue flag
+    # (progress made AND a feasible entry remains) is carried too, so
+    # cond reads a scalar instead of reducing [P, N] per evaluation.
     def cond(carry):
-        s, progress = carry[0], carry[7]
-        return jnp.any(s > NEG_INF * 0.5) & progress
+        return carry[7]
 
     def body(carry):
         (s, used, group_bits, resident_anti, gz, az, assignment, _,
@@ -365,6 +379,25 @@ def assign_parallel(state: ClusterState, pods: PodBatch,
         node_sorted = jnp.clip(group_id, 0, n - 1).astype(jnp.int32)
         fits_cum = jnp.all(
             seg_csum <= (state.cap - used)[node_sorted] + _EPS, axis=-1)
+        # Greedy-faithfulness guard: accept a prefix member only while
+        # the node REMAINS its best choice once the balance penalty is
+        # re-priced with everyone queued ahead of it — without this,
+        # look-alike batches overpack the round-entry-best node at its
+        # stale price (measured: sidecar co-placement fell to 0.79
+        # because app nodes were packed solid), where sequential
+        # greedy would have spilled to each pod's next-best node.
+        # Second-best row value WITHOUT top_k (XLA CPU lowers top_k to
+        # a full per-row sort — measured ~70 ms/round at N=5120):
+        # mask the argmax column, take the row max again.
+        second_best = jnp.max(
+            jnp.where(col_ids == choice[:, None], NEG_INF, s), axis=1)
+        bal_after = jnp.max(
+            (used[node_sorted] + seg_csum)
+            / jnp.maximum(state.cap, _EPS)[node_sorted], axis=-1)
+        raw_sel = jnp.take_along_axis(
+            raw, jnp.clip(choice, 0, n - 1)[:, None], axis=1)[:, 0]
+        adj_sorted = raw_sel[perm] - w_bal * bal_after
+        stays_best = adj_sorted >= second_best[perm] - 1e-6
         # Segmented EXCLUSIVE cumulative OR of earlier contenders'
         # group/anti bitplanes, via the cummax-with-segment-offset
         # trick (segment ids strictly increase along the sort, so
@@ -385,7 +418,7 @@ def assign_parallel(state: ClusterState, pods: PodBatch,
                                             axis=0)) >= 1
         pair_ok = (~jnp.any(excl_ab & (gb_planes[perm] >= 1), axis=1)
                    & ~jnp.any(excl_gb & (ab_planes[perm] >= 1), axis=1))
-        good = fits_cum & pair_ok
+        good = fits_cum & pair_ok & stays_best
         seg_start = jax.lax.cummax(jnp.where(first, idx, -1))
         last_bad = jax.lax.cummax(jnp.where(~good, idx, -1))
         prefix_ok = last_bad < seg_start  # all good since segment start
@@ -453,7 +486,7 @@ def assign_parallel(state: ClusterState, pods: PodBatch,
         new_anti = resident_anti.at[seg_cols].set(
             resident_anti[jnp.clip(seg_cols, 0, n - 1)]
             | planes_to_words(or_ab), mode="drop")
-        new_gz = add_zone_counts(gz, state.node_zone, pods.group_idx,
+        new_gz = add_zone_counts(gz, state.node_zone, pods.group_bit,
                                  choice, winner)
         # Winner ZONES are not unique (several nodes share one), so
         # the zone-anti residency update is a scatter-OR over a
@@ -485,8 +518,8 @@ def assign_parallel(state: ClusterState, pods: PodBatch,
             gb = new_group[cc]                            # [Pc, W]
             ra = new_anti[cc]
             aff_req = pods.affinity_bits[:, None, :]
-            affinity = jnp.all(aff_req == 0, axis=-1) | jnp.any(
-                (gb[None, :, :] & aff_req) != 0, axis=-1)
+            affinity = jnp.all(
+                (gb[None, :, :] & aff_req) == aff_req, axis=-1)
             aok = jnp.all(
                 (gb[None, :, :] & pods.anti_bits[:, None, :]) == 0,
                 axis=-1)
@@ -500,20 +533,25 @@ def assign_parallel(state: ClusterState, pods: PodBatch,
                   & (new_assignment == UNASSIGNED)[:, None])
             sub = jnp.where(ok, raw[:, cc] - w_bal * bal, NEG_INF)
             s2 = s.at[:, wcols].set(sub, mode="drop")
-            return jnp.where((new_assignment != UNASSIGNED)[:, None],
-                             NEG_INF, s2)
+            # Retire the winners' ROWS via a row scatter (losers and
+            # previously-assigned rows are already NEG_INF) — a full
+            # [P, N] where re-writes the whole matrix every round.
+            wrows = jnp.where(winner, pod_ids, p)
+            return s2.at[wrows].set(NEG_INF, mode="drop")
 
         new_s = jax.lax.cond(incremental_ok, incremental_update,
                              full_update, None)
+        cont = progress & jnp.any(new_s > NEG_INF * 0.5)
         return (new_s, new_used, new_group, new_anti, new_gz, new_az,
-                new_assignment, progress, rounds + 1)
+                new_assignment, cont, rounds + 1)
 
     init_assignment = jnp.full((p,), UNASSIGNED, jnp.int32)
-    init = (masked_scores(state.used, state.group_bits, state.resident_anti,
-                          state.gz_counts, state.az_anti, init_assignment),
+    s0 = masked_scores(state.used, state.group_bits, state.resident_anti,
+                       state.gz_counts, state.az_anti, init_assignment)
+    init = (s0,
             state.used, state.group_bits, state.resident_anti,
             state.gz_counts, state.az_anti, init_assignment,
-            jnp.bool_(True), jnp.int32(0))
+            jnp.any(s0 > NEG_INF * 0.5), jnp.int32(0))
     out = jax.lax.while_loop(cond, body, init)
     assignment, rounds = out[6], out[8]
     assignment = jnp.where(pods.pod_valid, assignment, UNASSIGNED)

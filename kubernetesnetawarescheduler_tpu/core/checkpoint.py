@@ -47,6 +47,7 @@ _STATE_ARRAYS = (
     "_metrics", "_metrics_age", "_lat", "_bw", "_cap", "_used",
     "_node_valid", "_label_bits", "_taint_bits", "_group_bits",
     "_resident_anti", "_node_zone", "_gz_counts", "_az_anti",
+    "_node_numeric",
 )
 
 # v2: constraint bitmask arrays widened to u32[N, mask_words]; raw
@@ -57,8 +58,13 @@ _STATE_ARRAYS = (
 # restore with empty spread state (counts rebuild as pods churn).
 # v4: zone-scoped anti-affinity residency (_az_anti words + per-record
 # zanti_bits).  Older checkpoints restore with it empty.
-FORMAT_VERSION = 4
-_ACCEPTED_VERSIONS = (2, 3, 4)
+# v5: labelSelector-parity groups — the selector-definition registry,
+# per-record full membership masks (member_bits) and pod labels (so
+# selectors registered after a restart can claim restored residents).
+# Pre-v5 records restore with member_bits=0; release paths fall back
+# to the legacy single group_bit.
+FORMAT_VERSION = 5
+_ACCEPTED_VERSIONS = (2, 3, 4, 5)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -158,11 +164,24 @@ def save_checkpoint(path: str, encoder: Encoder) -> None:
                       rec.priority, rec.namespace, rec.name,
                       int(rec.group_bit), int(rec.anti_bits),
                       int(rec.pdb_min), int(rec.group_slot),
-                      int(rec.zone), int(rec.zanti_bits)]
+                      int(rec.zone), int(rec.zanti_bits),
+                      int(rec.member_bits),
+                      (sorted(rec.labels) if rec.labels is not None
+                       else None)]
                 for uid, rec in encoder._committed.items()
             },
             # Zone interner (topology-spread domains).
             "zones": dict(encoder._zone_index),
+            # Numeric-label columns (v5): Gt/Lt key -> column of
+            # _node_numeric.
+            "numeric_keys": dict(encoder._numeric_keys),
+            # Selector-group registry (v5): group key -> canonical
+            # labelSelector structure, as nested lists.
+            "selector_defs": {
+                key: [[list(p) for p in ml],
+                      [[op, k2, list(vals)] for op, k2, vals in exprs]]
+                for key, (ml, exprs)
+                in encoder._selector_defs.items()},
         }
     np.savez_compressed(os.path.join(path, "state.npz"), **arrays)
     tmp = os.path.join(path, "meta.json.tmp")
@@ -205,6 +224,9 @@ def load_checkpoint(path: str,
                 if meta.get("format_version", 0) <= 3 \
                         and name == "_az_anti":
                     continue
+                if meta.get("format_version", 0) <= 4 \
+                        and name == "_node_numeric":
+                    continue
                 raise ValueError(
                     f"checkpoint state.npz is missing array {name!r}")
             stored = data[name.lstrip("_")]
@@ -245,17 +267,41 @@ def load_checkpoint(path: str,
         gslot = int(entry[8]) if len(entry) > 8 else -1
         zone = int(entry[9]) if len(entry) > 9 else -1
         zanti = int(entry[10]) if len(entry) > 10 else 0
+        member = int(entry[11]) if len(entry) > 11 else 0
+        # Pre-v5 entries (or null): labels unknown — never re-claim.
+        labels = (frozenset(entry[12])
+                  if len(entry) > 12 and entry[12] is not None
+                  else None)
         return CommitRecord(int(idx), np.asarray(req, np.float32), 0.0,
                             prio, ns, name, gbit, abits, pdb,
                             group_slot=gslot, zone=zone,
-                            zanti_bits=zanti)
+                            zanti_bits=zanti, member_bits=member,
+                            labels=labels)
 
     enc._committed = {uid: _rec(entry)
                       for uid, entry in meta.get("committed", {}).items()}
-    # Group/anti refcounts are derived state: rebuild from the ledger.
+    # Selector-group registry (v5; absent pre-v5).
+    enc._selector_defs = {
+        key: (tuple((str(k2), str(v)) for k2, v in ml),
+              tuple((str(op), str(k2), tuple(str(x) for x in vals))
+                    for op, k2, vals in exprs))
+        for key, (ml, exprs)
+        in meta.get("selector_defs", {}).items()}
+    enc._selector_gen = len(enc._selector_defs)
+    enc._numeric_keys = {k: int(v) for k, v
+                         in meta.get("numeric_keys", {}).items()}
+    # Group/anti refcounts and cluster-wide member counts are derived
+    # state: rebuild from the ledger (member_bits when present, the
+    # legacy single group_bit otherwise).
     for rec in enc._committed.values():
-        if rec.group_bit:
-            enc._ref_add(enc._group_refs, rec.node, rec.group_bit)
+        member = rec.member_bits or rec.group_bit
+        if member:
+            enc._ref_add(enc._group_refs, rec.node, member)
+            m = member
+            while m:
+                b = m & -m
+                m ^= b
+                enc._group_member_counts[b.bit_length() - 1] += 1
         if rec.anti_bits:
             enc._ref_add(enc._anti_refs, rec.node, rec.anti_bits)
         if rec.zanti_bits and rec.zone >= 0:
@@ -315,10 +361,21 @@ def replay_decisions(encoder: Encoder, pods: Sequence,
         assignment = np.asarray(assign(state, batch, cfg))
         state = commit_assignments(state, batch,
                                    jnp.asarray(assignment))
+        placed_pods, placed_idx = [], []
         for j, pod in enumerate(chunk):
             idx = int(assignment[j])
             node = encoder.node_name(idx) if idx >= 0 else ""
             if node:
                 placed_node[pod.name] = node
+                placed_pods.append(pod)
+                placed_idx.append(idx)
             log.append(pod.name, node)
+        # Mirror the live loop's ENCODER-side commits (bind →
+        # encoder.commit): encode-time state — group member counts
+        # behind the first-pod affinity waiver, selector memberships —
+        # must evolve identically or the replayed decisions diverge
+        # from the live log.  Device-side scoring still reads the
+        # locally-threaded `state`, so this cannot double-count usage.
+        if placed_pods:
+            encoder.commit_many(placed_pods, placed_idx)
     return log

@@ -138,6 +138,39 @@ def _requests_vector(requests: Mapping[str, float], r: int) -> np.ndarray:
     return vec
 
 
+def selector_matches(sel_def: tuple, labels: frozenset) -> bool:
+    """Evaluate a canonical labelSelector structure against a pod's
+    ``k=v`` label strings — Kubernetes ``LabelSelector`` semantics
+    (apimachinery ``labels.Requirement``): matchLabels AND; In needs
+    the key present with a listed value; NotIn passes when the key is
+    absent OR its value is unlisted; Exists/DoesNotExist test key
+    presence.  ``sel_def`` is ``(((k, v), ...), ((op, key, values),
+    ...))`` with both banks sorted (the canonical form the kubeclient
+    parser emits)."""
+    match_labels, exprs = sel_def
+    for k, v in match_labels:
+        if f"{k}={v}" not in labels:
+            return False
+    if exprs:
+        keys = {s.split("=", 1)[0] for s in labels}
+        for op, key, values in exprs:
+            if op == "In":
+                if not any(f"{key}={v}" in labels for v in values):
+                    return False
+            elif op == "NotIn":
+                if any(f"{key}={v}" in labels for v in values):
+                    return False
+            elif op == "Exists":
+                if key not in keys:
+                    return False
+            elif op == "DoesNotExist":
+                if key in keys:
+                    return False
+            else:
+                return False
+    return True
+
+
 class CommitRecord(NamedTuple):
     """One usage-ledger entry: everything needed to reverse a commit
     (node + request vector + group/anti bits), reconcile it (stamp),
@@ -164,6 +197,18 @@ class CommitRecord(NamedTuple):
     # Zone-scoped anti-affinity mask this pod declared (symmetric
     # residency recorded under ``zone``; 0 = none).
     zanti_bits: int = 0
+    # FULL group-membership mask (annotation group bit | every
+    # registered selector-group the pod's labels satisfy).  0 on
+    # records restored from pre-v5 checkpoints — release/gz paths
+    # fall back to ``group_bit``/``group_slot`` then.
+    member_bits: int = 0
+    # The pod's labels at commit time, kept so a selector-group
+    # registered LATER can claim this resident retroactively
+    # (register_selectors).  ``None`` = unknown (pre-v5 restore) —
+    # such residents are never retro-claimed; an EMPTY set is a
+    # genuinely label-less pod, which negative selectors (NotIn /
+    # DoesNotExist) do match.
+    labels: frozenset | None = None
 
 
 class Encoder:
@@ -176,6 +221,25 @@ class Encoder:
         self.labels = Interner("label", w)
         self.taints = Interner("taint", w)
         self.groups = Interner("group", w)
+        # labelSelector-parity group machinery: group key -> canonical
+        # selector structure (see :func:`selector_matches`).  A pod is
+        # a member of every registered selector its LABELS satisfy —
+        # no annotation opt-in required (kube semantics; the
+        # ``netaware.io/group`` annotation remains an additional,
+        # label-free membership surface).  ``_selector_gen`` bumps on
+        # every new registration so shape-cache entries computed
+        # against an older registry can never serve stale memberships.
+        self._selector_defs: dict[str, tuple] = {}
+        self._selector_gen = 0
+        # Live committed members per group bit-slot (cluster-wide):
+        # backs the first-pod escape hatch (a required affinity term
+        # whose group has NO member anywhere is waived for the first
+        # self-member pod, like kube-scheduler's special case).
+        self._group_member_counts = np.zeros((32 * w,), np.int64)
+        # Real policy/v1 PodDisruptionBudgets (uid -> the reduced
+        # object), consumed by the preemption planner beside the
+        # annotation-level surface.
+        self._pdbs: dict[str, object] = {}
         self._node_index: dict[str, int] = {}
         self._node_names: list[str] = []
         # Slots freed by remove_node, reused FIFO (oldest-freed first).
@@ -205,6 +269,13 @@ class Encoder:
         # Presence bits intern in the same label table under the bare
         # key (collision-free: full label strings always contain '=').
         self._label_keys: dict[str, set[int]] = {}
+        # Numeric nodeAffinity (Gt/Lt): label KEY -> column of the
+        # parsed-value table below (NaN = absent/non-numeric, failing
+        # every comparison).  Columns intern on first Gt/Lt reference,
+        # backfilling values for nodes already carrying the key.
+        self._numeric_keys: dict[str, int] = {}
+        self._node_numeric = np.full((n, cfg.max_numeric_labels),
+                                     np.nan, np.float32)
 
         # Staging (host) arrays — mirror of ClusterState fields.
         self._metrics = np.zeros((n, m), np.float32)
@@ -291,10 +362,14 @@ class Encoder:
         self._degraded_seen: set[tuple[str, str]] = set()
         self.degraded_total = 0  # distinct pods degraded (self-metrics)
 
-    def pop_degraded(self) -> list[tuple[str, str, int]]:
+    def pop_degraded(self) -> list[tuple[str, str, int, tuple]]:
         """Drain the constraint-degradation records
-        (``(namespace, name, dropped_count)``) accumulated since the
-        last call — see :meth:`_constraint_bits`."""
+        (``(namespace, name, dropped_count, detail_strings)``)
+        accumulated since the last call — see
+        :meth:`_constraint_bits`.  ``detail_strings`` names the
+        parse-time term drops (e.g. which anti-affinity term stopped
+        being enforced), so operators get term-level diagnostics, not
+        just a count."""
         with self._lock:
             out = list(self._degraded_pods)
             self._degraded_pods.clear()
@@ -421,6 +496,47 @@ class Encoder:
             if kb is not None:
                 bits |= 1 << kb
         _fill_words(self._label_bits[idx], bits)
+        # Numeric Gt/Lt table: refresh this node's value for every
+        # registered numeric key (label updates can change them).
+        for key, col in self._numeric_keys.items():
+            self._node_numeric[idx, col] = self._parse_numeric_label(
+                new, key)
+
+    @staticmethod
+    def _parse_numeric_label(labels, key: str) -> float:
+        """The node's value for ``key`` as a float (NaN when absent or
+        non-numeric — kube's Gt/Lt fail on both)."""
+        prefix = key + "="
+        for s in labels:
+            if s.startswith(prefix):
+                try:
+                    return float(s[len(prefix):])
+                except ValueError:
+                    return float("nan")
+        return float("nan")
+
+    def _numeric_col(self, key: str, lenient: bool) -> int | None:
+        """Column of the numeric-value table for label ``key``,
+        interning (and backfilling every node already carrying the
+        key) on first sight.  ``None`` on lenient overflow — the
+        caller degrades the term CLOSED.  Caller holds the lock."""
+        col = self._numeric_keys.get(key)
+        if col is not None:
+            return col
+        if len(self._numeric_keys) >= self.cfg.max_numeric_labels:
+            if lenient:
+                return None
+            raise ValueError(
+                f"too many numeric nodeAffinity keys "
+                f"(max {self.cfg.max_numeric_labels}; raise "
+                f"cfg.max_numeric_labels): cannot intern {key!r}")
+        col = len(self._numeric_keys)
+        self._numeric_keys[key] = col
+        for idx in self._label_keys.get(key, ()):
+            self._node_numeric[idx, col] = self._parse_numeric_label(
+                self._node_labels.get(idx, ()), key)
+        self._dirty["topo"] = True
+        return col
 
     def _selector_mask(self, keys: Iterable[str], lenient: bool) -> int:
         """Intern a pod selector's label keys, backfilling the bit of a
@@ -673,6 +789,9 @@ class Encoder:
             bits = []
             for pod in pods:
                 before = self.groups.overflow_drops
+                defs = getattr(pod, "selector_defs", None)
+                dropped_defs = (self.register_selectors(defs, True)
+                                if defs else 0)
                 bits.append((
                     (self.groups.bit(pod.group, lenient=True)
                      if pod.group else 0),
@@ -681,10 +800,12 @@ class Encoder:
                     (self.groups.mask(
                         getattr(pod, "zone_anti_groups", ()) or (),
                         lenient=True)
-                     if getattr(pod, "zone_anti_groups", None) else 0)))
-                if self.groups.overflow_drops > before:
+                     if getattr(pod, "zone_anti_groups", None) else 0),
+                    self._membership_mask(pod, lenient=True)))
+                if self.groups.overflow_drops > before or dropped_defs:
                     self._record_degraded(
-                        pod, self.groups.overflow_drops - before)
+                        pod, self.groups.overflow_drops - before
+                        + dropped_defs)
             keep = np.ones(len(pods), bool)
             for i, pod in enumerate(pods):
                 if pod.uid in self._committed:
@@ -699,9 +820,11 @@ class Encoder:
                     keep[i] = False
                     continue
                 gbit = bits[i][0]
-                # Single-bit group mask -> its slot index; the UNKNOWN
-                # sentinel counts nothing (its gz row never matches).
-                gslot = gbit.bit_length() - 1 if gbit else -1
+                member = bits[i][3]
+                # Spread-count slot (the constraint's selector group
+                # or the own group); the UNKNOWN sentinel counts
+                # nothing (its gz row never matches).
+                gslot = self._spread_slot(pod)
                 zone = int(self._node_zone[int(idx[i])])
                 zanti = bits[i][2]
                 if zanti and zone < 0:
@@ -716,9 +839,22 @@ class Encoder:
                     float(pod.priority), pod.namespace, pod.name,
                     bits[i][0], bits[i][1],
                     int(getattr(pod, "pdb_min_available", 0)),
-                    group_slot=gslot, zone=zone, zanti_bits=zanti)
-                if gslot >= 0 and zone >= 0:
-                    self._gz_counts[gslot, zone] += 1
+                    group_slot=gslot, zone=zone, zanti_bits=zanti,
+                    member_bits=member,
+                    labels=frozenset(getattr(pod, "labels", None)
+                                     or ()))
+                # Zone presence + member counts for EVERY membership
+                # bit (selector groups included), not just the own
+                # group: gz_counts is what zone affinity and spread
+                # read.
+                m = member
+                while m:
+                    b = m & -m
+                    m ^= b
+                    slot = b.bit_length() - 1
+                    if zone >= 0:
+                        self._gz_counts[slot, zone] += 1
+                    self._group_member_counts[slot] += 1
                 self._drop_nomination(pod.uid)
             np.add.at(self._used, idx[keep], reqs[keep])
             w = self.cfg.mask_words
@@ -726,11 +862,11 @@ class Encoder:
                 if not keep[i]:
                     continue
                 rec = self._committed[pod.uid]
-                if rec.group_bit:
+                if rec.member_bits:
                     self._group_bits[idx[i]] |= int_to_words(
-                        rec.group_bit, w)
+                        rec.member_bits, w)
                     self._ref_add(self._group_refs, int(idx[i]),
-                                  rec.group_bit)
+                                  rec.member_bits)
                 if rec.anti_bits:
                     self._resident_anti[idx[i]] |= int_to_words(
                         rec.anti_bits, w)
@@ -777,9 +913,11 @@ class Encoder:
         w = self.cfg.mask_words
         self._used[rec.node] = np.maximum(
             self._used[rec.node] - rec.req, 0.0)
-        if rec.group_bit:
-            cleared = self._ref_sub(self._group_refs, rec.node,
-                                    rec.group_bit)
+        # member_bits supersets group_bit on v5+ records; pre-v5
+        # restores carry member_bits=0 and fall back to the own bit.
+        member = rec.member_bits or rec.group_bit
+        if member:
+            cleared = self._ref_sub(self._group_refs, rec.node, member)
             self._group_bits[rec.node] &= np.invert(
                 int_to_words(cleared, w))
         if rec.anti_bits:
@@ -795,9 +933,25 @@ class Encoder:
         self._gz_sub(rec)
 
     def _gz_sub(self, rec: CommitRecord) -> None:
-        """Reverse one record's topology-spread count (caller holds
-        the lock)."""
-        if rec.group_slot >= 0 and rec.zone >= 0:
+        """Reverse one record's zone-presence/member counts (caller
+        holds the lock).  v5+ records reverse every membership bit;
+        pre-v5 restores (member_bits == 0) reverse the legacy single
+        own-group slot for gz — their member counts were rebuilt from
+        ``group_bit``, so that is what the count decrement mirrors."""
+        member = rec.member_bits or rec.group_bit
+        m = member
+        while m:
+            b = m & -m
+            m ^= b
+            slot = b.bit_length() - 1
+            if rec.member_bits and rec.zone >= 0:
+                self._gz_counts[slot, rec.zone] = max(
+                    0, self._gz_counts[slot, rec.zone] - 1)
+            self._group_member_counts[slot] = max(
+                0, self._group_member_counts[slot] - 1)
+        if member:
+            self._dirty["alloc"] = True
+        if not rec.member_bits and rec.group_slot >= 0 and rec.zone >= 0:
             self._gz_counts[rec.group_slot, rec.zone] = max(
                 0, self._gz_counts[rec.group_slot, rec.zone] - 1)
             self._dirty["alloc"] = True
@@ -956,6 +1110,8 @@ class Encoder:
                 self._cache["label_bits"] = jnp.asarray(self._label_bits)
                 self._cache["taint_bits"] = jnp.asarray(self._taint_bits)
                 self._cache["node_zone"] = jnp.asarray(self._node_zone)
+                self._cache["node_numeric"] = jnp.asarray(
+                    self._node_numeric)
             for key in self._dirty:
                 self._dirty[key] = False
             return ClusterState(**self._cache), self._static_version
@@ -994,8 +1150,7 @@ class Encoder:
             self.groups.mask(pod.affinity_groups, lenient,
                              on_overflow=self.groups.unknown),
             self.groups.mask(pod.anti_groups, lenient),
-            (self.groups.bit(pod.group, lenient)
-             if pod.group else 0),
+            self._membership_mask(pod, lenient),
         )
         drops_after = (self.taints.overflow_drops
                        + self.labels.overflow_drops
@@ -1046,7 +1201,129 @@ class Encoder:
             self._degraded_seen.clear()
         self._degraded_seen.add(key)
         self.degraded_total += 1
-        self._degraded_pods.append((pod.namespace, pod.name, count))
+        detail = tuple(getattr(pod, "parse_degraded_detail", ()) or ())
+        self._degraded_pods.append((pod.namespace, pod.name, count,
+                                    detail))
+
+    def register_selectors(self, defs: Mapping[str, tuple],
+                           lenient: bool) -> int:
+        """Register selector-group definitions (group key → canonical
+        structure for :func:`selector_matches`); returns the count of
+        keys that could not get a bit (interner overflow — the caller
+        records the degradation per pod).
+
+        A NEW registration retroactively claims committed residents
+        whose labels match — node group bits, refcounts, zone counts
+        and the cluster-wide member counts all update — because
+        Kubernetes evaluates selectors against live pods: a selector
+        first seen after its members were scheduled must still see
+        them.  Bumps ``_selector_gen`` so shape-cache entries computed
+        against the older registry die.  Caller holds the lock."""
+        degraded = 0
+        w = self.cfg.mask_words
+        for key, sel_def in defs.items():
+            if key in self._selector_defs:
+                continue
+            before = self.groups.overflow_drops
+            bit = self.groups.bit(key, lenient=lenient)
+            if self.groups.overflow_drops > before or not bit:
+                degraded += 1
+                continue
+            self._selector_defs[key] = tuple(sel_def)
+            self._selector_gen += 1
+            slot = bit.bit_length() - 1
+            for uid, rec in self._committed.items():
+                if (rec.labels is None or (rec.member_bits & bit)
+                        or not selector_matches(sel_def, rec.labels)):
+                    continue
+                self._committed[uid] = rec._replace(
+                    member_bits=rec.member_bits | bit)
+                self._group_bits[rec.node] |= int_to_words(bit, w)
+                self._ref_add(self._group_refs, rec.node, bit)
+                if rec.zone >= 0:
+                    self._gz_counts[slot, rec.zone] += 1
+                self._group_member_counts[slot] += 1
+                self._dirty["alloc"] = True
+        return degraded
+
+    def _membership_mask(self, pod: Pod, lenient: bool) -> int:
+        """The pod's FULL group-membership mask: its annotation group
+        bit | every registered selector-group its labels satisfy
+        (label-driven membership, kube semantics — no annotation
+        opt-in).  Caller holds the lock."""
+        mask = self.groups.bit(pod.group, lenient) if pod.group else 0
+        labels = getattr(pod, "labels", None)
+        if labels is not None:
+            # An EMPTY label set still evaluates: kube's NotIn /
+            # DoesNotExist (and the empty selector) match label-less
+            # pods too.
+            for key, sel_def in self._selector_defs.items():
+                if selector_matches(sel_def, labels):
+                    mask |= self.groups.bit(key, lenient=True)
+        return mask
+
+    def _spread_slot(self, pod: Pod) -> int:
+        """Bit-slot of the pod's topology-spread COUNTED group: the
+        constraint's labelSelector group when parsed
+        (``pod.spread_group``), else the pod's own group.  Caller
+        holds the lock."""
+        sg = getattr(pod, "spread_group", "") or pod.group
+        if not sg:
+            return -1
+        bit = self.groups.bit(sg, lenient=True)
+        return bit.bit_length() - 1 if bit else -1
+
+    def set_pdb(self, pdb) -> None:
+        """Upsert a real ``policy/v1`` PodDisruptionBudget: registers
+        its selector as a selector-group (member counting then rides
+        the same label-driven machinery as affinity) and records the
+        disruption bound for the preemption planner."""
+        with self._lock:
+            if pdb.selector_key:
+                self.register_selectors(
+                    {pdb.selector_key: pdb.selector_def}, lenient=True)
+            self._pdbs[pdb.uid or f"{pdb.namespace}/{pdb.name}"] = pdb
+
+    def remove_pdb(self, uid: str) -> None:
+        with self._lock:
+            self._pdbs.pop(uid, None)
+
+    def _apply_first_pod_escape(self, aff_row: np.ndarray,
+                                zaff_row: np.ndarray,
+                                gbit_row: np.ndarray,
+                                granted: set) -> None:
+        """Kube-scheduler's required-affinity special case: a term
+        whose group has NO live member anywhere is waived when the
+        incoming pod itself is a member — without it, the first pod of
+        a Deployment whose replicas carry required self-affinity
+        deadlocks Pending forever (ADVICE.md round 2, medium #1).
+
+        The waiver applies only when NO earlier pod of the same encode
+        pass is a member either (``granted`` is the caller's
+        accumulated member-slot set): an earlier member will normally
+        place this pass, and the conflict loop then chains the later
+        pod onto it within the batch — exactly the sequential
+        co-location kube's one-at-a-time queue gives (a sidecar queued
+        after its app must land beside it, not take the waiver).
+        Zone-scoped terms use the same cluster-wide member counts
+        (kube's rule is "no pod in the cluster matches the selector",
+        not per-domain).  Caller holds the lock."""
+        member = words_to_int(gbit_row)
+        if not member:
+            return
+        for row in (aff_row, zaff_row):
+            m = words_to_int(row)
+            cand = m & member
+            drop = 0
+            while cand:
+                b = cand & -cand
+                cand ^= b
+                slot = b.bit_length() - 1
+                if (self._group_member_counts[slot] == 0
+                        and slot not in granted):
+                    drop |= b
+            if drop:
+                _fill_words(row, m & ~drop)
 
     def _soft_rows(self, pod: Pod, sel_bits_row: np.ndarray,
                    sel_w_row: np.ndarray, grp_bits_row: np.ndarray,
@@ -1097,30 +1374,35 @@ class Encoder:
 
     def _ns_rows(self, pod: Pod, anyof_row: np.ndarray,
                  forbid_row: np.ndarray, used_row: np.ndarray,
+                 num_col_row: np.ndarray, num_lo_row: np.ndarray,
+                 num_hi_row: np.ndarray,
                  lenient: bool, record: bool = True) -> None:
         """Fill one pod's hard-nodeAffinity rows from
         ``pod.required_node_affinity`` (caller holds the lock).
 
         Rows are ``anyof u32[T2, E, W]`` / ``forbid u32[T2, W]`` /
-        ``used bool[T2]`` slices.  Ops map to bits as: In -> any-of
-        over the interned ``key=value`` strings; Exists -> any-of over
+        ``used bool[T2]`` / numeric ``col i32[T2, NE]`` +
+        ``lo/hi f32[T2, NE]`` slices.  Ops map as: In -> any-of over
+        the interned ``key=value`` strings; Exists -> any-of over
         the key-presence bit; NotIn/DoesNotExist -> the term's forbid
-        mask.  Hard constraints degrade CLOSED: terms beyond the
-        budget are dropped (fewer OR branches = stricter), an
-        over-budget or unrepresentable expression marks its term
-        unsatisfiable via the UNKNOWN sentinel (no node carries it),
-        and a pod whose every term degrades away keeps one
-        unsatisfiable term rather than silently losing the constraint.
-        Strict mode raises instead.  Every lenient degradation is
-        recorded for the per-pod ConstraintDegraded event unless
-        ``record=False`` (read-only callers like the preemption
-        planner, which re-encodes a pod the scoring path already
-        recorded).
+        mask; Gt/Lt -> a (numeric-column, lo, hi) comparison slot
+        (same-key Gt+Lt merge into one interval).  Hard constraints
+        degrade CLOSED: terms beyond the budget are dropped (fewer OR
+        branches = stricter), an over-budget or unrepresentable
+        expression marks its term unsatisfiable via the UNKNOWN
+        sentinel (no node carries it), and a pod whose every term
+        degrades away keeps one unsatisfiable term rather than
+        silently losing the constraint.  Strict mode raises instead.
+        Every lenient degradation is recorded for the per-pod
+        ConstraintDegraded event unless ``record=False`` (read-only
+        callers like the preemption planner, which re-encodes a pod
+        the scoring path already recorded).
         """
         terms = tuple(getattr(pod, "required_node_affinity", ()) or ())
         if not terms:
             return
         t2, e_max = anyof_row.shape[0], anyof_row.shape[1]
+        ne_max = num_col_row.shape[1]
         unknown = self.labels.unknown
         degraded = 0
         if len(terms) > t2:
@@ -1133,6 +1415,7 @@ class Encoder:
         for t, term in enumerate(terms):
             used_row[t] = True
             anyofs: list[int] = []
+            numeric: dict[int, list[float]] = {}  # col -> [lo, hi]
             forbid = 0
             unsat = False
             for expr in term:
@@ -1171,6 +1454,32 @@ class Encoder:
                     if m & unknown:
                         unsat = True
                     forbid |= m & ~unknown
+                elif op in ("Gt", "Lt"):
+                    # Numeric comparison: kube parses the single value
+                    # as an integer (we accept any float — a strict
+                    # superset); unparseable values and column-budget
+                    # overflow degrade the term CLOSED.
+                    try:
+                        val = float(values[0])
+                    except (IndexError, ValueError, TypeError):
+                        if not lenient:
+                            raise ValueError(
+                                f"pod {pod.name}: non-numeric "
+                                f"{op} value {values!r}") from None
+                        degraded += 1
+                        unsat = True
+                        continue
+                    col = self._numeric_col(key, lenient)
+                    if col is None:
+                        degraded += 1
+                        unsat = True
+                        continue
+                    lo, hi = numeric.setdefault(
+                        col, [-np.inf, np.inf])
+                    if op == "Gt":
+                        numeric[col][0] = max(lo, val)
+                    else:
+                        numeric[col][1] = min(hi, val)
                 else:
                     if not lenient:
                         raise ValueError(
@@ -1185,21 +1494,34 @@ class Encoder:
                         f"exceed max_ns_exprs={e_max}")
                 degraded += len(anyofs) - e_max
                 unsat = True
+            if len(numeric) > ne_max:
+                if not lenient:
+                    raise ValueError(
+                        f"pod {pod.name}: {len(numeric)} numeric "
+                        f"Gt/Lt keys exceed max_ns_num={ne_max}")
+                degraded += len(numeric) - ne_max
+                unsat = True
             if unsat:
                 anyof_row[t].fill(0)
                 _fill_words(anyof_row[t, 0], unknown)
                 forbid_row[t].fill(0)
+                num_col_row[t].fill(-1)
                 degraded += 1
             else:
                 for e, m in enumerate(anyofs):
                     _fill_words(anyof_row[t, e], m)
                 _fill_words(forbid_row[t], forbid)
+                for j, (col, (lo, hi)) in enumerate(
+                        sorted(numeric.items())):
+                    num_col_row[t, j] = col
+                    num_lo_row[t, j] = lo
+                    num_hi_row[t, j] = hi
         if degraded and record:
             self._record_degraded(pod, degraded)
 
     def _pod_constraint_rows(self, pod: Pod, lenient: bool,
                              rows: tuple) -> tuple:
-        """Fill one pod's 16 constraint-row slices and return its
+        """Fill one pod's 19 constraint-row slices and return its
         ``_constraint_bits`` tuple — with a SHAPE cache: pods of one
         service/Deployment share identical constraint sets (same
         tolerations/selectors/affinities/terms), so the interning and
@@ -1216,9 +1538,20 @@ class Encoder:
         raising); a strict-mode raise caches nothing.  Caller holds
         the lock.
         """
+        # New selector definitions must land BEFORE the cache lookup —
+        # a registration bumps _selector_gen (part of the key below),
+        # so entries whose memberships were computed against the older
+        # registry can never be served stale.
+        defs = getattr(pod, "selector_defs", None)
+        if defs:
+            dropped = self.register_selectors(defs, lenient=lenient)
+            if dropped:
+                self._record_degraded(pod, dropped)
         key: tuple | None = (
             lenient, pod.tolerations, pod.node_selector,
             pod.affinity_groups, pod.anti_groups, pod.group,
+            getattr(pod, "labels", frozenset()),
+            getattr(pod, "spread_group", ""), self._selector_gen,
             pod.required_node_affinity, pod.zone_affinity_groups,
             pod.zone_anti_groups, pod.soft_node_affinity,
             pod.soft_group_affinity, pod.soft_zone_affinity,
@@ -1246,7 +1579,8 @@ class Encoder:
             return bits
         (tol_r, sel_r, aff_r, anti_r, gbit_r, ssel_r, ssel_w_r,
          sgrp_r, sgrp_w_r, szone_r, szone_w_r, ns_any_r, ns_forb_r,
-         ns_used_r, zaff_r, zanti_r) = rows
+         ns_used_r, ns_ncol_r, ns_nlo_r, ns_nhi_r, zaff_r,
+         zanti_r) = rows
         # Capture the compute's INTENDED degradation count through the
         # explicit accumulator (deque-length arithmetic would read 0
         # once the bounded _degraded_pods is full, or when this pod's
@@ -1260,7 +1594,8 @@ class Encoder:
                     _fill_words(row, val)
             self._soft_rows(pod, ssel_r, ssel_w_r, sgrp_r, sgrp_w_r,
                             szone_r, szone_w_r)
-            self._ns_rows(pod, ns_any_r, ns_forb_r, ns_used_r, lenient)
+            self._ns_rows(pod, ns_any_r, ns_forb_r, ns_used_r,
+                          ns_ncol_r, ns_nlo_r, ns_nhi_r, lenient)
             zb = self._zone_bits(pod, lenient)
             if zb[0]:
                 _fill_words(zaff_r, zb[0])
@@ -1335,11 +1670,16 @@ class Encoder:
         sp_skew = np.zeros((p,), np.int32)
         sp_hard = np.zeros((p,), bool)
         t2, e_ns = cfg.max_ns_terms, cfg.max_ns_exprs
+        ne = cfg.max_ns_num
         ns_any = np.zeros((p, t2, e_ns, w), np.uint32)
         ns_forb = np.zeros((p, t2, w), np.uint32)
         ns_used = np.zeros((p, t2), bool)
+        ns_ncol = np.full((p, t2, ne), -1, np.int32)
+        ns_nlo = np.full((p, t2, ne), -np.inf, np.float32)
+        ns_nhi = np.full((p, t2, ne), np.inf, np.float32)
         zaff = np.zeros((p, w), np.uint32)
         zanti = np.zeros((p, w), np.uint32)
+        granted: set[int] = set()  # first-pod escape, one per group
         with self._lock:
             for i, pod in enumerate(pods):
                 # A nominated preemptor entering scoring: its own
@@ -1365,9 +1705,16 @@ class Encoder:
                     tol[i], sel[i], aff[i], anti[i], gbit[i],
                     ssel[i], ssel_w[i], sgrp[i], sgrp_w[i],
                     szone[i], szone_w[i], ns_any[i], ns_forb[i],
-                    ns_used[i], zaff[i], zanti[i]))
-                gmask = bits[4]
-                gidx[i] = gmask.bit_length() - 1 if gmask else -1
+                    ns_used[i], ns_ncol[i], ns_nlo[i], ns_nhi[i],
+                    zaff[i], zanti[i]))
+                self._apply_first_pod_escape(aff[i], zaff[i], gbit[i],
+                                             granted)
+                m = words_to_int(gbit[i])
+                while m:
+                    b = m & -m
+                    m ^= b
+                    granted.add(b.bit_length() - 1)
+                gidx[i] = self._spread_slot(pod)
                 sp_skew[i] = int(getattr(pod, "spread_maxskew", 0))
                 sp_hard[i] = bool(getattr(pod, "spread_hard", True))
                 if sp_skew[i] > 0 and gidx[i] < 0:
@@ -1394,6 +1741,9 @@ class Encoder:
             ns_anyof=jnp.asarray(ns_any),
             ns_forbid=jnp.asarray(ns_forb),
             ns_term_used=jnp.asarray(ns_used),
+            ns_num_col=jnp.asarray(ns_ncol),
+            ns_num_lo=jnp.asarray(ns_nlo),
+            ns_num_hi=jnp.asarray(ns_nhi),
             zaff_bits=jnp.asarray(zaff),
             zanti_bits=jnp.asarray(zanti))
 
@@ -1454,10 +1804,22 @@ class Encoder:
         ns_any = np.zeros((s, t2, e_ns, w), np.uint32)
         ns_forb = np.zeros((s, t2, w), np.uint32)
         ns_used = np.zeros((s, t2), bool)
+        ns_ncol = np.full((s, t2, cfg.max_ns_num), -1, np.int32)
+        ns_nlo = np.full((s, t2, cfg.max_ns_num), -np.inf, np.float32)
+        ns_nhi = np.full((s, t2, cfg.max_ns_num), np.inf, np.float32)
         zaff = np.zeros((s, w), np.uint32)
         zanti = np.zeros((s, w), np.uint32)
         batch = self.cfg.max_pods
         res_names = _res_names(r)
+        # First-pod escape: ``granted`` accumulates member slots of
+        # every pod already encoded this pass, so only the genuinely
+        # FIRST member of a group can take the waiver — later pods
+        # chain onto earlier members (in the conflict loop within a
+        # batch, or via committed counts across the host loop's
+        # batches; the stream sees both through this one set, under
+        # the same earlier-pods-bind approximation the peer-slot logic
+        # uses).
+        granted: set[int] = set()
         with self._lock:
             for i, pod in enumerate(pods):
                 _fill_requests_row(req[i], pod.requests, res_names)
@@ -1486,9 +1848,16 @@ class Encoder:
                     tol[i], sel[i], aff[i], anti[i], gbit[i],
                     ssel[i], ssel_w[i], sgrp[i], sgrp_w[i],
                     szone[i], szone_w[i], ns_any[i], ns_forb[i],
-                    ns_used[i], zaff[i], zanti[i]))
-                gmask = bits[4]
-                gidx[i] = gmask.bit_length() - 1 if gmask else -1
+                    ns_used[i], ns_ncol[i], ns_nlo[i], ns_nhi[i],
+                    zaff[i], zanti[i]))
+                self._apply_first_pod_escape(aff[i], zaff[i], gbit[i],
+                                             granted)
+                m = words_to_int(gbit[i])
+                while m:
+                    b = m & -m
+                    m ^= b
+                    granted.add(b.bit_length() - 1)
+                gidx[i] = self._spread_slot(pod)
                 sp_skew[i] = int(getattr(pod, "spread_maxskew", 0))
                 sp_hard[i] = bool(getattr(pod, "spread_hard", True))
                 if sp_skew[i] > 0 and gidx[i] < 0:
@@ -1516,5 +1885,8 @@ class Encoder:
             ns_anyof=jnp.asarray(ns_any),
             ns_forbid=jnp.asarray(ns_forb),
             ns_term_used=jnp.asarray(ns_used),
+            ns_num_col=jnp.asarray(ns_ncol),
+            ns_num_lo=jnp.asarray(ns_nlo),
+            ns_num_hi=jnp.asarray(ns_nhi),
             zaff_bits=jnp.asarray(zaff),
             zanti_bits=jnp.asarray(zanti))

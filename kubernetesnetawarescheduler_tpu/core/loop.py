@@ -103,6 +103,26 @@ class SchedulerLoop:
         # Node scale-down: free the encoder slot (round 1 leaked slots
         # and kept binding to deleted nodes).
         client.on_node_deleted(self._on_node_gone)
+        # Real policy/v1 PodDisruptionBudgets: watch + initial sync
+        # (events missed while down), feeding the preemption planner.
+        # Optional per ClusterClient contract, and defensive: a
+        # cluster (or test double) without policy/v1 access must not
+        # fail serving — the annotation surface still protects.
+        try:
+            client.on_pdb_changed(self._on_pdb)
+            initial_pdbs = client.list_pdbs()
+        except Exception:  # noqa: BLE001 — no policy/v1: degrade
+            initial_pdbs = None
+        if initial_pdbs:
+            for pdb in initial_pdbs:
+                self.encoder.set_pdb(pdb)
+
+    def _on_pdb(self, pdb, deleted: bool) -> None:
+        if deleted:
+            self.encoder.remove_pdb(pdb.uid or
+                                    f"{pdb.namespace}/{pdb.name}")
+        else:
+            self.encoder.set_pdb(pdb)
 
     def _on_node(self, node: Node) -> None:
         self.encoder.upsert_node(node)
@@ -194,13 +214,15 @@ class SchedulerLoop:
 
         self.client.create_events([
             Event(
-                message=(f"{count} constraint key(s) dropped: interner "
-                         "capacity exhausted (mask_words); affinity/"
-                         "anti-affinity may not be fully enforced"),
+                message=(f"{count} constraint key(s) dropped "
+                         "(interner capacity or unrepresentable "
+                         "terms); affinity/anti-affinity may not be "
+                         "fully enforced"
+                         + (": " + "; ".join(detail) if detail else "")),
                 reason="ConstraintDegraded", involved_pod=name,
                 namespace=namespace,
                 component=self.cfg.scheduler_name, type="Warning")
-            for namespace, name, count in degraded])
+            for namespace, name, count, detail in degraded])
 
     def _peer_node(self, pod_name: str) -> str:
         try:

@@ -159,11 +159,11 @@ def _kernel(params_ref, t_ref, bw_ref, lat_ref, validk_ref, nodef_ref,
                 bal, (used_r + req_r) / jnp.maximum(cap_r, eps))
 
         # W-word bit fields: subset/overlap tests accumulate over the
-        # static word loop (unrolled at trace time).
+        # static word loop (unrolled at trace time).  Required affinity
+        # is a subset test (terms AND, kube semantics) like the node
+        # selector.
         mw = mask_words
         ok = fits
-        aff_zero = jnp.ones_like(fits)
-        aff_hit = jnp.zeros_like(fits)
         for w in range(mw):
             taint = nodei_ref[w:w + 1, :]                    # (1, bn)
             label = nodei_ref[mw + w:mw + w + 1, :]
@@ -178,9 +178,7 @@ def _kernel(params_ref, t_ref, bw_ref, lat_ref, validk_ref, nodef_ref,
             ok = ok & ((label & sel) == sel)
             ok = ok & ((group & anti) == 0)
             ok = ok & ((ranti & gbit) == 0)
-            aff_zero = aff_zero & (aff == 0)
-            aff_hit = aff_hit | ((group & aff) != 0)
-        ok = ok & (aff_zero | aff_hit)
+            ok = ok & ((group & aff) == aff)
 
         # Soft (preferred) affinity: weighted bonuses, fused into the
         # same tile write.

@@ -20,11 +20,16 @@ Semantics notes (documented deltas vs kube-scheduler):
 - victims are chosen lowest-priority-first until the pod fits; the
   node is chosen to minimize (highest victim priority, victim count) —
   kube-scheduler's primary tie-breakers;
-- PodDisruptionBudgets are annotation-level
-  (``netaware.io/pdb-min-available`` on the members of a ``group``),
-  not PDB objects: the planner never disrupts a protected group below
-  its min-available, and a groupless pod with the annotation is
-  outright unevictable;
+- PodDisruptionBudgets come from TWO surfaces, strictest wins: real
+  ``policy/v1`` PDB objects (watched from the API server, selectors
+  canonicalized to label-driven selector-groups — Encoder.set_pdb)
+  and the annotation (``netaware.io/pdb-min-available`` on the
+  members of a ``group``).  The planner never disrupts a protected
+  group below its bound, a pod matching several protected selectors
+  consumes each one's budget, and a groupless pod with the annotation
+  is outright unevictable.  Percentage bounds resolve against live
+  member counts (kube uses the controller's expected scale — a
+  documented delta);
 - eviction is graceful (``cfg.preemption_grace_s`` becomes
   DeleteOptions.gracePeriodSeconds) and the preemptor is requeued only
   after every victim's deletion is CONFIRMED through the watch (or
@@ -36,6 +41,7 @@ Semantics notes (documented deltas vs kube-scheduler):
 from __future__ import annotations
 
 import dataclasses
+import math
 from typing import Sequence
 
 import numpy as np
@@ -84,11 +90,16 @@ def _refs_after(refs_row: np.ndarray, evicted_bits: list[int]) -> int:
 
 
 def _ns_ok_nodes(labels: np.ndarray, ns_any: np.ndarray,
-                 ns_forb: np.ndarray, ns_used: np.ndarray) -> np.ndarray:
+                 ns_forb: np.ndarray, ns_used: np.ndarray,
+                 node_numeric: np.ndarray | None = None,
+                 ns_ncol: np.ndarray | None = None,
+                 ns_nlo: np.ndarray | None = None,
+                 ns_nhi: np.ndarray | None = None) -> np.ndarray:
     """Host mirror of the kernel's hard-nodeAffinity mask
     (score.ns_affinity_ok), ``bool[N]`` over label-bit rows — same
     bit rows the device sees, so the plan can never target a node the
-    scoring kernel still rejects on matchExpressions."""
+    scoring kernel still rejects on matchExpressions (numeric Gt/Lt
+    included; NaN fails, like the kernel)."""
     if not ns_used.any():
         return np.ones(labels.shape[0], bool)
     expr_unused = (ns_any == 0).all(axis=-1)                   # [T2, E]
@@ -96,6 +107,11 @@ def _ns_ok_nodes(labels: np.ndarray, ns_any: np.ndarray,
     expr_ok = expr_unused[None] | hit                          # [N, T2, E]
     clean = ((labels[:, None, :] & ns_forb[None]) == 0).all(axis=-1)
     term_ok = expr_ok.all(axis=2) & clean & ns_used[None]      # [N, T2]
+    if ns_ncol is not None and (ns_ncol >= 0).any():
+        vals = node_numeric[:, np.clip(ns_ncol, 0, None)]  # [N, T2, NE]
+        with np.errstate(invalid="ignore"):
+            in_range = (vals > ns_nlo[None]) & (vals < ns_nhi[None])
+        term_ok &= ((ns_ncol[None] < 0) | in_range).all(axis=2)
     return term_ok.any(axis=1)
 
 
@@ -147,15 +163,23 @@ def plan_preemption(encoder: Encoder, pod: Pod) -> PreemptionPlan | None:
                           np.uint32)
         ns_forb = np.zeros((cfg.max_ns_terms, w), np.uint32)
         ns_used = np.zeros((cfg.max_ns_terms,), bool)
-        encoder._ns_rows(pod, ns_any, ns_forb, ns_used, lenient=True,
-                         record=False)
+        ns_ncol = np.full((cfg.max_ns_terms, cfg.max_ns_num), -1,
+                          np.int32)
+        ns_nlo = np.full((cfg.max_ns_terms, cfg.max_ns_num), -np.inf,
+                         np.float32)
+        ns_nhi = np.full((cfg.max_ns_terms, cfg.max_ns_num), np.inf,
+                         np.float32)
+        encoder._ns_rows(pod, ns_any, ns_forb, ns_used, ns_ncol,
+                         ns_nlo, ns_nhi, lenient=True, record=False)
         zaff_i, zanti_i = encoder._zone_bits(pod, lenient=True,
                                              record=False)
         gz_full = encoder._gz_counts.copy()
         az_refs = encoder._az_anti_refs.copy()
         taints = encoder._taint_bits[:n_real].copy()
         labels = encoder._label_bits[:n_real].copy()
-        ns_ok = _ns_ok_nodes(labels, ns_any, ns_forb, ns_used)
+        ns_ok = _ns_ok_nodes(labels, ns_any, ns_forb, ns_used,
+                             encoder._node_numeric[:n_real],
+                             ns_ncol, ns_nlo, ns_nhi)
         # Topology spread (hard mode only — soft never blocks): the
         # preemptor's zone-count row and the zone map, so a plan is
         # never made for a node the spread filter would still mask
@@ -181,34 +205,69 @@ def plan_preemption(encoder: Encoder, pod: Pod) -> PreemptionPlan | None:
                           & (node_zone >= 0))
             elig_zones = sorted({int(z) for z in node_zone[elig_nodes]})
         # Victim candidates per node: strictly lower priority only.
-        # PDB accounting (annotation-level): per group bit, how many
-        # members are live cluster-wide and the strictest min-available
-        # any member declared.  A groupless pod with pdb_min > 0 is
-        # simply not a candidate (it protects itself).
+        # Disruption accounting is per group bit-SLOT over FULL
+        # membership masks (a pod matching two protected selectors
+        # consumes both budgets, kube semantics).  Two protection
+        # surfaces merge, strictest wins: the annotation
+        # (``netaware.io/pdb-min-available`` on members of a group)
+        # and REAL policy/v1 PodDisruptionBudget objects
+        # (Encoder.set_pdb — selector-group member counting).  A
+        # groupless pod with the annotation is simply not a candidate
+        # (it protects itself).
         victims_by_node: dict[int, list] = {}
-        group_members: dict[int, int] = {}
-        group_min: dict[int, int] = {}
+        members_by_slot: dict[int, int] = {}
+        ann_min_by_slot: dict[int, int] = {}
         for uid, rec in encoder._committed.items():
             if uid in terminating:
                 # Graceful deletion in flight: not live for PDB
                 # accounting, not evictable again (re-deleting a
                 # terminating pod frees nothing).
                 continue
-            if rec.group_bit:
-                group_members[rec.group_bit] = \
-                    group_members.get(rec.group_bit, 0) + 1
-                if rec.pdb_min:
-                    group_min[rec.group_bit] = max(
-                        group_min.get(rec.group_bit, 0), rec.pdb_min)
+            m = rec.member_bits or rec.group_bit
+            while m:
+                b = m & -m
+                m ^= b
+                s = b.bit_length() - 1
+                members_by_slot[s] = members_by_slot.get(s, 0) + 1
+            if rec.pdb_min and rec.group_bit:
+                s = rec.group_bit.bit_length() - 1
+                ann_min_by_slot[s] = max(ann_min_by_slot.get(s, 0),
+                                         rec.pdb_min)
             if rec.priority < prio and rec.node < n_real:
                 if rec.pdb_min and not rec.group_bit:
                     continue  # self-protecting singleton
                 victims_by_node.setdefault(rec.node, []).append((uid, rec))
-        # Disruptions allowed per protected group before min-available
-        # is violated (never negative: an already-underprovisioned
-        # group cannot be disrupted at all).
-        group_budget = {g: max(group_members.get(g, 0) - m, 0)
-                        for g, m in group_min.items()}
+        # Allowed disruptions per protected slot (never negative: an
+        # already-underprovisioned group cannot be disrupted at all).
+        # Percentages resolve against live members — ceil for
+        # minAvailable, floor for maxUnavailable, both conservative.
+        group_budget: dict[int, int] = {}
+
+        def _bound(slot: int, allowed: float) -> None:
+            allowed = max(int(allowed), 0)
+            group_budget[slot] = min(
+                group_budget.get(slot, allowed), allowed)
+
+        for s, mn in ann_min_by_slot.items():
+            _bound(s, members_by_slot.get(s, 0) - mn)
+        for pdb in encoder._pdbs.values():
+            if not pdb.selector_key:
+                continue
+            bit = encoder.groups.bit(pdb.selector_key, lenient=True)
+            if not bit:
+                continue  # interner exhausted: bound untrackable
+            s = bit.bit_length() - 1
+            members = members_by_slot.get(s, 0)
+            if pdb.min_available is not None:
+                _bound(s, members - int(pdb.min_available))
+            if pdb.min_available_pct is not None:
+                _bound(s, members - math.ceil(
+                    members * pdb.min_available_pct / 100.0))
+            if pdb.max_unavailable is not None:
+                _bound(s, int(pdb.max_unavailable))
+            if pdb.max_unavailable_pct is not None:
+                _bound(s, math.floor(
+                    members * pdb.max_unavailable_pct / 100.0))
         node_names = list(encoder._node_names)
 
     tol_w = int_to_words(tol_i, w)
@@ -226,18 +285,27 @@ def plan_preemption(encoder: Encoder, pod: Pod) -> PreemptionPlan | None:
         cands = victims_by_node.get(node, [])
         free = cap[node] - used[node]
 
-        # Per-plan PDB budget: evicting a member of a protected group
-        # consumes one of its allowed disruptions.
+        # Per-plan PDB budget: evicting a pod consumes one allowed
+        # disruption of EVERY protected group it is a member of.
         budget = dict(group_budget)
 
+        def _prot_slots(rec) -> list[int]:
+            m = rec.member_bits or rec.group_bit
+            out = []
+            while m:
+                b = m & -m
+                m ^= b
+                s = b.bit_length() - 1
+                if s in budget:
+                    out.append(s)
+            return out
+
         def takeable(rec) -> bool:
-            g = rec.group_bit
-            return g not in budget or budget[g] > 0
+            return all(budget[s] > 0 for s in _prot_slots(rec))
 
         def take(rec) -> None:
-            g = rec.group_bit
-            if g in budget:
-                budget[g] -= 1
+            for s in _prot_slots(rec):
+                budget[s] -= 1
 
         # Mandatory victims: residents whose group conflicts with the
         # pod's anti-affinity, or who declared anti-affinity against

@@ -78,6 +78,9 @@ class PodStream:
     ns_anyof: jax.Array        # u32[S, T2, E, W]
     ns_forbid: jax.Array       # u32[S, T2, W]
     ns_term_used: jax.Array    # bool[S, T2]
+    ns_num_col: jax.Array      # i32[S, T2, NE]
+    ns_num_lo: jax.Array       # f32[S, T2, NE]
+    ns_num_hi: jax.Array       # f32[S, T2, NE]
     zaff_bits: jax.Array       # u32[S, W]
     zanti_bits: jax.Array      # u32[S, W]
 
@@ -130,6 +133,8 @@ def _make_step(state: ClusterState, cfg: SchedulerConfig, method: str,
             group_idx=sl.group_idx, spread_maxskew=sl.spread_maxskew,
             spread_hard=sl.spread_hard, ns_anyof=sl.ns_anyof,
             ns_forbid=sl.ns_forbid, ns_term_used=sl.ns_term_used,
+            ns_num_col=sl.ns_num_col, ns_num_lo=sl.ns_num_lo,
+            ns_num_hi=sl.ns_num_hi,
             zaff_bits=sl.zaff_bits, zanti_bits=sl.zanti_bits)
         if callable(static):
             # Mesh Pallas path: the per-batch static scores are
@@ -351,6 +356,9 @@ def pad_stream(stream: PodStream, multiple: int) -> PodStream:
         ns_anyof=pd(stream.ns_anyof, 0),
         ns_forbid=pd(stream.ns_forbid, 0),
         ns_term_used=pd(stream.ns_term_used, False),
+        ns_num_col=pd(stream.ns_num_col, -1),
+        ns_num_lo=pd(stream.ns_num_lo, -float("inf")),
+        ns_num_hi=pd(stream.ns_num_hi, float("inf")),
         zaff_bits=pd(stream.zaff_bits, 0),
         zanti_bits=pd(stream.zanti_bits, 0),
     )

@@ -432,6 +432,23 @@ def ns_affinity_ok(state: ClusterState, pods: PodBatch) -> jax.Array:
             == 0, axis=-1)                                 # [P,T2,N]
         term_ok = (jnp.all(expr_ok, axis=2) & clean
                    & pods.ns_term_used[:, :, None])
+        # Numeric Gt/Lt comparisons (AND'd per term, self-gated):
+        # node_numeric[:, col] must land in (lo, hi); NaN values
+        # (label absent/non-numeric) fail every comparison — kube's
+        # fail-closed direction.  col -1 = unused slot, trivially ok.
+        def with_numeric(tok):
+            col = pods.ns_num_col                          # [P,T2,NE]
+            vals = state.node_numeric[:, jnp.clip(col, 0,
+                                                  None)]   # [N,P,T2,NE]
+            vals = jnp.moveaxis(vals, 0, -1)               # [P,T2,NE,N]
+            in_range = ((vals > pods.ns_num_lo[..., None])
+                        & (vals < pods.ns_num_hi[..., None]))
+            num_ok = jnp.all((col[..., None] < 0) | in_range,
+                             axis=2)                       # [P,T2,N]
+            return tok & num_ok
+
+        term_ok = jax.lax.cond(jnp.any(pods.ns_num_col >= 0),
+                               with_numeric, lambda t: t, term_ok)
         no_constraint = ~jnp.any(pods.ns_term_used, axis=1)
         return no_constraint[:, None] | jnp.any(term_ok, axis=1)
 
@@ -474,10 +491,14 @@ def zone_affinity_ok(state: ClusterState, pods: PodBatch,
         zrow = jnp.clip(state.node_zone, 0, zmax - 1)
         pres = zwords[zrow]                                # [N, W]
         azn = az[zrow]                                     # [N, W]
+        # Zone affinity ANDs its terms like the host-scoped mask: the
+        # node's zone must host members of ALL listed groups.  A
+        # zone-less node is an empty domain — any requirement fails.
         zaff_req = pods.zaff_bits[:, None, :]
-        zaff = jnp.all(zaff_req == 0, axis=-1) | (
-            has_zone[None, :]
-            & jnp.any((pres[None, :, :] & zaff_req) != 0, axis=-1))
+        zaff = jnp.where(
+            has_zone[None, :],
+            jnp.all((pres[None, :, :] & zaff_req) == zaff_req, axis=-1),
+            jnp.all(zaff_req == 0, axis=-1))
         zanti = ~has_zone[None, :] | jnp.all(
             (pres[None, :, :] & pods.zanti_bits[:, None, :]) == 0,
             axis=-1)
@@ -521,7 +542,8 @@ def feasibility_mask(state: ClusterState, pods: PodBatch,
     - fit:       req <= cap - used for every resource
     - taints:    node taints ⊆ pod tolerations
     - selector:  required node labels all present
-    - pod affinity:      some required group present on node (if any)
+    - pod affinity:      ALL required groups present on node (terms
+      AND — kube joins multiple required terms conjunctively)
     - pod anti-affinity: no forbidden group present on node, and
       symmetrically no resident pod forbids this pod's group (k8s's
       existing-pod-anti-affinity symmetry)
@@ -531,10 +553,15 @@ def feasibility_mask(state: ClusterState, pods: PodBatch,
     free = state.cap - state.used
     fits = jnp.all(pods.req[:, None, :] <= free[None, :, :] + _EPS, axis=-1)
     # Bit fields are multi-word u32[., W]: subset/overlap tests reduce
-    # over the trailing word axis.
+    # over the trailing word axis.  Required affinity is a SUBSET test
+    # (node hosts members of ALL listed groups): each required term
+    # contributes one group bit and Kubernetes ANDs terms — the
+    # any-overlap join used before round 3 silently weakened multi-term
+    # pods and let UNSAT-degraded terms be absorbed by satisfiable
+    # ones (ADVICE.md round 2).  Empty masks pass trivially.
     aff_req = pods.affinity_bits[:, None, :]
-    affinity = jnp.all(aff_req == 0, axis=-1) | jnp.any(
-        (state.group_bits[None, :, :] & aff_req) != 0, axis=-1)
+    affinity = jnp.all(
+        (state.group_bits[None, :, :] & aff_req) == aff_req, axis=-1)
     anti = jnp.all(
         (state.group_bits[None, :, :] & pods.anti_bits[:, None, :]) == 0,
         axis=-1)

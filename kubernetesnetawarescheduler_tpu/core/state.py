@@ -96,6 +96,11 @@ class ClusterState:
     node_zone: jax.Array
     gz_counts: jax.Array
     az_anti: jax.Array
+    # f32[N, L]: parsed numeric label values per interned numeric KEY
+    # column (cfg.max_numeric_labels; NaN = absent/non-numeric — every
+    # Gt/Lt comparison against NaN is False, kube's fail-closed
+    # direction for nodes missing the label).
+    node_numeric: jax.Array
 
     @property
     def num_nodes(self) -> int:
@@ -125,8 +130,10 @@ class PodBatch:
     - ``tol_bits``       u32[P, W]  tolerated taints (bitmask)
     - ``sel_bits``       u32[P, W]  required node labels (bitmask; node
                                     must have ALL of these)
-    - ``affinity_bits``  u32[P, W]  required co-located pod groups (node
-                                    must host at least one if nonzero)
+    - ``affinity_bits``  u32[P, W]  required co-located pod groups (one
+                                    bit per required term; the node
+                                    must host members of ALL of them —
+                                    terms AND, kube semantics)
     - ``anti_bits``      u32[P, W]  anti-affinity pod groups (node must
                                     host NONE)
     - ``group_bit``      u32[P, W]  the pod's own group bit (0 = none),
@@ -173,6 +180,13 @@ class PodBatch:
     ns_anyof: jax.Array        # u32[P, T2, E, W]
     ns_forbid: jax.Array       # u32[P, T2, W]
     ns_term_used: jax.Array    # bool[P, T2]
+    # Numeric Gt/Lt comparisons per nodeSelectorTerm (``NE =
+    # cfg.max_ns_num``): node_numeric[:, col] must satisfy
+    # ``lo < value < hi`` (Gt v -> lo=v, Lt v -> hi=v; col -1 =
+    # unused slot).
+    ns_num_col: jax.Array      # i32[P, T2, NE]
+    ns_num_lo: jax.Array       # f32[P, T2, NE]
+    ns_num_hi: jax.Array       # f32[P, T2, NE]
     # Zone-scoped (topologyKey: topology.kubernetes.io/zone) hard pod
     # (anti-)affinity, in the same group bit space as
     # ``affinity_bits``/``anti_bits``: the pod requires (some member
@@ -210,6 +224,8 @@ def init_cluster_state(cfg: SchedulerConfig, **overrides: Any) -> ClusterState:
         node_zone=jnp.full((n,), -1, jnp.int32),
         gz_counts=jnp.zeros((32 * w, cfg.max_zones), jnp.int32),
         az_anti=jnp.zeros((cfg.max_zones, w), jnp.uint32),
+        node_numeric=jnp.full((n, cfg.max_numeric_labels), jnp.nan,
+                              jnp.float32),
     )
     fields.update(overrides)
     return ClusterState(**fields)
@@ -243,6 +259,12 @@ def init_pod_batch(cfg: SchedulerConfig, **overrides: Any) -> PodBatch:
                            jnp.uint32),
         ns_forbid=jnp.zeros((p, cfg.max_ns_terms, w), jnp.uint32),
         ns_term_used=jnp.zeros((p, cfg.max_ns_terms), jnp.bool_),
+        ns_num_col=jnp.full((p, cfg.max_ns_terms, cfg.max_ns_num), -1,
+                            jnp.int32),
+        ns_num_lo=jnp.full((p, cfg.max_ns_terms, cfg.max_ns_num),
+                           -jnp.inf, jnp.float32),
+        ns_num_hi=jnp.full((p, cfg.max_ns_terms, cfg.max_ns_num),
+                           jnp.inf, jnp.float32),
         zaff_bits=jnp.zeros((p, w), jnp.uint32),
         zanti_bits=jnp.zeros((p, w), jnp.uint32),
     )
@@ -326,25 +348,33 @@ def commit_assignments(state: ClusterState, pods: PodBatch,
         resident_anti=state.resident_anti | scatter_or_onehot(
             onehot, pods.anti_bits),
         gz_counts=add_zone_counts(state.gz_counts, state.node_zone,
-                                  pods.group_idx, assignment, placed),
+                                  pods.group_bit, assignment, placed),
         az_anti=state.az_anti | scatter_or_onehot(zhot,
                                                   pods.zanti_bits))
 
 
 def add_zone_counts(gz_counts: jax.Array, node_zone: jax.Array,
-                    group_idx: jax.Array, assignment: jax.Array,
+                    group_bit: jax.Array, assignment: jax.Array,
                     placed: jax.Array) -> jax.Array:
-    """Scatter-add placed pods into the per-(group, zone) count matrix
-    (the topologySpreadConstraints resident state).  Pods without a
-    group slot or landing on a zone-less node scatter out of range and
-    drop."""
-    g = gz_counts.shape[0]
+    """Add placed pods' FULL membership masks (``u32[P, W]``) into the
+    per-(group-slot, zone) count matrix (the resident state behind
+    topologySpreadConstraints and zone-scoped affinity).  Counting
+    every membership bit — not just a single own-group slot — keeps
+    the device replay consistent with the host ledger, where
+    label-driven selector-group memberships are multi-bit.  Pods on
+    zone-less nodes contribute nothing.  Same partitionable one-hot
+    matmul shape as :func:`scatter_or_onehot` (pod-axis contraction →
+    psum under GSPMD)."""
     z = gz_counts.shape[1]
     zone = node_zone[jnp.clip(assignment, 0, node_zone.shape[0] - 1)]
-    gi = jnp.where(placed & (group_idx >= 0) & (zone >= 0),
-                   group_idx, g)  # g/z out of range -> dropped
-    zi = jnp.where(zone >= 0, zone, z)
-    return gz_counts.at[gi, zi].add(1, mode="drop")
+    ok = placed & (zone >= 0)
+    zhot = ok[:, None] & (jnp.clip(zone, 0, z - 1)[:, None]
+                          == jnp.arange(z)[None, :])      # [P, Z]
+    counts = jax.lax.dot_general(
+        zhot.astype(jnp.bfloat16), bit_planes(group_bit),
+        (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)               # [Z, G]
+    return gz_counts + counts.T.astype(jnp.int32)
 
 
 def round_up(x: int, mult: int) -> int:

@@ -55,6 +55,19 @@ class ClusterClient:
         :meth:`list_nodes` periodically for events missed while
         down."""
 
+    def on_pdb_changed(self, handler) -> None:
+        """Register for PodDisruptionBudget changes:
+        ``handler(pdb, deleted: bool)``.  Optional — the default is no
+        signal (clients without policy/v1 access simply never feed the
+        planner real PDB objects; the annotation surface still
+        works)."""
+
+    def list_pdbs(self):
+        """All policy/v1 PodDisruptionBudgets, or ``None`` when the
+        client cannot provide them (initial sync for restarts — watch
+        events missed while down)."""
+        return None
+
     def bind(self, binding: Binding) -> None:
         raise NotImplementedError
 
@@ -142,6 +155,8 @@ class FakeCluster(ClusterClient):
         self._node_handlers: list[NodeHandler] = []
         self._deleted_handlers: list[PodHandler] = []
         self._node_deleted_handlers: list[NodeHandler] = []
+        self._pdbs: dict[str, object] = {}
+        self._pdb_handlers: list = []
 
     # -- population ---------------------------------------------------
 
@@ -176,6 +191,31 @@ class FakeCluster(ClusterClient):
         if pod.node_name:
             for h in handlers:
                 h(pod)
+
+    def add_pdb(self, pdb) -> None:
+        """Upsert a PodDisruptionBudget (keyed by uid or name); fans
+        out to on_pdb_changed handlers like a watch ADDED/MODIFIED."""
+        with self._lock:
+            self._pdbs[pdb.uid or pdb.name] = pdb
+            handlers = list(self._pdb_handlers)
+        for h in handlers:
+            h(pdb, False)
+
+    def remove_pdb(self, uid: str) -> None:
+        with self._lock:
+            pdb = self._pdbs.pop(uid, None)
+            handlers = list(self._pdb_handlers)
+        if pdb is not None:
+            for h in handlers:
+                h(pdb, True)
+
+    def on_pdb_changed(self, handler) -> None:
+        with self._lock:
+            self._pdb_handlers.append(handler)
+
+    def list_pdbs(self):
+        with self._lock:
+            return list(self._pdbs.values())
 
     def delete_node(self, name: str) -> None:
         """Remove a node (scale-down); fans out to on_node_deleted

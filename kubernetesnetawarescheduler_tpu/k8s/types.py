@@ -63,9 +63,22 @@ class Pod:
     peers: Mapping[str, float] = dataclasses.field(default_factory=dict)
     tolerations: frozenset[str] = frozenset()
     node_selector: frozenset[str] = frozenset()
+    # The pod's own labels (``k=v`` strings) — the basis of
+    # LABEL-driven group membership: a pod is a member of every
+    # registered selector-group its labels satisfy (kube semantics),
+    # in addition to its explicit ``group`` annotation below.
+    labels: frozenset[str] = frozenset()
     group: str = ""
     affinity_groups: frozenset[str] = frozenset()
     anti_groups: frozenset[str] = frozenset()
+    # Selector definitions for group keys referenced by this pod's
+    # (anti-)affinity/spread terms: canonical group key -> selector
+    # structure ``(matchLabels sorted ((k, v), ...), matchExpressions
+    # sorted ((op, key, values), ...))``.  The encoder registers these
+    # so OTHER pods' labels can be evaluated for membership — the
+    # labelSelector-parity path (no annotation opt-in required).
+    selector_defs: Mapping[str, tuple] = dataclasses.field(
+        default_factory=dict)
     # Zone-scoped (topologyKey: topology.kubernetes.io/zone) required
     # pod (anti-)affinity: the pod must land in a zone hosting a
     # member of some ``zone_affinity_groups`` group / hosting no
@@ -93,21 +106,26 @@ class Pod:
     #   podAffinity with topologyKey topology.kubernetes.io/zone);
     #   negative weight = preferred zone-level spreading.
     soft_zone_affinity: tuple = ()
-    # Zone-level topologySpreadConstraints (the counted pod set is the
-    # pod's own ``group``): ``spread_maxskew`` 0 disables;
-    # ``spread_hard`` True = whenUnsatisfiable: DoNotSchedule (mask),
-    # False = ScheduleAnyway (score penalty per unit of excess skew).
+    # Zone-level topologySpreadConstraints: ``spread_maxskew`` 0
+    # disables; ``spread_hard`` True = whenUnsatisfiable: DoNotSchedule
+    # (mask), False = ScheduleAnyway (score penalty per unit of excess
+    # skew).  ``spread_group`` names the COUNTED pod set (the
+    # constraint's labelSelector reduced to a group key, with its
+    # definition in ``selector_defs``); empty = the pod's own
+    # ``group``.
     spread_maxskew: int = 0
     spread_hard: bool = True
+    spread_group: str = ""
     # Hard ``requiredDuringSchedulingIgnoredDuringExecution``
     # nodeAffinity (the matchExpressions form the reference's probe
     # Deployment used only in its *preferred* stanza,
     # netperfScript/deployment.yaml:17-26): a tuple of
     # nodeSelectorTerms, OR'd; each term a tuple of expressions,
     # AND'd; each expression ``(op, key, values)`` with op one of
-    # "In" / "NotIn" / "Exists" / "DoesNotExist" (Gt/Lt are not
-    # supported and are rejected at parse time).  ``node_selector``
-    # (the map form) ANDs with this, matching Kubernetes.
+    # "In" / "NotIn" / "Exists" / "DoesNotExist" / "Gt" / "Lt"
+    # (numeric operators compare the node label's parsed value via
+    # the encoder's numeric label table).  ``node_selector`` (the map
+    # form) ANDs with this, matching Kubernetes.
     required_node_affinity: tuple = ()
     priority: float = 0.0
     # Count of hard constraints lost/narrowed at PARSE time (e.g. a
@@ -117,11 +135,42 @@ class Pod:
     # ConstraintDegraded event stream as interner-overflow drops, so
     # parse-time degradation is operator-visible too.
     parse_degraded: int = 0
+    # Human-readable descriptions of the parse-time drops above —
+    # surfaced verbatim in the ConstraintDegraded event so operators
+    # see WHICH term stopped being enforced (an anti-affinity term
+    # dropped OPEN is otherwise invisible until a co-location
+    # violation bites).
+    parse_degraded_detail: tuple = ()
     # Annotation-level PodDisruptionBudget: at least this many members
     # of the pod's ``group`` must stay up — preemption may not disrupt
     # below it.  With no group, a nonzero value protects the pod
     # itself from preemption outright.
     pdb_min_available: int = 0
+
+
+@dataclasses.dataclass(frozen=True, slots=True)
+class PodDisruptionBudget:
+    """A ``policy/v1`` PodDisruptionBudget, reduced to what the
+    preemption planner consumes: the selector (canonicalized to a
+    selector-group, so member counting rides the same label-driven
+    machinery as affinity) and the disruption bound.
+
+    Exactly one of the four bound fields is normally set (kube rejects
+    specs with both minAvailable and maxUnavailable); percentages are
+    resolved against the LIVE member count at planning time (kube
+    resolves against the controller's expected scale — a documented
+    delta; ceil for minAvailable, floor for maxUnavailable, both the
+    conservative direction)."""
+
+    name: str
+    namespace: str = "default"
+    uid: str = ""
+    selector_key: str = ""     # canonical group key of the selector
+    selector_def: tuple = ((), ())
+    min_available: int | None = None
+    min_available_pct: float | None = None
+    max_unavailable: int | None = None
+    max_unavailable_pct: float | None = None
 
 
 @dataclasses.dataclass(frozen=True, slots=True)
@@ -173,5 +222,6 @@ def failed_event(pod: Pod, component: str, why: str) -> Event:
     )
 
 
-__all__: Sequence[str] = ("Node", "Pod", "Binding", "Event",
+__all__: Sequence[str] = ("Node", "Pod", "PodDisruptionBudget",
+                          "Binding", "Event",
                           "scheduled_event", "failed_event")

@@ -68,6 +68,7 @@ def state_sharding(mesh: Mesh) -> ClusterState:
         # round reads arbitrary rows of it).
         gz_counts=s(None, None),
         az_anti=s(None, None),  # [Z, W], same reasoning
+        node_numeric=s("tp", None),
     )
 
 
@@ -98,6 +99,9 @@ def pods_sharding(mesh: Mesh) -> PodBatch:
         ns_anyof=s("dp", None, None, None),
         ns_forbid=s("dp", None, None),
         ns_term_used=s("dp", None),
+        ns_num_col=s("dp", None, None),
+        ns_num_lo=s("dp", None, None),
+        ns_num_hi=s("dp", None, None),
         zaff_bits=s("dp", None),
         zanti_bits=s("dp", None),
     )

@@ -134,7 +134,7 @@ def oracle_zone_ok(state, pods, gz=None, az=None):
                 continue
             pres = pres_by_zone[z]
             azb = as_int(az[z]) if az is not None else 0
-            if zaff and not (pres & zaff):
+            if (pres & zaff) != zaff:  # zone must host ALL listed groups
                 ok[i, j] = False
             if pres & zanti:
                 ok[i, j] = False
@@ -166,8 +166,10 @@ def oracle_feasible(state, pods, used=None, group_bits=None,
                    & ~as_int(pods["tol_bits"][i])) == 0
             sel = (as_int(state["label_bits"][j]) & as_int(pods["sel_bits"][i])) \
                 == as_int(pods["sel_bits"][i])
-            aff = (as_int(pods["affinity_bits"][i]) == 0
-                   or (as_int(group_bits[j]) & as_int(pods["affinity_bits"][i])) != 0)
+            # Required affinity: node must host members of ALL listed
+            # groups (terms AND, kube semantics) — a subset test.
+            aff_bits = as_int(pods["affinity_bits"][i])
+            aff = (as_int(group_bits[j]) & aff_bits) == aff_bits
             anti = (as_int(group_bits[j]) & as_int(pods["anti_bits"][i])) == 0
             sym = (as_int(resident_anti[j]) & as_int(pods["group_bit"][i])) == 0
             ok[i, j] = fits and tol and sel and aff and anti and sym
@@ -198,6 +200,17 @@ def oracle_ns_ok(state, pods):
                     a = as_int(pods["ns_anyof"][i, t, e])
                     if a and (lab & a) == 0:
                         good = False
+                # Numeric Gt/Lt comparisons (NaN fails, kube's
+                # direction for nodes missing the label).
+                if "ns_num_col" in pods:
+                    for k in range(pods["ns_num_col"].shape[2]):
+                        col = int(pods["ns_num_col"][i, t, k])
+                        if col < 0:
+                            continue
+                        val = float(state["node_numeric"][j, col])
+                        if not (pods["ns_num_lo"][i, t, k] < val
+                                < pods["ns_num_hi"][i, t, k]):
+                            good = False
                 if good:
                     any_term = True
             ok[i, j] = any_term
@@ -345,9 +358,15 @@ def oracle_assign_greedy(state, pods, cfg: SchedulerConfig):
         used[j] += pods["req"][i]
         group[j] |= pods["group_bit"][i]
         res_anti[j] |= pods["anti_bits"][i]
-        gi, z = int(pods["group_idx"][i]), int(state["node_zone"][j])
-        if gi >= 0 and z >= 0:
-            gz[gi, z] += 1
+        z = int(state["node_zone"][j])
+        if z >= 0:
+            # Every membership bit counts into the zone (multi-bit
+            # selector-group memberships, mirroring the host ledger).
+            gb = as_int(pods["group_bit"][i])
+            while gb:
+                b = gb & -gb
+                gb ^= b
+                gz[b.bit_length() - 1, z] += 1
         if z >= 0 and "zanti_bits" in pods:
             zb = as_int(pods["zanti_bits"][i])
             for word in range(w):

@@ -66,10 +66,16 @@ def check_assignment_safety(state_np, pods_np, assignment, cfg):
             used[j] += pods_np["req"][i]
             group[j] |= pods_np["group_bit"][i]
             res_anti[j] |= pods_np["anti_bits"][i]
-            gi = int(pods_np["group_idx"][i])
             z = int(state_np["node_zone"][j])
-            if gi >= 0 and z >= 0:
-                gz[gi, z] += 1
+            if z >= 0:
+                # Every membership bit counts into the zone (the
+                # device commit mirrors the host ledger's multi-bit
+                # selector-group memberships).
+                gb = oracle.as_int(pods_np["group_bit"][i])
+                while gb:
+                    b = gb & -gb
+                    gb ^= b
+                    gz[b.bit_length() - 1, z] += 1
             if z >= 0:
                 zb = oracle.as_int(pods_np["zanti_bits"][i])
                 for word in range(w):

@@ -106,8 +106,17 @@ def _check_pod(pod: Pod, node: Node, co_resident: list[Pod],
                    for term in pod.required_node_affinity):
             out.append("required_node_affinity")
     others = {q.group for q in co_resident if q is not pod and q.group}
-    if pod.affinity_groups and not (set(pod.affinity_groups) & others):
-        out.append("affinity")
+    # Terms AND (kube): every required group must have a co-resident
+    # member — except kube's first-pod waiver for a SELF-member group
+    # with no member anywhere; those are surfaced as orphans for the
+    # caller to bound (at most one waived pod per group per run).
+    for g in pod.affinity_groups:
+        if g in others:
+            continue
+        if g == pod.group:
+            out.append(("orphan", g))
+        else:
+            out.append("affinity")
     if set(pod.anti_groups) & others:
         out.append("anti")
     for q in co_resident:
@@ -115,9 +124,13 @@ def _check_pod(pod: Pod, node: Node, co_resident: list[Pod],
             out.append(f"symmetric anti vs {q.name}")
     zone_others = {q.group for q in zone_mates if q is not pod
                    and q.group}
-    if pod.zone_affinity_groups and not (
-            set(pod.zone_affinity_groups) & zone_others):
-        out.append("zone_affinity")
+    for g in pod.zone_affinity_groups:
+        if g in zone_others:
+            continue
+        if g == pod.group:
+            out.append(("zone_orphan", g))
+        else:
+            out.append("zone_affinity")
     if set(pod.zone_anti_groups) & zone_others:
         out.append("zone_anti")
     for q in zone_mates:
@@ -157,12 +170,23 @@ def test_random_pods_through_encoder_respect_object_semantics(seed):
     # (members don't terminate here) — same reasoning as the suite
     # audit.
     violations = []
+    orphans: dict[tuple, list[str]] = {}
     for p, node_name in placed:
         v = _check_pod(p, nodes[node_name], by_node[node_name],
                        by_zone.get(zone_of[node_name], []))
-        if v:
-            violations.append((p.name, node_name, v))
+        hard = [x for x in v if not (isinstance(x, tuple)
+                                     and x[0] in ("orphan",
+                                                  "zone_orphan"))]
+        if hard:
+            violations.append((p.name, node_name, hard))
+        for x in v:
+            if isinstance(x, tuple) and x[0] in ("orphan", "zone_orphan"):
+                orphans.setdefault(x, []).append(p.name)
     assert not violations, violations
+    # The first-pod waiver admits at most ONE memberless self-affine
+    # pod per (group, scope): a second would mean the waiver leaked.
+    for key, names in orphans.items():
+        assert len(names) == 1, (key, names)
 
     # Capacity per node.
     for node_name, members in by_node.items():
@@ -235,7 +259,7 @@ def test_degradation_replays_for_every_cache_hit_pod():
             for i in range(4)]
     enc.encode_pods(pods, node_of=lambda s: "", lenient=True)
     recs = enc.pop_degraded()
-    assert {(ns, name) for ns, name, _ in recs} == {
+    assert {(ns, name) for ns, name, _, _ in recs} == {
         ("default", f"deg-{i}") for i in range(4)}
     # All carry the same (shape-level) dropped-term count.
     assert len({c for *_ , c in recs}) == 1 and recs[0][2] >= 1

@@ -122,7 +122,8 @@ def test_term_overflow_degrades_closed_and_records():
                   (("In", "disk", ("ssd",)),),
                   (("In", "arch", ("arm",)),)))
     assert enc.node_name(_place(enc, pod)) == "a"
-    assert ("default", "p", 1) in enc.pop_degraded()
+    assert any(r[:3] == ("default", "p", 1)
+               for r in enc.pop_degraded())
     # Strict mode refuses instead of silently narrowing.
     with pytest.raises(ValueError):
         enc.encode_pods([pod], node_of=lambda s: "", lenient=False)
@@ -146,12 +147,93 @@ def test_unsupported_operator_degrades_closed():
     enc = _cluster(CFG, {"a": {"cpus=8"}, "b": {"arch=arm"}})
     pod = Pod(name="p", requests={"cpu": 1.0},
               required_node_affinity=(
-                  (("Gt", "cpus", ("4",)),),
+                  (("Frobnicate", "cpus", ("4",)),),
                   (("In", "arch", ("arm",)),)))
-    # The Gt term cannot be represented -> that OR branch is
+    # An unknown operator cannot be represented -> that OR branch is
     # unsatisfiable, the other still works.
     assert enc.node_name(_place(enc, pod)) == "b"
     assert enc.pop_degraded()
+
+
+def test_gt_lt_numeric_operators():
+    """Gt/Lt compare the node's parsed numeric label value against
+    the bound (round-3: the numeric label table replaces the old
+    degrade-to-unsatisfiable path; VERDICT.md round 2, missing #5)."""
+    enc = _cluster(CFG, {"a": {"cpus=8"}, "b": {"cpus=2"},
+                         "c": {"arch=arm"}})
+    pod = Pod(name="p", requests={"cpu": 1.0},
+              required_node_affinity=((("Gt", "cpus", ("4",)),),))
+    assert enc.node_name(_place(enc, pod)) == "a"
+    assert not enc.pop_degraded()
+    pod_lt = Pod(name="q", requests={"cpu": 1.0},
+                 required_node_affinity=((("Lt", "cpus", ("4",)),),))
+    assert enc.node_name(_place(enc, pod_lt)) == "b"
+    # A node without the label (c) fails BOTH directions (NaN —
+    # kube's fail-closed rule for missing labels).
+    pod_any = Pod(name="r", requests={"cpu": 1.0},
+                  required_node_affinity=(
+                      (("Gt", "cpus", ("0",)),),))
+    got = enc.node_name(_place(enc, pod_any))
+    assert got in ("a", "b")
+
+
+def test_gt_lt_interval_and_registration_order():
+    """Gt+Lt on one key merge into an interval; a node registered
+    AFTER the key was interned still gets its value parsed
+    (_set_node_labels refresh)."""
+    enc = _cluster(CFG, {"a": {"cpus=8"}, "b": {"cpus=2"}})
+    pod = Pod(name="p", requests={"cpu": 1.0},
+              required_node_affinity=(
+                  (("Gt", "cpus", ("1",)), ("Lt", "cpus", ("4",))),))
+    assert enc.node_name(_place(enc, pod)) == "b"
+    # New node arrives after the numeric key exists: value backfills.
+    from kubernetesnetawarescheduler_tpu.k8s.types import Node
+    enc.upsert_node(Node(name="d", capacity={"cpu": 8.0, "mem": 16.0},
+                         labels=frozenset({"cpus=3"})))
+    pod2 = Pod(name="q", requests={"cpu": 1.0},
+               required_node_affinity=(
+                   (("Gt", "cpus", ("2.5",)), ("Lt", "cpus", ("4",))),))
+    assert enc.node_name(_place(enc, pod2)) == "d"
+
+
+def test_gt_lt_matches_oracle():
+    """Kernel vs NumPy oracle on a batch with numeric terms."""
+    import jax.numpy as jnp
+
+    from kubernetesnetawarescheduler_tpu.core import score as score_lib
+    from tests import gen, oracle
+
+    rng = np.random.default_rng(0)
+    cfg = SchedulerConfig(max_nodes=16, max_pods=8, max_peers=2,
+                          use_bfloat16=False)
+    state_np, pods_np = gen.random_instance(rng, cfg, n_nodes=12,
+                                            n_pods=6)
+    # Attach a numeric table and per-pod Gt/Lt terms.
+    state_np["node_numeric"] = np.full(
+        (cfg.max_nodes, cfg.max_numeric_labels), np.nan, np.float32)
+    state_np["node_numeric"][:12, 0] = rng.uniform(0, 10, 12)
+    state_np["node_numeric"][3, 0] = np.nan  # label-less node
+    pods_np["ns_num_col"] = np.full(
+        (cfg.max_pods, cfg.max_ns_terms, cfg.max_ns_num), -1, np.int32)
+    pods_np["ns_num_lo"] = np.full(
+        (cfg.max_pods, cfg.max_ns_terms, cfg.max_ns_num), -np.inf,
+        np.float32)
+    pods_np["ns_num_hi"] = np.full(
+        (cfg.max_pods, cfg.max_ns_terms, cfg.max_ns_num), np.inf,
+        np.float32)
+    for i in range(6):
+        if rng.random() < 0.7:
+            t = int(rng.integers(0, cfg.max_ns_terms))
+            pods_np["ns_term_used"][i, t] = True
+            pods_np["ns_num_col"][i, t, 0] = 0
+            if rng.random() < 0.5:
+                pods_np["ns_num_lo"][i, t, 0] = rng.uniform(0, 10)
+            else:
+                pods_np["ns_num_hi"][i, t, 0] = rng.uniform(0, 10)
+    state, pods = gen.to_pytrees(cfg, state_np, pods_np)
+    got = np.asarray(score_lib.ns_affinity_ok(state, pods))
+    want = oracle.oracle_ns_ok(state_np, pods_np)
+    np.testing.assert_array_equal(got, want)
 
 
 def test_kubeclient_parses_required_stanza():
@@ -180,7 +262,7 @@ def test_kubeclient_parses_required_stanza():
     assert pod.required_node_affinity == (
         (("In", "disk", ("ssd", "nvme")), ("NotIn", "tier", ("spot",))),
         (("Exists", "gpu", ()),),
-        (("In", "", ()),),  # Gt: unrepresentable -> unsatisfiable term
+        (("Gt", "cpus", ("4",)),),  # numeric operators are first-class
     )
 
 

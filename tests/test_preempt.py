@@ -296,3 +296,132 @@ def test_overlapping_preemption_respects_pdb_and_reservations():
                              Pod(name="vipB", requests={"cpu": 2.0},
                                  priority=9.0))
     assert plan_b is None
+
+
+# -- real policy/v1 PodDisruptionBudget objects -----------------------
+
+
+def _pdb(name="pdb", min_available=None, min_pct=None,
+         max_unavailable=None, max_pct=None,
+         match_labels=(("app", "db"),)):
+    from kubernetesnetawarescheduler_tpu.k8s.types import (
+        PodDisruptionBudget,
+    )
+
+    key = ",".join(f"{k}={v}" for k, v in sorted(match_labels))
+    return PodDisruptionBudget(
+        name=name, uid=name, selector_key=key,
+        selector_def=(tuple(sorted(match_labels)), ()),
+        min_available=min_available, min_available_pct=min_pct,
+        max_unavailable=max_unavailable, max_unavailable_pct=max_pct)
+
+
+def _fill_labeled(cluster, loop, n, cpu=2.0, priority=1.0):
+    pods = [Pod(name=f"db-{i}", requests={"cpu": cpu},
+                priority=priority,
+                labels=frozenset({"app=db"})) for i in range(n)]
+    cluster.add_pods(pods)
+    assert loop.run_until_drained() == len(pods)
+    return pods
+
+
+def test_real_pdb_blocks_eviction():
+    """A policy/v1 PDB (selector app=db, minAvailable=2) — NOT the
+    annotation — must stop the planner from disrupting the selected
+    pods below the bound (VERDICT.md round 2, missing #4)."""
+    cluster, loop = make(num_nodes=1)
+    cluster.add_pdb(_pdb(min_available=2))  # watch-style delivery
+    _fill_labeled(cluster, loop, 2)  # node full: 2 members, none spare
+    plan = plan_preemption(loop.encoder,
+                           Pod(name="big", requests={"cpu": 3.0},
+                               priority=5.0))
+    assert plan is None
+
+
+def test_real_pdb_allows_disruption_within_budget():
+    cluster, loop = make(num_nodes=1)
+    cluster.add_pdb(_pdb(min_available=1))  # one disruption allowed
+    _fill_labeled(cluster, loop, 2)
+    plan = plan_preemption(loop.encoder,
+                           Pod(name="mid", requests={"cpu": 2.0},
+                               priority=5.0))
+    assert plan is not None
+    assert len(plan.victims) == 1
+
+
+def test_real_pdb_percentage_bounds():
+    """minAvailable '50%' over 2 live members = 1 must stay: one
+    disruption allowed (ceil semantics)."""
+    cluster, loop = make(num_nodes=1)
+    cluster.add_pdb(_pdb(min_pct=50.0))
+    _fill_labeled(cluster, loop, 2)
+    plan = plan_preemption(loop.encoder,
+                           Pod(name="mid", requests={"cpu": 2.0},
+                               priority=5.0))
+    assert plan is not None
+    assert len(plan.victims) == 1
+    # But a 3-cpu pod needing BOTH victims: blocked.
+    plan2 = plan_preemption(loop.encoder,
+                            Pod(name="big", requests={"cpu": 3.0},
+                                priority=5.0))
+    assert plan2 is None
+
+
+def test_real_pdb_max_unavailable_zero_is_frozen():
+    cluster, loop = make(num_nodes=1)
+    cluster.add_pdb(_pdb(max_unavailable=0))
+    _fill_labeled(cluster, loop, 2)
+    plan = plan_preemption(loop.encoder,
+                           Pod(name="mid", requests={"cpu": 2.0},
+                               priority=5.0))
+    assert plan is None
+
+
+def test_real_pdb_deletion_lifts_protection():
+    cluster, loop = make(num_nodes=1)
+    cluster.add_pdb(_pdb(min_available=2))
+    _fill_labeled(cluster, loop, 2)
+    assert plan_preemption(loop.encoder,
+                           Pod(name="mid", requests={"cpu": 2.0},
+                               priority=5.0)) is None
+    cluster.remove_pdb("pdb")
+    assert plan_preemption(loop.encoder,
+                           Pod(name="mid", requests={"cpu": 2.0},
+                               priority=5.0)) is not None
+
+
+def test_real_pdb_registered_before_members():
+    """PDB arrives BEFORE its members: the selector-group claims them
+    as they commit (no retroactive path needed) — protection holds."""
+    cluster, loop = make(num_nodes=1)
+    cluster.add_pdb(_pdb(min_available=2))
+    _fill_labeled(cluster, loop, 2)
+    assert plan_preemption(loop.encoder,
+                           Pod(name="big", requests={"cpu": 3.0},
+                               priority=5.0)) is None
+
+
+def test_pdb_from_json_parses_bounds():
+    from kubernetesnetawarescheduler_tpu.k8s.kubeclient import (
+        pdb_from_json,
+    )
+
+    obj = {"metadata": {"name": "db-pdb", "uid": "u1"},
+           "spec": {"selector": {"matchLabels": {"app": "db"}},
+                    "minAvailable": "60%"}}
+    pdb = pdb_from_json(obj)
+    assert pdb.selector_key == "app=db"
+    assert pdb.min_available is None
+    assert pdb.min_available_pct == 60.0
+    obj2 = {"metadata": {"name": "x"},
+            "spec": {"selector": {"matchExpressions": [
+                         {"key": "tier", "operator": "Exists"}]},
+                     "maxUnavailable": 1}}
+    pdb2 = pdb_from_json(obj2)
+    assert pdb2.selector_key.startswith("sel:")
+    assert pdb2.max_unavailable == 1
+    # Malformed selector: unenforceable -> None.
+    assert pdb_from_json({"metadata": {"name": "bad"},
+                          "spec": {"selector": {"matchExpressions": [
+                              {"key": "a", "operator": "Gt",
+                               "values": ["1"]}]}}}) is None

@@ -115,6 +115,12 @@ def test_sharded_replay_matches_single_device():
         ns_forbid=jnp.zeros((s, CFG.max_ns_terms, CFG.mask_words),
                             jnp.uint32),
         ns_term_used=jnp.zeros((s, CFG.max_ns_terms), jnp.bool_),
+        ns_num_col=jnp.full((s, CFG.max_ns_terms, CFG.max_ns_num), -1,
+                            jnp.int32),
+        ns_num_lo=jnp.full((s, CFG.max_ns_terms, CFG.max_ns_num),
+                           -jnp.inf, jnp.float32),
+        ns_num_hi=jnp.full((s, CFG.max_ns_terms, CFG.max_ns_num),
+                           jnp.inf, jnp.float32),
         zaff_bits=jnp.zeros((s, CFG.mask_words), jnp.uint32),
         zanti_bits=jnp.zeros((s, CFG.mask_words), jnp.uint32),
     )
@@ -194,6 +200,12 @@ def test_sharded_replay_never_gathers_full_nxn():
                            jnp.uint32),
         ns_forbid=jnp.zeros((s, cfg.max_ns_terms, w), jnp.uint32),
         ns_term_used=jnp.zeros((s, cfg.max_ns_terms), jnp.bool_),
+        ns_num_col=jnp.full((s, cfg.max_ns_terms, cfg.max_ns_num), -1,
+                            jnp.int32),
+        ns_num_lo=jnp.full((s, cfg.max_ns_terms, cfg.max_ns_num),
+                           -jnp.inf, jnp.float32),
+        ns_num_hi=jnp.full((s, cfg.max_ns_terms, cfg.max_ns_num),
+                           jnp.inf, jnp.float32),
         zaff_bits=jnp.zeros((s, w), jnp.uint32),
         zanti_bits=jnp.zeros((s, w), jnp.uint32),
     ), cfg.max_pods)
@@ -291,6 +303,12 @@ def test_sharded_pallas_replay_matches_dense():
                            jnp.uint32),
         ns_forbid=jnp.zeros((s, cfg.max_ns_terms, w), jnp.uint32),
         ns_term_used=jnp.zeros((s, cfg.max_ns_terms), jnp.bool_),
+        ns_num_col=jnp.full((s, cfg.max_ns_terms, cfg.max_ns_num), -1,
+                            jnp.int32),
+        ns_num_lo=jnp.full((s, cfg.max_ns_terms, cfg.max_ns_num),
+                           -jnp.inf, jnp.float32),
+        ns_num_hi=jnp.full((s, cfg.max_ns_terms, cfg.max_ns_num),
+                           jnp.inf, jnp.float32),
         zaff_bits=jnp.zeros((s, w), jnp.uint32),
         zanti_bits=jnp.zeros((s, w), jnp.uint32)),
         cfg.max_pods)

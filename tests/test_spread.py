@@ -208,11 +208,16 @@ def test_parallel_round_never_overshoots_hard_skew():
         cfg, node_valid=jnp.ones((3,), bool),
         cap=jnp.ones((3, 3)),
         node_zone=jnp.asarray([0, 0, 1], jnp.int32))
+    gb = np.zeros((2, cfg.mask_words), np.uint32)
+    gb[:, 0] = np.uint32(1 << 5)  # members of slot-5's group: they
+    # count toward their own constraint (label-parity counting tracks
+    # membership, not the bare group_idx)
     pods = init_pod_batch(
         cfg,
         req=jnp.asarray([[0.9, 0.05, 0.05], [0.05, 0.9, 0.05]],
                         jnp.float32),
         pod_valid=jnp.ones((2,), bool),
+        group_bit=jnp.asarray(gb),
         group_idx=jnp.asarray([5, 5], jnp.int32),
         spread_maxskew=jnp.asarray([1, 1], jnp.int32),
         spread_hard=jnp.asarray([True, True]))

@@ -193,27 +193,30 @@ def test_preemption_evicts_same_node_zone_conflicter():
 
 
 def test_parse_degradation_surfaces_as_event():
-    """An unrepresentable required anti term drops OPEN but the pod is
-    still flagged in the ConstraintDegraded stream via
-    Pod.parse_degraded."""
+    """An unrepresentable required anti term (unsupported topologyKey
+    — arbitrary selectors are representable since round 3) drops OPEN,
+    the pod is flagged in the ConstraintDegraded stream, and the
+    detail names the dropped term (ADVICE.md round 2, low #3)."""
     obj = {
         "metadata": {"name": "p", "uid": "u"},
         "spec": {
             "containers": [],
             "affinity": {"podAntiAffinity": {
                 "requiredDuringSchedulingIgnoredDuringExecution": [
-                    {"labelSelector": {"matchExpressions": [
-                        {"key": "app", "operator": "In",
-                         "values": ["db", "cache"]}]},  # multi-value
-                     "topologyKey": "kubernetes.io/hostname"}]}},
+                    {"labelSelector": {"matchLabels": {"app": "db"}},
+                     "topologyKey": "topology.kubernetes.io/rack"}]}},
         },
     }
     pod = pod_from_json(obj)
     assert pod.parse_degraded == 1
     assert pod.anti_groups == frozenset()  # dropped open
+    assert any("podAntiAffinity" in d and "OPEN" in d
+               for d in pod.parse_degraded_detail)
     enc = _zoned_cluster()
     enc.encode_pods([pod], node_of=lambda s: "", lenient=True)
-    assert ("default", "p", 1) in enc.pop_degraded()
+    recs = enc.pop_degraded()
+    assert any(r[:3] == ("default", "p", 1) and r[3]
+               for r in recs), recs
 
 
 def test_kubeclient_parses_required_pod_affinity():
@@ -307,14 +310,24 @@ def test_preferred_selector_folds_and_degrades_like_required():
                             "topology.kubernetes.io/zone"}}]}}}}
     pod = pod_from_json(base)
     assert pod.soft_zone_affinity == (("app=db,tier=prod", -50.0),)
-    # Multi-value In: unrepresentable -> the term vanishes (soft),
-    # never a mislabeled group.
+    # Multi-value In: representable since round 3 as a rich
+    # selector-group (label-driven membership), same weight.
     base["spec"]["affinity"]["podAntiAffinity"][
         "preferredDuringSchedulingIgnoredDuringExecution"][0][
         "podAffinityTerm"]["labelSelector"]["matchExpressions"][0][
         "values"] = ["prod", "staging"]
     pod2 = pod_from_json(base)
-    assert pod2.soft_zone_affinity == ()
+    assert len(pod2.soft_zone_affinity) == 1
+    key2, w2 = pod2.soft_zone_affinity[0]
+    assert key2.startswith("sel:") and w2 == -50.0
+    assert key2 in pod2.selector_defs
+    # A MALFORMED selector still vanishes score-neutrally.
+    base["spec"]["affinity"]["podAntiAffinity"][
+        "preferredDuringSchedulingIgnoredDuringExecution"][0][
+        "podAffinityTerm"]["labelSelector"]["matchExpressions"][0][
+        "operator"] = "Gt"
+    pod3 = pod_from_json(base)
+    assert pod3.soft_zone_affinity == ()
 
 
 def test_kubeclient_folds_single_in_expressions():
@@ -337,38 +350,59 @@ def test_kubeclient_folds_single_in_expressions():
     pod = pod_from_json(obj)
     assert pod.zone_affinity_groups == frozenset({"app=db,tier=prod"})
     assert pod.parse_degraded == 0
-    # A key folded to a CONFLICTING value is k8s's never-matches
-    # selector: degrade closed, don't keep the last value.
+    # A key with a CONFLICTING value is k8s's never-matches selector:
+    # since round 3 it stays a faithful rich selector-group that no
+    # pod's labels can satisfy (no member can ever exist) — honest
+    # unsatisfiability without the sentinel.
     obj["spec"]["affinity"]["podAffinity"][
         "requiredDuringSchedulingIgnoredDuringExecution"][0][
         "labelSelector"]["matchExpressions"].append(
         {"key": "app", "operator": "In", "values": ["cache"]})
     pod2 = pod_from_json(obj)
-    from kubernetesnetawarescheduler_tpu.k8s.kubeclient import UNSAT_GROUP
-    assert pod2.zone_affinity_groups == frozenset({UNSAT_GROUP})
-    assert pod2.parse_degraded == 1
-
-
-def test_kubeclient_unrepresentable_affinity_degrades_closed():
-    from kubernetesnetawarescheduler_tpu.k8s.kubeclient import (
-        UNSAT_GROUP,
+    assert pod2.parse_degraded == 0
+    (key2,) = pod2.zone_affinity_groups
+    assert key2.startswith("sel:")
+    from kubernetesnetawarescheduler_tpu.core.encode import (
+        selector_matches,
     )
+    sel = pod2.selector_defs[key2]
+    for labels in (frozenset({"app=db", "tier=prod"}),
+                   frozenset({"app=cache", "tier=prod"}),
+                   frozenset()):
+        assert not selector_matches(sel, labels)
 
+
+def test_kubeclient_negative_selector_affinity_is_representable():
+    """NotIn selectors are first-class since round 3: required
+    affinity to "pods without app=db" places beside any such resident
+    — and the incoming pod (itself app-less, so a self-member) gets
+    the first-pod waiver on an empty cluster instead of the old
+    UNSAT-sentinel deadlock."""
     obj = {
-        "metadata": {"name": "p"},
+        "metadata": {"name": "p", "uid": "p"},
         "spec": {
             "containers": [],
             "affinity": {"podAffinity": {
                 "requiredDuringSchedulingIgnoredDuringExecution": [
                     {"labelSelector": {"matchExpressions": [
                         {"key": "app", "operator": "NotIn",
-                         "values": ["db"]}]},  # negative selector:
-                     # no exact-label reduction exists
+                         "values": ["db"]}]},
                      "topologyKey": "kubernetes.io/hostname"}]}},
         },
     }
     pod = pod_from_json(obj)
-    assert UNSAT_GROUP in pod.affinity_groups
-    # And the sentinel group is never resident: the pod cannot place.
+    (key,) = pod.affinity_groups
+    assert key.startswith("sel:")
+    assert pod.parse_degraded == 0
+    # Empty cluster: the pod's own (empty) labels satisfy NotIn, so
+    # kube's first-pod special case admits it.
     enc = _zoned_cluster()
-    assert _place(enc, pod) == -1
+    assert _place(enc, pod) >= 0
+    # With a matching resident (no app label), the term binds to its
+    # node; a NON-matching resident (app=db) does not satisfy it.
+    enc2 = _zoned_cluster()
+    enc2.commit(Pod(name="m1", uid="m1", requests={"cpu": 1.0},
+                    labels=frozenset({"app=db"})), "a")
+    enc2.commit(Pod(name="m2", uid="m2", requests={"cpu": 1.0},
+                    labels=frozenset({"tier=x"})), "c")
+    assert enc2.node_name(_place(enc2, pod)) == "c"
