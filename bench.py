@@ -58,6 +58,116 @@ def _tpu_reachable_with_retries() -> bool:
     return False
 
 
+_TPU_ART_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "bench_artifacts", "tpu")
+
+
+def _persisted_tpu_density() -> dict | None:
+    """A mid-round hardware run captured by tools/tpu_watch.py.
+
+    The watcher probes the tunnel all round and, in any recovery
+    window, runs the bench legs cheapest-first and persists each
+    result (VERDICT r3 next-round #1).  If the tunnel is wedged again
+    at driver time, that persisted headline — a REAL hardware
+    measurement, schema-identical to this script's output — beats a
+    CPU stand-in.  Provenance fields mark it as a replayed artifact.
+
+    Guards (a stale or mismatched artifact must not masquerade as the
+    current measurement): the artifact must target the same metric
+    (same BENCH_NODES) and be younger than BENCH_TPU_ART_MAX_AGE_S
+    (default 24 h — one round).  The recorded git SHA is surfaced in
+    the provenance so a reviewer can diff artifact-code vs HEAD."""
+    path = os.path.join(_TPU_ART_DIR, "density_full.json")
+    try:
+        with open(path) as f:
+            leg = json.load(f)
+        age_s = __import__("time").time() - os.path.getmtime(path)
+    except (OSError, ValueError):
+        return None
+    if not leg.get("ok"):
+        return None
+    max_age = float(os.environ.get("BENCH_TPU_ART_MAX_AGE_S", "86400"))
+    if age_s > max_age:
+        return None
+    doc = leg.get("detail")  # tpu_legs.density_full stores bench.py's doc
+    if not isinstance(doc, dict) or "metric" not in doc:
+        return None
+    want_nodes = os.environ.get("BENCH_NODES", "5120")
+    if doc["metric"] != f"density_pods_per_sec_n{want_nodes}":
+        return None
+    doc.setdefault("detail", {})
+    doc["detail"]["persisted"] = True
+    doc["detail"]["measured_at"] = leg.get("ts", "")
+    doc["detail"]["measured_git"] = leg.get("git", "")
+    doc["detail"]["artifact_age_s"] = round(age_s)
+    return doc
+
+
+def _mark_driver_active():
+    """Touch driver.intent and take chip.lock so the round-long
+    watcher yields the single-owner chip to this run (it re-checks the
+    flag between legs).  Best-effort: lock acquisition waits at most
+    BENCH_LOCK_WAIT_S for a watcher leg to finish, then proceeds — the
+    startup probe decides what actually happens."""
+    try:
+        os.makedirs(_TPU_ART_DIR, exist_ok=True)
+        with open(os.path.join(_TPU_ART_DIR, "driver.intent"), "w") as f:
+            f.write(str(os.getpid()))
+    except OSError:
+        return None
+    try:
+        import fcntl
+        import time
+
+        lock_f = open(os.path.join(_TPU_ART_DIR, "chip.lock"), "w")
+        deadline = time.time() + float(
+            os.environ.get("BENCH_LOCK_WAIT_S", "900"))
+        while time.time() < deadline:
+            try:
+                fcntl.flock(lock_f, fcntl.LOCK_EX | fcntl.LOCK_NB)
+                return lock_f
+            except OSError:
+                time.sleep(5)
+        print("WARNING: chip.lock still held after wait; proceeding",
+              file=sys.stderr)
+        return lock_f
+    except Exception:  # noqa: BLE001
+        return None
+
+
+def _clear_driver_intent() -> None:
+    try:
+        os.remove(os.path.join(_TPU_ART_DIR, "driver.intent"))
+    except OSError:
+        pass
+
+
+def _probe_log_stats() -> dict:
+    """Proof-of-probing for the round: how many tunnel probes the
+    watcher made and whether any succeeded (VERDICT r3 done-criterion:
+    'a log proving N probe attempts spread across the whole round')."""
+    path = os.path.join(_TPU_ART_DIR, "probe_log.jsonl")
+    total = ok = 0
+    first = last = ""
+    try:
+        with open(path) as f:
+            for line in f:
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                if rec.get("note"):
+                    continue  # watcher start markers
+                total += 1
+                ok += 1 if rec.get("ok") else 0
+                last = rec.get("ts", "")
+                first = first or last
+    except OSError:
+        return {}
+    return {"probe_attempts": total, "probe_successes": ok,
+            "probe_first": first, "probe_last": last}
+
+
 def _run_backend_subprocess(backend: str, force_cpu: bool,
                             timeout_s: float | None = None):
     """Re-invoke this script pinned to one score backend and parse its
@@ -108,6 +218,14 @@ def _run_backend_subprocess(backend: str, force_cpu: bool,
 def main() -> None:
     tpu_ok = True
     force_cpu = os.environ.get("BENCH_FORCE_CPU", "") == "1"
+    if "BENCH_CHILD" not in os.environ and not force_cpu:
+        # Signal the round-long watcher (tools/tpu_watch.py) to yield
+        # the single-owner chip to this run.  Forced-CPU runs never
+        # need the chip, so they must not stall the watcher.
+        _mark_driver_active()
+        import atexit
+
+        atexit.register(_clear_driver_intent)
     if force_cpu:
         # Set for backend-subprocesses of a CPU-fallback parent: the
         # axon sitecustomize overrides JAX_PLATFORMS, so without this
@@ -124,6 +242,19 @@ def main() -> None:
             jax.config.update("jax_num_cpu_devices", int(ndev))
     elif os.environ.get("BENCH_SKIP_TPU_PROBE", "") != "1" \
             and not _tpu_reachable_with_retries():
+        persisted = _persisted_tpu_density()
+        if persisted is not None:
+            # The tunnel is wedged NOW, but the round-long watcher
+            # caught a recovery window and ran the full bench on
+            # hardware; replay that artifact rather than measure a
+            # CPU stand-in.
+            print("WARNING: TPU unreachable now; replaying the "
+                  "persisted mid-round TPU measurement "
+                  f"({persisted['detail'].get('measured_at', '?')})",
+                  file=sys.stderr)
+            persisted["detail"].update(_probe_log_stats())
+            print(json.dumps(persisted))
+            return
         # Degrade to CPU instead of hanging the driver: the JSON line
         # still appears, flagged via detail.backend (reported from
         # jax.default_backend() after the run, so it is always the
@@ -176,6 +307,14 @@ def main() -> None:
         # (e.g. first-ever Mosaic lowering on new hardware) costs one
         # timeout, not the other leg's measurement.
         for backend in backends:
+            if not force_cpu and not _tpu_reachable(timeout_s=60):
+                # Per-LEG probe (VERDICT r3 #1a): the tunnel can wedge
+                # between legs; a cheap re-probe converts that into a
+                # recorded per-leg error instead of a 900 s hang.
+                errors[backend] = "per-leg TPU probe failed"
+                print(f"WARNING: skipping {backend} leg: tunnel "
+                      "unreachable at leg start", file=sys.stderr)
+                continue
             try:
                 results[backend] = _run_backend_subprocess(
                     backend, force_cpu=force_cpu)
@@ -259,6 +398,18 @@ def main() -> None:
         # The driver's only artifact is this script's stdout — a CPU
         # fallback line with the TPU errors attached beats a nonzero
         # exit with nothing.
+        persisted = _persisted_tpu_density()
+        if persisted is not None:
+            # Same preference as the startup-probe fallback: a real
+            # persisted hardware measurement beats a CPU stand-in.
+            print(f"WARNING: all TPU legs failed ({errors}); replaying "
+                  "the persisted mid-round TPU measurement",
+                  file=sys.stderr)
+            persisted["detail"].update(_probe_log_stats())
+            for backend, err in errors.items():
+                persisted["detail"][f"{backend}_error"] = err
+            print(json.dumps(persisted))
+            return
         print(f"WARNING: all TPU legs failed ({errors}); falling back "
               "to CPU", file=sys.stderr)
         try:
@@ -303,6 +454,10 @@ def main() -> None:
         detail[f"{backend}_error"] = err
     if mesh_error:
         detail["mesh_error"] = mesh_error
+    if executed_backend != "tpu":
+        # CPU fallback: attach the watcher's round-long probe record as
+        # proof the tunnel was tried continuously, not just at startup.
+        detail.update(_probe_log_stats())
     print(json.dumps({
         "metric": f"density_pods_per_sec_n{num_nodes}",
         "value": round(res.pods_per_sec, 1),
