@@ -44,6 +44,8 @@ class QpsResult:
     conc_qps: float
     conc_clients: int
     mean_batch: float  # pods per kernel dispatch under concurrency
+    conc_dispatches: int = 0  # kernel dispatches in the timed window
+    batch_occupancy: float = 0.0  # mean_batch / max_pods
 
     def to_dict(self) -> dict:
         return dataclasses.asdict(self)
@@ -64,8 +66,8 @@ def _prioritize_args(i: int) -> dict:
 
 
 def run_qps(num_nodes: int = 5120, max_pods: int = 256,
-            seq_requests: int = 32, conc_clients: int = 16,
-            conc_requests: int = 128, seed: int = 0) -> QpsResult:
+            seq_requests: int = 32, conc_clients: int = 128,
+            conc_requests: int = 2048, seed: int = 0) -> QpsResult:
     cfg = SchedulerConfig(max_nodes=round_up(num_nodes, 128),
                           max_pods=max_pods, max_peers=4)
     cluster, lat, bw = build_fake_cluster(
@@ -132,6 +134,8 @@ def run_qps(num_nodes: int = 5120, max_pods: int = 256,
         conc_qps=round(conc_qps, 1),
         conc_clients=conc_clients,
         mean_batch=round(mean_batch, 2),
+        conc_dispatches=dispatches,
+        batch_occupancy=round(mean_batch / max_pods, 3),
     )
 
 

@@ -788,10 +788,15 @@ class Encoder:
             # ConstraintDegraded event like every other drop.
             bits = []
             for pod in pods:
-                before = self.groups.overflow_drops
                 defs = getattr(pod, "selector_defs", None)
                 dropped_defs = (self.register_selectors(defs, True)
                                 if defs else 0)
+                # Snapshot AFTER register_selectors: its failed bit()
+                # calls already bump overflow_drops, and dropped_defs
+                # reports them — snapshotting before would count each
+                # failure twice in the ConstraintDegraded event
+                # (ADVICE r3 low #1).
+                before = self.groups.overflow_drops
                 bits.append((
                     (self.groups.bit(pod.group, lenient=True)
                      if pod.group else 0),
@@ -1277,12 +1282,38 @@ class Encoder:
         """Upsert a real ``policy/v1`` PodDisruptionBudget: registers
         its selector as a selector-group (member counting then rides
         the same label-driven machinery as affinity) and records the
-        disruption bound for the preemption planner."""
+        disruption bound for the preemption planner.
+
+        A selector that cannot get a group bit (interner exhausted)
+        leaves the PDB UNENFORCED — the preemption planner finds no
+        slot and skips the bound (degrades OPEN).  Unlike every other
+        degradation that used to be silent (ADVICE r3 low #2), this is
+        surfaced through the same ConstraintDegraded event channel the
+        per-pod drops use, naming the PDB."""
         with self._lock:
+            degraded = False
             if pdb.selector_key:
                 self.register_selectors(
                     {pdb.selector_key: pdb.selector_def}, lenient=True)
+                degraded = pdb.selector_key not in self._selector_defs
             self._pdbs[pdb.uid or f"{pdb.namespace}/{pdb.name}"] = pdb
+            if degraded:
+                # Same identity-dedup discipline as _record_degraded:
+                # the PDB watch re-delivers on every resync, and
+                # without dedup each upsert re-fires the event while
+                # the interner stays exhausted.
+                key = (pdb.namespace, f"pdb/{pdb.name}")
+                if key not in self._degraded_seen:
+                    if len(self._degraded_seen) >= 4096:
+                        self._degraded_seen.clear()
+                    self._degraded_seen.add(key)
+                    self.degraded_total += 1
+                    self._degraded_pods.append((
+                        pdb.namespace, pdb.name, 1,
+                        (f"PodDisruptionBudget {pdb.namespace}/"
+                         f"{pdb.name} selector could not get a group"
+                         " bit (interner exhausted); its disruption"
+                         " bound is NOT enforced (degrades OPEN)",)))
 
     def remove_pdb(self, uid: str) -> None:
         with self._lock:

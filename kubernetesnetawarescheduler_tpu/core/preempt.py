@@ -255,7 +255,11 @@ def plan_preemption(encoder: Encoder, pod: Pod) -> PreemptionPlan | None:
                 continue
             bit = encoder.groups.bit(pdb.selector_key, lenient=True)
             if not bit:
-                continue  # interner exhausted: bound untrackable
+                # Interner exhausted: bound untrackable, the PDB
+                # degrades OPEN.  Not silent — Encoder.set_pdb already
+                # emitted a ConstraintDegraded event naming this PDB
+                # when the registration failed (ADVICE r3 low #2).
+                continue
             s = bit.bit_length() - 1
             members = members_by_slot.get(s, 0)
             if pdb.min_available is not None:

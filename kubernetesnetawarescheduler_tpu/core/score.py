@@ -162,8 +162,12 @@ def static_node_scores(state: ClusterState, cfg: SchedulerConfig
 
 def network_scores(state: ClusterState, pods: PodBatch,
                    cfg: SchedulerConfig,
-                   ct: jax.Array | None = None) -> jax.Array:
-    """Pod-aware network term ``f32[P, N]``.
+                   ct: jax.Array | None = None,
+                   transposed: bool = False) -> jax.Array:
+    """Pod-aware network term ``f32[P, N]`` (``f32[N, P]`` with
+    ``transposed=True`` — the node-major layout the conflict loop
+    carries; the gather path emits it natively via the einsum output
+    spec, no transpose pass).
 
     ``ct`` lets callers pass a precomputed :func:`prep_net_matrix`
     (the transposed, compute-dtype desirability matrix).
@@ -188,15 +192,19 @@ def network_scores(state: ClusterState, pods: PodBatch,
         safe = jnp.where(valid, pods.peers, 0)
         traffic = jnp.where(valid, pods.peer_traffic, 0.0)
         rows = ct[safe].astype(jnp.float32)        # [P, K, N]
-        return jnp.einsum("pk,pkn->pn", traffic, rows)
+        out = "np" if transposed else "pn"
+        return jnp.einsum(f"pk,pkn->{out}", traffic, rows)
     t = peer_traffic_matrix(pods, n)
     if cfg.use_bfloat16:
         # bf16 inputs, f32 accumulation: standard MXU recipe.
-        return jnp.dot(t.astype(jnp.bfloat16), ct,
-                       preferred_element_type=jnp.float32)
-    # Full f32: on TPU the default matmul precision is bf16 passes, so
-    # ask for HIGHEST explicitly when exactness is requested.
-    return jnp.dot(t, ct, precision=jax.lax.Precision.HIGHEST)
+        net = jnp.dot(t.astype(jnp.bfloat16), ct,
+                      preferred_element_type=jnp.float32)
+    else:
+        # Full f32: on TPU the default matmul precision is bf16
+        # passes, so ask for HIGHEST explicitly when exactness is
+        # requested.
+        net = jnp.dot(t, ct, precision=jax.lax.Precision.HIGHEST)
+    return net.T if transposed else net
 
 
 def soft_affinity_scores(state: ClusterState, pods: PodBatch,
@@ -528,6 +536,24 @@ def static_feasibility(state: ClusterState, pods: PodBatch) -> jax.Array:
         == pods.sel_bits[:, None, :], axis=-1)
     return (tol & sel & state.node_valid[None, :]
             & pods.pod_valid[:, None] & ns_affinity_ok(state, pods))
+
+
+def static_feasibility_t(state: ClusterState, pods: PodBatch
+                         ) -> jax.Array:
+    """:func:`static_feasibility` in node-major layout ``bool[N, P]``
+    — built natively with swapped broadcast axes (no transpose pass)
+    for the conflict loop's transposed carry.  The gated
+    ``ns_affinity_ok`` term keeps its pod-major internals and is
+    transposed at the seam (one cheap bool pass, zero when the gate is
+    closed and XLA folds the transpose of the broadcast ones)."""
+    tol = jnp.all(
+        (state.taint_bits[:, None, :] & ~pods.tol_bits[None, :, :]) == 0,
+        axis=-1)
+    sel = jnp.all(
+        (state.label_bits[:, None, :] & pods.sel_bits[None, :, :])
+        == pods.sel_bits[None, :, :], axis=-1)
+    return (tol & sel & state.node_valid[:, None]
+            & pods.pod_valid[None, :] & ns_affinity_ok(state, pods).T)
 
 
 def feasibility_mask(state: ClusterState, pods: PodBatch,

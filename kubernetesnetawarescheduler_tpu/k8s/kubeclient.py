@@ -213,7 +213,8 @@ def _required_node_terms(spec: Mapping) -> tuple:
     return tuple(out)
 
 
-def _preferred_group_terms(spec: Mapping, ann: Mapping) -> tuple:
+def _preferred_group_terms(spec: Mapping, ann: Mapping,
+                           namespace: str = "default") -> tuple:
     """Soft pod-(anti-)affinity as ``(host_terms, zone_terms, defs)``
     — term banks of ``(("group", weight), ...)`` plus the selector
     definitions their group keys need registered.
@@ -238,7 +239,17 @@ def _preferred_group_terms(spec: Mapping, ann: Mapping) -> tuple:
             raw = json.loads(ann[ANN_SOFT_AFFINITY])
             # Built fully before extending: a malformed entry rejects
             # the WHOLE annotation (score-neutral), never half of it.
-            parsed = [(str(g), float(v)) for g, v in raw.items()
+            # Bare group names are namespace-qualified like every
+            # other annotation group surface (pod_from_json _nsq;
+            # NS_SEP keeps qualified keys collision-free).
+            def _q(g: str) -> str:
+                if "/" in g:
+                    head, tail = g.split("/", 1)
+                    return f"{head}{NS_SEP}{tail}"
+                return f"{namespace}{NS_SEP}{g}"
+
+            parsed = [(_q(str(g)), float(v))
+                      for g, v in raw.items()
                       if float(v)]  # weight-0 entries are no-ops
             out.extend(parsed)
         except (ValueError, TypeError, AttributeError):
@@ -255,7 +266,10 @@ def _preferred_group_terms(spec: Mapping, ann: Mapping) -> tuple:
             tk = pat.get("topologyKey")
             if tk not in (_HOST_KEY, _ZONE_KEY):
                 continue
-            kd = _selector_key_def(pat.get("labelSelector") or {})
+            scope = _term_ns_scope(pat, namespace)
+            kd = (None if scope == "unrepresentable" else
+                  _selector_key_def(pat.get("labelSelector") or {},
+                                    ns_scope=scope))
             if not weight or kd is None:
                 # Malformed selector: degrade score-neutrally (soft
                 # semantics) — scoring a DIFFERENT group than the k8s
@@ -270,8 +284,29 @@ def _preferred_group_terms(spec: Mapping, ann: Mapping) -> tuple:
 
 _SEL_OPS = frozenset({"In", "NotIn", "Exists", "DoesNotExist"})
 
+# Reserved pseudo-label key carrying the pod's namespace for selector
+# evaluation.  Kubernetes label keys are validated server-side to
+# ``[A-Za-z0-9._-]`` names (optionally ``dns.prefix/``-qualified), so a
+# NUL byte can never collide with — or be spoofed by — a real workload
+# label (same trick as :data:`UNSAT_GROUP`).  ``pod_from_json`` injects
+# ``\x00ns=<namespace>`` into every parsed pod's label set, and
+# namespace-scoped selector defs carry an ``("In", "\x00ns", (...))``
+# expression, so namespace scoping rides the ordinary
+# ``selector_matches`` path with no schema change.
+_NS_KEY = "\x00ns"
+# Separator between a namespace qualifier and the group body in
+# canonical group keys: ``<ns>\x00/<body>``.  A bare "/" would be
+# ambiguous — label KEYS may legally carry a "dns.prefix/" (so the
+# cluster-wide key for ``{team/app: x}`` is the string "team/app=x",
+# which a "/"-separated qualifier for namespace "team" + ``app=x``
+# would collide with, silently merging two different selectors into
+# one group bit); no legal label key, value, or namespace contains a
+# NUL byte.
+NS_SEP = "\x00/"
 
-def _selector_key_def(sel: Mapping) -> tuple[str, tuple] | None:
+
+def _selector_key_def(sel: Mapping, ns_scope: tuple | None = None
+                      ) -> tuple[str, tuple] | None:
     """Canonicalize an ARBITRARY labelSelector to ``(group_key,
     selector_def)``, or ``None`` when malformed (an operator outside
     In/NotIn/Exists/DoesNotExist, a missing key, or a value list that
@@ -283,13 +318,28 @@ def _selector_key_def(sel: Mapping) -> tuple[str, tuple] | None:
     annotation opt-in (kube semantics; VERDICT.md round 2, missing #3
     and ADVICE.md medium #1).
 
+    ``ns_scope`` is the namespace scope of the term this selector came
+    from (VERDICT r3 missing #2 / ADVICE r3 medium): ``None`` means
+    cluster-wide (all namespaces — kube's ``namespaceSelector: {}``),
+    a tuple of names restricts membership to pods of those namespaces
+    by injecting an ``In`` expression on :data:`_NS_KEY`.  Distinct
+    scopes therefore canonicalize to DISTINCT group keys: a ``team-a``
+    pod's term never shares a bit with the same labels in ``team-b``.
+
     Key convention: selectors reducible to an exact-label conjunction
     (``matchLabels`` plus single-value non-conflicting ``In``
-    expressions) keep the legacy sorted ``k=v[,k=v]`` key — the SAME
-    string the ``netaware.io/group`` annotation convention uses, so
-    both membership surfaces share one bit.  Richer selectors get a
-    canonical ``sel:`` key.  An empty selector matches every pod
-    (kube's empty-LabelSelector rule) under the ``sel:any`` key."""
+    expressions) keep the sorted ``k=v[,k=v]`` key — cluster-wide
+    scope keeps the legacy bare string (the SAME key the
+    ``netaware.io/group`` annotation convention uses, so both
+    membership surfaces share one bit); a single-namespace scope
+    prefixes it as ``ns\\x00/k=v[,k=v]`` (:data:`NS_SEP` — a bare "/"
+    would collide with cluster-wide keys whose label key carries a
+    ``dns.prefix/``), matching how ``pod_from_json``
+    namespace-qualifies annotation group names — so the bit sharing
+    survives scoping.  Richer selectors and multi-namespace scopes get
+    a canonical ``sel:`` key (the repr covers the ns expression).  An
+    empty selector matches every pod (kube's empty-LabelSelector rule)
+    under ``sel:any`` / ``ns\\x00/sel:any``."""
     match = dict(sel.get("matchLabels") or {})
     exprs = []
     for e in sel.get("matchExpressions") or []:
@@ -307,11 +357,49 @@ def _selector_key_def(sel: Mapping) -> tuple[str, tuple] | None:
         exprs.append((str(op), str(key), values))
     ml = tuple(sorted((str(k), str(v)) for k, v in match.items()))
     exprs_t = tuple(sorted(exprs))
-    if not exprs_t:
+    ns_exprs = ()
+    prefix = ""
+    if ns_scope is not None:
+        ns_t = tuple(sorted(str(n) for n in ns_scope))
+        if not ns_t:
+            return None  # empty scope selects nothing representable
+        ns_exprs = (("In", _NS_KEY, ns_t),)
+        if len(ns_t) == 1:
+            prefix = f"{ns_t[0]}{NS_SEP}"
+    if not exprs_t and (ns_scope is None or prefix):
         if not ml:
-            return "sel:any", ((), ())
-        return ",".join(f"{k}={v}" for k, v in ml), (ml, ())
-    return f"sel:{(ml, exprs_t)!r}", (ml, exprs_t)
+            return f"{prefix}sel:any", ((), ns_exprs)
+        return (prefix + ",".join(f"{k}={v}" for k, v in ml),
+                (ml, ns_exprs))
+    full = (ml, tuple(sorted(exprs_t + ns_exprs)))
+    return f"sel:{full!r}", full
+
+
+def _term_ns_scope(term: Mapping, own_ns: str):
+    """Resolve a ``podAffinityTerm``'s namespace scope, kube
+    semantics (pkg/scheduler ``GetNamespaceLabelsSnapshot`` rules):
+
+    - neither ``namespaces`` nor ``namespaceSelector`` → the pod's OWN
+      namespace (the default the reference's probe placement leaned
+      on, deployment.yaml:17-26, by delegating to stock kube);
+    - ``namespaces: [...]`` → exactly those names;
+    - ``namespaceSelector: {}`` (empty object) → ALL namespaces
+      (returns ``None`` = cluster-wide, the pre-round-4 behavior);
+    - a non-empty ``namespaceSelector`` needs Namespace-object labels
+      this framework does not watch → ``"unrepresentable"`` (callers
+      degrade per the affinity/anti contract).  A ``namespaces`` list
+      alongside it would union with the selector's matches, which we
+      cannot compute either.
+    """
+    nsel = term.get("namespaceSelector")
+    if nsel is not None:
+        if nsel.get("matchLabels") or nsel.get("matchExpressions"):
+            return "unrepresentable"
+        return None  # empty selector = all namespaces
+    names = term.get("namespaces") or []
+    if names:
+        return tuple(sorted(str(n) for n in names))
+    return (own_ns,)
 
 
 _ZONE_KEY = "topology.kubernetes.io/zone"
@@ -324,7 +412,8 @@ _HOST_KEY = "kubernetes.io/hostname"
 UNSAT_GROUP = "\x00unrepresentable"
 
 
-def _required_group_terms(spec: Mapping) -> tuple:
+def _required_group_terms(spec: Mapping, namespace: str = "default"
+                          ) -> tuple:
     """``requiredDuringSchedulingIgnoredDuringExecution`` podAffinity /
     podAntiAffinity terms → ``(host_aff, host_anti, zone_aff,
     zone_anti)`` frozensets of group keys (the ``labelSelector
@@ -332,6 +421,11 @@ def _required_group_terms(spec: Mapping) -> tuple:
     group string, matching ``netaware.io/group``).
 
     Scope/degradation contract:
+    - Terms are NAMESPACE-scoped per kube semantics
+      (:func:`_term_ns_scope`): default own-namespace, widened by
+      ``namespaces:``/``namespaceSelector: {}``; a non-empty
+      ``namespaceSelector`` is unrepresentable (no Namespace watch)
+      and degrades like a malformed selector.
     - ``topologyKey: kubernetes.io/hostname`` terms land in the
       host-scoped sets, ``topology.kubernetes.io/zone`` in the
       zone-scoped ones.
@@ -374,10 +468,15 @@ def _required_group_terms(spec: Mapping) -> tuple:
         for term in (aff.get(kind) or {}).get(
                 "requiredDuringSchedulingIgnoredDuringExecution") or []:
             tk = term.get("topologyKey")
-            kd = _selector_key_def(term.get("labelSelector") or {})
+            scope = _term_ns_scope(term, namespace)
+            kd = (None if scope == "unrepresentable" else
+                  _selector_key_def(term.get("labelSelector") or {},
+                                    ns_scope=scope))
             if tk not in (_HOST_KEY, _ZONE_KEY) or kd is None:
                 degraded += 1
-                why = ("malformed labelSelector" if kd is None
+                why = ("non-empty namespaceSelector (no Namespace "
+                       "watch)" if scope == "unrepresentable"
+                       else "malformed labelSelector" if kd is None
                        else f"unsupported topologyKey {tk!r}")
                 detail.append(
                     f"required {kind} term dropped "
@@ -402,7 +501,8 @@ def _required_group_terms(spec: Mapping) -> tuple:
             tuple(detail))
 
 
-def _spread_constraint(spec: Mapping) -> tuple[int, bool, str, dict]:
+def _spread_constraint(spec: Mapping, namespace: str = "default"
+                       ) -> tuple[int, bool, str, dict]:
     """First zone-level ``topologySpreadConstraint`` as
     ``(maxSkew, hard, spread_group, defs)``; ``(0, True, "", {})`` =
     none.
@@ -411,11 +511,12 @@ def _spread_constraint(spec: Mapping) -> tuple[int, bool, str, dict]:
     representable (hostname-level spreading is anti-affinity's job in
     this framework).  The counted pod set is the constraint's
     labelSelector, canonicalized to a selector-group
-    (:func:`_selector_key_def`) whose membership is label-driven —
-    full labelSelector parity; a constraint WITHOUT a selector (or
-    with a malformed one) falls back to the pod's own group
-    (``spread_group == ""``).  Unrepresentable constraints are skipped
-    (degrade open)."""
+    (:func:`_selector_key_def`) scoped to the pod's OWN namespace —
+    kube counts topology-spread members per namespace, always (no
+    ``namespaces`` widening field exists on the constraint); a
+    constraint WITHOUT a selector (or with a malformed one) falls
+    back to the pod's own group (``spread_group == ""``).
+    Unrepresentable constraints are skipped (degrade open)."""
     for c in spec.get("topologySpreadConstraints") or []:
         if c.get("topologyKey") != "topology.kubernetes.io/zone":
             continue
@@ -429,7 +530,7 @@ def _spread_constraint(spec: Mapping) -> tuple[int, bool, str, dict]:
                      "DoNotSchedule") != "ScheduleAnyway"
         sel = c.get("labelSelector")
         if sel:
-            kd = _selector_key_def(sel)
+            kd = _selector_key_def(sel, ns_scope=(namespace,))
             if kd is not None:
                 return skew, hard, kd[0], {kd[0]: kd[1]}
         return skew, hard, "", {}
@@ -498,20 +599,35 @@ def pod_from_json(obj: Mapping) -> Pod:
         v = ann.get(key, "")
         return frozenset(x.strip() for x in v.split(",") if x.strip())
 
-    spread_skew, spread_hard, spread_group, spread_defs = \
-        _spread_constraint(spec)
-    (host_aff, host_anti, zone_aff, zone_anti, parse_degraded,
-     req_defs, degraded_detail) = _required_group_terms(spec)
-    soft_host_terms, soft_zone_terms, soft_defs = \
-        _preferred_group_terms(spec, ann)
-    selector_defs = {**req_defs, **soft_defs, **spread_defs}
     namespace = meta.get("namespace", "default")
+    spread_skew, spread_hard, spread_group, spread_defs = \
+        _spread_constraint(spec, namespace)
+    (host_aff, host_anti, zone_aff, zone_anti, parse_degraded,
+     req_defs, degraded_detail) = _required_group_terms(spec, namespace)
+    soft_host_terms, soft_zone_terms, soft_defs = \
+        _preferred_group_terms(spec, ann, namespace)
+    selector_defs = {**req_defs, **soft_defs, **spread_defs}
     # Qualify peer references with the pod's own namespace (unless the
     # annotation already says "ns/name"): the pod cache and node_of()
     # are namespace-keyed, and a bare name would collide across
     # namespaces (same-named pods in staging/prod are routine).
     peers = {(k if "/" in k else f"{namespace}/{k}"): v
              for k, v in peers.items()}
+
+    def _nsq(group: str) -> str:
+        """Namespace-qualify a bare annotation group name (explicit
+        ``ns/name`` opts into cross-namespace grouping, same
+        convention as peers above; the canonical internal form uses
+        :data:`NS_SEP` so the key can never collide with a
+        cluster-wide key whose label carries a ``dns.prefix/``).
+        Keeps the annotation surface and the namespace-scoped
+        selector keys sharing one bit: selector ``app=db`` in team-a
+        and annotation group ``app=db`` on a team-a pod both intern
+        as ``team-a\\x00/app=db``."""
+        if "/" in group:
+            head, tail = group.split("/", 1)
+            return f"{head}{NS_SEP}{tail}"
+        return f"{namespace}{NS_SEP}{group}"
 
     return Pod(
         name=meta.get("name", ""),
@@ -523,11 +639,15 @@ def pod_from_json(obj: Mapping) -> Pod:
         peers=peers,
         tolerations=tolerations,
         node_selector=_flatten(spec.get("nodeSelector")),
-        labels=_flatten(meta.get("labels")),
+        # The \x00ns pseudo-label makes namespace scope visible to
+        # selector_matches (see _NS_KEY) without a schema change.
+        labels=(_flatten(meta.get("labels"))
+                | frozenset({f"{_NS_KEY}={namespace}"})),
         required_node_affinity=_required_node_terms(spec),
-        group=ann.get(ANN_GROUP, ""),
-        affinity_groups=_csv(ANN_AFFINITY) | host_aff,
-        anti_groups=_csv(ANN_ANTI) | host_anti,
+        group=_nsq(ann.get(ANN_GROUP, "")) if ann.get(ANN_GROUP) else "",
+        affinity_groups=frozenset(map(_nsq, _csv(ANN_AFFINITY)))
+        | host_aff,
+        anti_groups=frozenset(map(_nsq, _csv(ANN_ANTI))) | host_anti,
         zone_affinity_groups=zone_aff,
         zone_anti_groups=zone_anti,
         selector_defs=selector_defs,
@@ -556,7 +676,13 @@ def pdb_from_json(obj: Mapping):
 
     meta = obj.get("metadata") or {}
     spec = obj.get("spec") or {}
-    kd = _selector_key_def(spec.get("selector") or {})
+    # A PDB protects pods of its OWN namespace only (policy/v1
+    # semantics) — without the scope, same-labeled pods in other
+    # namespaces would inflate the member count and let the preemption
+    # planner evict below a real PDB's bound (ADVICE r3 medium).
+    kd = _selector_key_def(
+        spec.get("selector") or {},
+        ns_scope=(meta.get("namespace", "default"),))
     if kd is None:
         return None
 

@@ -478,9 +478,12 @@ def test_pod_from_json_preferred_affinity():
     assert (frozenset({"zone=a"}), 50.0) in pod.soft_node_affinity
     assert (frozenset({"zone=b"}), 50.0) in pod.soft_node_affinity
     assert len(pod.soft_node_affinity) == 3
-    assert ("cache", -15.0) in pod.soft_group_affinity
-    assert ("app=db", 30.0) in pod.soft_group_affinity
-    assert ("app=web", -20.0) in pod.soft_group_affinity
+    # Group keys are namespace-qualified (round-4 namespace scoping):
+    # bare annotation names and own-namespace selector terms both land
+    # under the pod's namespace.
+    assert ("default\x00/cache", -15.0) in pod.soft_group_affinity
+    assert ("default\x00/app=db", 30.0) in pod.soft_group_affinity
+    assert ("default\x00/app=web", -20.0) in pod.soft_group_affinity
 
 
 def test_effective_request_init_containers_and_overhead():

@@ -410,7 +410,9 @@ def test_pdb_from_json_parses_bounds():
            "spec": {"selector": {"matchLabels": {"app": "db"}},
                     "minAvailable": "60%"}}
     pdb = pdb_from_json(obj)
-    assert pdb.selector_key == "app=db"
+    # PDB selectors are scoped to the PDB's own namespace (round-4
+    # namespace scoping).
+    assert pdb.selector_key == "default\x00/app=db"
     assert pdb.min_available is None
     assert pdb.min_available_pct == 60.0
     obj2 = {"metadata": {"name": "x"},

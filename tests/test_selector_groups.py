@@ -248,27 +248,35 @@ def test_kubeclient_parses_rich_selectors_and_spread():
         },
     }
     pod = pod_from_json(obj)
-    assert pod.labels == frozenset({"app=db", "tier=be"})
+    # Parsed labels carry the reserved namespace pseudo-label (the
+    # selector namespace-scoping carrier, kubeclient._NS_KEY).
+    assert pod.labels == frozenset({"app=db", "tier=be",
+                                    "\x00ns=default"})
     assert pod.parse_degraded == 0
     assert len(pod.affinity_groups) == 1
     assert len(pod.anti_groups) == 1
     aff_key = next(iter(pod.affinity_groups))
     anti_key = next(iter(pod.anti_groups))
     assert aff_key.startswith("sel:") and anti_key.startswith("sel:")
-    assert pod.spread_group == "app=db"
-    assert set(pod.selector_defs) == {aff_key, anti_key, "app=db"}
-    # Definitions evaluate correctly.
+    assert pod.spread_group == "default\x00/app=db"
+    assert set(pod.selector_defs) == {aff_key, anti_key,
+                                      "default\x00/app=db"}
+    # Definitions evaluate correctly — membership requires the
+    # matching namespace (terms default to the pod's own).
     from kubernetesnetawarescheduler_tpu.core.encode import (
         selector_matches,
     )
     assert selector_matches(pod.selector_defs[aff_key],
-                            frozenset({"app=cache"}))
+                            frozenset({"app=cache", "\x00ns=default"}))
     assert not selector_matches(pod.selector_defs[aff_key],
-                                frozenset({"app=web"}))
+                                frozenset({"app=cache",
+                                           "\x00ns=team-b"}))
+    assert not selector_matches(pod.selector_defs[aff_key],
+                                frozenset({"app=web", "\x00ns=default"}))
     assert selector_matches(pod.selector_defs[anti_key],
-                            frozenset({"app=db"}))
+                            frozenset({"app=db", "\x00ns=default"}))
     assert not selector_matches(pod.selector_defs[anti_key],
-                                frozenset({"tier=be"}))
+                                frozenset({"tier=be", "\x00ns=default"}))
 
 
 def test_selector_key_def_canonicalization():
@@ -297,3 +305,121 @@ def test_empty_selector_matches_all_pods():
               affinity_groups=frozenset({"sel:any"}),
               selector_defs={"sel:any": ((), ())})
     assert enc.node_name(_place(enc, pod)) == "c"
+
+
+# --- Namespace scoping (VERDICT r3 missing #2 / ADVICE r3 medium) ---
+
+def _kube_pod(name, ns, labels=None, anti=None, aff=None, ns_list=None,
+              ns_selector=None):
+    """Minimal v1.Pod JSON with an optional required (anti-)affinity
+    term on app=db at hostname topology."""
+    term = {"topologyKey": "kubernetes.io/hostname",
+            "labelSelector": {"matchLabels": {"app": "db"}}}
+    if ns_list is not None:
+        term["namespaces"] = ns_list
+    if ns_selector is not None:
+        term["namespaceSelector"] = ns_selector
+    affinity = {}
+    if anti:
+        affinity["podAntiAffinity"] = {
+            "requiredDuringSchedulingIgnoredDuringExecution": [term]}
+    if aff:
+        affinity["podAffinity"] = {
+            "requiredDuringSchedulingIgnoredDuringExecution": [term]}
+    return pod_from_json({
+        "metadata": {"name": name, "namespace": ns,
+                     "labels": dict(labels or {})},
+        "spec": {
+            "containers": [{"resources": {"requests": {"cpu": "1"}}}],
+            **({"affinity": affinity} if affinity else {}),
+        },
+    })
+
+
+def test_namespace_scopes_required_anti_affinity():
+    """Same-labeled pods in DIFFERENT namespaces neither satisfy nor
+    violate each other's terms (kube's own-namespace default) — the
+    VERDICT r3 done-criterion for missing #2."""
+    enc = _cluster()
+    # A team-b resident with app=db labels on node b.
+    enc.commit(_kube_pod("r", "team-b", labels={"app": "db"}), "b")
+    # team-a anti-affinity against app=db: the team-b resident must
+    # NOT repel it — node b stays feasible (and is otherwise equal).
+    p = _kube_pod("p", "team-a", labels={"app": "db"}, anti=True)
+    batch = enc.encode_pods([p], node_of=lambda s: "", lenient=True)
+    from kubernetesnetawarescheduler_tpu.core import score as score_lib
+    ok = np.asarray(score_lib.feasibility_mask(enc.snapshot(),
+                                               batch))[0]
+    assert ok[1], "foreign-namespace resident must not trigger anti"
+    # Same term from a team-b pod IS repelled from node b.
+    q = _kube_pod("q", "team-b", labels={"app": "x"}, anti=True)
+    batch = enc.encode_pods([q], node_of=lambda s: "", lenient=True)
+    ok = np.asarray(score_lib.feasibility_mask(enc.snapshot(),
+                                               batch))[0]
+    assert not ok[1], "own-namespace resident must trigger anti"
+
+
+def test_namespace_scopes_required_affinity():
+    """Required affinity is satisfied only by same-namespace members;
+    a foreign-namespace look-alike does not help."""
+    enc = _cluster()
+    enc.commit(_kube_pod("r", "team-b", labels={"app": "db"}), "b")
+    p = _kube_pod("p", "team-a", labels={"tier": "fe"}, aff=True)
+    assert _place(enc, p) == -1, \
+        "foreign-namespace member must not satisfy required affinity"
+    enc.commit(_kube_pod("r2", "team-a", labels={"app": "db"}), "c")
+    p2 = _kube_pod("p2", "team-a", labels={"tier": "fe"}, aff=True)
+    assert enc.node_name(_place(enc, p2)) == "c"
+
+
+def test_namespaces_list_widens_scope():
+    """An explicit ``namespaces:`` list replaces the own-namespace
+    default (kube semantics)."""
+    enc = _cluster()
+    enc.commit(_kube_pod("r", "team-b", labels={"app": "db"}), "b")
+    p = _kube_pod("p", "team-a", aff=True, ns_list=["team-b"])
+    assert enc.node_name(_place(enc, p)) == "b"
+
+
+def test_empty_namespace_selector_is_cluster_wide():
+    """``namespaceSelector: {}`` matches all namespaces."""
+    enc = _cluster()
+    enc.commit(_kube_pod("r", "team-b", labels={"app": "db"}), "b")
+    p = _kube_pod("p", "team-a", aff=True, ns_selector={})
+    assert enc.node_name(_place(enc, p)) == "b"
+
+
+def test_nonempty_namespace_selector_degrades():
+    """A non-empty namespaceSelector needs Namespace labels we do not
+    watch: the affinity term degrades CLOSED (pod unschedulable), and
+    the degradation is counted for the operator event."""
+    enc = _cluster()
+    enc.commit(_kube_pod("r", "team-b", labels={"app": "db"}), "b")
+    p = _kube_pod("p", "team-a", aff=True,
+                  ns_selector={"matchLabels": {"env": "prod"}})
+    assert p.parse_degraded == 1
+    assert _place(enc, p) == -1
+
+
+def test_pdb_scoped_to_own_namespace():
+    """A PDB only counts same-namespace pods as members (ADVICE r3
+    medium: foreign-namespace pods must not inflate the budget)."""
+    from kubernetesnetawarescheduler_tpu.k8s.kubeclient import (
+        pdb_from_json,
+    )
+
+    pdb = pdb_from_json({
+        "metadata": {"name": "guard", "namespace": "team-a"},
+        "spec": {"minAvailable": 1,
+                 "selector": {"matchLabels": {"app": "db"}}},
+    })
+    assert pdb is not None
+    enc = _cluster()
+    enc.set_pdb(pdb)
+    enc.commit(_kube_pod("a1", "team-a", labels={"app": "db"}), "a")
+    enc.commit(_kube_pod("b1", "team-b", labels={"app": "db"}), "b")
+    bit = enc.groups.bit(pdb.selector_key, lenient=True)
+    slot = bit.bit_length() - 1
+    counts = int(enc._group_member_counts[slot])
+    assert counts == 1, (
+        f"PDB members must be namespace-scoped, got {counts}")

@@ -244,9 +244,11 @@ def test_kubeclient_parses_required_pod_affinity():
         },
     }
     pod = pod_from_json(obj)
-    assert pod.zone_affinity_groups == frozenset({"app=db"})
-    assert pod.anti_groups == frozenset({"app=cache"})
-    assert pod.zone_anti_groups == frozenset({"app=noisy"})
+    # Terms default to the pod's own namespace (round-4 namespace
+    # scoping): keys are ns-qualified.
+    assert pod.zone_affinity_groups == frozenset({"default\x00/app=db"})
+    assert pod.anti_groups == frozenset({"default\x00/app=cache"})
+    assert pod.zone_anti_groups == frozenset({"default\x00/app=noisy"})
 
 
 def test_soft_zone_affinity_pulls_and_spreads():
@@ -288,8 +290,8 @@ def test_kubeclient_parses_preferred_zone_stanza():
         },
     }
     pod = pod_from_json(obj)
-    assert pod.soft_zone_affinity == (("app=db", 80.0),
-                                      ("app=noisy", -60.0))
+    assert pod.soft_zone_affinity == (("default\x00/app=db", 80.0),
+                                      ("default\x00/app=noisy", -60.0))
     assert pod.soft_group_affinity == ()
 
 
@@ -309,7 +311,8 @@ def test_preferred_selector_folds_and_degrades_like_required():
                         "topologyKey":
                             "topology.kubernetes.io/zone"}}]}}}}
     pod = pod_from_json(base)
-    assert pod.soft_zone_affinity == (("app=db,tier=prod", -50.0),)
+    assert pod.soft_zone_affinity == (
+        ("default\x00/app=db,tier=prod", -50.0),)
     # Multi-value In: representable since round 3 as a rich
     # selector-group (label-driven membership), same weight.
     base["spec"]["affinity"]["podAntiAffinity"][
@@ -348,7 +351,8 @@ def test_kubeclient_folds_single_in_expressions():
         },
     }
     pod = pod_from_json(obj)
-    assert pod.zone_affinity_groups == frozenset({"app=db,tier=prod"})
+    assert pod.zone_affinity_groups == frozenset(
+        {"default\x00/app=db,tier=prod"})
     assert pod.parse_degraded == 0
     # A key with a CONFLICTING value is k8s's never-matches selector:
     # since round 3 it stays a faithful rich selector-group that no
@@ -401,8 +405,12 @@ def test_kubeclient_negative_selector_affinity_is_representable():
     # With a matching resident (no app label), the term binds to its
     # node; a NON-matching resident (app=db) does not satisfy it.
     enc2 = _zoned_cluster()
+    # Residents carry the namespace pseudo-label a parsed pod would
+    # (the parsed pod's term is scoped to namespace "default").
     enc2.commit(Pod(name="m1", uid="m1", requests={"cpu": 1.0},
-                    labels=frozenset({"app=db"})), "a")
+                    labels=frozenset({"app=db", "\x00ns=default"})),
+                "a")
     enc2.commit(Pod(name="m2", uid="m2", requests={"cpu": 1.0},
-                    labels=frozenset({"tier=x"})), "c")
+                    labels=frozenset({"tier=x", "\x00ns=default"})),
+                "c")
     assert enc2.node_name(_place(enc2, pod)) == "c"

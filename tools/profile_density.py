@@ -1,0 +1,124 @@
+"""Ablation profile of the density replay (VERDICT r3 next-round #2).
+
+Builds the bench instance at the headline shape, then re-times the
+device replay with each constraint family zeroed out of the stream —
+no code changes, so the measured deltas are exactly what each family
+costs on the hot path.  CPU backend (the only backend ever measured).
+
+Usage: python tools/profile_density.py [nodes] [pods]
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+from kubernetesnetawarescheduler_tpu.config import SchedulerConfig  # noqa: E402
+from kubernetesnetawarescheduler_tpu.core.loop import SchedulerLoop  # noqa: E402
+from kubernetesnetawarescheduler_tpu.core.replay import (  # noqa: E402
+    pad_stream,
+    replay_stream,
+)
+from kubernetesnetawarescheduler_tpu.core.state import round_up  # noqa: E402
+from kubernetesnetawarescheduler_tpu.bench.fakecluster import (  # noqa: E402
+    ClusterSpec,
+    WorkloadSpec,
+    build_fake_cluster,
+    feed_metrics,
+    generate_workload,
+)
+
+
+def build(num_nodes: int, num_pods: int, batch: int = 128):
+    cfg = SchedulerConfig(max_nodes=round_up(num_nodes, 128),
+                          max_pods=batch, max_peers=4,
+                          queue_capacity=num_pods + batch)
+    cluster, lat, bw = build_fake_cluster(
+        ClusterSpec(num_nodes=num_nodes, seed=0))
+    loop = SchedulerLoop(cluster, cfg, method="parallel")
+    loop.encoder.set_network(lat, bw)
+    feed_metrics(cluster, loop.encoder, np.random.default_rng(1))
+    pods = generate_workload(WorkloadSpec(num_pods=num_pods, seed=0),
+                             scheduler_name=cfg.scheduler_name)
+    cluster.add_pods(pods)
+    queued = loop.queue.pop_batch(len(pods), timeout=0.0)
+    t0 = time.perf_counter()
+    stream = pad_stream(
+        loop.encoder.encode_stream(queued, node_of=loop._peer_node),
+        cfg.max_pods)
+    encode_s = time.perf_counter() - t0
+    state = loop.encoder.snapshot()
+    return cfg, state, stream, encode_s, len(queued)
+
+
+def ablate(stream, what: str):
+    import jax.numpy as jnp
+
+    z = {}
+    if what in ("ns", "all"):
+        z["ns_term_used"] = jnp.zeros_like(stream.ns_term_used)
+        z["ns_num_col"] = jnp.full_like(stream.ns_num_col, -1)
+        z["ns_anyof"] = jnp.zeros_like(stream.ns_anyof)
+        z["ns_forbid"] = jnp.zeros_like(stream.ns_forbid)
+    if what in ("zone", "all"):
+        z["zaff_bits"] = jnp.zeros_like(stream.zaff_bits)
+        z["zanti_bits"] = jnp.zeros_like(stream.zanti_bits)
+    if what in ("soft", "all"):
+        z["soft_sel_bits"] = jnp.zeros_like(stream.soft_sel_bits)
+        z["soft_grp_bits"] = jnp.zeros_like(stream.soft_grp_bits)
+        z["soft_zone_bits"] = jnp.zeros_like(stream.soft_zone_bits)
+    if what in ("spread", "all"):
+        z["spread_maxskew"] = jnp.zeros_like(stream.spread_maxskew)
+    if what in ("affinity", "all"):
+        z["affinity_bits"] = jnp.zeros_like(stream.affinity_bits)
+        z["anti_bits"] = jnp.zeros_like(stream.anti_bits)
+        z["group_bit"] = jnp.zeros_like(stream.group_bit)
+    return dataclasses.replace(stream, **z)
+
+
+def time_replay(state, stream, cfg, label: str, reps: int = 3):
+    # compile
+    a, _, r = replay_stream(state, stream, cfg, "parallel",
+                            with_stats=True)
+    np.asarray(a)
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        a, _, r = replay_stream(state, stream, cfg, "parallel",
+                                with_stats=True)
+        np.asarray(a)
+        best = min(best, time.perf_counter() - t0)
+    rounds = np.asarray(r)
+    nb = stream.pod_valid.shape[0] // cfg.max_pods
+    print(f"{label:18s} wall {best:7.3f}s  per-batch "
+          f"{best / nb * 1e3:7.2f} ms  rounds p50/p99/max "
+          f"{np.percentile(rounds, 50):.0f}/"
+          f"{np.percentile(rounds, 99):.0f}/{rounds.max()}  "
+          f"bound {int((np.asarray(a) >= 0).sum())}")
+    return best
+
+
+def main():
+    nodes = int(sys.argv[1]) if len(sys.argv) > 1 else 5120
+    pods = int(sys.argv[2]) if len(sys.argv) > 2 else 8192
+    cfg, state, stream, encode_s, nq = build(nodes, pods)
+    print(f"N={nodes} pods={nq} encode {encode_s:.2f}s "
+          f"({nq / encode_s:.0f} pods/s host encode)")
+    base = time_replay(state, stream, cfg, "full")
+    for fam in ("ns", "zone", "soft", "spread", "affinity", "all"):
+        t = time_replay(state, ablate(stream, fam), cfg, f"-{fam}")
+        print(f"   {fam}: {100 * (base - t) / base:+.1f}% of full")
+
+
+if __name__ == "__main__":
+    main()

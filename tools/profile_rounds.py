@@ -1,0 +1,91 @@
+"""Per-batch (wall, rounds) regression for assign_parallel.
+
+Feeds the SAME generated workload as the density bench batch by batch
+through schedule_batch, timing each assign dispatch and reading its
+executed round count — the slope of wall-vs-rounds is the per-round
+cost, the intercept the fixed per-batch cost (s0 + static prep +
+dispatch).  Guides VERDICT r3 next-round #2/#4.
+
+Usage: python tools/profile_rounds.py [nodes] [pods] [batch]
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+from kubernetesnetawarescheduler_tpu.core.assign import (  # noqa: E402
+    assign_parallel,
+)
+from kubernetesnetawarescheduler_tpu.core.state import (  # noqa: E402
+    commit_assignments,
+)
+from tools.profile_density import build  # noqa: E402
+
+
+def main():
+    nodes = int(sys.argv[1]) if len(sys.argv) > 1 else 5120
+    pods = int(sys.argv[2]) if len(sys.argv) > 2 else 8192
+    batch = int(sys.argv[3]) if len(sys.argv) > 3 else 128
+    cfg, state, stream, _, nq = build(nodes, pods, batch)
+    import dataclasses
+
+    from kubernetesnetawarescheduler_tpu.core.replay import fold_stream
+    from kubernetesnetawarescheduler_tpu.core.state import PodBatch
+
+    folded = fold_stream(stream, cfg)
+    nb = stream.pod_valid.shape[0] // batch
+    batch_fields = {f.name for f in dataclasses.fields(PodBatch)}
+
+    def batch_at(i):
+        kw = {}
+        for name in batch_fields:
+            # PodBatch fields fold 1:1 from the stream except peers
+            # (peer_nodes resolves peer_pods placed earlier).
+            src = "peer_nodes" if name == "peers" else name
+            kw[name] = getattr(folded, src)[i] \
+                if hasattr(folded, src) else None
+        return PodBatch(**kw)
+
+    from kubernetesnetawarescheduler_tpu.core.replay import (
+        compute_assign_static,
+    )
+
+    static = compute_assign_static(state, cfg)
+    jax.block_until_ready(static)
+
+    samples = []
+    for i in range(nb):
+        pb = batch_at(i)
+        t0 = time.perf_counter()
+        assignment, rounds = assign_parallel(state, pb, cfg,
+                                             static=static,
+                                             with_stats=True)
+        assignment.block_until_ready()
+        dt = time.perf_counter() - t0
+        if i > 0:  # first call pays compile
+            samples.append((dt, int(rounds)))
+        state = commit_assignments(state, pb, assignment)
+        jax.block_until_ready(state.used)
+    walls = np.array([s[0] for s in samples])
+    rounds = np.array([s[1] for s in samples], float)
+    A = np.vstack([rounds, np.ones_like(rounds)]).T
+    (slope, intercept), *_ = np.linalg.lstsq(A, walls, rcond=None)
+    print(f"batches={len(samples)} rounds mean {rounds.mean():.1f} "
+          f"p50 {np.percentile(rounds, 50):.0f} "
+          f"p99 {np.percentile(rounds, 99):.0f} max {rounds.max():.0f}")
+    print(f"wall/batch mean {walls.mean() * 1e3:.2f} ms  "
+          f"per-round {slope * 1e3:.2f} ms  fixed {intercept * 1e3:.2f} ms")
+
+
+if __name__ == "__main__":
+    main()
