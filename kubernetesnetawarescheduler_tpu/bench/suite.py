@@ -557,6 +557,8 @@ def run_soft_affinity_config(out_dir: str | None = None,
                         soft_spread_fraction=0.3,
                         zones=ClusterSpec().zones, seed=seed)
     pods = generate_workload(spec, scheduler_name=cfg.scheduler_name)
+    state_initial = loop.encoder.snapshot()
+    free0 = np.asarray(state_initial.cap - state_initial.used).copy()
     wall = _drain(loop, pods)
 
     zones = {n.name: n.zone for n in loop.client.list_nodes()}
@@ -572,6 +574,39 @@ def run_soft_affinity_config(out_dir: str | None = None,
         want_zone = next(iter(labels)).split("=", 1)[1]
         if zones[node] == f"zone-{want_zone}":
             satisfied += 1
+
+    # Capacity-aware attainable optimum (VERDICT r3 next-round #6):
+    # replay the SUBMISSION order against the starting free capacity —
+    # a zone preference counts as attainable when, at that pod's turn
+    # (with every earlier pod's usage applied at its REAL node), the
+    # preferred zone still had a node that fits the pod.  The achieved
+    # rate divided by this is the honest soft-pull score: preferences
+    # whose zone was already full are not losses.
+    from kubernetesnetawarescheduler_tpu.core.encode import (
+        _requests_vector,
+    )
+
+    zone_of_idx: dict[int, str] = {}
+    for n in loop.client.list_nodes():
+        try:
+            zone_of_idx[loop.encoder.node_index(n.name)] = n.zone
+        except KeyError:
+            pass
+    free = free0
+    attainable = 0
+    for p in pods:
+        node = loop.client.node_of(p.name)
+        if not node:
+            continue
+        req = _requests_vector(p.requests, free.shape[1])
+        if p.soft_node_affinity:
+            (labels, _w), = p.soft_node_affinity
+            want = f"zone-{next(iter(labels)).split('=', 1)[1]}"
+            for idx, zone in zone_of_idx.items():
+                if zone == want and np.all(req <= free[idx] + 1e-6):
+                    attainable += 1
+                    break
+        free[loop.encoder.node_index(node)] -= req
 
     def _max_colocation(workload: Sequence[Pod], lp) -> float:
         """Mean over spread-preferring pods of same-group co-residents
@@ -611,6 +646,12 @@ def run_soft_affinity_config(out_dir: str | None = None,
         "zone_pref_satisfied": satisfied,
         "zone_pref_rate": round(satisfied / placed_prefer, 3)
         if placed_prefer else 0.0,
+        # Falsifiable bar: attainable optimum + achieved/attainable.
+        "zone_pref_attainable": attainable,
+        "zone_pref_optimum_rate": round(attainable / placed_prefer, 3)
+        if placed_prefer else 0.0,
+        "zone_pref_vs_optimum": round(satisfied / attainable, 3)
+        if attainable else 0.0,
         "spread_colocation": round(coloc, 3),
         "spread_colocation_control": round(coloc_control, 3),
         "violations_total": sum(viol.values()),
@@ -797,10 +838,38 @@ def run_sidecar_config(out_dir: str | None = None, num_nodes: int = 5120,
                                             scheduler_name=cfg.scheduler_name,
                                             seed=seed)
     wall_apps = _drain(loop, apps)
+    # Post-app free capacity snapshot: the basis of the ATTAINABLE
+    # co-placement optimum below (VERDICT r3 next-round #6 — an audit
+    # without a falsifiable bar cannot distinguish a capacity-bound
+    # 0.72 from a real loss).
+    state_after_apps = loop.encoder.snapshot()
+    free_after_apps = np.asarray(state_after_apps.cap
+                                 - state_after_apps.used).copy()
     wall_side = _drain(loop, sidecars)
 
     nodes = {n.name: n for n in loop.client.list_nodes()}
     co_node = co_rack = placed = 0
+    # Capacity-aware attainable optimum: walk the (app, sidecar) pairs
+    # in schedule order and greedily place each sidecar on its app's
+    # node whenever it still fits — the co-placement count a scheduler
+    # that cared about NOTHING else could reach given these app
+    # placements.  The real scheduler also balances load and serves
+    # whole batches at once, so rate/optimum is the honest score.
+    from kubernetesnetawarescheduler_tpu.core.encode import (
+        _requests_vector,
+    )
+
+    free = free_after_apps
+    attainable = 0
+    for app, side in zip(apps, sidecars):
+        an = loop.client.node_of(app.name)
+        if not an:
+            continue
+        ai = loop.encoder.node_index(an)
+        req = _requests_vector(side.requests, free.shape[1])
+        if np.all(req <= free[ai] + 1e-6):
+            attainable += 1
+            free[ai] -= req
     for app, side in zip(apps, sidecars):
         an = loop.client.node_of(app.name)
         sn = loop.client.node_of(side.name)
@@ -812,14 +881,21 @@ def run_sidecar_config(out_dir: str | None = None, num_nodes: int = 5120,
         if nodes[an].rack == nodes[sn].rack:
             co_rack += 1
     wall = wall_apps + wall_side
+    rate = round(co_node / placed, 4) if placed else 0.0
+    optimum = round(attainable / placed, 4) if placed else 0.0
     metrics = {
         "num_nodes": num_nodes,
         "apps": len(apps),
         "sidecar_pairs_placed": placed,
         "coplaced_same_node": co_node,
         "coplaced_same_rack": co_rack,
-        "coplacement_rate": round(co_node / placed, 4) if placed else 0.0,
+        "coplacement_rate": rate,
         "same_rack_rate": round(co_rack / placed, 4) if placed else 0.0,
+        # Falsifiable bar: attainable optimum + achieved/attainable.
+        "coplacement_attainable": attainable,
+        "coplacement_optimum_rate": optimum,
+        "coplacement_vs_optimum": round(co_node / attainable, 4)
+        if attainable else 0.0,
         "pods_per_sec": (round(loop.scheduled / wall, 1) if wall else 0.0),
     }
     artifacts = []

@@ -1612,6 +1612,15 @@ class Encoder:
          sgrp_r, sgrp_w_r, szone_r, szone_w_r, ns_any_r, ns_forb_r,
          ns_used_r, ns_ncol_r, ns_nlo_r, ns_nhi_r, zaff_r,
          zanti_r) = rows
+        # Rows the compute may have written, tracked EXPLICITLY (a
+        # superset is fine: untouched rows still hold the caller's
+        # defaults, so an extra copy is a no-op).  The previous
+        # ``r.any()`` sweep over all 19 rows cost ~30% of a rich-
+        # constraint stream encode (160k tiny-ndarray reductions per
+        # 10k pods) and, worse, the ns numeric rows' NON-zero defaults
+        # (-1 / ±inf) made every cache entry store-and-copy them even
+        # for pods with no nodeAffinity at all.
+        touched: list[int] = []
         # Capture the compute's INTENDED degradation count through the
         # explicit accumulator (deque-length arithmetic would read 0
         # once the bounded _degraded_pods is full, or when this pod's
@@ -1619,19 +1628,30 @@ class Encoder:
         self._degrade_capture = 0
         try:
             bits = self._constraint_bits(pod, lenient)
-            for row, val in zip((tol_r, sel_r, aff_r, anti_r, gbit_r),
-                                bits):
+            for j, (row, val) in enumerate(
+                    zip((tol_r, sel_r, aff_r, anti_r, gbit_r), bits)):
                 if val:  # rows are pre-zeroed; most masks are 0
                     _fill_words(row, val)
+                    touched.append(j)
             self._soft_rows(pod, ssel_r, ssel_w_r, sgrp_r, sgrp_w_r,
                             szone_r, szone_w_r)
+            if pod.soft_node_affinity:
+                touched += [5, 6]
+            if pod.soft_group_affinity:
+                touched += [7, 8]
+            if pod.soft_zone_affinity:
+                touched += [9, 10]
             self._ns_rows(pod, ns_any_r, ns_forb_r, ns_used_r,
                           ns_ncol_r, ns_nlo_r, ns_nhi_r, lenient)
+            if getattr(pod, "required_node_affinity", ()) or ():
+                touched += [11, 12, 13, 14, 15, 16]
             zb = self._zone_bits(pod, lenient)
             if zb[0]:
                 _fill_words(zaff_r, zb[0])
+                touched.append(17)
             if zb[1]:
                 _fill_words(zanti_r, zb[1])
+                touched.append(18)
             d_delta = self._degrade_capture
         finally:
             # A strict-mode raise must not leave the accumulator armed
@@ -1651,8 +1671,7 @@ class Encoder:
                 self._shape_cache.clear()
             self._shape_cache[key] = (
                 bits,
-                tuple((j, r.copy())
-                      for j, r in enumerate(rows) if r.any()),
+                tuple((j, rows[j].copy()) for j in touched),
                 d_delta)
         return bits
 

@@ -132,16 +132,24 @@ def net_cost_matrix(state: ClusterState, cfg: SchedulerConfig) -> jax.Array:
     return jnp.where(pair_valid, c, 0.0)
 
 
+def _use_bf16(cfg: SchedulerConfig) -> bool:
+    """bf16 compute only on TPU: the MXU's native format there, but
+    XLA CPU's DotThunk rejects BF16xBF16->F32 outright at some shapes
+    (and emulates it ~50x slower where it is supported) — the same
+    backend gate as ``state._plane_dtype``."""
+    return cfg.use_bfloat16 and jax.default_backend() == "tpu"
+
+
 def prep_net_matrix(c: jax.Array, cfg: SchedulerConfig) -> jax.Array:
-    """Transpose (and cast, in bf16 mode) the desirability matrix into
-    the layout the score matmul consumes.  At N=5k this touches 100 MB
-    — done once per replay/static-compute, NOT per batch: inside one
-    jitted scan XLA hoists it as loop-invariant, but a chunked/
-    pipelined drain dispatches many separate executables and would
-    otherwise re-transpose per chunk (measured ~2x per-batch cost on
-    the CPU fallback)."""
+    """Transpose (and cast, in bf16 mode on TPU) the desirability
+    matrix into the layout the score matmul consumes.  At N=5k this
+    touches 100 MB — done once per replay/static-compute, NOT per
+    batch: inside one jitted scan XLA hoists it as loop-invariant, but
+    a chunked/pipelined drain dispatches many separate executables and
+    would otherwise re-transpose per chunk (measured ~2x per-batch
+    cost on the CPU fallback)."""
     ct = c.T
-    return ct.astype(jnp.bfloat16) if cfg.use_bfloat16 else ct
+    return ct.astype(jnp.bfloat16) if _use_bf16(cfg) else ct
 
 
 def static_node_scores(state: ClusterState, cfg: SchedulerConfig
@@ -195,10 +203,14 @@ def network_scores(state: ClusterState, pods: PodBatch,
         out = "np" if transposed else "pn"
         return jnp.einsum(f"pk,pkn->{out}", traffic, rows)
     t = peer_traffic_matrix(pods, n)
-    if cfg.use_bfloat16:
+    if _use_bf16(cfg):
         # bf16 inputs, f32 accumulation: standard MXU recipe.
         net = jnp.dot(t.astype(jnp.bfloat16), ct,
                       preferred_element_type=jnp.float32)
+    elif cfg.use_bfloat16:
+        # bf16 requested but not on TPU: plain f32 matmul (the ct
+        # prep also stayed f32 — see _use_bf16).
+        net = jnp.dot(t, ct)
     else:
         # Full f32: on TPU the default matmul precision is bf16
         # passes, so ask for HIGHEST explicitly when exactness is

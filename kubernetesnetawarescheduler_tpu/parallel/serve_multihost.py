@@ -1,0 +1,206 @@
+"""Multi-host SERVING: a process-0 controller drives the global mesh.
+
+Lifts the round-3 restriction (``serve.py`` refused ``--multihost``
+with ``jax.process_count() > 1``; VERDICT r3 next-round #9).  The
+design keeps serving SINGLE-CONTROLLER — exactly one informer, queue,
+encoder and binder, all on process 0 — because independent control
+planes would watch divergent API-server streams and POST duplicate
+Bindings.  What is distributed is the COMPUTE: every process joins the
+same GSPMD score+assign step over the global ``(dp, tp)`` mesh, so the
+N×N network matrices' HBM and the scoring FLOPs split across hosts
+(ICI within a slice, DCN across; the collectives are XLA's).
+
+Protocol (all payloads move via
+``jax.experimental.multihost_utils.broadcast_one_to_all``, process 0
+sending):
+
+1. header ``i32[3] = (opcode, big_sync, seq)``
+2. ``OP_SYNC`` payloads, only when ``big_sync``: the topology-scale
+   state (N×N lat/bw, capacities, label/taint bits, zones) — re-sent
+   only when the encoder's static version moves (metrics/network
+   ingest, node lifecycle), never per cycle.
+3. the per-cycle payloads: the placement-mutable state columns
+   (``used``/``group_bits``/… — O(N), ~0.5 MB at N=5120) and the
+   encoded :class:`PodBatch`.
+4. every process runs the SAME jitted sharded assign; the replicated
+   assignment returns to the controller's binder.  Followers discard
+   it (their ledger is process 0's).
+
+``OP_STOP`` shuts followers down.  Followers block inside the header
+broadcast while the controller is idle — no polling, no heartbeat.
+
+The host ledger (process 0's encoder) stays the single source of
+truth, mirroring the single-process serving loop: device state is
+re-derived from broadcast snapshots each cycle, so bind failures,
+preemptions and node lifecycle never need distributed reconciliation.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from kubernetesnetawarescheduler_tpu.config import SchedulerConfig
+from kubernetesnetawarescheduler_tpu.core.state import (
+    ClusterState,
+    PodBatch,
+)
+
+OP_STEP = 0
+OP_STOP = 1
+
+# ClusterState leaves that change with topology/ingest cadence (the
+# static_version counter), broadcast only on OP_SYNC...
+BIG_FIELDS = ("lat", "bw", "cap", "label_bits", "taint_bits",
+              "node_zone", "node_numeric", "metrics", "metrics_age",
+              "node_valid")
+# ...vs the placement-mutable columns, broadcast every cycle.
+MUT_FIELDS = ("used", "group_bits", "resident_anti", "gz_counts",
+              "az_anti")
+
+
+def _bcast(tree):
+    from jax.experimental import multihost_utils
+
+    return multihost_utils.broadcast_one_to_all(tree)
+
+
+def _np_tree(tree):
+    return jax.tree_util.tree_map(np.asarray, tree)
+
+
+class MultihostController:
+    """Wraps the mesh-sharded assign callable with the broadcast
+    protocol.  Installed as ``loop._assign`` on process 0, so the
+    ordinary :class:`~...core.loop.SchedulerLoop` serving machinery
+    (informers, queue, binder, preemption, events) runs unchanged —
+    its score/assign dispatch just happens to be joined by every other
+    process."""
+
+    def __init__(self, cfg: SchedulerConfig, mesh, assign_fn) -> None:
+        self._cfg = cfg
+        self._mesh = mesh
+        self._assign_fn = assign_fn
+        # Last-synced BIG leaves, held by strong reference: the
+        # encoder's snapshot returns the SAME array objects while its
+        # dirty-group is clean, so identity comparison against the
+        # cycle's OWN state detects exactly the changes that cycle
+        # consumed.  (A separate version-counter read would race the
+        # ingest threads: a bump landing between the cycle's snapshot
+        # and the version read would be recorded as synced while its
+        # data was never broadcast — followers then diverge forever.)
+        self._synced_big: tuple | None = None
+        self._seq = 0
+
+    def __call__(self, state: ClusterState, pods: PodBatch, cfg=None):
+        big = tuple(getattr(state, f) for f in BIG_FIELDS)
+        big_sync = 0 if (self._synced_big is not None
+                         and all(a is b for a, b in
+                                 zip(big, self._synced_big))) else 1
+        self._seq += 1
+        _bcast(jnp.asarray([OP_STEP, big_sync, self._seq % (2 ** 31)],
+                           jnp.int32))
+        if big_sync:
+            _bcast(tuple(np.asarray(x) for x in big))
+            self._synced_big = big
+        _bcast(tuple(np.asarray(getattr(state, f))
+                     for f in MUT_FIELDS))
+        _bcast(_np_tree(pods))
+        return self._assign_fn(state, pods)
+
+    def stop(self) -> None:
+        _bcast(jnp.asarray([OP_STOP, 0, 0], jnp.int32))
+
+
+def install_controller(loop, cfg: SchedulerConfig, mesh) -> \
+        "MultihostController":
+    """Swap process 0's serving-loop assign for the broadcasting
+    controller (the loop was built with ``mesh=`` so ``loop._assign``
+    is already the sharded fn)."""
+    ctl = MultihostController(cfg, mesh, loop._assign)
+    loop._assign = ctl
+    # The extender webhook's sharded score path compiles over the
+    # GLOBAL mesh, but followers only join assign-step broadcasts — a
+    # webhook request would hang process 0 at its first cross-process
+    # collective (holding the batcher's dispatch lock, stranding every
+    # later request).  Webhook scoring therefore runs PROCESS-LOCAL
+    # (score_pods_auto fallback in api/extender._ScoreBatcher); only
+    # the scheduling cycle's assign is distributed.
+    loop.sharded_score = None
+    return ctl
+
+
+def run_follower(cfg: SchedulerConfig, mesh, method: str = "parallel",
+                 max_steps: int | None = None) -> int:
+    """Follower loop for processes 1..P-1: receive, assemble, join the
+    sharded step, repeat until OP_STOP.  Returns the step count."""
+    from kubernetesnetawarescheduler_tpu.parallel.sharding import (
+        sharded_assign_fn,
+    )
+
+    assign_fn = sharded_assign_fn(cfg, mesh, method)
+    big: dict[str, np.ndarray] = {}
+    # Broadcast SHAPE templates and the state skeleton are
+    # loop-invariant — built once, not per cycle (at N=5120 the
+    # ClusterState skeleton alone holds two ~100 MB N×N zero planes).
+    big_zeros = _big_zeros(cfg)
+    mut_zeros = _mut_zeros(cfg)
+    batch_zeros = _batch_zeros(cfg)
+    header_zeros = jnp.zeros((3,), jnp.int32)
+    from kubernetesnetawarescheduler_tpu.core.state import (
+        init_cluster_state,
+    )
+
+    template = init_cluster_state(cfg)
+    steps = 0
+    while max_steps is None or steps < max_steps:
+        header = np.asarray(_bcast(header_zeros))
+        if int(header[0]) == OP_STOP:
+            break
+        if int(header[1]):
+            vals = _bcast(big_zeros)
+            big = dict(zip(BIG_FIELDS, map(np.asarray, vals)))
+        mut = _bcast(mut_zeros)
+        batch_np = _bcast(batch_zeros)
+        state = dataclasses.replace(
+            template,
+            **{f: jnp.asarray(v) for f, v in big.items()},
+            **{f: jnp.asarray(np.asarray(v))
+               for f, v in zip(MUT_FIELDS, mut)})
+        pods = jax.tree_util.tree_map(jnp.asarray, batch_np)
+        assignment = assign_fn(state, pods)
+        jax.block_until_ready(assignment)
+        steps += 1
+    return steps
+
+
+def _big_zeros(cfg: SchedulerConfig):
+    """Zero-valued pytree with the BIG_FIELDS shapes (broadcast needs
+    identical structure on every process)."""
+    from kubernetesnetawarescheduler_tpu.core.state import (
+        init_cluster_state,
+    )
+
+    empty = init_cluster_state(cfg)
+    return tuple(np.asarray(getattr(empty, f)) for f in BIG_FIELDS)
+
+
+def _mut_zeros(cfg: SchedulerConfig):
+    from kubernetesnetawarescheduler_tpu.core.state import (
+        init_cluster_state,
+    )
+
+    empty = init_cluster_state(cfg)
+    return tuple(np.asarray(getattr(empty, f)) for f in MUT_FIELDS)
+
+
+def _batch_zeros(cfg: SchedulerConfig):
+    from kubernetesnetawarescheduler_tpu.core.state import (
+        init_pod_batch,
+    )
+
+    return _np_tree(init_pod_batch(cfg))

@@ -201,6 +201,23 @@ def _leaf_placer(shardings):
         shardings, is_leaf=lambda x: isinstance(x, NamedSharding))
     cache: dict[int, tuple] = {}
 
+    def _put(leaf, shard):
+        if jax.process_count() > 1:
+            # Multi-process: device_put runs a cross-process equality
+            # assert that compares with ``==`` — the NaN-sentinel
+            # ``node_numeric`` plane (NaN = label absent, fail-closed)
+            # is equal-by-bits on every process yet NaN != NaN, so the
+            # check aborts serving.  make_array_from_callback builds
+            # the global array straight from the (identical, broadcast
+            # -synchronized) host copy without the check — and without
+            # the check's allgather.
+            import numpy as _np
+
+            arr = _np.asarray(leaf)
+            return jax.make_array_from_callback(
+                arr.shape, shard, lambda idx: arr[idx])
+        return jax.device_put(leaf, shard)
+
     def place(tree):
         leaves, treedef = jax.tree_util.tree_flatten(tree)
         out = []
@@ -209,7 +226,7 @@ def _leaf_placer(shardings):
             if hit is not None and hit[0] is leaf:
                 out.append(hit[1])
             else:
-                y = jax.device_put(leaf, shard)
+                y = _put(leaf, shard)
                 cache[i] = (leaf, y)
                 out.append(y)
         return jax.tree_util.tree_unflatten(treedef, out)

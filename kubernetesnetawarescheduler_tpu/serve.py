@@ -138,12 +138,13 @@ def main(argv=None) -> int:
                     help="join the multi-process JAX runtime before "
                          "device init (TPU pods: coordinator "
                          "auto-detects from the environment); implies "
-                         "--mesh. SERVING supports exactly ONE "
-                         "process (it exits after distributed init if "
-                         "jax.process_count() > 1) — multi-process "
-                         "meshes are for the offline replay/bench "
-                         "paths (parallel.sharded_replay_stream). "
-                         "Bootstrap failures are fatal — see "
+                         "--mesh. Multi-process serving is "
+                         "single-CONTROLLER: process 0 runs the "
+                         "control plane and broadcasts each cycle to "
+                         "the other processes, which join the global-"
+                         "mesh compute as followers "
+                         "(parallel/serve_multihost.py). Bootstrap "
+                         "failures are fatal — see "
                          "parallel/multihost.py")
     ap.add_argument("--coordinator", default="",
                     help="explicit coordinator address for "
@@ -173,21 +174,30 @@ def main(argv=None) -> int:
                 coordinator_address=args.coordinator or None,
                 num_processes=args.num_processes,
                 process_id=args.process_id)
-        if jax.process_count() > 1:
-            # SERVING is single-controller: every process would run
-            # its own informer/queue/binder against divergent watch
-            # streams, feeding inconsistent "global" values into the
-            # SPMD kernels and POSTing duplicate Bindings.  The
-            # multi-PROCESS mesh is for the offline replay/bench
-            # paths (sharded_replay_stream — one controller, one
-            # input stream); serving shards over the chips of ONE
-            # process (the v5e-4 north-star shape) via this same
-            # flag.
-            ap.error(
-                "--multihost serving supports one process with many "
-                "local devices; multi-process meshes are for the "
-                "replay/bench paths (parallel.sharded_replay_stream)")
         mesh = global_mesh()
+        if jax.process_count() > 1 and jax.process_index() != 0:
+            # SERVING stays single-controller: exactly one informer,
+            # queue, encoder and binder (process 0) — independent
+            # control planes would watch divergent API-server streams
+            # and POST duplicate Bindings.  Every OTHER process joins
+            # the global-mesh compute as a follower: it receives each
+            # cycle's state/batch via broadcast and participates in
+            # the same GSPMD score+assign step, so the N×N matrices'
+            # HBM and the scoring FLOPs split across hosts
+            # (parallel/serve_multihost.py; VERDICT r3 next #9).
+            from kubernetesnetawarescheduler_tpu.parallel import (
+                serve_multihost,
+            )
+
+            cfg_f = (load_config(args.config) if args.config
+                     else SchedulerConfig())
+            print(f"multihost follower {jax.process_index()}/"
+                  f"{jax.process_count()} joining the mesh",
+                  file=sys.stderr)
+            steps = serve_multihost.run_follower(cfg_f, mesh)
+            print(f"multihost follower exiting after {steps} steps",
+                  file=sys.stderr)
+            return
 
     cfg = load_config(args.config) if args.config else SchedulerConfig()
 
@@ -363,6 +373,23 @@ def main(argv=None) -> int:
     for t in threads:
         t.start()
 
+    # Multi-process mesh: process 0 is the single controller; wrap its
+    # assign dispatch with the broadcast protocol that keeps follower
+    # processes joined to every sharded step (serve_multihost).
+    multihost_ctl = None
+    if mesh is not None:
+        import jax
+
+        if jax.process_count() > 1:
+            from kubernetesnetawarescheduler_tpu.parallel import (
+                serve_multihost,
+            )
+
+            multihost_ctl = serve_multihost.install_controller(
+                loop, cfg, mesh)
+            print(f"multihost controller driving "
+                  f"{jax.process_count()} processes", file=sys.stderr)
+
     # Main serving loop: drain any informer-fed queue work; extender-
     # path requests are served by the UDS/gRPC threads directly.
     # Every ~60s: resync pending pods (restart/drop recovery) and
@@ -391,6 +418,9 @@ def main(argv=None) -> int:
         uds.stop()
         if grpc_server is not None:
             grpc_server.stop(grace=1.0)
+        if multihost_ctl is not None:
+            # Release the followers blocked in their header broadcast.
+            multihost_ctl.stop()
     return 0
 
 

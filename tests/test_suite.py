@@ -75,6 +75,12 @@ def test_sidecar_config_coplaces():
     # app (loopback-pinned diagonal of the net-cost matrix).
     assert res.metrics["coplacement_rate"] >= 0.9
     assert res.metrics["same_rack_rate"] >= res.metrics["coplacement_rate"]
+    # Falsifiable bar (VERDICT r3 next #6): co-placement must track
+    # the capacity-aware attainable optimum — sidecar placement is
+    # pure network scoring (the app peer dwarfs every other term), so
+    # losses beyond capacity are real regressions.
+    assert res.metrics["coplacement_optimum_rate"] > 0
+    assert res.metrics["coplacement_vs_optimum"] >= 0.9, res.metrics
 
 
 @pytest.mark.parametrize("name", list(suite.CONFIGS))
@@ -94,6 +100,13 @@ def test_soft_affinity_config_biases_without_violating():
     # Soft push: spread-preferring pods co-locate less than the
     # control run with the term disabled.
     assert m["spread_colocation"] <= m["spread_colocation_control"]
+    # Falsifiable bar (VERDICT r3 next #6): achieved zone-pull vs the
+    # capacity-aware attainable optimum.  A PREFERENCE is a weighted
+    # bias competing with peers/balance/metric terms, so the floor is
+    # lower than the hard-constraint audits — it catches collapse,
+    # not legitimate trade-offs.
+    assert m["zone_pref_optimum_rate"] > 0
+    assert m["zone_pref_vs_optimum"] >= 0.6, m
 
 
 def test_spread_config_no_skew_violations():

@@ -1,0 +1,86 @@
+"""Host-encode throughput at rich-constraint shapes (VERDICT r3 #8).
+
+Measures encode_stream pods/s on a 10k-pod stream with EVERY
+constraint family active (peers, required/anti affinity, tolerations,
+soft zone/spread preferences, hard+soft topology spread, zone
+(anti-)affinity, nodeAffinity matchExpressions) — the shape where the
+per-pod Python interning loop would become the bottleneck at the
+north-star rate.  Reports cold (first-sight shapes) and warm
+(shape-cache hit) numbers and writes bench_artifacts/encode_profile.json.
+
+Usage: python tools/profile_encode.py [nodes] [pods]
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+from kubernetesnetawarescheduler_tpu.config import SchedulerConfig  # noqa: E402
+from kubernetesnetawarescheduler_tpu.core.loop import SchedulerLoop  # noqa: E402
+from kubernetesnetawarescheduler_tpu.core.state import round_up  # noqa: E402
+from kubernetesnetawarescheduler_tpu.bench.fakecluster import (  # noqa: E402
+    ClusterSpec,
+    WorkloadSpec,
+    build_fake_cluster,
+    feed_metrics,
+    generate_workload,
+)
+
+RICH = dict(peer_fraction=0.8, affinity_fraction=0.3, anti_fraction=0.3,
+            tolerate_fraction=0.3, soft_zone_fraction=0.4,
+            soft_spread_fraction=0.4, spread_fraction=0.5,
+            zone_aff_fraction=0.2, zone_anti_fraction=0.2,
+            ns_fraction=0.4)
+
+
+def main():
+    nodes = int(sys.argv[1]) if len(sys.argv) > 1 else 5120
+    pods = int(sys.argv[2]) if len(sys.argv) > 2 else 10240
+    cfg = SchedulerConfig(max_nodes=round_up(nodes, 128), max_pods=128,
+                          max_peers=4, queue_capacity=pods + 128)
+    cluster, lat, bw = build_fake_cluster(
+        ClusterSpec(num_nodes=nodes, seed=0))
+    loop = SchedulerLoop(cluster, cfg, method="parallel")
+    loop.encoder.set_network(lat, bw)
+    feed_metrics(cluster, loop.encoder, np.random.default_rng(1))
+
+    out = {"num_nodes": nodes, "num_pods": pods}
+    for label, spec_kw in (("default", {}), ("rich", RICH)):
+        workload = generate_workload(
+            WorkloadSpec(num_pods=pods, seed=3, **spec_kw),
+            scheduler_name=cfg.scheduler_name)
+        cluster.add_pods(workload)
+        queued = loop.queue.pop_batch(len(workload), timeout=0.0)
+        t0 = time.perf_counter()
+        loop.encoder.encode_stream(queued, node_of=loop._peer_node)
+        cold = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        loop.encoder.encode_stream(queued, node_of=loop._peer_node)
+        warm = time.perf_counter() - t0
+        out[label] = {
+            "cold_pods_per_sec": round(len(queued) / cold),
+            "warm_pods_per_sec": round(len(queued) / warm),
+            "cold_s": round(cold, 2), "warm_s": round(warm, 2),
+        }
+        print(f"{label:8s} cold {len(queued) / cold:8.0f} pods/s   "
+              f"warm {len(queued) / warm:8.0f} pods/s")
+    art = os.path.join(os.path.dirname(__file__), "..",
+                       "bench_artifacts", "encode_profile.json")
+    with open(art, "w") as f:
+        json.dump(out, f, indent=2)
+    print(f"wrote {os.path.normpath(art)}")
+
+
+if __name__ == "__main__":
+    main()
