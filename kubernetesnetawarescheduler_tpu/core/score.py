@@ -220,8 +220,13 @@ def network_scores(state: ClusterState, pods: PodBatch,
 
 
 def soft_affinity_scores(state: ClusterState, pods: PodBatch,
-                         cfg: SchedulerConfig) -> jax.Array:
-    """Weighted preferred-affinity score term ``f32[P, N]``.
+                         cfg: SchedulerConfig,
+                         transposed: bool = False) -> jax.Array:
+    """Weighted preferred-affinity score term ``f32[P, N]``
+    (``f32[N, P]`` with ``transposed=True`` — the dead branch then
+    materializes node-major zeros directly, so constraint-free
+    batches pay no per-batch transpose; the live banks transpose at
+    the seam, only when soft terms are actually present).
 
     The score-side counterpart of the hard masks in
     :func:`feasibility_mask` — ``preferredDuringSchedulingIgnoredDuring
@@ -270,17 +275,21 @@ def soft_affinity_scores(state: ClusterState, pods: PodBatch,
             jnp.where(group_match, pods.soft_grp_w[:, :, None], 0.0),
             axis=1)
         scale = jnp.float32(cfg.weights.soft_affinity / 100.0)
-        return scale * (label_term + group_term)
+        out = scale * (label_term + group_term)
+        return out.T if transposed else out
 
+    shape = (n, p) if transposed else (p, n)
     pred = (jnp.any(pods.soft_sel_bits != 0)
             | jnp.any(pods.soft_grp_bits != 0))
     bank = jax.lax.cond(pred, live,
-                        lambda _: jnp.zeros((p, n), jnp.float32), None)
-    return bank + soft_zone_scores(state, pods, cfg)
+                        lambda _: jnp.zeros(shape, jnp.float32), None)
+    return bank + soft_zone_scores(state, pods, cfg,
+                                   transposed=transposed)
 
 
 def soft_zone_scores(state: ClusterState, pods: PodBatch,
-                     cfg: SchedulerConfig) -> jax.Array:
+                     cfg: SchedulerConfig,
+                     transposed: bool = False) -> jax.Array:
     """Zone-scoped preferred pod (anti-)affinity term, ``f32[P, N]``:
     bonus ``w_t`` on nodes whose ZONE hosts a member of the term's
     group (``gz_counts`` presence, like the hard
@@ -309,10 +318,12 @@ def soft_zone_scores(state: ClusterState, pods: PodBatch,
                   & has_zone[None, None, :])                # [P, T, N]
         term = jnp.sum(
             jnp.where(zmatch, pods.soft_zone_w[:, :, None], 0.0), axis=1)
-        return jnp.float32(cfg.weights.soft_affinity / 100.0) * term
+        out = jnp.float32(cfg.weights.soft_affinity / 100.0) * term
+        return out.T if transposed else out
 
+    shape = (n, p) if transposed else (p, n)
     return jax.lax.cond(jnp.any(pods.soft_zone_bits != 0), live,
-                        lambda _: jnp.zeros((p, n), jnp.float32), None)
+                        lambda _: jnp.zeros(shape, jnp.float32), None)
 
 
 def spread_active(pods: PodBatch) -> jax.Array:
@@ -418,8 +429,12 @@ def balance_penalty(state: ClusterState, pods: PodBatch) -> jax.Array:
     return jnp.max(frac, axis=-1)
 
 
-def ns_affinity_ok(state: ClusterState, pods: PodBatch) -> jax.Array:
-    """Hard nodeAffinity matchExpressions mask, ``bool[P, N]``.
+def ns_affinity_ok(state: ClusterState, pods: PodBatch,
+                   transposed: bool = False) -> jax.Array:
+    """Hard nodeAffinity matchExpressions mask, ``bool[P, N]``
+    (``bool[N, P]`` with ``transposed=True``; the common no-terms
+    branch then materializes node-major ones directly — no transpose
+    pass).
 
     A pod passes a node when ANY of its OR'd ``nodeSelectorTerms``
     passes; a term passes when ALL its any-of expressions hit at least
@@ -470,10 +485,12 @@ def ns_affinity_ok(state: ClusterState, pods: PodBatch) -> jax.Array:
         term_ok = jax.lax.cond(jnp.any(pods.ns_num_col >= 0),
                                with_numeric, lambda t: t, term_ok)
         no_constraint = ~jnp.any(pods.ns_term_used, axis=1)
-        return no_constraint[:, None] | jnp.any(term_ok, axis=1)
+        out = no_constraint[:, None] | jnp.any(term_ok, axis=1)
+        return out.T if transposed else out
 
+    shape = (n, p) if transposed else (p, n)
     return jax.lax.cond(jnp.any(pods.ns_term_used), live,
-                        lambda _: jnp.ones((p, n), bool), None)
+                        lambda _: jnp.ones(shape, bool), None)
 
 
 def zone_affinity_ok(state: ClusterState, pods: PodBatch,
@@ -555,9 +572,9 @@ def static_feasibility_t(state: ClusterState, pods: PodBatch
     """:func:`static_feasibility` in node-major layout ``bool[N, P]``
     — built natively with swapped broadcast axes (no transpose pass)
     for the conflict loop's transposed carry.  The gated
-    ``ns_affinity_ok`` term keeps its pod-major internals and is
-    transposed at the seam (one cheap bool pass, zero when the gate is
-    closed and XLA folds the transpose of the broadcast ones)."""
+    ``ns_affinity_ok`` term keeps its pod-major internals and
+    transposes at the seam only when terms are PRESENT (its dead
+    branch emits node-major ones directly)."""
     tol = jnp.all(
         (state.taint_bits[:, None, :] & ~pods.tol_bits[None, :, :]) == 0,
         axis=-1)
@@ -565,7 +582,8 @@ def static_feasibility_t(state: ClusterState, pods: PodBatch
         (state.label_bits[:, None, :] & pods.sel_bits[None, :, :])
         == pods.sel_bits[None, :, :], axis=-1)
     return (tol & sel & state.node_valid[:, None]
-            & pods.pod_valid[None, :] & ns_affinity_ok(state, pods).T)
+            & pods.pod_valid[None, :]
+            & ns_affinity_ok(state, pods, transposed=True))
 
 
 def feasibility_mask(state: ClusterState, pods: PodBatch,
