@@ -331,9 +331,14 @@ def _run_density_device(cluster, loop: SchedulerLoop, pods, cfg,
     chunk_times: list[float] = []
     round_samples: list[int] = []
     if pipeline:
+        # Eager setup (static prep + stream upload + window dispatch)
+        # happens inside this CALL — after it, per-chunk samples time
+        # chunk service only; the setup still lands in the throughput
+        # wall above.
+        chunks = replay_stream_pipelined(state, stream, cfg, method,
+                                         chunk_batches)
         prev = time.perf_counter()
-        for pod_start, assignment, rounds in replay_stream_pipelined(
-                state, stream, cfg, method, chunk_batches):
+        for pod_start, assignment, rounds in chunks:
             round_samples.extend(int(r) for r in rounds)
             now = time.perf_counter()
             # Host-observed latency of this chunk (blocking fetch),

@@ -280,7 +280,15 @@ def replay_stream_pipelined(state: ClusterState, stream: PodStream,
     while a small window keeps the device >= ``window * chunk_batches``
     batches ahead — far more than it needs to never go idle.
     The final short chunk falls back to :func:`_replay_chunk` with a
-    smaller static ``chunk_batches`` (one extra compile, cached)."""
+    smaller static ``chunk_batches`` (one extra compile, cached).
+
+    SETUP IS EAGER (runs at call time, before the generator is
+    returned): the one-time static prep (~3 HBM passes over the N×N
+    matrix) and the whole-stream upload belong to replay startup, not
+    to chunk 0's latency — a live deployment pays them once per state
+    refresh, amortized.  Callers that time per-chunk service latency
+    should take their clock AFTER this call returns (bench/density.py
+    does; the one-time cost still lands in its throughput wall)."""
     static = compute_assign_static(state, cfg)
     s_total = stream.num_pods
     batch = cfg.max_pods
@@ -311,13 +319,18 @@ def replay_stream_pipelined(state: ClusterState, stream: PodStream,
 
     while start < nb and len(pending) < max(1, dispatch_window):
         dispatch_one()
-    while pending:
-        pod_start, assignment, rounds = pending.popleft()
-        if start < nb:
-            # Refill the window BEFORE the blocking fetch so the
-            # dispatch rides the transport ahead of the fetch request.
-            dispatch_one()
-        yield pod_start, np.asarray(assignment), np.asarray(rounds)
+
+    def drain():
+        while pending:
+            pod_start, assignment, rounds = pending.popleft()
+            if start < nb:
+                # Refill the window BEFORE the blocking fetch so the
+                # dispatch rides the transport ahead of the fetch
+                # request.
+                dispatch_one()
+            yield pod_start, np.asarray(assignment), np.asarray(rounds)
+
+    return drain()
 
 
 def pad_stream(stream: PodStream, multiple: int) -> PodStream:
