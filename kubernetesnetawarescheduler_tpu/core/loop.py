@@ -87,6 +87,19 @@ class SchedulerLoop:
             self.sharded_score = None
             self._assign = {"greedy": assign_greedy,
                             "parallel": assign_parallel}[method]
+            # Batch-invariant static prep cache (the same explicit
+            # (state, version) threading the extender batcher's
+            # _static_for uses): the O(N^2) metric-vote/network
+            # normalization depends only on metrics/network/validity —
+            # never on placements — so serving cycles reuse it until
+            # the encoder's static version moves.  Without this every
+            # watch-loop cycle re-derived ~3 HBM passes over the N x N
+            # matrix (tens of ms at N=5120 on the CPU fallback).
+            self._static_version: int | None = None
+            self._static_val = None
+        # The mesh serving fns keep their own leaf-placer transfer
+        # cache; only the plain path threads an explicit static pair.
+        self._assign_takes_static = mesh is None
         # is_parked keeps resync/watch re-deliveries of a preemptor
         # that is waiting for victim confirmation out of the queue —
         # scoring it early would drop its reservation and burn its
@@ -186,7 +199,13 @@ class SchedulerLoop:
             # whole batch's cycle down with it.
             batch = self.encoder.encode_pods(
                 pods, node_of=self._peer_node, lenient=True)
-            state = self.encoder.snapshot()
+            # Atomic (state, version) pair — a separate version read
+            # on either side of snapshot() can mispair them when an
+            # ingest thread dirties state in between (the same hazard
+            # the extender batcher documents), and the assign static
+            # cache would then serve stale normalizers against fresh
+            # state.
+            state, static_version = self.encoder.snapshot_versioned()
             # Name/generation table captured WITH the snapshot: the
             # bind path resolves indices against this table, so a slot
             # freed+reused mid-cycle binds to the old (gone) name —
@@ -195,11 +214,30 @@ class SchedulerLoop:
             node_table = self.encoder.node_table()
         self._emit_degraded_events()
         with self.timer.phase("score_assign"):
-            assignment = np.asarray(
-                jax_block(self._assign(state, batch, self.cfg)))
+            if self._assign_takes_static:
+                static = self._static_for(state, static_version)
+                assignment = np.asarray(
+                    jax_block(self._assign(state, batch, self.cfg,
+                                           static)))
+            else:
+                assignment = np.asarray(
+                    jax_block(self._assign(state, batch, self.cfg)))
         with self.timer.phase("bind"):
             bound = self._bind_all(pods, assignment, node_table)
         return bound
+
+    def _static_for(self, state, version: int):
+        """Version-keyed cache of the batch-invariant assign static
+        (see __init__); ``version`` must come from the SAME
+        ``snapshot_versioned`` call that produced ``state``."""
+        if self._static_version != version:
+            from kubernetesnetawarescheduler_tpu.core.pallas_score import (
+                compute_assign_static,
+            )
+
+            self._static_val = compute_assign_static(state, self.cfg)
+            self._static_version = version
+        return self._static_val
 
     def _emit_degraded_events(self) -> None:
         """Per-pod Warning events for constraint degradation on
