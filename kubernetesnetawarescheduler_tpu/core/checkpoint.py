@@ -50,21 +50,25 @@ _STATE_ARRAYS = (
     "_node_numeric",
 )
 
-# v2: constraint bitmask arrays widened to u32[N, mask_words]; raw
-# node-label sets persisted (lazy label interning needs them to
-# rebuild the reverse map on restore).
-# v3: topology-spread state (_node_zone/_gz_counts arrays, the zone
-# interner table, and per-record group_slot/zone).  v2 checkpoints
-# restore with empty spread state (counts rebuild as pods churn).
-# v4: zone-scoped anti-affinity residency (_az_anti words + per-record
-# zanti_bits).  Older checkpoints restore with it empty.
-# v5: labelSelector-parity groups — the selector-definition registry,
-# per-record full membership masks (member_bits) and pod labels (so
-# selectors registered after a restart can claim restored residents).
-# Pre-v5 records restore with member_bits=0; release paths fall back
-# to the legacy single group_bit.
-FORMAT_VERSION = 5
-_ACCEPTED_VERSIONS = (2, 3, 4, 5)
+# Format history (NONE of the pre-v6 formats load anymore — see
+# _ACCEPTED_VERSIONS; kept as a record of what each version added):
+# v2 widened constraint bitmasks to u32[N, mask_words] and persisted
+# raw node-label sets; v3 added topology-spread state; v4 zone-scoped
+# anti-affinity residency; v5 the labelSelector-parity registry with
+# per-record full membership masks and pod labels.  (The _rec() short-
+# entry tolerances below remain live for a different reason: ledger
+# ENTRIES may legitimately predate group tracking — the phantom-ref
+# behavior test_restore_rebuilds_group_refcounts pins.)
+# v6: namespace-scoped group keys (round 4) — selector-group and
+# annotation-group keys parsed from kube objects now carry the
+# namespace qualifier (kubeclient.NS_SEP).  Pre-v6 checkpoints hold
+# memberships under the old cluster-wide keys: restoring them into the
+# scoped parser would silently SPLIT each group across old/new keys
+# (old residents invisible to new pods' terms — anti-affinity would
+# degrade open without an event), so pre-v6 is REFUSED rather than
+# migrated; the ledger is reconstructable from the API server.
+FORMAT_VERSION = 6
+_ACCEPTED_VERSIONS = (6,)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -183,7 +187,14 @@ def save_checkpoint(path: str, encoder: Encoder) -> None:
                 for key, (ml, exprs)
                 in encoder._selector_defs.items()},
         }
-    np.savez_compressed(os.path.join(path, "state.npz"), **arrays)
+    # Atomic like meta.json below: a crash mid-savez must not leave a
+    # truncated state.npz beside a valid meta (np.load raises
+    # BadZipFile on the next start — a crash-looping daemon until an
+    # operator deletes the file).
+    tmp_npz = os.path.join(path, "state.npz.tmp")
+    with open(tmp_npz, "wb") as fh:
+        np.savez_compressed(fh, **arrays)
+    os.replace(tmp_npz, os.path.join(path, "state.npz"))
     tmp = os.path.join(path, "meta.json.tmp")
     with open(tmp, "w", encoding="utf-8") as fh:
         json.dump(meta, fh, indent=2)
@@ -199,7 +210,11 @@ def load_checkpoint(path: str,
         meta = json.load(fh)
     if meta.get("format_version") not in _ACCEPTED_VERSIONS:
         raise ValueError(
-            f"unsupported checkpoint format {meta.get('format_version')}")
+            f"unsupported checkpoint format "
+            f"{meta.get('format_version')} (this build reads "
+            f"{_ACCEPTED_VERSIONS}; pre-v6 group keys predate "
+            "namespace scoping and cannot be restored faithfully — "
+            "start fresh, the ledger rebuilds from the API server)")
     stored_cfg = config_from_dict(meta["config"])
     cfg = cfg or stored_cfg
     if (cfg.max_nodes, cfg.num_metrics, cfg.num_resources,
@@ -214,19 +229,10 @@ def load_checkpoint(path: str,
     with np.load(os.path.join(path, "state.npz")) as data:
         for name in _STATE_ARRAYS:
             if name.lstrip("_") not in data:
-                # Only a v2 checkpoint may legitimately lack the v3
-                # spread arrays; a v3 file missing them is corrupt and
-                # must fail loudly, not restore hard constraints
-                # against silently-empty counts.
-                if meta.get("format_version") == 2 and name in (
-                        "_node_zone", "_gz_counts"):
-                    continue
-                if meta.get("format_version", 0) <= 3 \
-                        and name == "_az_anti":
-                    continue
-                if meta.get("format_version", 0) <= 4 \
-                        and name == "_node_numeric":
-                    continue
+                # v6 writes every array; a file missing one is corrupt
+                # and must fail loudly, not restore hard constraints
+                # against silently-empty state.  (The pre-v6
+                # missing-array tolerances died with their versions.)
                 raise ValueError(
                     f"checkpoint state.npz is missing array {name!r}")
             stored = data[name.lstrip("_")]

@@ -242,12 +242,24 @@ def main(argv=None) -> int:
         from kubernetesnetawarescheduler_tpu.core.checkpoint import (
             load_checkpoint,
         )
-        restored = load_checkpoint(args.checkpoint_dir, cfg)
+        try:
+            restored = load_checkpoint(args.checkpoint_dir, cfg)
+        except Exception as exc:  # noqa: BLE001 — an incompatible
+            # (pre-v6 group keys) or corrupt checkpoint must not take
+            # the daemon down, whatever the parse failure raises
+            # (ValueError, BadZipFile from a truncated npz, KeyError
+            # from a gutted meta): the ledger is reconstructable from
+            # the API server — start fresh and say so.
+            restored = None
+            print(f"IGNORING checkpoint {args.checkpoint_dir}: "
+                  f"{type(exc).__name__}: {exc}", file=sys.stderr)
         # The checkpoint must describe THIS cluster: a node table that
         # diverges from the live registrations would silently schedule
         # onto a phantom subset and break ingest-by-name.  Shape checks
         # alone (load_checkpoint) cannot catch that.
-        if restored._node_names == loop.encoder._node_names:
+        if restored is None:
+            pass
+        elif restored._node_names == loop.encoder._node_names:
             loop.encoder = restored
             print(f"restored checkpoint from {args.checkpoint_dir}",
                   file=sys.stderr)

@@ -160,3 +160,57 @@ def test_restore_rebuilds_group_refcounts(tmp_path):
     # Phantom ref: the bit must NOT clear (members may predate the
     # ledger's group tracking).
     assert (words_to_int(enc3._group_bits[0]) & gbit)
+
+
+def test_namespaced_selector_defs_roundtrip(tmp_path):
+    """v6: namespace-scoped group keys contain a NUL separator
+    (kubeclient.NS_SEP) and their defs carry the reserved \\x00ns
+    In-expression — both must survive the JSON meta round-trip, and a
+    restored encoder must keep enforcing the scoped membership."""
+    from kubernetesnetawarescheduler_tpu.core.encode import (
+        Encoder,
+        selector_matches,
+    )
+    from kubernetesnetawarescheduler_tpu.k8s.kubeclient import (
+        pod_from_json,
+    )
+    from kubernetesnetawarescheduler_tpu.k8s.types import Node
+
+    cfg = SchedulerConfig(max_nodes=8, max_pods=4, max_peers=2)
+    enc = Encoder(cfg)
+    enc.upsert_node(Node(name="a", capacity={"cpu": 8.0, "mem": 16.0}))
+    resident = pod_from_json({
+        "metadata": {"name": "r", "namespace": "team-a",
+                     "labels": {"app": "db"}},
+        "spec": {"containers": [
+            {"resources": {"requests": {"cpu": "1"}}}]},
+    })
+    member = pod_from_json({
+        "metadata": {"name": "p", "namespace": "team-a",
+                     "labels": {"tier": "fe"}},
+        "spec": {
+            "containers": [{"resources": {"requests": {"cpu": "1"}}}],
+            "affinity": {"podAffinity": {
+                "requiredDuringSchedulingIgnoredDuringExecution": [
+                    {"topologyKey": "kubernetes.io/hostname",
+                     "labelSelector": {"matchLabels": {"app": "db"}}},
+                ]}},
+        },
+    })
+    (key,) = member.affinity_groups
+    assert "\x00/" in key  # namespace-qualified
+    enc.register_selectors(member.selector_defs, lenient=True)
+    enc.commit(resident, "a")
+
+    path = str(tmp_path / "ckpt")
+    save_checkpoint(path, enc)
+    enc2 = load_checkpoint(path, cfg)
+    assert key in enc2._selector_defs
+    sel = enc2._selector_defs[key]
+    assert selector_matches(sel, frozenset({"app=db",
+                                            "\x00ns=team-a"}))
+    assert not selector_matches(sel, frozenset({"app=db",
+                                                "\x00ns=team-b"}))
+    # The restored resident still carries the scoped membership bit.
+    bit = enc2.groups.bit(key, lenient=True)
+    assert bit and (enc2._committed[resident.uid].member_bits & bit)
