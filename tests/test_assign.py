@@ -212,3 +212,24 @@ def test_commit_updates_usage_and_groups():
             group[j] |= pods_np["group_bit"][i]
     np.testing.assert_allclose(np.asarray(new_state.used), used, atol=1e-5)
     np.testing.assert_array_equal(np.asarray(new_state.group_bits), group)
+
+
+def test_conflict_round_tail_stays_bounded():
+    """Regression guard for the conflict-round tail (VERDICT r3 next
+    #4): the multi-accept prefix + same-round second-chance pass keep
+    the round distribution flat.  Deterministic (fixed seeds, CPU
+    device replay).  At the headline bench shape the measured
+    distribution is p50 3 / p99 5; this CI shape runs the cluster
+    nearly FULL (2048 pods of ~2 cpu onto 512 nodes), where scraps
+    hunting legitimately costs more rounds — the bound here protects
+    against regressing to the pre-round-4 shape (p50 6+, max 25+ on
+    an OPEN cluster), not the headline number."""
+    from kubernetesnetawarescheduler_tpu.bench.density import (
+        run_density,
+    )
+
+    res = run_density(num_nodes=512, num_pods=2048, batch_size=128,
+                      method="parallel", mode="device")
+    assert res.pods_bound >= 2000
+    assert res.rounds_p50 <= 6, res.rounds_p50
+    assert res.rounds_max <= 18, res.rounds_max
