@@ -60,11 +60,11 @@ for cycle in range(2):
     if not batch_pods:
         break
     total += loop.schedule_pods(batch_pods)
-    # Reference: unsharded single-device assignment on the SAME state
-    # the cycle consumed must match what the mesh produced (the bind
-    # already committed, so re-derive against the pre-commit ledger by
-    # checking every bound pod's node is where the reference puts it
-    # — cheap proxy: all bound, none lost).
+    # Mid-stream ingest: bumps the encoder's static inputs so the NEXT
+    # cycle's snapshot returns fresh big-leaf objects — the
+    # controller's identity check must fire a second big_sync and the
+    # follower must absorb it (the r4 review's mispair scenario).
+    feed_metrics(cluster, loop.encoder, np.random.default_rng(42 + cycle))
 print(f"CONTROLLER_BOUND={total}", flush=True)
 ctl.stop()
 """
