@@ -100,6 +100,17 @@ class SchedulerLoop:
         # The mesh serving fns keep their own leaf-placer transfer
         # cache; only the plain path threads an explicit static pair.
         self._assign_takes_static = mesh is None
+        # Conflict-round samples from serving cycles (parallel method,
+        # one per batch) — the same observable the bench reports
+        # (rounds_p50/p99), exposed through /metrics so an operator
+        # sees round-bound latency without a replay harness.
+        from collections import deque
+
+        self.round_samples: deque = deque(maxlen=256)
+        # Appends happen on the serving thread while /metrics scrapes
+        # from the UDS/gRPC threads; iterating a deque mid-append
+        # raises RuntimeError, so both sides take this lock.
+        self._round_lock = threading.Lock()
         # is_parked keeps resync/watch re-deliveries of a preemptor
         # that is waiting for victim confirmation out of the queue —
         # scoring it early would drop its reservation and burn its
@@ -214,14 +225,22 @@ class SchedulerLoop:
             node_table = self.encoder.node_table()
         self._emit_degraded_events()
         with self.timer.phase("score_assign"):
+            stats = self.method == "parallel"
+            # assign_greedy has no with_stats parameter — pass the kw
+            # only when asking for it (stats implies parallel).
+            kw = {"with_stats": True} if stats else {}
             if self._assign_takes_static:
                 static = self._static_for(state, static_version)
-                assignment = np.asarray(
-                    jax_block(self._assign(state, batch, self.cfg,
-                                           static)))
+                out = self._assign(state, batch, self.cfg, static, **kw)
             else:
-                assignment = np.asarray(
-                    jax_block(self._assign(state, batch, self.cfg)))
+                out = self._assign(state, batch, self.cfg, **kw)
+            if stats:
+                assignment_dev, rounds = out
+                assignment = np.asarray(jax_block(assignment_dev))
+                with self._round_lock:
+                    self.round_samples.append(int(rounds))
+            else:
+                assignment = np.asarray(jax_block(out))
         with self.timer.phase("bind"):
             bound = self._bind_all(pods, assignment, node_table)
         return bound

@@ -96,7 +96,8 @@ class MultihostController:
         self._synced_big: tuple | None = None
         self._seq = 0
 
-    def __call__(self, state: ClusterState, pods: PodBatch, cfg=None):
+    def __call__(self, state: ClusterState, pods: PodBatch, cfg=None,
+                 *, with_stats: bool = False):
         big = tuple(getattr(state, f) for f in BIG_FIELDS)
         big_sync = 0 if (self._synced_big is not None
                          and all(a is b for a, b in
@@ -110,7 +111,11 @@ class MultihostController:
         _bcast(tuple(np.asarray(getattr(state, f))
                      for f in MUT_FIELDS))
         _bcast(_np_tree(pods))
-        return self._assign_fn(state, pods)
+        # Every process must run the SAME jitted program: followers
+        # derive with_stats from their own method (parallel <-> stats,
+        # mirroring SchedulerLoop), so forwarding the controller's
+        # request keeps the collective consistent.
+        return self._assign_fn(state, pods, with_stats=with_stats)
 
     def stop(self) -> None:
         _bcast(jnp.asarray([OP_STOP, 0, 0], jnp.int32))
@@ -172,8 +177,12 @@ def run_follower(cfg: SchedulerConfig, mesh, method: str = "parallel",
             **{f: jnp.asarray(np.asarray(v))
                for f, v in zip(MUT_FIELDS, mut)})
         pods = jax.tree_util.tree_map(jnp.asarray, batch_np)
-        assignment = assign_fn(state, pods)
-        jax.block_until_ready(assignment)
+        # Same program as the controller: parallel runs the stats
+        # variant (SchedulerLoop always asks for rounds with the
+        # parallel assigner); a divergent choice here would hang the
+        # cross-process collective on mismatched computations.
+        out = assign_fn(state, pods, with_stats=(method == "parallel"))
+        jax.block_until_ready(out)
         steps += 1
     return steps
 

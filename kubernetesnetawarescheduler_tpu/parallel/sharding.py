@@ -166,10 +166,24 @@ def sharded_assign_fn(cfg: SchedulerConfig, mesh: Mesh,
         in_shardings=(state_sharding(mesh), pods_sharding(mesh)),
         out_shardings=NamedSharding(mesh, P()),
     )
+    # Stats variant (parallel only): also returns the replicated
+    # conflict-round scalar, so mesh serving feeds the same
+    # netaware_conflict_rounds observable as the plain path.
+    jitted_stats = None
+    if method == "parallel":
+        jitted_stats = jax.jit(
+            partial(assign, cfg=_force_dense(cfg), with_stats=True),
+            in_shardings=(state_sharding(mesh), pods_sharding(mesh)),
+            out_shardings=(NamedSharding(mesh, P()),
+                           NamedSharding(mesh, P())),
+        )
     place_state = state_placer or _leaf_placer(state_sharding(mesh))
 
-    def fn(state, pods, cfg_arg=None):
-        return jitted(place_state(state), pods)
+    def fn(state, pods, cfg_arg=None, *, with_stats: bool = False):
+        placed = place_state(state)
+        if with_stats and jitted_stats is not None:
+            return jitted_stats(placed, pods)
+        return jitted(placed, pods)
 
     return fn
 

@@ -97,6 +97,30 @@ def render_metrics(loop) -> str:
                 float(batcher.requests),
                 "Webhook score requests (filter+prioritize)")
 
+    # Conflict-round distribution over recent serving cycles (one
+    # sample per batch, parallel assigner): whether score latency is
+    # matmul-bound or round-bound — the bench's rounds_p50/p99, live.
+    round_lock = getattr(loop, "_round_lock", None)
+    if round_lock is not None:
+        with round_lock:
+            # Snapshot under the lock: the serving thread appends
+            # while this scrape iterates, and a deque mutated during
+            # iteration raises (intermittent 500s on /metrics).
+            rounds = np.asarray(tuple(loop.round_samples), dtype=float)
+    else:
+        rounds = np.zeros((0,))
+    if rounds.size:
+        lines.append("# HELP netaware_conflict_rounds Conflict-"
+                     "resolution rounds per scheduled batch")
+        lines.append("# TYPE netaware_conflict_rounds summary")
+        for q in _QUANTILES:
+            lines.append(
+                f'netaware_conflict_rounds{{quantile="{q:g}"}} '
+                f"{_fmt(float(np.quantile(rounds, q)))}")
+        lines.append(
+            f"netaware_conflict_rounds_sum {_fmt(float(rounds.sum()))}")
+        lines.append(f"netaware_conflict_rounds_count {rounds.size}")
+
     # Metric staleness distribution over ready nodes — the quantity the
     # exp(-age/tau) decay consumes.
     lines.append("# HELP netaware_metric_staleness_seconds Age of each "

@@ -108,3 +108,56 @@ def test_two_process_controller_follower(tmp_path):
     steps = int(fol_out.split("FOLLOWER_STEPS=")[1].split()[0])
     assert bound == 12, f"controller bound {bound} of 12"
     assert steps >= 1, "follower never joined a step"
+
+
+_SERVE_WORKER = r"""
+import sys
+import jax
+jax.config.update("jax_platforms", "cpu")
+pid = int(sys.argv[1])
+port = sys.argv[2]
+from kubernetesnetawarescheduler_tpu import serve
+rc = serve.main([
+    "--cluster", "fake:16", "--once",
+    "--uds", f"/tmp/mh-serve-{pid}.sock",
+    "--probe-period-s", "0",
+    "--multihost", "--coordinator", f"127.0.0.1:{port}",
+    "--num-processes", "2", "--process-id", str(pid),
+])
+print(f"SERVE_RC={rc or 0}", flush=True)
+"""
+
+
+def test_serve_main_two_process_wiring(tmp_path):
+    """End-to-end ``serve.main --multihost`` on two real processes:
+    process 0 builds the full daemon (fake cluster, UDS server,
+    controller install) and exits after one cycle, broadcasting
+    OP_STOP from its shutdown path; process 1 takes the follower
+    branch and must exit cleanly on that stop — covering the serve.py
+    wiring the protocol-level test above bypasses."""
+    port = _free_port()
+    script = tmp_path / "serve_worker.py"
+    script.write_text(_SERVE_WORKER)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    procs = [subprocess.Popen(
+        [sys.executable, str(script), str(i), str(port)],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        cwd=repo, env=env) for i in (0, 1)]
+    outs = []
+    for p in procs:
+        try:
+            out, err = p.communicate(timeout=210)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        outs.append((p.returncode, out.decode(), err.decode()))
+    for i, (rc, out, err) in enumerate(outs):
+        assert rc == 0, f"process {i} failed: {err[-800:]}"
+        assert "SERVE_RC=0" in out
+    assert "multihost controller driving 2 processes" in outs[0][2]
+    assert "multihost follower exiting" in outs[1][2]
