@@ -180,7 +180,8 @@ def _run_density_device(cluster, loop: SchedulerLoop, pods, cfg,
     fastest when per-dispatch latency is high (tunneled chips).
 
     ``pipeline=True`` — chunked replay with an async bind worker: all
-    chunks dispatched eagerly (the scan carry threads the dependency),
+    chunks dispatched ahead through a bounded window (the scan carry
+    threads the dependency),
     each chunk's assignments bound while the device runs later chunks —
     the async binding-cycle shape kube-scheduler itself uses, vs the
     reference's fully synchronous cycle (scheduler.go:189-237).  Wins

@@ -351,8 +351,8 @@ def commit_assignments(state: ClusterState, pods: PodBatch,
     onehot = placed[:, None] & (
         assignment[:, None] == jnp.arange(state.num_nodes)[None, :])
     # Zone-scoped symmetric anti-affinity: OR each placed pod's
-    # zanti_bits into its landing ZONE's row.  Several winners can
-    # share a zone (unlike nodes, which take one winner per round), so
+    # zanti_bits into its landing ZONE's row.  Several placed pods can
+    # share a zone (and, via the multi-accept prefix, even a node), so
     # this must be an OR-reduction over a [P, Z] one-hot, not a
     # scatter-set; pods on zone-less nodes drop out (their "zone" is
     # the node itself — the hostname machinery already covers it).
