@@ -138,7 +138,12 @@ def main() -> None:
         f.write(str(os.getpid()))
     _log_probe(True, note="watcher started (pid %d)" % os.getpid())
     lock_f = open(os.path.join(ART, "chip.lock"), "w")
-    while True:
+    # Self-expire: rounds hand off to fresh builders (and fresh
+    # watchers); a forgotten watcher from a previous round must not
+    # accumulate as a zombie prober forever.
+    deadline = time.time() + float(os.environ.get("WATCH_MAX_S",
+                                                  str(24 * 3600)))
+    while time.time() < deadline:
         if _driver_active():
             _log_probe(False, note="driver active; watcher yielding")
             time.sleep(PROBE_INTERVAL_S)
