@@ -20,8 +20,15 @@ Legs, in cost order:
 from __future__ import annotations
 
 import json
+import os
 import sys
 import time
+
+# Invoked as ``python tools/tpu_legs.py``, so sys.path[0] is tools/ —
+# put the repo root first so the package (and __graft_entry__) import.
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
 
 
 def _require_tpu():
@@ -43,7 +50,6 @@ def leg_probe() -> dict:
 
 def leg_compile() -> dict:
     jax = _require_tpu()
-    sys.path.insert(0, ".")
     import __graft_entry__
 
     fn, args = __graft_entry__.entry()
@@ -125,7 +131,6 @@ def leg_density_small() -> dict:
 def leg_density_full() -> dict:
     """The headline bench at full shape, via bench.py itself so the
     persisted artifact has the exact schema the driver records."""
-    import os
     import subprocess
 
     env = dict(os.environ)
@@ -164,8 +169,6 @@ def _git_sha() -> str:
 
 
 def main() -> None:
-    import os
-
     leg = sys.argv[1]
     t0 = time.perf_counter()
     try:

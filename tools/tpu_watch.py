@@ -141,8 +141,11 @@ def main() -> None:
     # Self-expire: rounds hand off to fresh builders (and fresh
     # watchers); a forgotten watcher from a previous round must not
     # accumulate as a zombie prober forever.
-    deadline = time.time() + float(os.environ.get("WATCH_MAX_S",
-                                                  str(24 * 3600)))
+    try:
+        max_s = float(os.environ.get("WATCH_MAX_S", ""))
+    except ValueError:
+        max_s = 24 * 3600
+    deadline = time.time() + max_s
     while time.time() < deadline:
         if _driver_active():
             _log_probe(False, note="driver active; watcher yielding")
@@ -173,6 +176,13 @@ def main() -> None:
                 fcntl.flock(lock_f, fcntl.LOCK_UN)
         all_green = all(_leg_ok(leg) for leg in LEG_ORDER)
         time.sleep(REFRESH_INTERVAL_S if all_green else PROBE_INTERVAL_S)
+    # Expiry is part of the probe record, not a silent stop — and the
+    # pidfile contract (docstring) must not point at a recycled PID.
+    _log_probe(True, note="watcher expired (pid %d)" % os.getpid())
+    try:
+        os.unlink(os.path.join(ART, "watch.pid"))
+    except OSError:
+        pass
 
 
 if __name__ == "__main__":
