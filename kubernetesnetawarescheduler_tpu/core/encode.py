@@ -1803,6 +1803,33 @@ class Encoder:
         """Encode a whole workload for the device-resident replay
         (:func:`~kubernetesnetawarescheduler_tpu.core.replay.replay_stream`).
 
+        One-shot form of :meth:`encode_stream_chunks` — a single chunk
+        spanning the whole workload, field-for-field identical to the
+        chunked pass."""
+        return next(self.encode_stream_chunks(
+            pods, node_of, chunk_pods=max(len(pods), 1),
+            lenient=lenient))
+
+    def encode_stream_chunks(self, pods: Sequence[Pod],
+                             node_of: Callable[[str], str],
+                             chunk_pods: int,
+                             lenient: bool = False):
+        """ONE encode pass over the workload, yielded as
+        :class:`PodStream` chunks of ``chunk_pods`` pods (the final
+        chunk shorter; one empty chunk for an empty workload).
+
+        The chunked pass and :meth:`encode_stream` are field-for-field
+        equal: peer stream indices are GLOBAL (the index space covers
+        the whole workload, so peers crossing chunk boundaries resolve
+        identically), and the first-pod-escape ``granted`` set persists
+        across chunks.  The encoder lock is held per chunk rather than
+        across the pass, so a concurrent binder can interleave
+        ``commit_many`` between chunks (the overlapped pipeline drain
+        in bench/density.py) instead of stalling until the whole
+        workload is encoded — safe because commits only ADD committed
+        group members, which the escape already sees through
+        ``granted`` for every in-stream pod.
+
         Unlike :meth:`encode_pods`, peers naming pods *within this
         stream* are kept as stream indices (resolved on device against
         the replay's own assignments); peers already placed resolve to
@@ -1870,73 +1897,93 @@ class Encoder:
         # the same earlier-pods-bind approximation the peer-slot logic
         # uses).
         granted: set[int] = set()
-        with self._lock:
-            for i, pod in enumerate(pods):
-                _fill_requests_row(req[i], pod.requests, res_names)
-                slot = 0
-                for peer_name, vol in pod.peers.items():
-                    if slot >= k:
-                        break
-                    j = stream_index.get(peer_name)
-                    if j is not None:
-                        if j // batch >= i // batch:
-                            # Same/later batch: unresolvable at scoring
-                            # time, exactly as the host loop sees it —
-                            # don't burn a slot.
-                            continue
-                        peer_pods[i, slot] = j
-                    else:
-                        peer_node = node_of(peer_name)
-                        idx = (self._node_index.get(peer_node)
-                               if peer_node else None)
-                        if idx is None:
-                            continue
-                        peer_nodes[i, slot] = idx
-                    traffic[i, slot] = vol
-                    slot += 1
-                bits = self._pod_constraint_rows(pod, lenient, (
-                    tol[i], sel[i], aff[i], anti[i], gbit[i],
-                    ssel[i], ssel_w[i], sgrp[i], sgrp_w[i],
-                    szone[i], szone_w[i], ns_any[i], ns_forb[i],
-                    ns_used[i], ns_ncol[i], ns_nlo[i], ns_nhi[i],
-                    zaff[i], zanti[i]))
-                self._apply_first_pod_escape(aff[i], zaff[i], gbit[i],
-                                             granted)
-                m = words_to_int(gbit[i])
-                while m:
-                    b = m & -m
-                    m ^= b
-                    granted.add(b.bit_length() - 1)
-                gidx[i] = self._spread_slot(pod)
-                sp_skew[i] = int(getattr(pod, "spread_maxskew", 0))
-                sp_hard[i] = bool(getattr(pod, "spread_hard", True))
-                if sp_skew[i] > 0 and gidx[i] < 0:
-                    # A spread constraint with no countable group is
-                    # inert — a DoNotSchedule pod would silently
-                    # schedule anywhere.  Flag it like every other
-                    # constraint degradation.
-                    self._record_degraded(pod, 1)
-                prio[i] = pod.priority
-                valid[i] = True
-        return PodStream(
-            req=jnp.asarray(req), peer_pods=jnp.asarray(peer_pods),
-            peer_nodes=jnp.asarray(peer_nodes),
-            peer_traffic=jnp.asarray(traffic), tol_bits=jnp.asarray(tol),
-            sel_bits=jnp.asarray(sel), affinity_bits=jnp.asarray(aff),
-            anti_bits=jnp.asarray(anti), group_bit=jnp.asarray(gbit),
-            priority=jnp.asarray(prio), pod_valid=jnp.asarray(valid),
-            soft_sel_bits=jnp.asarray(ssel), soft_sel_w=jnp.asarray(ssel_w),
-            soft_grp_bits=jnp.asarray(sgrp), soft_grp_w=jnp.asarray(sgrp_w),
-            soft_zone_bits=jnp.asarray(szone),
-            soft_zone_w=jnp.asarray(szone_w),
-            group_idx=jnp.asarray(gidx),
-            spread_maxskew=jnp.asarray(sp_skew),
-            spread_hard=jnp.asarray(sp_hard),
-            ns_anyof=jnp.asarray(ns_any),
-            ns_forbid=jnp.asarray(ns_forb),
-            ns_term_used=jnp.asarray(ns_used),
-            ns_num_col=jnp.asarray(ns_ncol),
-            ns_num_lo=jnp.asarray(ns_nlo),
-            ns_num_hi=jnp.asarray(ns_nhi),
-            zaff_bits=jnp.asarray(zaff),
-            zanti_bits=jnp.asarray(zanti))
+        if chunk_pods < 1:
+            raise ValueError(f"chunk_pods must be >= 1, got {chunk_pods}")
+
+        def _slice(a: int, b: int) -> PodStream:
+            return PodStream(
+                req=jnp.asarray(req[a:b]),
+                peer_pods=jnp.asarray(peer_pods[a:b]),
+                peer_nodes=jnp.asarray(peer_nodes[a:b]),
+                peer_traffic=jnp.asarray(traffic[a:b]),
+                tol_bits=jnp.asarray(tol[a:b]),
+                sel_bits=jnp.asarray(sel[a:b]),
+                affinity_bits=jnp.asarray(aff[a:b]),
+                anti_bits=jnp.asarray(anti[a:b]),
+                group_bit=jnp.asarray(gbit[a:b]),
+                priority=jnp.asarray(prio[a:b]),
+                pod_valid=jnp.asarray(valid[a:b]),
+                soft_sel_bits=jnp.asarray(ssel[a:b]),
+                soft_sel_w=jnp.asarray(ssel_w[a:b]),
+                soft_grp_bits=jnp.asarray(sgrp[a:b]),
+                soft_grp_w=jnp.asarray(sgrp_w[a:b]),
+                soft_zone_bits=jnp.asarray(szone[a:b]),
+                soft_zone_w=jnp.asarray(szone_w[a:b]),
+                group_idx=jnp.asarray(gidx[a:b]),
+                spread_maxskew=jnp.asarray(sp_skew[a:b]),
+                spread_hard=jnp.asarray(sp_hard[a:b]),
+                ns_anyof=jnp.asarray(ns_any[a:b]),
+                ns_forbid=jnp.asarray(ns_forb[a:b]),
+                ns_term_used=jnp.asarray(ns_used[a:b]),
+                ns_num_col=jnp.asarray(ns_ncol[a:b]),
+                ns_num_lo=jnp.asarray(ns_nlo[a:b]),
+                ns_num_hi=jnp.asarray(ns_nhi[a:b]),
+                zaff_bits=jnp.asarray(zaff[a:b]),
+                zanti_bits=jnp.asarray(zanti[a:b]))
+
+        pos = 0
+        while True:
+            end = min(pos + chunk_pods, s)
+            with self._lock:
+                for i in range(pos, end):
+                    pod = pods[i]
+                    _fill_requests_row(req[i], pod.requests, res_names)
+                    slot = 0
+                    for peer_name, vol in pod.peers.items():
+                        if slot >= k:
+                            break
+                        j = stream_index.get(peer_name)
+                        if j is not None:
+                            if j // batch >= i // batch:
+                                # Same/later batch: unresolvable at
+                                # scoring time, exactly as the host
+                                # loop sees it — don't burn a slot.
+                                continue
+                            peer_pods[i, slot] = j
+                        else:
+                            peer_node = node_of(peer_name)
+                            idx = (self._node_index.get(peer_node)
+                                   if peer_node else None)
+                            if idx is None:
+                                continue
+                            peer_nodes[i, slot] = idx
+                        traffic[i, slot] = vol
+                        slot += 1
+                    self._pod_constraint_rows(pod, lenient, (
+                        tol[i], sel[i], aff[i], anti[i], gbit[i],
+                        ssel[i], ssel_w[i], sgrp[i], sgrp_w[i],
+                        szone[i], szone_w[i], ns_any[i], ns_forb[i],
+                        ns_used[i], ns_ncol[i], ns_nlo[i], ns_nhi[i],
+                        zaff[i], zanti[i]))
+                    self._apply_first_pod_escape(aff[i], zaff[i],
+                                                 gbit[i], granted)
+                    m = words_to_int(gbit[i])
+                    while m:
+                        b = m & -m
+                        m ^= b
+                        granted.add(b.bit_length() - 1)
+                    gidx[i] = self._spread_slot(pod)
+                    sp_skew[i] = int(getattr(pod, "spread_maxskew", 0))
+                    sp_hard[i] = bool(getattr(pod, "spread_hard", True))
+                    if sp_skew[i] > 0 and gidx[i] < 0:
+                        # A spread constraint with no countable group
+                        # is inert — a DoNotSchedule pod would
+                        # silently schedule anywhere.  Flag it like
+                        # every other constraint degradation.
+                        self._record_degraded(pod, 1)
+                    prio[i] = pod.priority
+                    valid[i] = True
+            yield _slice(pos, end)
+            pos = end
+            if pos >= s:
+                return

@@ -304,33 +304,166 @@ def replay_stream_pipelined(state: ClusterState, stream: PodStream,
              state.gz_counts, state.az_anti,
              jnp.full((s_total,), UNASSIGNED, jnp.int32))
 
-    from collections import deque
-    pending: deque = deque()
-    start = 0
+    start_box = [0]
 
     def dispatch_one():
-        nonlocal carry, start
+        nonlocal carry
+        start = start_box[0]
+        if start >= nb:
+            return False
         cb = min(chunk_batches, nb - start)
         carry, assignment, rounds = _replay_chunk(
             state, static, carry, folded, jnp.int32(start), s_total,
             cfg, method, cb)
-        pending.append((start * batch, assignment, rounds))
-        start += cb
+        start_box[0] = start + cb
+        return start * batch, assignment, rounds
 
-    while start < nb and len(pending) < max(1, dispatch_window):
-        dispatch_one()
+    return _windowed_drain(dispatch_one, dispatch_window)
+
+
+@partial(jax.jit, static_argnames=("cfg", "method"))
+def _replay_chunk_feed(state: ClusterState, static, carry, chunk_folded,
+                       batch_ids: jax.Array, cfg: SchedulerConfig,
+                       method: str):
+    """One chunk of the feed-based pipelined replay: like
+    :func:`_replay_chunk` but the chunk's stream slice arrives as its
+    own ``[cb, batch, ...]`` pytree (uploaded per chunk by the encode
+    producer) instead of being dynamic-sliced out of a device-resident
+    whole-stream copy.  ``batch_ids`` are the chunk's global batch
+    indices (traced, so every equal-length chunk shares one
+    executable; the final short chunk compiles once more)."""
+    s_total = carry[-1].shape[0]
+    step = _make_step(state, cfg, method, s_total, static)
+    carry, (assignments, rounds) = jax.lax.scan(
+        step, carry, (batch_ids, chunk_folded))
+    return carry, assignments.reshape(-1), rounds
+
+
+def _prefetch_to_host(*arrays) -> None:
+    """Start async device→host copies so the later ``np.asarray`` finds
+    the data already in flight — on a remote/tunneled chip this hides
+    most of the per-chunk transport behind the compute of later
+    chunks.  Best-effort: backends without the method just skip."""
+    for a in arrays:
+        fn = getattr(a, "copy_to_host_async", None)
+        if fn is not None:
+            try:
+                fn()
+            except Exception:  # noqa: BLE001 — purely an optimization
+                return
+
+
+def _windowed_drain(dispatch_next, dispatch_window: int,
+                    prefetch: bool = True):
+    """The dispatch-window scaffolding shared by both pipelined
+    replays: keep up to ``dispatch_window`` chunks in flight (JAX's
+    async dispatch queues them with the carry threading the data
+    dependency), refilling BEFORE each blocking fetch so the next
+    dispatch rides the transport ahead of the fetch request — and, in
+    the feed variant, so the encode producer keeps running ahead.
+
+    ``dispatch_next()`` dispatches one chunk and returns
+    ``(pod_start, assignment, rounds)`` device handles, or ``False``
+    once the stream is exhausted.  The initial window fill happens
+    HERE, eagerly at call time (the "setup is eager" contract both
+    variants document); the returned generator then yields
+    ``(pod_start, np.ndarray assignment, np.ndarray rounds)`` in
+    stream order."""
+    from collections import deque
+    pending: deque = deque()
+
+    def refill() -> bool:
+        item = dispatch_next()
+        if item is False:
+            return False
+        if prefetch:
+            _prefetch_to_host(item[1], item[2])
+        pending.append(item)
+        return True
+
+    while len(pending) < max(1, dispatch_window) and refill():
+        pass
 
     def drain():
         while pending:
             pod_start, assignment, rounds = pending.popleft()
-            if start < nb:
-                # Refill the window BEFORE the blocking fetch so the
-                # dispatch rides the transport ahead of the fetch
-                # request.
-                dispatch_one()
+            if len(pending) < max(1, dispatch_window):
+                refill()
             yield pod_start, np.asarray(assignment), np.asarray(rounds)
 
     return drain()
+
+
+def replay_stream_pipelined_feed(state: ClusterState, chunk_iter,
+                                 s_total: int, cfg: SchedulerConfig,
+                                 method: str = "parallel",
+                                 dispatch_window: int = 4,
+                                 prefetch: bool = True):
+    """Pipelined replay fed by an encode producer: consumes
+    :class:`PodStream` chunks from ``chunk_iter`` (each a multiple of
+    ``cfg.max_pods`` pods except the last, concatenating to
+    ``s_total``) and yields ``(start_pod_index, assignment, rounds)``
+    per chunk, in order — the same contract as
+    :func:`replay_stream_pipelined`.
+
+    The difference is WHERE the stream comes from: the whole-stream
+    variant needs the workload fully encoded and uploaded before the
+    first dispatch, so at the bench's headline shape the host spends
+    seconds encoding while the device sits idle.  Here the host encode
+    (Encoder.encode_stream_chunks on a producer thread) overlaps the
+    device drain — chunk ``i+window`` is being encoded while chunk
+    ``i`` computes and chunk ``i-1`` binds, collapsing the wall clock
+    from ``encode + replay`` to ``max(encode, replay)``.
+
+    SETUP IS EAGER, matching the whole-stream variant: the static prep
+    AND the initial window fill (blocking on the producer for the
+    first ``dispatch_window`` chunks, dispatching each) run at call
+    time, so a caller timing per-chunk service latency after this call
+    returns never charges the encode ramp-up to chunk 0's sample.
+
+    ``prefetch`` starts async device→host copies at dispatch time
+    (see :func:`_prefetch_to_host`)."""
+    static = compute_assign_static(state, cfg)
+    batch = cfg.max_pods
+    if s_total % batch != 0:
+        raise ValueError(
+            f"stream length {s_total} not a multiple of max_pods={batch}")
+    nb = s_total // batch
+    carry = (state.used, state.group_bits, state.resident_anti,
+             state.gz_counts, state.az_anti,
+             jnp.full((s_total,), UNASSIGNED, jnp.int32))
+
+    it = iter(chunk_iter)
+    start_box = [0]
+
+    def dispatch_next():
+        nonlocal carry
+        start = start_box[0]
+        try:
+            ch = next(it)
+        except StopIteration:
+            if start != nb:
+                raise ValueError(
+                    f"chunk iterator ended at batch {start} of {nb}")
+            return False
+        cp = ch.num_pods
+        if cp % batch != 0 or cp == 0:
+            raise ValueError(
+                f"chunk of {cp} pods is not a positive multiple of "
+                f"max_pods={batch}")
+        cb = cp // batch
+        if start + cb > nb:
+            raise ValueError(
+                f"chunks overrun s_total={s_total} at batch {start}+{cb}")
+        folded = jax.tree_util.tree_map(
+            lambda x: x.reshape((cb, batch) + x.shape[1:]), ch)
+        ids = jnp.arange(start, start + cb, dtype=jnp.int32)
+        carry, assignment, rounds = _replay_chunk_feed(
+            state, static, carry, folded, ids, cfg, method)
+        start_box[0] = start + cb
+        return start * batch, assignment, rounds
+
+    return _windowed_drain(dispatch_next, dispatch_window, prefetch)
 
 
 def pad_stream(stream: PodStream, multiple: int) -> PodStream:

@@ -1,0 +1,125 @@
+"""The overlapped (feed-based) pipeline must change WHERE work happens,
+never WHAT comes out.
+
+Three equivalences pin it:
+
+1. ``Encoder.encode_stream_chunks`` concatenated == one-shot
+   ``encode_stream``, field for field, for chunk sizes from 1 pod to
+   larger-than-the-workload — the global peer index space and the
+   first-pod-escape ``granted`` continuity survive chunking.
+2. ``replay_stream_pipelined_feed`` == monolithic ``replay_stream``
+   assignments on a constraint-rich instance whose peers cross chunk
+   boundaries.
+3. ``run_density(mode="pipeline")`` binds the identical set of pods
+   with encode overlap forced ON and forced OFF.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+
+from kubernetesnetawarescheduler_tpu.bench.density import run_density
+from kubernetesnetawarescheduler_tpu.bench.fakecluster import (
+    ClusterSpec,
+    WorkloadSpec,
+    build_fake_cluster,
+    feed_metrics,
+    generate_workload,
+)
+from kubernetesnetawarescheduler_tpu.config import SchedulerConfig
+from kubernetesnetawarescheduler_tpu.core.loop import SchedulerLoop
+from kubernetesnetawarescheduler_tpu.core.replay import (
+    pad_stream,
+    replay_stream,
+    replay_stream_pipelined_feed,
+)
+
+RICH = dict(services=12, peer_fraction=0.7, affinity_fraction=0.2,
+            anti_fraction=0.15, tolerate_fraction=0.1,
+            soft_zone_fraction=0.2, soft_spread_fraction=0.2,
+            spread_fraction=0.25, zone_aff_fraction=0.15)
+
+
+def _loop_and_queue(num_pods=200, batch=16):
+    cfg = SchedulerConfig(max_nodes=128, max_pods=batch, max_peers=4,
+                          queue_capacity=num_pods + batch)
+    cluster, lat, bw = build_fake_cluster(ClusterSpec(num_nodes=96, seed=7))
+    loop = SchedulerLoop(cluster, cfg, method="parallel")
+    loop.encoder.set_network(lat, bw)
+    feed_metrics(cluster, loop.encoder, np.random.default_rng(8))
+    pods = generate_workload(WorkloadSpec(num_pods=num_pods, seed=9, **RICH),
+                             scheduler_name=cfg.scheduler_name)
+    cluster.add_pods(pods)
+    queued = loop.queue.pop_batch(num_pods, timeout=0.0)
+    return cfg, loop, queued
+
+
+def _tree_np(stream):
+    return jax.tree_util.tree_map(np.asarray, stream)
+
+
+def test_encode_stream_chunks_equals_one_shot():
+    cfg, loop, queued = _loop_and_queue()
+    one = _tree_np(loop.encoder.encode_stream(queued,
+                                              node_of=loop._peer_node))
+    fields = list(one.__dataclass_fields__)
+    # 1 pod/chunk (maximum lock churn), a batch-aligned size, a
+    # non-divisor size, and larger-than-the-workload (single chunk).
+    for chunk_pods in (1, 48, 56, 10_000):
+        chunks = list(loop.encoder.encode_stream_chunks(
+            queued, node_of=loop._peer_node, chunk_pods=chunk_pods))
+        assert sum(c.num_pods for c in chunks) == len(queued)
+        for f in fields:
+            got = np.concatenate(
+                [np.asarray(getattr(c, f)) for c in chunks])
+            want = np.asarray(getattr(one, f))
+            assert np.array_equal(got, want), (
+                f"chunk_pods={chunk_pods}: field {f} differs")
+
+
+def test_encode_stream_chunks_empty_workload():
+    cfg, loop, _ = _loop_and_queue(num_pods=16)
+    chunks = list(loop.encoder.encode_stream_chunks(
+        [], node_of=lambda n: "", chunk_pods=4))
+    assert len(chunks) == 1
+    assert chunks[0].num_pods == 0
+
+
+def test_feed_replay_equals_monolithic():
+    cfg, loop, queued = _loop_and_queue()
+    stream = pad_stream(
+        loop.encoder.encode_stream(queued, node_of=loop._peer_node),
+        cfg.max_pods)
+    state = loop.encoder.snapshot()
+    want = np.asarray(replay_stream(state, stream, cfg, "parallel")[0])
+
+    # Feed the SAME pass chunked (3 batches per chunk; 200 pods at
+    # batch 16 -> chunks of 48 pods, final short chunk padded), with
+    # peers crossing every chunk boundary (peer_fraction=0.7).
+    chunks = [
+        pad_stream(c, cfg.max_pods)
+        for c in loop.encoder.encode_stream_chunks(
+            queued, node_of=loop._peer_node,
+            chunk_pods=3 * cfg.max_pods)
+    ]
+    got = np.full(stream.num_pods, -9, np.int32)
+    for s0, a, rounds in replay_stream_pipelined_feed(
+            state, iter(chunks), stream.num_pods, cfg, "parallel"):
+        got[s0:s0 + len(a)] = a
+        assert len(rounds) * cfg.max_pods == len(a)
+    assert np.array_equal(got, want)
+
+
+def test_density_pipeline_overlap_matches_serial(monkeypatch):
+    results = {}
+    for ov in ("0", "1"):
+        monkeypatch.setenv("BENCH_ENCODE_OVERLAP", ov)
+        r = run_density(num_nodes=32, num_pods=120, batch_size=16,
+                        method="parallel", mode="pipeline",
+                        chunk_batches=2, seed=11)
+        results[ov] = r
+    assert results["0"].pods_bound == results["1"].pods_bound
+    assert results["0"].pods_unschedulable == \
+        results["1"].pods_unschedulable
