@@ -96,13 +96,16 @@ class WorkloadSpec:
     netbw_range: tuple[float, float] = (0.05, 2.0)
 
 
-def build_fake_cluster(spec: ClusterSpec) -> tuple[FakeCluster, np.ndarray,
-                                                   np.ndarray]:
+def build_fake_cluster(spec: ClusterSpec, client_cls=FakeCluster,
+                       **client_kw) -> tuple[FakeCluster, np.ndarray,
+                                             np.ndarray]:
     """Create a populated :class:`FakeCluster` plus its ground-truth
     ``(lat_ms, bw_bps)`` matrices (what a perfect probe pipeline would
-    measure)."""
+    measure).  ``client_cls``/``client_kw`` let tests swap in a
+    fault-injecting subclass or an emulated API RTT
+    (``bind_latency_s``)."""
     rng = np.random.default_rng(spec.seed)
-    cluster = FakeCluster()
+    cluster = client_cls(**client_kw)
     n = spec.num_nodes
     zones = np.arange(n) % spec.zones
     racks = (np.arange(n) // spec.zones) % spec.racks_per_zone

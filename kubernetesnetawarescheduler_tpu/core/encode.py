@@ -884,7 +884,8 @@ class Encoder:
                                   rec.zanti_bits)
             self._dirty["alloc"] = True
 
-    def release(self, pod: Pod, node_name: str = "") -> None:
+    def release(self, pod: Pod, node_name: str = "",
+                rollback: bool = False) -> None:
         """Reverse this pod's commit (pod deletion/completion).
 
         Ledger-driven: the subtraction uses the committed record, not
@@ -894,13 +895,23 @@ class Encoder:
         :meth:`commit_many`.  Group/anti bits are refcounted per
         (node, bit): the bit clears when the LAST member pod leaves —
         without this, a node that ever hosted group ``g`` would block
-        anti-``g`` pods forever."""
+        anti-``g`` pods forever.
+
+        ``rollback=True`` is the assume-then-bind undo: release the
+        commit if it still exists, but NEVER plant an early-release
+        marker — the marker guards deletion-beats-commit races, and a
+        rollback whose record was already removed (node scale-down
+        deleted it directly) planting one would silently cancel the
+        pod's next legitimate commit after a requeue, leaving a
+        running pod's usage unaccounted forever."""
         with self._lock:
             if self._nominations:
                 self._drop_nomination_locked(pod.uid)
             self._terminating.discard(pod.uid)
             rec = self._committed.pop(pod.uid, None)
             if rec is None:
+                if rollback:
+                    return
                 self._early_releases[pod.uid] = None
                 if len(self._early_releases) > 4096:
                     # Bound stray markers (e.g. a pod whose bind failed

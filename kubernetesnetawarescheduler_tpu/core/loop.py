@@ -41,10 +41,22 @@ class SchedulerLoop:
 
     def __init__(self, client: ClusterClient, cfg: SchedulerConfig,
                  method: str = "parallel", decision_log=None,
-                 encoder: Encoder | None = None, mesh=None) -> None:
+                 encoder: Encoder | None = None, mesh=None,
+                 async_bind: bool = False) -> None:
         self.cfg = cfg
         self.client = client
         self.method = method
+        # Assume-then-bind (kube-scheduler's own cache pattern): the
+        # cycle commits usage to the encoder IMMEDIATELY after the
+        # kernel decides ("assume") and hands the network bind to a
+        # worker thread, so the next cycle's snapshot sees the
+        # placements without waiting a bind_many round-trip.  A bind
+        # that the API server later rejects is rolled back via the
+        # ledger-driven encoder.release.  Off by default: the
+        # synchronous cycle is the reference's shape
+        # (scheduler.go:196-233) and what most tests pin; serve.py
+        # enables it via --async-bind / config.
+        self.async_bind = async_bind
         # Optional core.checkpoint.DecisionLog: records the kernel's
         # choice per pod (node or "" for unschedulable) at decision
         # time, the replayable record behind restart-determinism.
@@ -104,7 +116,30 @@ class SchedulerLoop:
         # one per batch) — the same observable the bench reports
         # (rounds_p50/p99), exposed through /metrics so an operator
         # sees round-bound latency without a replay harness.
+        import queue as queue_mod
         from collections import deque
+
+        self._bind_q: queue_mod.Queue | None = None
+        self._bind_worker: threading.Thread | None = None
+        self._bind_worker_err: list[BaseException] = []
+        # Uids assumed by THIS process (duplicate-delivery filter for
+        # the assume path).  Deliberately not the encoder ledger: a
+        # restored checkpoint could, after an unclean shutdown, carry
+        # a committed-but-never-bound pod, and filtering on the ledger
+        # would drop its re-delivery before the network forever — the
+        # sync path heals exactly that case via bind + commit dedup,
+        # and with a process-local set the assume path does too.
+        # Mutated from the cycle thread (add) and the bind worker
+        # (discard on rollback); both are GIL-atomic set ops.
+        self._assumed_uids: set[str] = set()
+        if async_bind:
+            # Bounded: a dead/slow API server must apply backpressure
+            # to the cycle, not buffer unbounded assumed state.
+            self._bind_q = queue_mod.Queue(maxsize=8)
+            self._bind_worker = threading.Thread(
+                target=self._bind_worker_main, daemon=True,
+                name="bind-worker")
+            self._bind_worker.start()
 
         self.round_samples: deque = deque(maxlen=256)
         # Appends happen on the serving thread while /metrics scrapes
@@ -156,6 +191,8 @@ class SchedulerLoop:
 
     def _on_pod_gone(self, pod: Pod) -> None:
         self._preempt_attempts.pop(pod.uid, None)
+        # Keep the assume-dedup set bounded by live-pod lifetime.
+        self._assumed_uids.discard(pod.uid)
         # A deleted preemptor abandons its reservation and wait.
         with self._preempt_lock:
             if self._awaiting_preemption.pop(pod.uid, None) is not None:
@@ -242,7 +279,11 @@ class SchedulerLoop:
             else:
                 assignment = np.asarray(jax_block(out))
         with self.timer.phase("bind"):
-            bound = self._bind_all(pods, assignment, node_table)
+            if self.async_bind:
+                bound = self._assume_and_enqueue(pods, assignment,
+                                                 node_table)
+            else:
+                bound = self._bind_all(pods, assignment, node_table)
         return bound
 
     def _static_for(self, state, version: int):
@@ -398,7 +439,17 @@ class SchedulerLoop:
             node_table = self.encoder.node_table()
         table_names, table_gens = node_table
         events: list = []
+        bindable, node_idxs, names = self._plan_bind(
+            pods, assignment, table_names, events, comp)
+        return self._finish_bind(bindable, node_idxs, names, table_gens,
+                                 events, comp, assumed=None)
 
+    def _plan_bind(self, pods: Sequence[Pod], assignment: np.ndarray,
+                   table_names: list, events: list, comp: str):
+        """Network-free half of a bind pass: per-pod decision-log
+        entries, the preemption/unschedulable path for kernel
+        rejections, and the (pod, node index, node name) triples worth
+        sending to the API server."""
         bindable: list[Pod] = []
         node_idxs: list[int] = []
         names: list[str] = []
@@ -419,7 +470,19 @@ class SchedulerLoop:
             bindable.append(pod)
             node_idxs.append(idx)
             names.append(name)
+        return bindable, node_idxs, names
 
+    def _finish_bind(self, bindable: list, node_idxs: list, names: list,
+                     table_gens: list, events: list, comp: str,
+                     assumed: set | None) -> int:
+        """Network half of a bind pass: ``bind_many`` plus per-pod
+        outcome handling.  ``assumed is None`` is the synchronous
+        cycle — successes are committed here (generation-guarded).
+        Otherwise ``assumed`` holds the uids whose usage the cycle
+        already committed at assume time: successes need no commit,
+        and every failure of an assumed pod ROLLS BACK via the
+        ledger-driven ``encoder.release`` before the usual
+        event/requeue handling."""
         outcomes = self.client.bind_many([
             Binding(pod_name=pod.name, namespace=pod.namespace,
                     node_name=name)
@@ -442,12 +505,15 @@ class SchedulerLoop:
                 where = (self._bound_where(pod)
                          if isinstance(exc, ValueError) else None)
                 if where == name:
-                    if self.encoder.is_committed(pod.uid):
+                    if assumed is None and \
+                            self.encoder.is_committed(pod.uid):
                         # Duplicate delivery of a pod we already bound
                         # AND accounted: healing it again would inflate
                         # the scheduled counter and emit a second
                         # "Scheduled" event (commit_many dedups the
-                        # ledger, but counters/events are not idempotent).
+                        # ledger, but counters/events are not
+                        # idempotent).  The assume path filters
+                        # duplicates before the network instead.
                         continue
                     ok_pods.append(pod)
                     ok_idxs.append(idx)
@@ -459,16 +525,19 @@ class SchedulerLoop:
                     # transient so the retry re-checks once the cache
                     # catches up, instead of dropping a pod that may
                     # be running on the node we chose.
+                    self._rollback_assumed(pod, name, assumed)
                     self._requeue_transient(pod, exc, events, comp)
                     continue
                 # Permanent rejection (pod gone / bound elsewhere):
                 # event + drop, batch continues.
+                self._rollback_assumed(pod, name, assumed)
                 self.bind_failures += 1
                 events.append(failed_event(
                     pod, comp, f"bind rejected: {exc}"))
             else:
                 # Transient API error: requeue with a retry budget
                 # instead of stranding the pod as Pending forever.
+                self._rollback_assumed(pod, name, assumed)
                 self._requeue_transient(pod, exc, events, comp)
 
         if self._bind_retries:
@@ -477,25 +546,127 @@ class SchedulerLoop:
         if self._preempt_attempts:
             for pod in ok_pods:
                 self._preempt_attempts.pop(pod.uid, None)
-        # Drop commits whose slot was freed (and possibly reused) since
-        # the snapshot: the node is gone, its pods are being garbage-
-        # collected, and booking usage onto the slot's new tenant would
-        # corrupt accounting.
-        fresh = [(pod, idx) for pod, idx in zip(ok_pods, ok_idxs)
-                 if self.encoder.slot_generation(idx) == table_gens[idx]]
-        self.encoder.commit_many([p for p, _ in fresh],
-                                 [i for _, i in fresh])
+        if assumed is None:
+            # Drop commits whose slot was freed (and possibly reused)
+            # since the snapshot: the node is gone, its pods are being
+            # garbage-collected, and booking usage onto the slot's new
+            # tenant would corrupt accounting.
+            fresh = [(pod, idx) for pod, idx in zip(ok_pods, ok_idxs)
+                     if self.encoder.slot_generation(idx) ==
+                     table_gens[idx]]
+            self.encoder.commit_many([p for p, _ in fresh],
+                                     [i for _, i in fresh])
         self.client.create_events(events)
         self.scheduled += len(ok_pods)
         return len(ok_pods)
 
+    def _rollback_assumed(self, pod: Pod, name: str,
+                          assumed: set | None) -> None:
+        """Reverse an assume-time commit for a pod whose bind failed
+        (assume-then-bind cycle only; no-op for the sync path and for
+        pods that were never assumed, e.g. stale-generation slots).
+        ``rollback=True``: if the record is already gone (node removal
+        raced the bind), do NOT plant an early-release marker — it
+        would cancel the pod's next commit after the requeue."""
+        if assumed is not None and pod.uid in assumed:
+            self._assumed_uids.discard(pod.uid)
+            self.encoder.release(pod, name, rollback=True)
+
+    def _assume_and_enqueue(self, pods: Sequence[Pod],
+                            assignment: np.ndarray,
+                            node_table: tuple[list[str], list[int]]
+                            ) -> int:
+        """Assume-then-bind cycle tail (kube's cache pattern): commit
+        fresh placements into the encoder NOW so the next cycle's
+        snapshot sees them, then queue the network half for the bind
+        worker.  Returns the number of pods assumed; bind
+        confirmations update ``scheduled`` asynchronously
+        (``flush_binds`` drains)."""
+        if self._bind_worker_err:
+            raise self._bind_worker_err[0]
+        comp = self.cfg.scheduler_name
+        table_names, table_gens = node_table
+        events: list = []
+        bindable, node_idxs, names = self._plan_bind(
+            pods, assignment, table_names, events, comp)
+        keep: list[tuple[Pod, int, str]] = []
+        for pod, idx, name in zip(bindable, node_idxs, names):
+            if pod.uid in self._assumed_uids:
+                # Duplicate queue delivery of a pod THIS process
+                # already assumed: the sync path heals this on the
+                # 409; here it can be dropped before the network even
+                # sees it.  (Process-local on purpose — see __init__.)
+                continue
+            keep.append((pod, idx, name))
+        fresh = [(pod, idx) for pod, idx, _ in keep
+                 if self.encoder.slot_generation(idx) == table_gens[idx]]
+        self.encoder.commit_many([p for p, _ in fresh],
+                                 [i for _, i in fresh])
+        assumed = {p.uid for p, _ in fresh}
+        self._assumed_uids |= assumed
+        self._bind_q.put(([p for p, _, _ in keep],
+                          [i for _, i, _ in keep],
+                          [n for _, _, n in keep],
+                          table_gens, events, comp, assumed))
+        return len(fresh)
+
+    def _bind_worker_main(self) -> None:
+        while True:
+            item = self._bind_q.get()
+            if item is None:
+                self._bind_q.task_done()
+                return
+            try:
+                keep_p, keep_i, keep_n, gens, events, comp, assumed = \
+                    item
+                with self.timer.phase("bind_net"):
+                    self._finish_bind(keep_p, keep_i, keep_n, gens,
+                                      events, comp, assumed)
+            except BaseException as exc:  # noqa: BLE001 — surfaced on
+                # the next cycle / flush; a dead worker must fail the
+                # serving loop loudly, not strand assumed pods.
+                self._bind_worker_err.append(exc)
+            finally:
+                self._bind_q.task_done()
+
+    def flush_binds(self, timeout: float | None = None) -> None:
+        """Block until every queued bind batch has been processed
+        (assume-then-bind mode; no-op otherwise), then re-raise the
+        first worker error if one occurred.  Call before reading
+        bind-dependent state (checkpoints, tests, shutdown)."""
+        if self._bind_q is None:
+            return
+        deadline = (None if timeout is None
+                    else time.monotonic() + timeout)
+        while self._bind_q.unfinished_tasks:
+            if deadline is not None and time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"bind queue not drained within {timeout}s")
+            time.sleep(0.002)
+        if self._bind_worker_err:
+            raise self._bind_worker_err[0]
+
+    def stop_bind_worker(self, timeout: float | None = 30.0) -> None:
+        """Drain outstanding binds and stop the worker (shutdown
+        path; the loop cannot schedule in async mode afterwards)."""
+        if self._bind_q is None:
+            return
+        self.flush_binds(timeout)
+        self._bind_q.put(None)
+        self._bind_worker.join(timeout)
+
     def run_until_drained(self, max_cycles: int = 10_000) -> int:
-        """Drain the queue; returns total pods bound."""
+        """Drain the queue; returns total pods bound (assume-then-bind
+        mode: total pods assumed, with all binds flushed)."""
         total = 0
         for _ in range(max_cycles):
             n = self.run_once(timeout=0.0)
             if n == 0 and len(self.queue) == 0:
-                break
+                # The bind worker may still requeue transient failures
+                # — only an empty queue AFTER a flush is drained.
+                self.flush_binds()
+                if len(self.queue) == 0:
+                    break
             total += n
         return total
 

@@ -54,7 +54,7 @@ def _agent_reachable(host: str, port: int, timeout_s: float = 3.0) -> bool:
 
 
 def build_fake(num_nodes: int, seed: int, cfg: SchedulerConfig,
-               mesh=None):
+               mesh=None, async_bind: bool = False):
     from kubernetesnetawarescheduler_tpu.bench.fakecluster import (
         ClusterSpec,
         build_fake_cluster,
@@ -66,7 +66,7 @@ def build_fake(num_nodes: int, seed: int, cfg: SchedulerConfig,
 
     cluster, lat, bw = build_fake_cluster(
         ClusterSpec(num_nodes=num_nodes, seed=seed))
-    loop = SchedulerLoop(cluster, cfg, mesh=mesh)
+    loop = SchedulerLoop(cluster, cfg, mesh=mesh, async_bind=async_bind)
     loop.encoder.set_network(lat, bw)
     feed_metrics(cluster, loop.encoder, np.random.default_rng(seed + 1))
     return loop, lat, bw
@@ -128,6 +128,13 @@ def main(argv=None) -> int:
     ap.add_argument("--once", action="store_true",
                     help="serve one readiness cycle then exit "
                          "(smoke-test mode)")
+    ap.add_argument("--async-bind", action="store_true",
+                    help="assume-then-bind cycle (kube's cache "
+                         "pattern): commit placements to the local "
+                         "ledger immediately and confirm binds on a "
+                         "worker thread, keeping API-server RTT off "
+                         "the scheduling cycle; rejected binds roll "
+                         "back")
     ap.add_argument("--mesh", action="store_true",
                     help="shard the watch-loop's score+assign kernels "
                          "over ALL LOCAL devices via the (dp, tp) "
@@ -220,7 +227,8 @@ def main(argv=None) -> int:
     if kind == "fake":
         loop, lat_truth, bw_truth = build_fake(int(param or "128"),
                                                args.seed, cfg,
-                                               mesh=mesh)
+                                               mesh=mesh,
+                                               async_bind=args.async_bind)
     elif kind in ("incluster", "kube"):
         from kubernetesnetawarescheduler_tpu.core.loop import SchedulerLoop
         from kubernetesnetawarescheduler_tpu.k8s.kubeclient import KubeClient
@@ -231,7 +239,8 @@ def main(argv=None) -> int:
         # SchedulerLoop's Informer lists + subscribes nodes itself;
         # resync() recovers pods already pending at startup (the
         # re-list the reference lacked — ADD-only, scheduler.go:165).
-        loop = SchedulerLoop(client, cfg, mesh=mesh)
+        loop = SchedulerLoop(client, cfg, mesh=mesh,
+                             async_bind=args.async_bind)
         loop.informer.resync()
     else:
         ap.error(f"unknown cluster kind {kind!r} "
@@ -418,7 +427,24 @@ def main(argv=None) -> int:
             if args.once:
                 break
     finally:
-        if args.checkpoint_dir:
+        ledger_settled = True
+        try:
+            # Settle the ledger before it is checkpointed: queued bind
+            # batches may still roll back on rejection.
+            loop.stop_bind_worker()
+        except Exception as exc:  # noqa: BLE001 — surfaced below: an
+            # unsettled ledger costs the checkpoint, not the shutdown
+            ledger_settled = False
+            print(f"WARNING: bind worker drain failed: {exc}",
+                  file=sys.stderr)
+        if args.checkpoint_dir and not ledger_settled:
+            # A ledger with assumed-but-unconfirmed binds must not be
+            # persisted: a restart would trust placements the API
+            # server may have rejected.  The ledger is reconstructable
+            # from the API server, so no checkpoint beats a wrong one.
+            print(f"SKIPPING checkpoint save to {args.checkpoint_dir}: "
+                  "bind queue did not drain", file=sys.stderr)
+        elif args.checkpoint_dir:
             from kubernetesnetawarescheduler_tpu.core.checkpoint import (
                 save_checkpoint,
             )
