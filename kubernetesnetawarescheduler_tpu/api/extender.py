@@ -160,9 +160,28 @@ class _ScoreBatcher:
         self._adaptive_tick = adaptive_tick_s
         self._lock = threading.Lock()          # guards _queue
         self._dispatch_lock = threading.Lock()  # one kernel at a time
-        self._queue: list[list] = []  # entries: [pod, event, row|exc]
+        self._queue: list[list] = []  # [pod, event, row|exc, cand_idx]
         self.dispatches = 0  # kernel dispatch count (observability)
         self.requests = 0    # score requests served (observability)
+        # Finisher: delivers a dispatched wave's results once its
+        # device->host copy lands, OFF the dispatch path.  The fetch
+        # RTT is the serving bottleneck on remote-attached devices
+        # (measured ~65 ms fixed through the axon dev tunnel, vs
+        # sub-ms device compute) — blocking the dispatch lock on it
+        # serialized wave k+1's formation behind wave k's fetch.  The
+        # leader now dispatches, starts the async copy, hands the wave
+        # to this thread, and the next wave encodes under the in-
+        # flight transfer (same overlap the replay path gets from
+        # _prefetch_to_host, core/replay.py).
+        import queue as _queue_mod
+
+        self._finish_q: Any = _queue_mod.SimpleQueue()
+        self._closed = False
+        self._deliver_lock = threading.Lock()
+        self._finisher = threading.Thread(
+            target=self._finish_loop, daemon=True,
+            name="extender-batch-finisher")
+        self._finisher.start()
         # Static-score cache: the O(N^2) batch-invariant prep (metric
         # vote + net normalization) depends only on metrics/network/
         # validity — NOT on placements — so binds between requests do
@@ -171,8 +190,19 @@ class _ScoreBatcher:
         self._static_version: int | None = None
         self._static_val = None
 
-    def score(self, pod: Pod) -> np.ndarray:
-        """Full masked score row ``f32[N]`` for one pod (blocking).
+    def score(self, pod: Pod,
+              cand_idx: np.ndarray | None = None) -> np.ndarray:
+        """Masked scores for one pod (blocking).
+
+        With ``cand_idx`` (int node indices; ``-1`` = unknown node,
+        masked by the caller): returns ``f32[len(cand_idx)]`` — the
+        scores at exactly those nodes, gathered ON DEVICE before the
+        host fetch.  The webhook only ever needs the request's
+        candidate nodes, so fetching the full ``[B, N]`` matrix moved
+        ~5 MB per wave at N=5120 where ~64 KB suffices — through the
+        axon dev tunnel that transfer dominated serving latency
+        (measured conc_qps 304 on TPU vs 1,274 on local CPU).  Without
+        ``cand_idx``: the full masked row ``f32[N]``.
 
         DESIGNATED-LEADER coalescing: the request that finds the queue
         EMPTY becomes its wave's leader — it sleeps one tick (letting
@@ -186,7 +216,7 @@ class _ScoreBatcher:
         dispatch).  One sleeping leader + parked waiters gives both
         wave-sized batches and a quiet interpreter.
         """
-        entry = [pod, threading.Event(), None]
+        entry = [pod, threading.Event(), None, cand_idx]
         with self._lock:
             self.requests += 1  # under the lock: threaded servers
             self._queue.append(entry)
@@ -195,26 +225,25 @@ class _ScoreBatcher:
             time.sleep(self._window)
         if lead:
             time.sleep(self._adaptive_tick)  # let the wave gather
-            while not entry[1].is_set():
-                with self._dispatch_lock:
-                    if entry[1].is_set():
-                        break
+            with self._dispatch_lock:
+                if not entry[1].is_set():
                     self._drain_locked()
-        else:
-            # Parked: a leader exists (ours, or the in-flight dispatch
-            # that will claim us).  The coarse-timeout self-rescue
-            # covers the one race where our wave's leader was served
-            # by an in-flight dispatch that claimed the queue BEFORE
-            # we enqueued... which cannot strand us either (we were
-            # appended after the claim, so the next empty-queue
-            # arrival leads) — it is purely a liveness backstop.
-            while not entry[1].wait(timeout=0.05):
-                if self._dispatch_lock.acquire(blocking=False):
-                    try:
-                        if not entry[1].is_set():
-                            self._drain_locked()
-                    finally:
-                        self._dispatch_lock.release()
+        # Park until delivery (drains return at DISPATCH time; results
+        # land via the finisher thread once the async device->host
+        # copy completes).  Non-leaders park here directly: a leader
+        # exists (theirs, or the in-flight dispatch that will claim
+        # them).  The non-blocking re-drain is a pure liveness
+        # backstop — it cannot strand anyone (an entry appended after
+        # a claim makes the next empty-queue arrival lead) — and it
+        # lets a delivered-to thread lead the NEXT wave while a prior
+        # one is still in flight.
+        while not entry[1].wait(timeout=0.05):
+            if self._dispatch_lock.acquire(blocking=False):
+                try:
+                    if not entry[1].is_set():
+                        self._drain_locked()
+                finally:
+                    self._dispatch_lock.release()
         if isinstance(entry[2], BaseException):
             raise entry[2]
         return entry[2]
@@ -229,6 +258,11 @@ class _ScoreBatcher:
         # Adaptive gather: keep absorbing while arrivals continue.  A
         # silent tick ends the wait, so an idle server adds one tick
         # (~0.5 ms) of latency; the deadline bounds the worst case.
+        # (Deliberately NOT extended while a prior wave's fetch is in
+        # flight: transfers PIPELINE on the device link — measured
+        # 38 ms/dispatch at a 65 ms fetch RTT — so many small
+        # overlapping waves beat fewer merged ones; an A/B of a
+        # merge-while-inflight wait scored 743 vs 988 conc_qps.)
         if self._adaptive_max > 0:
             deadline = time.perf_counter() + self._adaptive_max
             while (len(batch) < self._loop.cfg.max_pods
@@ -242,6 +276,7 @@ class _ScoreBatcher:
                 batch.extend(fresh)
         loop = self._loop
         max_pods = loop.cfg.max_pods
+        handed = 0  # entries handed to the finisher (it owns those)
         try:
             for start in range(0, len(batch), max_pods):
                 chunk = batch[start:start + max_pods]
@@ -263,18 +298,106 @@ class _ScoreBatcher:
                 # version-keyed reuse.
                 sharded = getattr(loop, "sharded_score", None)
                 if sharded is not None:
-                    rows = np.asarray(sharded(state, enc, static))
+                    rows = sharded(state, enc, static)
                 else:
-                    rows = np.asarray(
-                        score_pods_auto(state, enc, loop.cfg, static))
-                for i, e in enumerate(chunk):
-                    e[2] = rows[i]
-                    e[1].set()
+                    rows = score_pods_auto(state, enc, loop.cfg, static)
+                idxs = [e[3] for e in chunk]
+                width = (_round_pow2(max(len(ix) for ix in idxs))
+                         if all(ix is not None for ix in idxs)
+                         else rows.shape[1])
+                if width < rows.shape[1]:
+                    # Device-side candidate gather: fetch [B, C]
+                    # (C = pow2 max candidate count) instead of the
+                    # full [B, N] matrix.  Skipped when the candidate
+                    # lists cover ~the whole cluster (width would pad
+                    # PAST N and transfer more than the full matrix).
+                    idx_mat = np.zeros((rows.shape[0], width),
+                                       dtype=np.int32)
+                    for i, ix in enumerate(idxs):
+                        idx_mat[i, :len(ix)] = np.maximum(ix, 0)
+                    out = _gather_rows(rows, idx_mat)
+                    gathered = True
+                else:
+                    # A full-row consumer in the wave: fetch the
+                    # whole matrix, everyone slices from it.
+                    out = rows
+                    gathered = False
+                copy_async = getattr(out, "copy_to_host_async", None)
+                if copy_async is not None:
+                    copy_async()
+                # Hand delivery to the finisher: the dispatch path is
+                # free for the next wave while this one's transfer is
+                # in flight.
+                item = (chunk, out, idxs, gathered)
+                self._finish_q.put(item)
+                handed += len(chunk)
+                if self._closed:
+                    # close() raced this hand-off: the finisher may
+                    # already be gone.  Deliver inline — _deliver is
+                    # idempotent, so finisher-also-delivered is safe.
+                    self._deliver(item)
         except BaseException as exc:  # deliver, don't strand waiters
-            for e in batch:
+            # Only to entries NOT handed to the finisher — it is the
+            # sole owner of those (delivering here would both poison
+            # chunks whose scores computed fine and race its writes).
+            for e in batch[handed:]:
                 if not e[1].is_set():
                     e[2] = exc
                     e[1].set()
+
+    def close(self) -> None:
+        """Stop the finisher thread (idempotent).  Waves already
+        queued are delivered first — the sentinel is FIFO-ordered
+        behind them; waves handed off concurrently with the close are
+        delivered inline by their dispatcher (see _drain_locked)."""
+        self._closed = True
+        self._finish_q.put(None)
+
+    def _finish_loop(self) -> None:
+        """Deliver dispatched waves as their device->host copies land
+        (daemon thread; one wave at a time, FIFO)."""
+        import queue as _queue_mod
+
+        while True:
+            item = self._finish_q.get()
+            if item is None:
+                # Sentinel: drain anything that slipped in behind it
+                # before exiting — no wave's waiters may be stranded.
+                while True:
+                    try:
+                        item = self._finish_q.get_nowait()
+                    except _queue_mod.Empty:
+                        return
+                    if item is not None:
+                        self._deliver(item)
+            else:
+                self._deliver(item)
+
+    def _deliver(self, item) -> None:
+        """Fetch a dispatched wave's results and wake its waiters.
+        Idempotent (guarded by _deliver_lock + the first entry's
+        event), so the close()-race inline delivery in _drain_locked
+        cannot double-deliver against the finisher."""
+        chunk, out, idxs, gathered = item
+        with self._deliver_lock:
+            if chunk and chunk[0][1].is_set():
+                return  # already delivered by the other path
+            try:
+                vals = np.asarray(out)  # blocks on the async copy
+                for i, e in enumerate(chunk):
+                    ix = idxs[i]
+                    if gathered:
+                        e[2] = vals[i, :len(ix)]
+                    elif ix is None:
+                        e[2] = vals[i]
+                    else:
+                        e[2] = vals[i][np.maximum(ix, 0)]
+                    e[1].set()
+            except BaseException as exc:  # noqa: BLE001
+                for e in chunk:
+                    if not e[1].is_set():
+                        e[2] = exc
+                        e[1].set()
 
 
     def _static_for(self, state, version: int):
@@ -292,6 +415,23 @@ class _ScoreBatcher:
             self._static_val = compute_static(state, cfg)
             self._static_version = version
         return self._static_val
+
+
+def _gather_rows(rows, idx_mat):
+    """jit'd ``rows[b, idx_mat[b, c]]`` — the device-side candidate
+    gather.  Shape universe is (pow2 pod pad) x (pow2 candidate pad),
+    so the jit cache stays small and warms within a burst."""
+    import jax
+    import jax.numpy as jnp
+
+    global _GATHER_JIT
+    if _GATHER_JIT is None:
+        _GATHER_JIT = jax.jit(
+            lambda r, ix: jnp.take_along_axis(r, ix, axis=1))
+    return _GATHER_JIT(rows, idx_mat)
+
+
+_GATHER_JIT = None
 
 
 def _round_pow2(n: int) -> int:
@@ -322,6 +462,10 @@ class ExtenderHandlers:
         # Surfaced on the loop so /metrics (utils/selfmetrics) can
         # report the coalescing rate.
         loop._extender_batcher = self._batcher
+
+    def close(self) -> None:
+        """Release the batcher's finisher thread (idempotent)."""
+        self._batcher.close()
 
     # -- ops ----------------------------------------------------------
 
@@ -367,18 +511,19 @@ class ExtenderHandlers:
         # Kernel choice (dense XLA vs tiled Pallas) follows
         # cfg.score_backend — this Score/Filter service path is where
         # the 5k-node tiled kernel earns its keep.  The batcher
-        # coalesces concurrent requests into one dispatch.
-        scores = self._batcher.score(pod)
-        feasible = scores > float(NEG_INF) * 0.5
+        # coalesces concurrent requests into one dispatch and gathers
+        # the candidate columns on device, so only [B, C] crosses the
+        # host boundary.
         idx = []
         for name in names:
             try:
                 idx.append(loop.encoder.node_index(name))
             except KeyError:
                 idx.append(-1)
-        idx_arr = np.asarray(idx, dtype=np.int64)
-        ok = np.where(idx_arr >= 0, feasible[np.maximum(idx_arr, 0)], False)
-        sc = np.where(ok, scores[np.maximum(idx_arr, 0)], float(NEG_INF))
+        idx_arr = np.asarray(idx, dtype=np.int32)
+        vals = self._batcher.score(pod, idx_arr)
+        ok = (idx_arr >= 0) & (vals > float(NEG_INF) * 0.5)
+        sc = np.where(ok, vals, float(NEG_INF))
         return names, ok, sc
 
     def filter(self, args: Mapping[str, Any]) -> Mapping[str, Any]:

@@ -78,6 +78,11 @@ class ScorerServer:
         class Server(socketserver.ThreadingUnixStreamServer):
             daemon_threads = True
             allow_reuse_address = True
+            # The batcher coalesces 100+ concurrent webhook clients
+            # into shared dispatches; socketserver's default listen
+            # backlog of 5 EAGAINs a concurrent connect burst before
+            # the batcher ever sees it.
+            request_queue_size = 256
 
         self._server = Server(uds_path, Handler)
         self._thread: threading.Thread | None = None
@@ -90,6 +95,7 @@ class ScorerServer:
     def stop(self) -> None:
         self._server.shutdown()
         self._server.server_close()
+        self._handlers.close()  # releases the batcher's finisher thread
         if os.path.exists(self.uds_path):
             os.unlink(self.uds_path)
 

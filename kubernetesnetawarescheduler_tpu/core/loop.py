@@ -505,15 +505,20 @@ class SchedulerLoop:
                 where = (self._bound_where(pod)
                          if isinstance(exc, ValueError) else None)
                 if where == name:
-                    if assumed is None and \
-                            self.encoder.is_committed(pod.uid):
+                    if self.encoder.is_committed(pod.uid) and \
+                            (assumed is None or
+                             pod.uid not in assumed):
                         # Duplicate delivery of a pod we already bound
                         # AND accounted: healing it again would inflate
                         # the scheduled counter and emit a second
                         # "Scheduled" event (commit_many dedups the
                         # ledger, but counters/events are not
                         # idempotent).  The assume path filters
-                        # duplicates before the network instead.
+                        # same-process duplicates before the network
+                        # (_assumed_uids); cross-restart duplicates
+                        # reach here NOT in `assumed` (already in the
+                        # restored ledger, so excluded from the
+                        # assume set) and are skipped the same way.
                         continue
                     ok_pods.append(pod)
                     ok_idxs.append(idx)
@@ -569,8 +574,13 @@ class SchedulerLoop:
         raced the bind), do NOT plant an early-release marker — it
         would cancel the pod's next commit after the requeue."""
         if assumed is not None and pod.uid in assumed:
-            self._assumed_uids.discard(pod.uid)
+            # Release BEFORE discarding from _assumed_uids: the other
+            # order opens a window where a concurrent duplicate
+            # delivery in _assume_and_enqueue sees "not assumed" yet
+            # "still committed" — it would skip its own assume-commit
+            # while this release erases the usage underneath it.
             self.encoder.release(pod, name, rollback=True)
+            self._assumed_uids.discard(pod.uid)
 
     def _assume_and_enqueue(self, pods: Sequence[Pod],
                             assignment: np.ndarray,
@@ -599,7 +609,14 @@ class SchedulerLoop:
                 continue
             keep.append((pod, idx, name))
         fresh = [(pod, idx) for pod, idx, _ in keep
-                 if self.encoder.slot_generation(idx) == table_gens[idx]]
+                 if self.encoder.slot_generation(idx) == table_gens[idx]
+                 # A pod already in the (possibly checkpoint-restored)
+                 # ledger needs no assume-commit, and must NOT enter
+                 # this cycle's `assumed` set: a cross-restart
+                 # duplicate delivery then heals through the 409 path
+                 # below without inflating counters/events (the
+                 # process-local _assumed_uids filter cannot see it).
+                 and not self.encoder.is_committed(pod.uid)]
         self.encoder.commit_many([p for p, _ in fresh],
                                  [i for _, i in fresh])
         assumed = {p.uid for p, _ in fresh}

@@ -10,15 +10,22 @@ target is expressed as one (p99 Score() < 5 ms, BASELINE.json).
 from __future__ import annotations
 
 import contextlib
+import threading
 import time
 from typing import Iterator, Mapping
 
 
 class PhaseTimer:
-    """Accumulates wall-clock samples per named phase."""
+    """Accumulates wall-clock samples per named phase.
+
+    Thread-safe: the serving cycle, the async bind worker and the
+    /metrics scrape thread all touch one timer — an unsynchronized
+    first ``phase()`` from the worker would insert a dict key mid-
+    ``summary()`` iteration on the scrape thread."""
 
     def __init__(self) -> None:
         self._samples: dict[str, list[float]] = {}
+        self._lock = threading.Lock()
 
     @contextlib.contextmanager
     def phase(self, name: str) -> Iterator[None]:
@@ -26,21 +33,24 @@ class PhaseTimer:
         try:
             yield
         finally:
-            self._samples.setdefault(name, []).append(
-                time.perf_counter() - start)
+            self.record(name, time.perf_counter() - start)
 
     def record(self, name: str, seconds: float) -> None:
-        self._samples.setdefault(name, []).append(seconds)
+        with self._lock:
+            self._samples.setdefault(name, []).append(seconds)
 
     def count(self, name: str) -> int:
-        return len(self._samples.get(name, ()))
+        with self._lock:
+            return len(self._samples.get(name, ()))
 
     def total(self, name: str) -> float:
-        return sum(self._samples.get(name, ()))
+        with self._lock:
+            return sum(self._samples.get(name, ()))
 
     def percentile(self, name: str, q: float) -> float:
         """q in [0, 100]; nearest-rank on the sorted samples."""
-        samples = sorted(self._samples.get(name, ()))
+        with self._lock:
+            samples = sorted(self._samples.get(name, ()))
         if not samples:
             return 0.0
         rank = min(len(samples) - 1, max(0, int(round(
@@ -48,8 +58,10 @@ class PhaseTimer:
         return samples[rank]
 
     def summary(self) -> Mapping[str, Mapping[str, float]]:
+        with self._lock:
+            names = list(self._samples)
         out: dict[str, dict[str, float]] = {}
-        for name in self._samples:
+        for name in names:
             out[name] = {
                 "count": float(self.count(name)),
                 "total_s": self.total(name),
@@ -59,4 +71,5 @@ class PhaseTimer:
         return out
 
     def reset(self) -> None:
-        self._samples.clear()
+        with self._lock:
+            self._samples.clear()

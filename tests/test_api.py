@@ -329,3 +329,52 @@ def test_batcher_static_cache_tracks_metric_updates():
     assert out2[best] == max(out2.values())
     assert out2[best] == 10  # top of the 0..10 extender scale
     assert out1 != out2
+
+
+def test_batcher_candidate_gather_matches_full_row():
+    """The device-side candidate gather (score(pod, cand_idx) fetches
+    [B, C] instead of the full [B, N] matrix) must return exactly the
+    full row's values at those indices, mask unknown nodes (-1), and
+    fall back to one full fetch when a full-row consumer shares the
+    wave."""
+    from kubernetesnetawarescheduler_tpu.api.extender import _pod_from_k8s
+
+    cluster, loop = make_loop(num_nodes=12)
+    handlers = ExtenderHandlers(loop)
+    batcher = handlers._batcher
+    names = [n.name for n in cluster.list_nodes()]
+    args = extender_args(names)
+    pod = _pod_from_k8s(args["pod"])
+
+    full = batcher.score(pod)  # no idx: the full f32[N] row
+    idx = np.asarray([loop.encoder.node_index(n) for n in names]
+                     + [-1], dtype=np.int32)
+    got = batcher.score(pod, idx)
+    assert got.shape == (len(names) + 1,)
+    np.testing.assert_allclose(got[:-1], full[idx[:-1]], rtol=1e-6)
+
+    # The -1 (unknown node) slot gathers node 0's value; the HANDLER
+    # masks it — assert the public path reports it infeasible.
+    bogus = names + ["no-such-node"]
+    out = handlers.filter({"pod": args["pod"], "nodenames": bogus})
+    assert "no-such-node" in out["failedNodes"]
+    assert set(out["nodenames"]) <= set(names)
+
+    # Mixed wave: one full-row consumer + gathered consumers, one
+    # dispatch, everyone correct.
+    import threading
+
+    results = {}
+    handlers2 = ExtenderHandlers(loop, batch_window_s=0.01)
+    b2 = handlers2._batcher
+    threads = [threading.Thread(target=lambda: results.__setitem__(
+                   "full", b2.score(pod))),
+               threading.Thread(target=lambda: results.__setitem__(
+                   "gathered", b2.score(pod, idx[:4])))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert results["full"].shape == full.shape
+    np.testing.assert_allclose(results["gathered"],
+                               results["full"][idx[:4]], rtol=1e-6)

@@ -192,3 +192,54 @@ def test_async_cycle_never_blocks_on_api_rtt():
     assert loop.timer.count("bind_net") > 0
     assert len(cluster.bindings) == 48
     loop.stop_bind_worker()
+
+def test_restart_duplicate_delivery_not_recounted(tmp_path):
+    """Cross-restart duplicate: a pod bound AND committed before a
+    checkpointed restart is re-delivered (stale watch replay).  The
+    process-local _assumed_uids filter cannot see it, so it must be
+    excluded from the assume set (already in the restored ledger) and
+    heal through the 409 path WITHOUT a second Scheduled accounting."""
+    from kubernetesnetawarescheduler_tpu.core.checkpoint import (
+        load_checkpoint,
+        save_checkpoint,
+    )
+
+    cfg = SchedulerConfig(max_nodes=64, max_pods=16, max_peers=4,
+                          queue_capacity=24)
+    cluster, lat, bw = build_fake_cluster(ClusterSpec(num_nodes=48,
+                                                      seed=21))
+    loop = SchedulerLoop(cluster, cfg, method="parallel",
+                         async_bind=True)
+    loop.encoder.set_network(lat, bw)
+    feed_metrics(cluster, loop.encoder, np.random.default_rng(22))
+    pods = generate_workload(
+        WorkloadSpec(num_pods=8, seed=23, services=8,
+                     peer_fraction=0.5),
+        scheduler_name=cfg.scheduler_name)
+    cluster.add_pods(pods)
+    loop.run_until_drained()
+    loop.flush_binds()
+    loop.stop_bind_worker()
+    first_scheduled = loop.scheduled
+    assert first_scheduled > 0
+    bound = {b.pod_name: b.node_name for b in cluster.bindings}
+    save_checkpoint(str(tmp_path / "ckpt"), loop.encoder)
+
+    enc2 = load_checkpoint(str(tmp_path / "ckpt"))
+    loop2 = SchedulerLoop(cluster, cfg, method="parallel",
+                          async_bind=True, encoder=enc2)
+    # Re-deliver every already-bound pod — SAME Pod objects, same
+    # uids — as a stale watch replay would.
+    replayed = [p for p in pods if p.name in bound]
+    assert replayed
+    for pod in replayed:
+        loop2.queue.push(pod)
+    loop2.run_until_drained()
+    loop2.flush_binds()
+    loop2.stop_bind_worker()
+    # No duplicate accounting: nothing new was scheduled, no second
+    # binding, and the usage ledger is unchanged.
+    assert loop2.scheduled == 0
+    assert {b.pod_name: b.node_name for b in cluster.bindings} == bound
+    assert np.array_equal(np.asarray(loop.encoder.snapshot().used),
+                          np.asarray(loop2.encoder.snapshot().used))

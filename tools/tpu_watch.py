@@ -31,9 +31,11 @@ import time
 
 ART = os.path.join(os.path.dirname(__file__), "..", "bench_artifacts",
                    "tpu")
-LEG_ORDER = ["compile", "pallas_equal", "density_small", "density_full"]
+LEG_ORDER = ["compile", "pallas_equal", "density_small", "serving_qps",
+             "density_full"]
 LEG_TIMEOUT_S = {"compile": 900, "pallas_equal": 1200,
-                 "density_small": 1800, "density_full": 5400}
+                 "density_small": 1800, "serving_qps": 1800,
+                 "density_full": 5400}
 PROBE_TIMEOUT_S = 120
 PROBE_INTERVAL_S = 120
 REFRESH_INTERVAL_S = 1800   # sleep cadence once every leg is green
