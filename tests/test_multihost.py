@@ -119,6 +119,23 @@ def test_mesh_extender_scoring_matches_unsharded():
         got[label] = ExtenderHandlers(loop).prioritize(args)
     assert got["plain"] == got["mesh"]
     assert any(h["score"] for h in got["plain"])
+    # Narrow candidate list: pow2(9) = 16 < N = 64, so this goes
+    # through the device-side candidate GATHER on the mesh-sharded
+    # rows (48 candidates above pad to the full width and take the
+    # full-fetch path, which would leave the gather+GSPMD combination
+    # untested).
+    args_narrow = dict(args)
+    args_narrow["nodenames"] = [f"node-{j:04d}" for j in range(9)]
+    narrow = {}
+    for label, mesh in (("plain", None), ("mesh", global_mesh(2, 4))):
+        cluster, lat, bw = build_fake_cluster(
+            ClusterSpec(num_nodes=48, seed=11))
+        loop = SchedulerLoop(cluster, cfg, mesh=mesh)
+        loop.encoder.set_network(lat, bw)
+        feed_metrics(cluster, loop.encoder, np.random.default_rng(12))
+        narrow[label] = ExtenderHandlers(loop).prioritize(args_narrow)
+    assert narrow["plain"] == narrow["mesh"]
+    assert len(narrow["plain"]) == 9
 
 
 def test_init_multihost_is_idempotent(monkeypatch):

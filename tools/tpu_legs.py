@@ -16,6 +16,9 @@ Legs, in cost order:
 ``density_small``  N=1024 density replay, both score backends
 ``serving_qps``    extender webhook QPS at N=5120 with TPU scoring —
                    the path a real kube-scheduler integration drives
+``serve_smoke``    the FULL standalone daemon (serve.py --cluster
+                   kube:<url>) against an in-repo fake API server:
+                   HTTP watch -> encode -> TPU score -> bind POSTs
 ``density_full``   the headline N=5120 bench.py run (BENCH_* inherited)
 """
 
@@ -146,6 +149,86 @@ def leg_serving_qps() -> dict:
     return out
 
 
+def leg_serve_smoke() -> dict:
+    """End-to-end daemon on hardware: serve.py (the daemon proper, no
+    --once) drains a 2,048-pod backlog from a fake kube API server
+    (tests/test_kubeclient.FakeApiServer — real HTTP list/watch
+    streams, real Binding/Event POSTs) with the kernels on the TPU.
+    A --once warm pass first (one 256-pod cycle) so the timed number
+    measures serving, not XLA compilation."""
+    jax = _require_tpu()
+    import json as _json
+    import tempfile
+
+    from kubernetesnetawarescheduler_tpu import serve
+    from tests.test_kubeclient import (
+        FakeApiServer,
+        _node_json,
+        _pod_json,
+    )
+
+    import threading
+
+    n_nodes, n_pods = 512, 2048
+    tmp = tempfile.mkdtemp()
+    cfg_path = os.path.join(tmp, "cfg.json")
+    with open(cfg_path, "w") as f:
+        _json.dump({"max_nodes": n_nodes, "max_pods": 256,
+                    "max_peers": 4,
+                    "queue_capacity": n_pods + 256}, f)
+
+    def make_api(num_pods: int) -> FakeApiServer:
+        api = FakeApiServer()
+        api.nodes = [_node_json(f"node-{i:04d}") for i in range(n_nodes)]
+        api.node_events = [{"type": "ADDED", "object": n}
+                           for n in api.nodes]
+        api.pods = [_pod_json(f"pod-{i:05d}") for i in range(num_pods)]
+        api.pod_events = [{"type": "ADDED", "object": p}
+                          for p in api.pods]
+        return api
+
+    def argv(api: FakeApiServer) -> list[str]:
+        uds = os.path.join(tempfile.mkdtemp(), "scorer.sock")
+        return ["--cluster", f"kube:{api.url}", "--kube-token", "t",
+                "--uds", uds, "--config", cfg_path, "--async-bind"]
+
+    # Warm pass: one --once cycle (a single 256-pod batch) compiles
+    # every jit shape — the cluster size fixes them.
+    api = make_api(256)
+    try:
+        rc = serve.main(argv(api) + ["--once"])
+        if rc != 0:
+            raise SystemExit(f"warm serve rc={rc}")
+    finally:
+        api.stop()
+
+    # Timed pass: the daemon proper (no --once), polled until the
+    # backlog is drained.  The serve thread has no stop hook off the
+    # main thread; this leg's process exits right after, which is the
+    # cleanup.
+    api = make_api(n_pods)
+    t0 = time.perf_counter()
+    th = threading.Thread(target=serve.main, args=(argv(api),),
+                          daemon=True)
+    th.start()
+    deadline = time.monotonic() + 900
+    while len(api.bindings) < n_pods and time.monotonic() < deadline:
+        if not th.is_alive():
+            raise SystemExit(
+                f"serve daemon died after {len(api.bindings)} binds")
+        time.sleep(0.05)
+    wall = time.perf_counter() - t0
+    bound = len(api.bindings)
+    if bound < n_pods:
+        # A deadline exit must NOT persist as a green artifact whose
+        # rate measures the timeout rather than the drain.
+        raise SystemExit(f"only {bound}/{n_pods} pods bound "
+                         f"within {wall:.0f}s")
+    return {"backend": jax.default_backend(), "nodes": n_nodes,
+            "pods": n_pods, "bound": bound, "wall_s": round(wall, 2),
+            "binds_per_sec": round(bound / wall, 1)}
+
+
 def leg_density_full() -> dict:
     """The headline bench at full shape, via bench.py itself so the
     persisted artifact has the exact schema the driver records."""
@@ -172,6 +255,7 @@ LEGS = {
     "pallas_equal": leg_pallas_equal,
     "density_small": leg_density_small,
     "serving_qps": leg_serving_qps,
+    "serve_smoke": leg_serve_smoke,
     "density_full": leg_density_full,
 }
 
