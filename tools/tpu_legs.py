@@ -19,6 +19,9 @@ Legs, in cost order:
 ``serve_smoke``    the FULL standalone daemon (serve.py --cluster
                    kube:<url>) against an in-repo fake API server:
                    HTTP watch -> encode -> TPU score -> bind POSTs
+``device_latency`` p50/p99 of one jitted schedule_batch at the bench
+                   shape, timed at the device boundary (the north
+                   star's p99 Score() < 5 ms, minus tunnel transport)
 ``density_full``   the headline N=5120 bench.py run (BENCH_* inherited)
 """
 
@@ -149,6 +152,69 @@ def leg_serving_qps() -> dict:
     return out
 
 
+def leg_device_latency() -> dict:
+    """The north star's p99 Score() < 5 ms, measured at the DEVICE
+    boundary on hardware: one jitted schedule_batch (score + conflict
+    resolution + commit — the full per-batch decision) at the bench
+    shape (N=5120, batch 128, constraints on), 200 reps, host-timed
+    with block_until_ready.  No bulk device->host transfer is
+    involved, so the tunnel's ~65 ms fetch RTT — which dominates the
+    HOST-observed per-chunk percentiles in density_full — does not
+    mask the device's own latency."""
+    jax = _require_tpu()
+    import numpy as np
+
+    from kubernetesnetawarescheduler_tpu.config import SchedulerConfig
+    from kubernetesnetawarescheduler_tpu.core.assign import schedule_batch
+    from tests import gen
+
+    out = {}
+    for backend in ("pallas", "xla"):
+        cfg = SchedulerConfig(max_nodes=5120, max_pods=128, max_peers=4,
+                              score_backend=backend)
+        rng = np.random.default_rng(7)
+        state_np, pods_np = gen.random_instance(rng, cfg, n_nodes=5120,
+                                                n_pods=128)
+        state, pods = gen.to_pytrees(cfg, state_np, pods_np)
+        step = jax.jit(lambda s, p, c=cfg: schedule_batch(s, p, c))
+        jax.block_until_ready(step(state, pods))  # compile
+        times = []
+        for _ in range(200):
+            t0 = time.perf_counter()
+            jax.block_until_ready(step(state, pods))
+            times.append((time.perf_counter() - t0) * 1e3)
+        times.sort()
+        out[backend] = {
+            "p50_ms": round(times[len(times) // 2], 3),
+            "p99_ms": round(times[int(len(times) * 0.99) - 1], 3),
+            "max_ms": round(times[-1], 3),
+            "reps": len(times),
+        }
+    return out
+
+
+def leg_scale_probe() -> dict:
+    """Scale headroom past the north-star shape: the tiled Pallas
+    path at 1.6x and 2.5x the 5k-node target (BASELINE.json), 16,384
+    pods each.  Proves the ≥10k pods/s bar holds well beyond the
+    shape it was set for."""
+    _require_tpu()
+    from kubernetesnetawarescheduler_tpu.bench.density import run_density
+
+    out = {}
+    for n in (8192, 12800):
+        res = run_density(num_nodes=n, num_pods=16384, batch_size=128,
+                          method="parallel", mode="pipeline",
+                          chunk_batches=16, score_backend="pallas")
+        out[f"n{n}"] = {
+            "pods_per_sec": round(res.pods_per_sec, 1),
+            "score_p50_ms": round(res.score_p50_ms, 2),
+            "score_p99_ms": round(res.score_p99_ms, 2),
+            "pods_bound": res.pods_bound,
+        }
+    return out
+
+
 def leg_serve_smoke() -> dict:
     """End-to-end daemon on hardware: serve.py (the daemon proper, no
     --once) drains a 2,048-pod backlog from a fake kube API server
@@ -256,6 +322,8 @@ LEGS = {
     "density_small": leg_density_small,
     "serving_qps": leg_serving_qps,
     "serve_smoke": leg_serve_smoke,
+    "device_latency": leg_device_latency,
+    "scale_probe": leg_scale_probe,
     "density_full": leg_density_full,
 }
 

@@ -36,10 +36,12 @@ ART = os.path.join(os.path.dirname(__file__), "..", "bench_artifacts",
 # re-wedged tunnel), and the headline density artifact must never be
 # starved by it.
 LEG_ORDER = ["compile", "pallas_equal", "density_small", "serving_qps",
-             "density_full", "serve_smoke"]
+             "density_full", "device_latency", "serve_smoke",
+             "scale_probe"]
 LEG_TIMEOUT_S = {"compile": 900, "pallas_equal": 1200,
                  "density_small": 1800, "serving_qps": 1800,
-                 "serve_smoke": 1800, "density_full": 5400}
+                 "device_latency": 900, "serve_smoke": 1800,
+                 "scale_probe": 1800, "density_full": 5400}
 PROBE_TIMEOUT_S = 120
 PROBE_INTERVAL_S = 120
 REFRESH_INTERVAL_S = 1800   # sleep cadence once every leg is green
