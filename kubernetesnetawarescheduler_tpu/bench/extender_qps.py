@@ -161,11 +161,42 @@ def _dispatch_count(handlers: ExtenderHandlers) -> int:
     return handlers._batcher.dispatches
 
 
-def main() -> None:
+def main(argv=None) -> None:
+    """``--write [PATH]`` persists the result (with the executing
+    backend recorded) as the bench artifact —
+    ``bench_artifacts/extender_qps.json`` by default — so the number
+    the docs cite is regenerable by one command."""
+    import argparse
     import json
+    import os
 
-    res = run_qps()
-    print(json.dumps(res.to_dict()))
+    import jax
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--write", nargs="?", const="", default=None,
+                    help="persist to PATH (default: the repo's "
+                         "bench_artifacts/extender_qps.json)")
+    args = ap.parse_args(argv)
+    doc = run_qps().to_dict()
+    doc["backend"] = jax.default_backend()
+    try:
+        import subprocess
+
+        doc["git"] = subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"],
+            capture_output=True, timeout=10,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+        ).stdout.decode().strip()
+    except Exception:  # noqa: BLE001 — provenance is best-effort
+        pass
+    print(json.dumps(doc))
+    if args.write is not None:
+        path = args.write or os.path.join(
+            os.path.dirname(os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__)))),
+            "bench_artifacts", "extender_qps.json")
+        with open(path, "w") as f:
+            json.dump(doc, f, indent=2)
 
 
 if __name__ == "__main__":

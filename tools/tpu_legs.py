@@ -7,21 +7,23 @@ sitecustomize registers the TPU backend; if PJRT init fell back to CPU
 the leg FAILS rather than record a CPU number as a hardware artifact)
 and prints one JSON line ``{"leg", "ok", ...}``.
 
-Legs, in cost order:
+Legs, in cost order (the watcher runs them in this order so a short
+tunnel window still yields artifacts):
 
 ``probe``          jax.devices() only (~s)          — tunnel liveness
 ``compile``        jit + run entry()'s tiled Pallas kernel (Mosaic
                    lowering, the round-3 verdict's #1 unproven claim)
-``pallas_equal``   dense XLA vs tiled Pallas on hardware, tight rtol
+``device_latency`` p50/p99 of one jitted schedule_batch at the bench
+                   shape, timed at the device boundary (the north
+                   star's p99 Score() < 5 ms, minus tunnel transport)
 ``density_small``  N=1024 density replay, both score backends
 ``serving_qps``    extender webhook QPS at N=5120 with TPU scoring —
                    the path a real kube-scheduler integration drives
 ``serve_smoke``    the FULL standalone daemon (serve.py --cluster
                    kube:<url>) against an in-repo fake API server:
                    HTTP watch -> encode -> TPU score -> bind POSTs
-``device_latency`` p50/p99 of one jitted schedule_batch at the bench
-                   shape, timed at the device boundary (the north
-                   star's p99 Score() < 5 ms, minus tunnel transport)
+``pallas_equal``   dense XLA vs tiled Pallas on hardware, tight rtol
+``scale_probe``    N=8192 / N=12800 headroom past the north star
 ``density_full``   the headline N=5120 bench.py run (BENCH_* inherited)
 """
 

@@ -31,13 +31,13 @@ import time
 
 ART = os.path.join(os.path.dirname(__file__), "..", "bench_artifacts",
                    "tpu")
-# serve_smoke sits AFTER density_full despite being cheaper: a
-# leg-specific smoke failure breaks the leg loop (the break assumes a
-# re-wedged tunnel), and the headline density artifact must never be
-# starved by it.
-LEG_ORDER = ["compile", "pallas_equal", "density_small", "serving_qps",
-             "density_full", "device_latency", "serve_smoke",
-             "scale_probe"]
+# Cheapest first, so a short tunnel window still yields artifacts.  A
+# leg failure no longer assumes a re-wedged tunnel (that starved later
+# legs on any leg-specific bug): the loop re-probes after a failure
+# and only stops when the tunnel itself is gone.
+LEG_ORDER = ["compile", "device_latency", "density_small",
+             "serving_qps", "serve_smoke", "pallas_equal",
+             "scale_probe", "density_full"]
 LEG_TIMEOUT_S = {"compile": 900, "pallas_equal": 1200,
                  "density_small": 1800, "serving_qps": 1800,
                  "device_latency": 900, "serve_smoke": 1800,
@@ -45,7 +45,12 @@ LEG_TIMEOUT_S = {"compile": 900, "pallas_equal": 1200,
 PROBE_TIMEOUT_S = 120
 PROBE_INTERVAL_S = 120
 REFRESH_INTERVAL_S = 1800   # sleep cadence once every leg is green
-REFRESH_FULL_S = 4 * 3600   # re-run density_full at most this often
+REFRESH_FULL_S = 4 * 3600   # re-run any green leg at most this often
+                            # (keeps artifacts tracking current code
+                            # across a round without re-measuring on
+                            # every probe; never-clobber-success means
+                            # a failed refresh cannot lose the prior
+                            # capture)
 DRIVER_INTENT_FRESH_S = 3 * 3600
 
 
@@ -174,12 +179,12 @@ def main() -> None:
                 for leg in LEG_ORDER:
                     if _driver_active():
                         break
-                    if _leg_ok(leg) and (leg != "density_full"
-                                         or _leg_age_s(leg)
-                                         < REFRESH_FULL_S):
+                    if _leg_ok(leg) and _leg_age_s(leg) < REFRESH_FULL_S:
                         continue  # green and fresh enough
-                    if not _run_leg(leg):
-                        break  # tunnel likely re-wedged; back to probing
+                    if not _run_leg(leg) and not _probe():
+                        break  # tunnel re-wedged; back to probing
+                    # leg-specific failure with a live tunnel: move on
+                    # so one bad leg can't starve the rest
             finally:
                 fcntl.flock(lock_f, fcntl.LOCK_UN)
         all_green = all(_leg_ok(leg) for leg in LEG_ORDER)
