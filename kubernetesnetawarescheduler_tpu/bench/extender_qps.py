@@ -182,13 +182,14 @@ def main(argv=None) -> None:
     try:
         import subprocess
 
-        doc["git"] = subprocess.run(
+        proc = subprocess.run(
             ["git", "rev-parse", "--short", "HEAD"],
             capture_output=True, timeout=10,
-            cwd=os.path.dirname(os.path.abspath(__file__)),
-        ).stdout.decode().strip()
-    except Exception:  # noqa: BLE001 — provenance is best-effort
-        pass
+            cwd=os.path.dirname(os.path.abspath(__file__)))
+        if proc.returncode == 0 and proc.stdout.strip():
+            doc["git"] = proc.stdout.decode().strip()
+    except Exception:  # noqa: BLE001 — provenance is best-effort;
+        pass  # omit the key rather than write a blank SHA
     print(json.dumps(doc))
     if args.write is not None:
         path = args.write or os.path.join(

@@ -54,7 +54,8 @@ def _agent_reachable(host: str, port: int, timeout_s: float = 3.0) -> bool:
 
 
 def build_fake(num_nodes: int, seed: int, cfg: SchedulerConfig,
-               mesh=None, async_bind: bool = False):
+               mesh=None, async_bind: bool = False,
+               burst_batches: int = 8):
     from kubernetesnetawarescheduler_tpu.bench.fakecluster import (
         ClusterSpec,
         build_fake_cluster,
@@ -66,7 +67,8 @@ def build_fake(num_nodes: int, seed: int, cfg: SchedulerConfig,
 
     cluster, lat, bw = build_fake_cluster(
         ClusterSpec(num_nodes=num_nodes, seed=seed))
-    loop = SchedulerLoop(cluster, cfg, mesh=mesh, async_bind=async_bind)
+    loop = SchedulerLoop(cluster, cfg, mesh=mesh, async_bind=async_bind,
+                         burst_batches=burst_batches)
     loop.encoder.set_network(lat, bw)
     feed_metrics(cluster, loop.encoder, np.random.default_rng(seed + 1))
     return loop, lat, bw
@@ -128,6 +130,10 @@ def main(argv=None) -> int:
     ap.add_argument("--once", action="store_true",
                     help="serve one readiness cycle then exit "
                          "(smoke-test mode)")
+    ap.add_argument("--burst-batches", type=int, default=8,
+                    help="with a deep backlog, drain up to this many "
+                         "batches per device dispatch (one fetch for "
+                         "all of them); 1 disables burst mode")
     ap.add_argument("--async-bind", action="store_true",
                     help="assume-then-bind cycle (kube's cache "
                          "pattern): commit placements to the local "
@@ -225,10 +231,10 @@ def main(argv=None) -> int:
     kind, _, param = args.cluster.partition(":")
     lat_truth = bw_truth = None
     if kind == "fake":
-        loop, lat_truth, bw_truth = build_fake(int(param or "128"),
-                                               args.seed, cfg,
-                                               mesh=mesh,
-                                               async_bind=args.async_bind)
+        loop, lat_truth, bw_truth = build_fake(
+            int(param or "128"), args.seed, cfg, mesh=mesh,
+            async_bind=args.async_bind,
+            burst_batches=args.burst_batches)
     elif kind in ("incluster", "kube"):
         from kubernetesnetawarescheduler_tpu.core.loop import SchedulerLoop
         from kubernetesnetawarescheduler_tpu.k8s.kubeclient import KubeClient
@@ -240,7 +246,8 @@ def main(argv=None) -> int:
         # resync() recovers pods already pending at startup (the
         # re-list the reference lacked — ADD-only, scheduler.go:165).
         loop = SchedulerLoop(client, cfg, mesh=mesh,
-                             async_bind=args.async_bind)
+                             async_bind=args.async_bind,
+                             burst_batches=args.burst_batches)
         loop.informer.resync()
     else:
         ap.error(f"unknown cluster kind {kind!r} "

@@ -204,6 +204,7 @@ def test_bind_phase_overlaps_api_latency_at_batch_128():
     assert loop.run_until_drained() == 128
     bind_p99_ms = loop.timer.percentile("bind", 99) * 1e3
     # Serial would be >= 128 ms of pure latency; concurrent should be
-    # ~16 ms plus bookkeeping.  60 ms keeps CI noise out while still
-    # proving the overlap.
-    assert bind_p99_ms < 60.0, f"bind_p99 {bind_p99_ms:.1f} ms"
+    # ~16 ms plus bookkeeping.  90 ms keeps 1-core-CI noise out
+    # (co-run jit compile pressure measured 61.8 ms once) while still
+    # proving the overlap against the >=128 ms serial floor.
+    assert bind_p99_ms < 90.0, f"bind_p99 {bind_p99_ms:.1f} ms"
