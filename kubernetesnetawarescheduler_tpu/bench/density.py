@@ -190,9 +190,16 @@ def run_density(num_nodes: int = 100, num_pods: int = 300,
         # compiles the burst shape; wave 2 (a lone small batch)
         # compiles the per-batch shape.
         # cfg.max_pods, not batch_size: an explicitly-passed cfg may
-        # differ, and the burst trigger keys on cfg.max_pods.
-        waves = ([2 * cfg.max_pods, 8] if wloop.burst_batches > 1
-                 else [min(cfg.max_pods, 8)])
+        # differ, and the burst trigger keys on cfg.max_pods.  Wave 2
+        # stays strictly below the 2*max_pods burst trigger so the
+        # per-batch program compiles too; the burst wave is skipped
+        # when the queue can never hold two batches (burst then never
+        # engages in the measured run either).
+        waves = []
+        if (wloop.burst_batches > 1
+                and cfg.queue_capacity >= 2 * cfg.max_pods):
+            waves.append(2 * cfg.max_pods)
+        waves.append(min(cfg.max_pods, 8))
         for i, n_warm in enumerate(waves):
             warm = generate_workload(
                 WorkloadSpec(num_pods=n_warm, seed=seed + 99 + i),

@@ -219,7 +219,18 @@ class SchedulerLoop:
             self.encoder.set_pdb(pdb)
 
     def _on_node(self, node: Node) -> None:
+        try:
+            self.encoder.node_index(node.name)
+            is_new = False
+        except KeyError:
+            is_new = True
         self.encoder.upsert_node(node)
+        if is_new:
+            # New capacity: retry pods rejected while the cluster was
+            # full (kube's unschedulable-queue flush on NodeAdd).
+            # Only genuinely NEW nodes — requeueing on every node
+            # UPDATE would churn the queue on routine status traffic.
+            self._requeue_parked()
 
     def _on_node_gone(self, node: Node) -> None:
         self.encoder.remove_node(node.name)
@@ -721,12 +732,17 @@ class SchedulerLoop:
             self._drop_assumed_node(pod)
             # The rollback freed assumed capacity: retry pods the
             # kernel rejected while it was held.
-            while self._unsched_parked:
-                try:
-                    parked = self._unsched_parked.popleft()
-                except IndexError:
-                    break
-                self.queue.push(parked)  # full queue drops; resync heals
+            self._requeue_parked()
+
+    def _requeue_parked(self) -> None:
+        """Requeue every parked unschedulable pod (called when
+        capacity appears: an assumed-bind rollback or a new node)."""
+        while self._unsched_parked:
+            try:
+                parked = self._unsched_parked.popleft()
+            except IndexError:
+                break
+            self.queue.push(parked)  # full queue drops; resync heals
 
     def _assume_and_enqueue(self, pods: Sequence[Pod],
                             assignment: np.ndarray,

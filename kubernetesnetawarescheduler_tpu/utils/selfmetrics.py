@@ -49,6 +49,10 @@ def render_metrics(loop) -> str:
             "Bind attempts rejected or errored")
     counter("netaware_preemptions_total", loop.preemptions,
             "Pods evicted to make room for higher-priority pods")
+    counter("netaware_burst_cycles_total",
+            getattr(loop, "burst_cycles", 0),
+            "Backlog bursts served (multi-batch single-dispatch "
+            "cycles)")
     gauge("netaware_queue_depth", len(loop.queue),
           "Pending pods waiting in the scheduling queue")
     counter("netaware_queue_dropped_total",

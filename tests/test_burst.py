@@ -167,3 +167,34 @@ def test_burst_rollback_requeues_parked_unschedulable():
     # No overcommit despite rollback + retry.
     snap = loop.encoder.snapshot()
     assert (np.asarray(snap.used) <= np.asarray(snap.cap) + 1e-4).all()
+
+
+def test_node_add_requeues_parked_unschedulable():
+    """kube parity: adding a node flushes the parked unschedulable
+    pods (assume-then-bind mode), so new capacity is used without
+    waiting for the periodic resync."""
+    from kubernetesnetawarescheduler_tpu.k8s.types import Node, Pod
+
+    cfg = SchedulerConfig(max_nodes=8, max_pods=4, max_peers=2,
+                          queue_capacity=16)
+    cluster, lat, bw = build_fake_cluster(ClusterSpec(num_nodes=2,
+                                                      seed=71))
+    loop = SchedulerLoop(cluster, cfg, method="parallel",
+                         async_bind=True)
+    loop.encoder.set_network(lat, bw)
+    feed_metrics(cluster, loop.encoder, np.random.default_rng(72))
+    # A pod no existing node can hold.
+    big = Pod(name="big", uid="big", requests={"cpu": 1000.0},
+              scheduler_name=cfg.scheduler_name)
+    cluster.add_pod(big)
+    loop.run_until_drained()
+    loop.flush_binds()
+    assert loop.unschedulable == 1
+    assert not any(b.pod_name == "big" for b in cluster.bindings)
+    # A node that fits it appears -> the parked pod requeues and binds.
+    cluster.add_node(Node(name="huge", capacity={"cpu": 2000.0,
+                                                 "mem": 4000.0}))
+    loop.run_until_drained()
+    loop.flush_binds()
+    loop.stop_bind_worker()
+    assert any(b.pod_name == "big" for b in cluster.bindings)
