@@ -250,3 +250,32 @@ def test_restart_duplicate_delivery_not_recounted(tmp_path):
     assert {b.pod_name: b.node_name for b in cluster.bindings} == bound
     assert np.array_equal(np.asarray(loop.encoder.snapshot().used),
                           np.asarray(loop2.encoder.snapshot().used))
+
+
+def test_assumed_node_cross_namespace_eviction():
+    """Two same-named pods in different namespaces: deleting one must
+    not evict the other's assumed-placement entry (the bare-name alias
+    is dropped owner-checked; the qualified key survives untouched)."""
+    from kubernetesnetawarescheduler_tpu.bench.fakecluster import (
+        build_fake_cluster as _bfc,
+    )
+    from kubernetesnetawarescheduler_tpu.k8s.types import Pod
+
+    cfg = SchedulerConfig(max_nodes=8, max_pods=4, max_peers=2)
+    cluster, _, _ = _bfc(ClusterSpec(num_nodes=4, seed=81))
+    loop = SchedulerLoop(cluster, cfg, async_bind=True)
+    loop._assumed_node["web"] = ("team-b", "node-0001")
+    loop._assumed_node["team-a/web"] = ("team-a", "node-0000")
+    loop._assumed_node["team-b/web"] = ("team-b", "node-0001")
+    # team-a's deletion: bare alias owned by team-b survives.
+    loop._on_pod_gone(Pod(name="web", namespace="team-a", uid="a"))
+    assert "team-a/web" not in loop._assumed_node
+    assert loop._assumed_node["web"] == ("team-b", "node-0001")
+    assert loop._assumed_node["team-b/web"] == ("team-b", "node-0001")
+    # Peer resolution returns the node, not the tuple.
+    assert loop._peer_node("web") == "node-0001"
+    assert loop._peer_node("team-b/web") == "node-0001"
+    # team-b's deletion drops its bare alias too.
+    loop._on_pod_gone(Pod(name="web", namespace="team-b", uid="b"))
+    assert "web" not in loop._assumed_node
+    loop.stop_bind_worker()

@@ -198,3 +198,4 @@ def test_node_add_requeues_parked_unschedulable():
     loop.flush_binds()
     loop.stop_bind_worker()
     assert any(b.pod_name == "big" for b in cluster.bindings)
+

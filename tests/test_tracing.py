@@ -1,0 +1,46 @@
+"""PhaseTimer thread-safety (utils/tracing.py).
+
+The serving cycle, the async bind worker and the /metrics scrape
+thread share one PhaseTimer.  What this test CAN catch under the GIL:
+an implementation that iterates the samples dict directly during
+``summary()`` raises ``RuntimeError: dictionary changed size during
+iteration`` when another thread inserts a NEW phase key — the exact
+scrape-vs-first-bind_net race the lock guards.  What it cannot catch:
+a lock removal that keeps snapshot-copy semantics (GIL-atomic) — that
+regression only surfaces on free-threaded builds.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from kubernetesnetawarescheduler_tpu.utils.tracing import PhaseTimer
+
+
+def test_phase_timer_scrape_during_new_key_inserts():
+    timer = PhaseTimer()
+    errs: list[BaseException] = []
+
+    def writer():
+        # Bounded: every record inserts a NEW key, the case that
+        # breaks unprotected dict iteration.
+        for i in range(8000):
+            timer.record(f"phase-{i}", 0.001)
+
+    def reader():
+        try:
+            for _ in range(80):
+                timer.summary()
+                timer.percentile("phase-1", 99)
+        except BaseException as exc:  # noqa: BLE001
+            errs.append(exc)
+
+    w = threading.Thread(target=writer)
+    r = threading.Thread(target=reader)
+    w.start()
+    r.start()
+    w.join(timeout=60)
+    r.join(timeout=60)
+    assert not w.is_alive() and not r.is_alive()
+    assert not errs, errs
+    assert timer.count("phase-1") == 1
