@@ -59,9 +59,7 @@ class SchedulerLoop:
         # in-stream peers resolve against earlier batches' placements
         # exactly as sequential cycles would (pinned by
         # tests/test_replay.py and test_burst.py).  0 or 1 disables.
-        # Plain single-device path only — the mesh cycle keeps its
-        # sharded per-batch fns.
-        self.burst_batches = burst_batches if mesh is None else 1
+        self.burst_batches = burst_batches
         # Assume-then-bind (kube-scheduler's own cache pattern): the
         # cycle commits usage to the encoder IMMEDIATELY after the
         # kernel decides ("assume") and hands the network bind to a
@@ -110,10 +108,11 @@ class SchedulerLoop:
                 serving_fns,
             )
 
-            self._assign, self.sharded_score = serving_fns(cfg, mesh,
-                                                           method)
+            (self._assign, self.sharded_score,
+             self._sharded_burst) = serving_fns(cfg, mesh, method)
         else:
             self.sharded_score = None
+            self._sharded_burst = None
             self._assign = {"greedy": assign_greedy,
                             "parallel": assign_parallel}[method]
             # Batch-invariant static prep cache (the same explicit
@@ -333,13 +332,21 @@ class SchedulerLoop:
         self.timer.record("encode", (time.perf_counter() - t0) / n_real)
         self._emit_degraded_events()
         t0 = time.perf_counter()
-        with_stats = self.method == "parallel"
-        # Same version-keyed static cache as the per-batch cycle —
-        # recomputing the O(N²) prep inside every burst dispatch
-        # halved serving throughput on the CPU fallback.
-        static = self._static_for(state, version)
-        out = replay_stream_static(state, stream, static, self.cfg,
-                                   self.method, with_stats=with_stats)
+        if self._sharded_burst is not None:
+            # Mesh path: the shared-placer sharded scan (node axis on
+            # tp, batch axis on dp); static prep runs inside the
+            # dispatch like the mesh per-batch path, amortized over
+            # the burst.
+            out, with_stats = self._sharded_burst(state, stream)
+        else:
+            with_stats = self.method == "parallel"
+            # Same version-keyed static cache as the per-batch cycle —
+            # recomputing the O(N²) prep inside every burst dispatch
+            # halved serving throughput on the CPU fallback.
+            static = self._static_for(state, version)
+            out = replay_stream_static(state, stream, static, self.cfg,
+                                       self.method,
+                                       with_stats=with_stats)
         if with_stats:
             assignment_dev, _final_state, rounds_dev = out
             assignment = np.asarray(jax_block(assignment_dev))

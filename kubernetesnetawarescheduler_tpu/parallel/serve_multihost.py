@@ -136,6 +136,14 @@ def install_controller(loop, cfg: SchedulerConfig, mesh) -> \
     # (score_pods_auto fallback in api/extender._ScoreBatcher); only
     # the scheduling cycle's assign is distributed.
     loop.sharded_score = None
+    # Same reasoning for the backlog burst: followers join PER-BATCH
+    # assign-step broadcasts only, and a controller-side burst would
+    # run a global-mesh scan the followers never enter — process 0
+    # would hang at its first cross-process collective.  Multi-host
+    # serving therefore stays per-batch; single-process mesh loops
+    # keep their burst.
+    loop.burst_batches = 1
+    loop._sharded_burst = None
     return ctl
 
 
