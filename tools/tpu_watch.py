@@ -6,11 +6,15 @@ probe wastes any mid-round recovery window.  This watcher loops for
 the whole round:
 
   probe (killable subprocess, 120 s timeout)
-    -> on success, run the bench legs cheapest-first
-       (compile -> pallas_equal -> density_small -> density_full),
-       persisting each leg's JSON to ``bench_artifacts/tpu/<leg>.json``
-       the moment it lands — a 3-minute window still yields the Mosaic
-       compile artifact even if the tunnel dies before the full bench.
+    -> on success, run the bench legs cheapest-first (LEG_ORDER — the
+       authoritative sequence; see tools/tpu_legs.py for what each
+       does), persisting each leg's JSON to
+       ``bench_artifacts/tpu/<leg>.json`` the moment it lands — a
+       3-minute window still yields the Mosaic compile artifact even
+       if the tunnel dies before the full bench.  Green legs are
+       age-refreshed (REFRESH_FULL_S) so artifacts track current
+       code; a leg-specific failure moves on to the next leg after a
+       re-probe confirms the tunnel itself is alive.
 
 Every probe attempt is appended to
 ``bench_artifacts/tpu/probe_log.jsonl`` so the round has PROOF of
@@ -37,11 +41,12 @@ ART = os.path.join(os.path.dirname(__file__), "..", "bench_artifacts",
 # and only stops when the tunnel itself is gone.
 LEG_ORDER = ["compile", "device_latency", "density_small",
              "serving_qps", "serve_smoke", "pallas_equal",
-             "scale_probe", "density_full"]
+             "serving_host", "scale_probe", "density_full"]
 LEG_TIMEOUT_S = {"compile": 900, "pallas_equal": 1200,
                  "density_small": 1800, "serving_qps": 1800,
                  "device_latency": 900, "serve_smoke": 1800,
-                 "scale_probe": 1800, "density_full": 5400}
+                 "serving_host": 1800, "scale_probe": 1800,
+                 "density_full": 5400}
 PROBE_TIMEOUT_S = 120
 PROBE_INTERVAL_S = 120
 REFRESH_INTERVAL_S = 1800   # sleep cadence once every leg is green
