@@ -427,3 +427,33 @@ def test_pdb_from_json_parses_bounds():
                           "spec": {"selector": {"matchExpressions": [
                               {"key": "a", "operator": "Gt",
                                "values": ["1"]}]}}}) is None
+
+
+def test_preemption_fires_from_a_backlog_burst():
+    """A high-priority pod scheduled INSIDE a burst (multi-batch
+    single-dispatch cycle) still goes through the preemption planner
+    when the kernel rejects it: the burst path shares _plan_bind with
+    the per-batch cycle, so kernel rejections get identical
+    preempt-or-fail handling."""
+    cfg = SchedulerConfig(max_nodes=8, max_pods=2, max_peers=2,
+                          enable_preemption=True, queue_capacity=32)
+    cluster = FakeCluster()
+    for i in range(2):
+        cluster.add_node(Node(name=f"n{i}", capacity={"cpu": 4.0}))
+    loop = SchedulerLoop(cluster, cfg, burst_batches=4)
+    for i in range(2):
+        loop.encoder.update_metrics(f"n{i}", {"cpu": 10.0})
+    fill(cluster, loop, 2)  # both nodes full: 2x2cpu each, prio 1
+    # Deep queue (>= 2 batches of 2): vip + filler pods arrive as one
+    # burst; the filler pods are unschedulable (cluster full, equal
+    # priority), the vip preempts.
+    cluster.add_pods(
+        [Pod(name="vip", requests={"cpu": 3.0}, priority=9.0)]
+        + [Pod(name=f"x{i}", requests={"cpu": 2.0}, priority=1.0)
+           for i in range(5)])
+    loop.run_until_drained()
+    assert loop.burst_cycles > 0
+    assert cluster.node_of("vip") != ""
+    assert loop.preemptions == 2
+    evict_events = [e for e in cluster.events if e.reason == "Preempted"]
+    assert len(evict_events) == 2
