@@ -46,6 +46,12 @@ class QpsResult:
     mean_batch: float  # pods per kernel dispatch under concurrency
     conc_dispatches: int = 0  # kernel dispatches in the timed window
     batch_occupancy: float = 0.0  # mean_batch / max_pods
+    # Every timed pass, so the best-of selection behind ``conc_qps``
+    # is visible in the artifact itself, not just in the docs
+    # (advisor r4: a best-of-N number with the N hidden systematically
+    # overstates the steady state).
+    conc_qps_passes: list[float] = dataclasses.field(
+        default_factory=list)
 
     def to_dict(self) -> dict:
         return dataclasses.asdict(self)
@@ -131,11 +137,13 @@ def run_qps(num_nodes: int = 5120, max_pods: int = 256,
     conc_qps = 0.0
     dispatches = 0
     mean_batch = 0.0
+    passes: list[float] = []
     for _ in range(2):
         done.clear()
         dispatches_before = _dispatch_count(handlers)
         conc_wall = run_threads()
         qps = len(done) / conc_wall
+        passes.append(round(qps, 1))
         if qps > conc_qps:
             conc_qps = qps
             dispatches = _dispatch_count(handlers) - dispatches_before
@@ -149,6 +157,7 @@ def run_qps(num_nodes: int = 5120, max_pods: int = 256,
         mean_batch=round(mean_batch, 2),
         conc_dispatches=dispatches,
         batch_occupancy=round(mean_batch / max_pods, 3),
+        conc_qps_passes=passes,
     )
 
 

@@ -159,13 +159,35 @@ class SchedulerLoop:
         # bare-name alias (annotation peers use bare names) can be
         # dropped owner-checked — popping it unconditionally on pod
         # deletion would evict a same-named pod from another
-        # namespace.  GIL-atomic dict ops, same threading contract as
-        # _assumed_uids.
+        # namespace.  _alias_lock guards the compound read-modify-
+        # write sequences (refcounted bare-alias poisoning below);
+        # single-key reads stay lock-free (GIL-atomic), same threading
+        # contract as _assumed_uids.
         self._assumed_node: dict[str, tuple[str, str]] = {}
+        # Namespaces with a LIVE assumption per bare pod name.  While
+        # two or more namespaces hold the same bare name, the bare
+        # alias is ambiguous and stays dropped ("poisoned") — the
+        # refcount makes the poison sticky across re-assumes (a dict
+        # probe alone cannot distinguish "never collided" from
+        # "poisoned then popped") and restores the survivor's alias
+        # when the collision clears.
+        self._bare_ns: dict[str, set[str]] = {}
+        self._alias_lock = threading.Lock()
         # Pods the kernel rejected while unconfirmed assumptions held
         # capacity: requeued when a rollback frees some (bounded; the
         # periodic resync re-delivers anything dropped).
         self._unsched_parked: "deque[Pod]" = deque(maxlen=1024)
+        # O(1) membership alongside the deque (PodQueue._queued's
+        # pattern) so the per-deletion purge check in _on_pod_gone is
+        # a set probe, not a 1024-entry scan under the lock.  May
+        # over-approximate (a maxlen-evicted pod's uid lingers until
+        # its deletion) — harmless: the rebuild just finds nothing.
+        self._parked_uids: set[str] = set()
+        # Guards every _unsched_parked iteration/mutation: the cycle
+        # thread appends, the bind worker and node-add callback drain,
+        # and _on_pod_gone rebuilds — same mid-iteration RuntimeError
+        # hazard _round_lock documents for round_samples.
+        self._parked_lock = threading.Lock()
         if async_bind:
             # Bounded: a dead/slow API server must apply backpressure
             # to the cycle, not buffer unbounded assumed state.
@@ -239,6 +261,18 @@ class SchedulerLoop:
         # Keep the assume-dedup set bounded by live-pod lifetime.
         self._assumed_uids.discard(pod.uid)
         self._drop_assumed_node(pod)
+        # A deleted pod must not be revived by _requeue_parked (the
+        # spurious assume/bind would roll back via the bind failure,
+        # but inflates counters and emits a bogus event first).
+        with self._parked_lock:
+            if pod.uid in self._parked_uids:
+                from collections import deque
+
+                self._parked_uids.discard(pod.uid)
+                self._unsched_parked = deque(
+                    (p for p in self._unsched_parked
+                     if p.uid != pod.uid),
+                    maxlen=self._unsched_parked.maxlen)
         # A deleted preemptor abandons its reservation and wait.
         with self._preempt_lock:
             if self._awaiting_preemption.pop(pod.uid, None) is not None:
@@ -310,14 +344,20 @@ class SchedulerLoop:
             replay_stream_static,
         )
 
-        # Timer samples are per-batch-NORMALIZED (timer.record of
-        # wall / n_real per phase): the percentile streams feed
-        # host-mode density and /metrics as per-batch latency, and an
-        # un-normalized burst sample would read as an 8x regression
-        # (the pipeline replay normalizes its per-chunk samples the
-        # same way).
+        # Timer samples are per-batch-NORMALIZED (wall / n_real per
+        # phase): the percentile streams feed host-mode density and
+        # /metrics as per-batch latency, and an un-normalized burst
+        # sample would read as an 8x regression (the pipeline replay
+        # normalizes its per-chunk samples the same way).  Each phase
+        # records the normalized value with WEIGHT n_real so a burst
+        # carries its full per-batch weight in the percentile streams
+        # (one averaged sample per burst structurally under-reported
+        # the tail), and the un-normalized cycle wall goes to
+        # ``burst_wall`` — the latency the last batch in the burst
+        # actually observed end-to-end.
         n_real = -(-len(pods) // self.cfg.max_pods)
-        t0 = time.perf_counter()
+        cycle_t0 = time.perf_counter()
+        t0 = cycle_t0
         stream = self.encoder.encode_stream(
             pods, node_of=self._peer_node, lenient=True)
         # Pad to the FULL burst shape, not just a batch multiple:
@@ -329,7 +369,9 @@ class SchedulerLoop:
                             self.burst_batches * self.cfg.max_pods)
         state, version = self.encoder.snapshot_versioned()
         node_table = self.encoder.node_table()
-        self.timer.record("encode", (time.perf_counter() - t0) / n_real)
+        self.timer.record("encode",
+                          (time.perf_counter() - t0) / n_real,
+                          count=n_real)
         self._emit_degraded_events()
         t0 = time.perf_counter()
         if self._sharded_burst is not None:
@@ -358,7 +400,8 @@ class SchedulerLoop:
             assignment_dev, _final_state = out
             assignment = np.asarray(jax_block(assignment_dev))
         self.timer.record("score_assign",
-                          (time.perf_counter() - t0) / n_real)
+                          (time.perf_counter() - t0) / n_real,
+                          count=n_real)
         assignment = assignment[:len(pods)]
         t0 = time.perf_counter()
         if self.async_bind:
@@ -366,7 +409,11 @@ class SchedulerLoop:
                                              node_table)
         else:
             bound = self._bind_all(pods, assignment, node_table)
-        self.timer.record("bind", (time.perf_counter() - t0) / n_real)
+        self.timer.record("bind",
+                          (time.perf_counter() - t0) / n_real,
+                          count=n_real)
+        self.timer.record("burst_wall",
+                          time.perf_counter() - cycle_t0)
         self.burst_cycles += 1
         return bound
 
@@ -454,13 +501,58 @@ class SchedulerLoop:
                 component=self.cfg.scheduler_name, type="Warning")
             for namespace, name, count, detail in degraded])
 
+    def _publish_assumed_node(self, pod: Pod, node_name: str) -> None:
+        """Record an assumed placement under the qualified name and —
+        when unambiguous — the bare alias.  On a cross-namespace
+        bare-name collision the bare alias is POISONED (dropped, and
+        held dropped by _bare_ns' refcount) instead of last-writer-
+        wins: an annotation peer's bare reference must never silently
+        resolve to the other namespace's node; the inherently
+        ambiguous lookup falls through to the client, whose own
+        bare-name semantics then apply.  Qualified references always
+        resolve exactly."""
+        entry = (pod.namespace, node_name)
+        with self._alias_lock:
+            nss = self._bare_ns.setdefault(pod.name, set())
+            nss.add(pod.namespace)
+            if len(nss) == 1:
+                self._assumed_node[pod.name] = entry
+            else:
+                self._assumed_node.pop(pod.name, None)
+            self._assumed_node[f"{pod.namespace}/{pod.name}"] = entry
+
     def _drop_assumed_node(self, pod: Pod) -> None:
-        """Remove a pod's assumed-placement entries; the bare-name
-        alias is dropped only when this pod's namespace owns it."""
-        entry = self._assumed_node.get(pod.name)
-        if entry is not None and entry[0] == pod.namespace:
-            self._assumed_node.pop(pod.name, None)
-        self._assumed_node.pop(f"{pod.namespace}/{pod.name}", None)
+        """Remove a pod's assumed-placement entries.  The bare-name
+        alias is dropped only when this pod's namespace owns it; when
+        the drop resolves a cross-namespace collision down to one
+        surviving namespace, the survivor's bare alias is restored
+        (see _bare_ns in __init__)."""
+        with self._alias_lock:
+            self._assumed_node.pop(f"{pod.namespace}/{pod.name}", None)
+            nss = self._bare_ns.get(pod.name)
+            if nss is None:
+                # Never assumed (or already fully dropped): nothing
+                # beyond the owner-checked bare cleanup below.
+                entry = self._assumed_node.get(pod.name)
+                if entry is not None and entry[0] == pod.namespace:
+                    self._assumed_node.pop(pod.name, None)
+                return
+            nss.discard(pod.namespace)
+            if not nss:
+                del self._bare_ns[pod.name]
+                entry = self._assumed_node.get(pod.name)
+                if entry is not None and entry[0] == pod.namespace:
+                    self._assumed_node.pop(pod.name, None)
+            elif len(nss) == 1:
+                # Collision resolved: the survivor becomes bare-
+                # addressable again (its qualified entry is live iff
+                # its assumption still is).
+                ns = next(iter(nss))
+                surv = self._assumed_node.get(f"{ns}/{pod.name}")
+                if surv is not None:
+                    self._assumed_node[pod.name] = surv
+                else:
+                    self._assumed_node.pop(pod.name, None)
 
     def _peer_node(self, pod_name: str) -> str:
         # The scheduler's own assumed cache first (assume-then-bind:
@@ -617,7 +709,9 @@ class SchedulerLoop:
                 # slow periodic resync.  kube-scheduler's own
                 # unschedulable-queue flush on cluster events.
                 if self.async_bind:
-                    self._unsched_parked.append(pod)
+                    with self._parked_lock:
+                        self._unsched_parked.append(pod)
+                        self._parked_uids.add(pod.uid)
                 continue
             name = table_names[idx]
             if self.decision_log is not None:
@@ -743,13 +837,18 @@ class SchedulerLoop:
 
     def _requeue_parked(self) -> None:
         """Requeue every parked unschedulable pod (called when
-        capacity appears: an assumed-bind rollback or a new node)."""
-        while self._unsched_parked:
-            try:
+        capacity appears: an assumed-bind rollback or a new node).
+
+        Pushes happen UNDER the lock so a concurrent _on_pod_gone
+        cannot miss a drained-but-unpushed pod and revive a deletion
+        (queue.push is non-blocking — a full queue drops — and takes
+        no lock that ever waits on _parked_lock, so the nesting cannot
+        deadlock)."""
+        with self._parked_lock:
+            while self._unsched_parked:
                 parked = self._unsched_parked.popleft()
-            except IndexError:
-                break
-            self.queue.push(parked)  # full queue drops; resync heals
+                self._parked_uids.discard(parked.uid)
+                self.queue.push(parked)  # full queue drops; resync heals
 
     def _assume_and_enqueue(self, pods: Sequence[Pod],
                             assignment: np.ndarray,
@@ -797,9 +896,7 @@ class SchedulerLoop:
                 # ("ns/name", kubeclient pod_from_json), annotation
                 # peers and the fake cluster use bare names — the
                 # same dual indexing the stream encode uses.
-                entry = (pod.namespace, name)
-                self._assumed_node[pod.name] = entry
-                self._assumed_node[f"{pod.namespace}/{pod.name}"] = entry
+                self._publish_assumed_node(pod, name)
         self._bind_q.put(([p for p, _, _ in keep],
                           [i for _, i, _ in keep],
                           [n for _, _, n in keep],

@@ -24,7 +24,12 @@ class PhaseTimer:
     ``summary()`` iteration on the scrape thread."""
 
     def __init__(self) -> None:
-        self._samples: dict[str, list[float]] = {}
+        # (seconds, weight) pairs: a burst cycle records its
+        # per-batch-normalized sample once with weight n_batches
+        # instead of n_batches identical floats, so storage stays
+        # O(cycles) in a long-lived daemon while the percentile math
+        # still gives each batch full weight.
+        self._samples: dict[str, list[tuple[float, int]]] = {}
         self._lock = threading.Lock()
 
     @contextlib.contextmanager
@@ -35,27 +40,38 @@ class PhaseTimer:
         finally:
             self.record(name, time.perf_counter() - start)
 
-    def record(self, name: str, seconds: float) -> None:
+    def record(self, name: str, seconds: float,
+               count: int = 1) -> None:
+        """Record ``count`` observations of ``seconds`` (weighted)."""
+        if count < 1:
+            return
         with self._lock:
-            self._samples.setdefault(name, []).append(seconds)
+            self._samples.setdefault(name, []).append((seconds, count))
 
     def count(self, name: str) -> int:
         with self._lock:
-            return len(self._samples.get(name, ()))
+            return sum(c for _, c in self._samples.get(name, ()))
 
     def total(self, name: str) -> float:
         with self._lock:
-            return sum(self._samples.get(name, ()))
+            return sum(s * c for s, c in self._samples.get(name, ()))
 
     def percentile(self, name: str, q: float) -> float:
-        """q in [0, 100]; nearest-rank on the sorted samples."""
+        """q in [0, 100]; nearest-rank on the weight-expanded sorted
+        samples (identical to materializing each pair ``count``
+        times)."""
         with self._lock:
             samples = sorted(self._samples.get(name, ()))
         if not samples:
             return 0.0
-        rank = min(len(samples) - 1, max(0, int(round(
-            q / 100.0 * (len(samples) - 1)))))
-        return samples[rank]
+        n = sum(c for _, c in samples)
+        rank = min(n - 1, max(0, int(round(q / 100.0 * (n - 1)))))
+        cum = 0
+        for value, c in samples:
+            cum += c
+            if rank < cum:
+                return value
+        return samples[-1][0]
 
     def summary(self) -> Mapping[str, Mapping[str, float]]:
         with self._lock:

@@ -279,3 +279,42 @@ def test_assumed_node_cross_namespace_eviction():
     loop._on_pod_gone(Pod(name="web", namespace="team-b", uid="b"))
     assert "web" not in loop._assumed_node
     loop.stop_bind_worker()
+
+
+def test_assumed_node_collision_poisons_bare_alias():
+    """Cross-namespace bare-name collision: the bare alias must stay
+    dropped (sticky poison) while both assumptions are live — even
+    across a re-assume of either pod — and be restored for the
+    survivor once the collision clears.  Qualified keys always
+    resolve."""
+    from kubernetesnetawarescheduler_tpu.bench.fakecluster import (
+        build_fake_cluster as _bfc,
+    )
+    from kubernetesnetawarescheduler_tpu.k8s.types import Pod
+
+    cfg = SchedulerConfig(max_nodes=8, max_pods=4, max_peers=2)
+    cluster, _, _ = _bfc(ClusterSpec(num_nodes=4, seed=82))
+    loop = SchedulerLoop(cluster, cfg, async_bind=True)
+    pa = Pod(name="web", namespace="team-a", uid="a")
+    pb = Pod(name="web", namespace="team-b", uid="b")
+    loop._publish_assumed_node(pa, "node-0000")
+    assert loop._assumed_node["web"] == ("team-a", "node-0000")
+    # Second namespace assumes the same bare name: poison.
+    loop._publish_assumed_node(pb, "node-0001")
+    assert "web" not in loop._assumed_node
+    assert loop._assumed_node["team-a/web"] == ("team-a", "node-0000")
+    assert loop._assumed_node["team-b/web"] == ("team-b", "node-0001")
+    # Re-assume while the collision is live (rollback -> requeue ->
+    # assume again): the poison must be sticky, not last-writer-wins.
+    loop._drop_assumed_node(pb)
+    loop._publish_assumed_node(pb, "node-0002")
+    assert "web" not in loop._assumed_node
+    # One side's deletion clears the collision: the survivor becomes
+    # bare-addressable again.
+    loop._on_pod_gone(pb)
+    assert loop._assumed_node["web"] == ("team-a", "node-0000")
+    assert loop._peer_node("web") == "node-0000"
+    loop._on_pod_gone(pa)
+    assert "web" not in loop._assumed_node
+    assert not loop._bare_ns
+    loop.stop_bind_worker()
