@@ -101,7 +101,46 @@ def _persisted_tpu_density() -> dict | None:
     doc["detail"]["measured_at"] = leg.get("ts", "")
     doc["detail"]["measured_git"] = leg.get("git", "")
     doc["detail"]["artifact_age_s"] = round(age_s)
+    if "score_p99_source" not in doc["detail"]:
+        # Artifact captured by a pre-round-5 bench.py: its score_* are
+        # HOST-observed (tunnel transport included).  Re-label them
+        # honestly and promote the watcher's device-boundary latency
+        # artifact — a real hardware measurement at the same shape —
+        # to the primary fields, with provenance.
+        d = doc["detail"]
+        d["host_score_p50_ms"] = d.get("score_p50_ms")
+        d["host_score_p99_ms"] = d.get("score_p99_ms")
+        d["host_score_samples"] = d.get("score_samples")
+        dl = _persisted_device_latency(d.get("score_backend", "pallas"))
+        if dl is not None:
+            d["score_p50_ms"] = dl["p50_ms"]
+            d["score_p99_ms"] = dl["p99_ms"]
+            d["score_samples"] = dl["reps"]
+            d["score_p99_source"] = "device_boundary_artifact"
+            d["score_p99_artifact_git"] = dl.get("git", "")
+        else:
+            d["score_p99_source"] = "host_observed"
     return doc
+
+
+def _persisted_device_latency(backend: str) -> dict | None:
+    """The watcher's ``device_latency`` leg for one score backend
+    (tools/tpu_legs.leg_device_latency), with the capturing git SHA
+    attached; None when absent/failed."""
+    path = os.path.join(_TPU_ART_DIR, "device_latency.json")
+    try:
+        with open(path) as f:
+            leg = json.load(f)
+    except (OSError, ValueError):
+        return None
+    if not leg.get("ok"):
+        return None
+    sub = leg.get("detail", {}).get(backend)
+    if not isinstance(sub, dict) or "p99_ms" not in sub:
+        return None
+    sub = dict(sub)
+    sub["git"] = leg.get("git", "")
+    return sub
 
 
 def _mark_driver_active():
@@ -170,9 +209,10 @@ def _probe_log_stats() -> dict:
 
 
 def _run_backend_subprocess(backend: str, force_cpu: bool,
-                            timeout_s: float | None = None):
+                            timeout_s: float | None = None,
+                            env_extra: dict | None = None) -> dict:
     """Re-invoke this script pinned to one score backend and parse its
-    headline JSON back into a result-shaped object.
+    headline JSON doc back.
 
     In the backend-comparison mode EVERY leg runs this way and the
     parent never initializes a JAX backend at all: the TPU is a
@@ -187,6 +227,8 @@ def _run_backend_subprocess(backend: str, force_cpu: bool,
     env["BENCH_CHILD"] = "1"  # suppresses the child's own CPU fallback
     if force_cpu:
         env["BENCH_FORCE_CPU"] = "1"
+    if env_extra:
+        env.update(env_extra)
     proc = subprocess.run([sys.executable, __file__],
                           capture_output=True, timeout=timeout_s,
                           env=env)
@@ -195,25 +237,156 @@ def _run_backend_subprocess(backend: str, force_cpu: bool,
             f"subprocess rc={proc.returncode}: "
             f"{proc.stderr.decode(errors='replace')[-300:]}")
     line = proc.stdout.decode().strip().splitlines()[-1]
-    doc = json.loads(line)
+    return json.loads(line)
 
-    class _Sub:  # duck-typed slice of DensityResult the report reads
-        pods_per_sec = float(doc["value"])
-        pods_bound = int(doc["detail"]["pods_bound"])
-        pods_unschedulable = int(doc["detail"]["pods_unschedulable"])
-        score_p50_ms = float(doc["detail"]["score_p50_ms"])
-        score_p99_ms = float(doc["detail"]["score_p99_ms"])
-        encode_p99_ms = float(doc["detail"]["encode_p99_ms"])
-        bind_p99_ms = float(doc["detail"]["bind_p99_ms"])
-        score_samples = int(doc["detail"]["score_samples"])
-        executed_backend = str(doc["detail"]["backend"])
-        mesh_desc = str(doc["detail"].get("mesh", ""))
-        mode_str = str(doc["detail"]["mode"])
-        rounds_p50 = float(doc["detail"].get("rounds_p50", 0.0))
-        rounds_p99 = float(doc["detail"].get("rounds_p99", 0.0))
-        rounds_max = int(doc["detail"].get("rounds_max", 0))
 
-    return _Sub()
+def _measure_device_leg(num_nodes: int, batch: int,
+                        backend: str) -> dict | None:
+    """Device-boundary schedule-step latency at the bench shape
+    (VERDICT r4 #2: the artifact's PRIMARY p99 must be measured where
+    the north-star bar means it — at the device, not through the
+    tunnel's fetch RTT).  None on failure; the caller falls back to
+    host-observed numbers, labeled as such."""
+    try:
+        from kubernetesnetawarescheduler_tpu.bench.density import (
+            measure_device_latency,
+        )
+
+        reps = int(os.environ.get("BENCH_DEVICE_REPS", "300"))
+        return measure_device_latency(num_nodes, batch,
+                                      score_backend=backend, reps=reps)
+    except Exception as exc:  # noqa: BLE001 — the density headline
+        # must survive a microbench failure
+        print(f"WARNING: device-latency leg failed: "
+              f"{type(exc).__name__}: {exc}", file=sys.stderr)
+        return None
+
+
+def _assemble_doc(res, *, num_nodes: int, batch: int, method: str,
+                  mode: str, executed_backend: str, score_backend: str,
+                  mesh_desc: str, device_lat: dict | None) -> dict:
+    """The headline JSON doc for one fully-executed density leg.
+
+    ``score_p50/p99_ms`` are the DEVICE-BOUNDARY percentiles of one
+    ISOLATED per-batch dispatch (assign + commit on the serving
+    loop's cached static) when the microbench succeeded
+    (``score_p99_source: "device_boundary"``) — a conservative
+    latency: no pipelining, full dispatch overhead per sample.  The
+    drain's host-observed numbers are always preserved under
+    ``host_score_*``: in pipeline mode those are per-batch
+    steady-state SERVICE times (chunk arrival gaps with the dispatch
+    window full — they can legitimately sit below the isolated
+    dispatch latency), and on a tunneled chip they additionally carry
+    the ~65 ms fetch RTT.  ``host_score_samples`` counts per-batch
+    WEIGHTED observations since round 5's PhaseTimer change."""
+    detail = {
+        "pods_bound": res.pods_bound,
+        "pods_unschedulable": res.pods_unschedulable,
+        "host_score_p50_ms": round(res.score_p50_ms, 2),
+        "host_score_p99_ms": round(res.score_p99_ms, 2),
+        "host_score_samples": res.score_samples,
+        "encode_p99_ms": round(res.encode_p99_ms, 2),
+        "bind_p99_ms": round(res.bind_p99_ms, 2),
+        "batch_size": batch,
+        "method": method,
+        "mode": mode,
+        "backend": executed_backend,
+        "score_backend": score_backend,
+        "mesh": mesh_desc,
+        # Conflict-round distribution of assign_parallel (one sample
+        # per batch): whether device latency is matmul-bound or
+        # round-bound (VERDICT.md round 2, weak #1).
+        "rounds_p50": round(getattr(res, "rounds_p50", 0.0), 1),
+        "rounds_p99": round(getattr(res, "rounds_p99", 0.0), 1),
+        "rounds_max": int(getattr(res, "rounds_max", 0)),
+    }
+    if device_lat is not None:
+        detail.update({
+            "score_p50_ms": device_lat["p50_ms"],
+            "score_p99_ms": device_lat["p99_ms"],
+            "score_max_ms": device_lat["max_ms"],
+            "score_samples": device_lat["reps"],
+            "score_static_prep_ms": device_lat.get("static_prep_ms"),
+            "score_p99_source": "device_boundary",
+            # What the host sees beyond the device's own latency:
+            # dispatch/fetch transport (the dev tunnel's RTT when
+            # present; near zero co-located).
+            "host_transport_p50_ms": round(max(
+                0.0, res.score_p50_ms - device_lat["p50_ms"]), 2),
+        })
+    else:
+        detail.update({
+            "score_p50_ms": round(res.score_p50_ms, 2),
+            "score_p99_ms": round(res.score_p99_ms, 2),
+            "score_samples": res.score_samples,
+            "score_p99_source": "host_observed",
+        })
+    return {
+        "metric": f"density_pods_per_sec_n{num_nodes}",
+        "value": round(res.pods_per_sec, 1),
+        "unit": "pods/s",
+        "vs_baseline": round(res.pods_per_sec / REFERENCE_PODS_PER_SEC,
+                             2),
+        "detail": detail,
+    }
+
+
+def _attach_north_star(doc: dict) -> None:
+    """Self-certify the BASELINE.json bar inside the artifact
+    (VERDICT r4 #2: the driver artifact must pass/fail the p99 bar on
+    its face, no cross-referencing).  ``p99_met`` is judged on the
+    primary (device-boundary when available) p99."""
+    detail = doc["detail"]
+    p99 = float(detail.get("score_p99_ms", 1e9))
+    ns = {
+        "pods_per_sec_target": 10000.0,
+        "p99_bar_ms": 5.0,
+        "pods_per_sec_met": float(doc["value"]) >= 10000.0,
+        "p99_met": p99 < 5.0,
+        "p99_source": detail.get("score_p99_source", "unknown"),
+    }
+    detail["north_star"] = ns
+    if detail.get("backend") == "tpu" and not (
+            ns["pods_per_sec_met"] and ns["p99_met"]):
+        print(f"WARNING: north-star bar missed on TPU: {ns}",
+              file=sys.stderr)
+
+
+def _attach_cpu_density(doc: dict) -> None:
+    """A fresh CPU density leg rides along with every TPU (or
+    persisted-TPU) headline so backend regressions on the always-
+    available backend are caught even on tunnel-wedge rounds
+    (VERDICT r4 #6).  Reduced pod count: this is a regression canary,
+    not the headline."""
+    if os.environ.get("BENCH_SKIP_CPU_LEG", "") == "1":
+        return
+    cpu_pods = os.environ.get(
+        "BENCH_CPU_PODS",
+        str(min(16384, int(os.environ.get("BENCH_PODS", "65536")))))
+    try:
+        sub = _run_backend_subprocess(
+            "xla", force_cpu=True,
+            timeout_s=float(os.environ.get("BENCH_CPU_TIMEOUT_S",
+                                           "3600")),
+            env_extra={"BENCH_PODS": cpu_pods,
+                       "BENCH_DEVICE_REPS": "100",
+                       "BENCH_MESH": "off"})
+        d = sub["detail"]
+        doc["detail"]["cpu_density"] = {
+            "pods_per_sec": sub["value"],
+            "num_pods": int(cpu_pods),
+            "score_p50_ms": d.get("score_p50_ms"),
+            "score_p99_ms": d.get("score_p99_ms"),
+            "score_p99_source": d.get("score_p99_source"),
+            "host_score_p99_ms": d.get("host_score_p99_ms"),
+            "mode": d.get("mode"),
+            "measured_now": True,
+        }
+    except Exception as exc:  # noqa: BLE001
+        doc["detail"]["cpu_density_error"] = \
+            f"{type(exc).__name__}: {exc}"
+        print(f"WARNING: CPU density leg failed: {exc}",
+              file=sys.stderr)
 
 
 def main() -> None:
@@ -254,6 +427,8 @@ def main() -> None:
                   f"({persisted['detail'].get('measured_at', '?')})",
                   file=sys.stderr)
             persisted["detail"].update(_probe_log_stats())
+            _attach_north_star(persisted)
+            _attach_cpu_density(persisted)
             print(json.dumps(persisted))
             return
         # Degrade to CPU instead of hanging the driver: the JSON line
@@ -299,7 +474,6 @@ def main() -> None:
 
     results = {}
     errors = {}
-    executed_backend = ""
     mesh_desc = ""
     mesh_error = ""
     if len(backends) > 1:
@@ -330,7 +504,6 @@ def main() -> None:
             try:
                 results[backend] = _run_backend_subprocess(
                     backend, force_cpu=force_cpu)
-                executed_backend = results[backend].executed_backend
             except Exception as exc:  # noqa: BLE001 — a failing
                 # backend must not discard the other's measurement:
                 # the headline line is the driver's only artifact.
@@ -391,14 +564,24 @@ def main() -> None:
         backend = backends[0]
         try:
             with trace_cm:
-                results[backend] = run_density(
+                res = run_density(
                     num_nodes=num_nodes, num_pods=num_pods,
                     batch_size=batch, method=method, mode=mode,
                     chunk_batches=chunk_batches, score_backend=backend,
                     mesh=mesh)
         except Exception as exc:  # noqa: BLE001
             errors[backend] = f"{type(exc).__name__}: {exc}"
+            res = None
         executed_backend = jax.default_backend()
+        if res is not None:
+            # The device-boundary microbench shares this process (and
+            # so the single-owner chip) with the drain above.
+            device_lat = _measure_device_leg(num_nodes, batch, backend)
+            results[backend] = _assemble_doc(
+                res, num_nodes=num_nodes, batch=batch, method=method,
+                mode=mode, executed_backend=executed_backend,
+                score_backend=backend, mesh_desc=mesh_desc,
+                device_lat=device_lat)
     if (not results and not force_cpu
             and "BENCH_CHILD" not in os.environ):
         # Top-level invocations only: a comparison-mode CHILD leg
@@ -420,6 +603,8 @@ def main() -> None:
             persisted["detail"].update(_probe_log_stats())
             for backend, err in errors.items():
                 persisted["detail"][f"{backend}_error"] = err
+            _attach_north_star(persisted)
+            _attach_cpu_density(persisted)
             print(json.dumps(persisted))
             return
         print(f"WARNING: all TPU legs failed ({errors}); falling back "
@@ -428,55 +613,41 @@ def main() -> None:
             # Generous explicit timeout: the 900s default is sized for
             # TPU legs; the CPU density run at full scale can exceed it
             # and this leg is the last line of defense for the JSON.
+            # Reduced microbench reps: 300 isolated N=5120 dispatches
+            # on CPU would add ~60% more scoring work to a leg that is
+            # already the slowest path through this script.
             results["xla"] = _run_backend_subprocess(
-                "xla", force_cpu=True, timeout_s=7200)
-            executed_backend = results["xla"].executed_backend
+                "xla", force_cpu=True, timeout_s=7200,
+                env_extra={"BENCH_DEVICE_REPS": os.environ.get(
+                    "BENCH_DEVICE_REPS", "100")})
         except Exception as exc:  # noqa: BLE001
             errors["cpu-fallback"] = f"{type(exc).__name__}: {exc}"
     if not results:
         raise SystemExit(f"all score backends failed: {errors}")
-    best = max(results, key=lambda b: results[b].pods_per_sec)
-    res = results[best]
-    detail = {
-        "pods_bound": res.pods_bound,
-        "pods_unschedulable": res.pods_unschedulable,
-        "score_p50_ms": round(res.score_p50_ms, 2),
-        "score_p99_ms": round(res.score_p99_ms, 2),
-        "encode_p99_ms": round(res.encode_p99_ms, 2),
-        "bind_p99_ms": round(res.bind_p99_ms, 2),
-        "score_samples": res.score_samples,
-        "batch_size": batch,
-        "method": method,
-        "mode": getattr(res, "mode_str", mode),
-        "backend": executed_backend,
-        "score_backend": best,
-        "mesh": getattr(res, "mesh_desc", mesh_desc),
-        # Conflict-round distribution of assign_parallel (one sample
-        # per batch): whether device latency is matmul-bound or
-        # round-bound (VERDICT.md round 2, weak #1).
-        "rounds_p50": round(getattr(res, "rounds_p50", 0.0), 1),
-        "rounds_p99": round(getattr(res, "rounds_p99", 0.0), 1),
-        "rounds_max": int(getattr(res, "rounds_max", 0)),
-    }
+    best = max(results, key=lambda b: float(results[b]["value"]))
+    doc = results[best]
+    detail = doc["detail"]
     for backend, r in results.items():
         if backend != best:
-            detail[f"{backend}_pods_per_sec"] = round(r.pods_per_sec, 1)
-            detail[f"{backend}_score_p50_ms"] = round(r.score_p50_ms, 2)
+            detail[f"{backend}_pods_per_sec"] = r["value"]
+            detail[f"{backend}_score_p50_ms"] = \
+                r["detail"].get("score_p50_ms")
     for backend, err in errors.items():
         detail[f"{backend}_error"] = err
     if mesh_error:
         detail["mesh_error"] = mesh_error
-    if executed_backend != "tpu":
-        # CPU fallback: attach the watcher's round-long probe record as
-        # proof the tunnel was tried continuously, not just at startup.
-        detail.update(_probe_log_stats())
-    print(json.dumps({
-        "metric": f"density_pods_per_sec_n{num_nodes}",
-        "value": round(res.pods_per_sec, 1),
-        "unit": "pods/s",
-        "vs_baseline": round(res.pods_per_sec / REFERENCE_PODS_PER_SEC, 2),
-        "detail": detail,
-    }))
+    if "BENCH_CHILD" not in os.environ:
+        # Top-level assembly only: children emit their leg's doc
+        # verbatim and the parent certifies/augments once.
+        _attach_north_star(doc)
+        if detail.get("backend") == "tpu":
+            _attach_cpu_density(doc)
+        if detail.get("backend") != "tpu":
+            # CPU fallback: attach the watcher's round-long probe
+            # record as proof the tunnel was tried continuously, not
+            # just at startup.
+            detail.update(_probe_log_stats())
+    print(json.dumps(doc))
 
 
 if __name__ == "__main__":

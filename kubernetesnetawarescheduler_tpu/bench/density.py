@@ -522,3 +522,83 @@ def _run_density_device(cluster, loop: SchedulerLoop, pods, cfg,
         rounds_p99=_percentile(round_samples, 99),
         rounds_max=max(round_samples, default=0),
     )
+
+
+def measure_device_latency(num_nodes: int, batch_size: int,
+                           score_backend: str = "pallas",
+                           reps: int = 300, seed: int = 7,
+                           warmup_reps: int = 5) -> dict:
+    """p50/p99/max of ONE jitted ``schedule_batch`` (score + conflict
+    resolution + commit — the full per-batch scheduling decision) at
+    the given shape, timed at the DEVICE boundary.
+
+    This is the north star's "p99 Score() < 5 ms" measured where the
+    bar means it: ``block_until_ready`` on the device output with no
+    bulk device->host transfer, so a tunneled dev chip's ~65 ms fetch
+    RTT — which dominates the HOST-observed per-chunk percentiles in
+    the density replay — does not masquerade as kernel latency.  The
+    reference's equivalent cost was 5 serial node_exporter scrapes per
+    pod (scheduler.go:191, :275-279): milliseconds of network I/O per
+    POD versus sub-millisecond per BATCH here.
+
+    The timed step is the SERVING LOOP's cache-hit per-batch dispatch:
+    ``assign_parallel`` with the precomputed batch-invariant static
+    (SchedulerLoop._static_for amortizes the O(N²) normalizer prep
+    across cycles until metrics/network move) plus
+    ``commit_assignments`` — exactly what one watch-loop cycle sends
+    to the device.  The one-off prep cost is reported separately as
+    ``static_prep_ms``.
+
+    Returns a dict (not a DensityResult): this is a microbenchmark of
+    the per-batch decision, not a drain."""
+    import jax
+
+    from kubernetesnetawarescheduler_tpu.core.assign import (
+        assign_parallel,
+        commit_assignments,
+    )
+    from kubernetesnetawarescheduler_tpu.core.pallas_score import (
+        compute_assign_static,
+    )
+
+    cfg = SchedulerConfig(max_nodes=_round_up(num_nodes, 128),
+                          max_pods=batch_size, max_peers=4,
+                          score_backend=score_backend)
+    loop = _throwaway_loop(num_nodes, seed, cfg, "parallel")
+    pods = generate_workload(
+        WorkloadSpec(num_pods=batch_size, seed=seed + 5, services=8,
+                     peer_fraction=0.5, affinity_fraction=0.1,
+                     anti_fraction=0.1),
+        scheduler_name=cfg.scheduler_name)
+    batch = loop.encoder.encode_pods(pods, node_of=lambda n: "",
+                                     lenient=True)
+    state = loop.encoder.snapshot()
+    prep = jax.jit(lambda s: compute_assign_static(s, cfg))
+    static = jax.block_until_ready(prep(state))  # compile
+    t0 = time.perf_counter()
+    static = jax.block_until_ready(prep(state))
+    static_prep_ms = (time.perf_counter() - t0) * 1e3
+
+    def _step(s, b, st):
+        a = assign_parallel(s, b, cfg, st)
+        return a, commit_assignments(s, b, a)
+
+    step = jax.jit(_step)
+    for _ in range(max(1, warmup_reps)):
+        jax.block_until_ready(step(state, batch, static))
+    times = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        jax.block_until_ready(step(state, batch, static))
+        times.append(time.perf_counter() - t0)
+    return {
+        "p50_ms": round(_percentile_ms(times, 50), 3),
+        "p99_ms": round(_percentile_ms(times, 99), 3),
+        "max_ms": round(max(times) * 1e3, 3),
+        "reps": len(times),
+        "static_prep_ms": round(static_prep_ms, 3),
+        "num_nodes": num_nodes,
+        "batch_size": batch_size,
+        "score_backend": score_backend,
+        "backend": jax.default_backend(),
+    }

@@ -44,3 +44,39 @@ def test_phase_timer_scrape_during_new_key_inserts():
     assert not w.is_alive() and not r.is_alive()
     assert not errs, errs
     assert timer.count("phase-1") == 1
+
+
+def test_weighted_records_match_materialized_duplicates():
+    """record(name, v, count=n) must be indistinguishable — for
+    count/total/percentile — from n separate record(name, v) calls
+    (the burst cycle's weighting contract, core/loop.py
+    schedule_pods_burst)."""
+    import random
+
+    rng = random.Random(7)
+    weighted = PhaseTimer()
+    expanded = PhaseTimer()
+    for _ in range(200):
+        v = rng.uniform(0.0001, 0.05)
+        c = rng.choice([1, 1, 1, 2, 8, 50])
+        weighted.record("x", v, count=c)
+        for _ in range(c):
+            expanded.record("x", v)
+    assert weighted.count("x") == expanded.count("x")
+    assert abs(weighted.total("x") - expanded.total("x")) < 1e-9
+    for q in (0, 1, 25, 50, 75, 90, 99, 100):
+        assert weighted.percentile("x", q) == \
+            expanded.percentile("x", q), f"q={q}"
+
+
+def test_weighted_record_edge_cases():
+    t = PhaseTimer()
+    t.record("y", 0.5, count=0)   # ignored
+    t.record("y", 0.5, count=-3)  # ignored
+    assert t.count("y") == 0
+    assert t.percentile("y", 99) == 0.0
+    t.record("y", 0.25, count=3)
+    assert t.count("y") == 3
+    assert t.percentile("y", 0) == 0.25
+    assert t.percentile("y", 100) == 0.25
+    assert abs(t.total("y") - 0.75) < 1e-12
