@@ -248,11 +248,17 @@ def _measure_device_leg(num_nodes: int, batch: int,
     tunnel's fetch RTT).  None on failure; the caller falls back to
     host-observed numbers, labeled as such."""
     try:
+        import jax
+
         from kubernetesnetawarescheduler_tpu.bench.density import (
             measure_device_latency,
         )
 
-        reps = int(os.environ.get("BENCH_DEVICE_REPS", "300"))
+        # Default reps gated on the EXECUTED backend: 300 isolated
+        # N=5120 dispatches are cheap on the chip but add ~60% extra
+        # scoring work to an already-slowest-path CPU leg.
+        default = "300" if jax.default_backend() == "tpu" else "100"
+        reps = int(os.environ.get("BENCH_DEVICE_REPS", default))
         return measure_device_latency(num_nodes, batch,
                                       score_backend=backend, reps=reps)
     except Exception as exc:  # noqa: BLE001 — the density headline
@@ -427,8 +433,13 @@ def main() -> None:
                   f"({persisted['detail'].get('measured_at', '?')})",
                   file=sys.stderr)
             persisted["detail"].update(_probe_log_stats())
-            _attach_north_star(persisted)
-            _attach_cpu_density(persisted)
+            if "BENCH_CHILD" not in os.environ:
+                # Unreachable for children today (they always carry
+                # BENCH_SKIP_TPU_PROBE=1), but the certify/augment-
+                # once invariant should hold locally, not by distant
+                # env plumbing.
+                _attach_north_star(persisted)
+                _attach_cpu_density(persisted)
             print(json.dumps(persisted))
             return
         # Degrade to CPU instead of hanging the driver: the JSON line
@@ -613,13 +624,9 @@ def main() -> None:
             # Generous explicit timeout: the 900s default is sized for
             # TPU legs; the CPU density run at full scale can exceed it
             # and this leg is the last line of defense for the JSON.
-            # Reduced microbench reps: 300 isolated N=5120 dispatches
-            # on CPU would add ~60% more scoring work to a leg that is
-            # already the slowest path through this script.
+            # (_measure_device_leg self-trims its reps on CPU.)
             results["xla"] = _run_backend_subprocess(
-                "xla", force_cpu=True, timeout_s=7200,
-                env_extra={"BENCH_DEVICE_REPS": os.environ.get(
-                    "BENCH_DEVICE_REPS", "100")})
+                "xla", force_cpu=True, timeout_s=7200)
         except Exception as exc:  # noqa: BLE001
             errors["cpu-fallback"] = f"{type(exc).__name__}: {exc}"
     if not results:

@@ -250,79 +250,23 @@ def leg_serve_smoke() -> dict:
     --once) drains a 2,048-pod backlog from a fake kube API server
     (tests/test_kubeclient.FakeApiServer — real HTTP list/watch
     streams, real Binding/Event POSTs) with the kernels on the TPU.
-    A --once warm pass first (one 256-pod cycle) so the timed number
-    measures serving, not XLA compilation."""
-    jax = _require_tpu()
-    import json as _json
-    import tempfile
 
-    from kubernetesnetawarescheduler_tpu import serve
-    from tests.test_kubeclient import (
-        FakeApiServer,
-        _node_json,
-        _pod_json,
+    Warm passes compile BOTH jit shapes (backlog-burst AND per-batch)
+    before the timed window — round 4 warmed only the per-batch
+    shape, so its timed drain paid the burst program's XLA compile
+    in-window, a large slice of the 69 binds/s it recorded (root
+    cause + phase budget: tools/bind_budget.py, with the fake
+    server's missing TCP_NODELAY as the other slice).  Shared harness:
+    bench/daemon_smoke.drain_daemon."""
+    jax = _require_tpu()
+    from kubernetesnetawarescheduler_tpu.bench.daemon_smoke import (
+        drain_daemon,
     )
 
-    import threading
-
-    n_nodes, n_pods = 512, 2048
-    tmp = tempfile.mkdtemp()
-    cfg_path = os.path.join(tmp, "cfg.json")
-    with open(cfg_path, "w") as f:
-        _json.dump({"max_nodes": n_nodes, "max_pods": 256,
-                    "max_peers": 4,
-                    "queue_capacity": n_pods + 256}, f)
-
-    def make_api(num_pods: int) -> FakeApiServer:
-        api = FakeApiServer()
-        api.nodes = [_node_json(f"node-{i:04d}") for i in range(n_nodes)]
-        api.node_events = [{"type": "ADDED", "object": n}
-                           for n in api.nodes]
-        api.pods = [_pod_json(f"pod-{i:05d}") for i in range(num_pods)]
-        api.pod_events = [{"type": "ADDED", "object": p}
-                          for p in api.pods]
-        return api
-
-    def argv(api: FakeApiServer) -> list[str]:
-        uds = os.path.join(tempfile.mkdtemp(), "scorer.sock")
-        return ["--cluster", f"kube:{api.url}", "--kube-token", "t",
-                "--uds", uds, "--config", cfg_path, "--async-bind"]
-
-    # Warm pass: one --once cycle (a single 256-pod batch) compiles
-    # every jit shape — the cluster size fixes them.
-    api = make_api(256)
-    try:
-        rc = serve.main(argv(api) + ["--once"])
-        if rc != 0:
-            raise SystemExit(f"warm serve rc={rc}")
-    finally:
-        api.stop()
-
-    # Timed pass: the daemon proper (no --once), polled until the
-    # backlog is drained.  The serve thread has no stop hook off the
-    # main thread; this leg's process exits right after, which is the
-    # cleanup.
-    api = make_api(n_pods)
-    t0 = time.perf_counter()
-    th = threading.Thread(target=serve.main, args=(argv(api),),
-                          daemon=True)
-    th.start()
-    deadline = time.monotonic() + 900
-    while len(api.bindings) < n_pods and time.monotonic() < deadline:
-        if not th.is_alive():
-            raise SystemExit(
-                f"serve daemon died after {len(api.bindings)} binds")
-        time.sleep(0.05)
-    wall = time.perf_counter() - t0
-    bound = len(api.bindings)
-    if bound < n_pods:
-        # A deadline exit must NOT persist as a green artifact whose
-        # rate measures the timeout rather than the drain.
-        raise SystemExit(f"only {bound}/{n_pods} pods bound "
-                         f"within {wall:.0f}s")
-    return {"backend": jax.default_backend(), "nodes": n_nodes,
-            "pods": n_pods, "bound": bound, "wall_s": round(wall, 2),
-            "binds_per_sec": round(bound / wall, 1)}
+    out = drain_daemon(n_nodes=512, n_pods=2048, deadline_s=900,
+                       collect_phases=True)
+    out["backend"] = jax.default_backend()
+    return out
 
 
 def leg_density_full() -> dict:
@@ -393,8 +337,13 @@ def main() -> None:
                       if k.startswith("BENCH_")},
         "detail": detail,
     }))
-    if not ok:
-        sys.exit(1)
+    # Flush, then skip interpreter teardown: legs that ran serve.main
+    # in a daemon thread (serve_smoke) can SIGABRT during finalization
+    # ("FATAL: exception not rethrown"), which would discard the
+    # block-buffered JSON line the watcher is about to parse.
+    sys.stdout.flush()
+    sys.stderr.flush()
+    os._exit(0 if ok else 1)
 
 
 if __name__ == "__main__":
