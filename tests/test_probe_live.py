@@ -1,0 +1,101 @@
+"""Iperf3Prober's LIVE subprocess path (VERDICT r4 next-round #10).
+
+``Iperf3Prober`` was the one reference capability (run.sh:12's
+``iperf3 -c <host> -J``) exercised only by mock: CI never spawned a
+real process through it.  These tests close that:
+
+- ALWAYS run: a stub ``iperf3`` executable on PATH (a script that
+  validates the argv contract and emits structurally-valid iperf3
+  JSON) drives the real ``subprocess.run`` + parse path end-to-end,
+  including the non-zero-exit error contract.
+- WHEN the real binary exists (absent in this image — skip): a
+  localhost ``iperf3 -s`` server and a real probe through it.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import stat
+import subprocess
+import sys
+
+import pytest
+
+from kubernetesnetawarescheduler_tpu.ingest.probe import Iperf3Prober
+
+_STUB = """#!{python}
+import json, sys
+args = sys.argv[1:]
+# argv contract (run.sh:12 parity): -c <target> -J -Z -t <secs> -T ..
+assert "-J" in args, args
+assert "-c" in args, args
+target = args[args.index("-c") + 1]
+assert target == "10.0.0.2", target
+assert "-t" in args, args
+fail = {fail!r}
+if fail:
+    sys.stderr.write("iperf3: error - unable to connect\\n")
+    sys.exit(1)
+sys.stdout.write(json.dumps({{
+    "title": "stub",
+    "start": {{"test_start": {{"protocol": "TCP", "duration": 2}}}},
+    "intervals": [],
+    "end": {{
+        "streams": [{{
+            "sender": {{"bits_per_second": 2.5e9, "bytes": 1}},
+            "receiver": {{"bits_per_second": 2.4e9, "bytes": 1}},
+        }}],
+        "sum_sent": {{"bits_per_second": 2.5e9}},
+        "sum_received": {{"bits_per_second": 2.4e9}},
+    }},
+}}))
+"""
+
+
+def _install_stub(tmp_path, monkeypatch, fail: bool = False) -> None:
+    stub = tmp_path / "iperf3"
+    stub.write_text(_STUB.format(python=sys.executable, fail=fail))
+    stub.chmod(stub.stat().st_mode | stat.S_IXUSR)
+    monkeypatch.setenv(
+        "PATH", f"{tmp_path}{os.pathsep}" + os.environ.get("PATH", ""))
+
+
+def test_prober_spawns_and_parses_subprocess(tmp_path, monkeypatch):
+    _install_stub(tmp_path, monkeypatch)
+    prober = Iperf3Prober({"node-a": "10.0.0.1",
+                           "node-b": "10.0.0.2"}, duration_s=2)
+    lat, bw = prober.probe("node-a", "node-b")
+    # iperf3 carries no latency figure; bandwidth is the receiver's
+    # (the reference's chosen leaf, scheduler.go:528).
+    assert lat is None
+    assert bw == pytest.approx(2.4e9)
+
+
+def test_prober_propagates_subprocess_failure(tmp_path, monkeypatch):
+    _install_stub(tmp_path, monkeypatch, fail=True)
+    prober = Iperf3Prober({"node-b": "10.0.0.2"}, duration_s=2)
+    with pytest.raises(subprocess.CalledProcessError):
+        prober.probe("node-a", "node-b")
+
+
+@pytest.mark.skipif(shutil.which("iperf3") is None,
+                    reason="real iperf3 binary not installed")
+def test_prober_against_real_localhost_iperf3():
+    """The genuinely-live leg: a localhost iperf3 server, real bytes.
+    Skipped where the binary is absent (this image); runs anywhere
+    iperf3 is installed."""
+    server = subprocess.Popen(
+        ["iperf3", "-s", "-1"],  # -1: serve one client then exit
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+    try:
+        import time
+
+        time.sleep(0.5)  # let the server bind :5201
+        prober = Iperf3Prober({"self": "127.0.0.1"}, duration_s=1)
+        lat, bw = prober.probe("origin", "self")
+        assert lat is None
+        assert bw > 1e6  # loopback moves at least a megabit
+    finally:
+        server.terminate()
+        server.wait(timeout=10)
