@@ -59,9 +59,25 @@ class ScorerServer:
         self.uds_path = uds_path
         if os.path.exists(uds_path):
             os.unlink(uds_path)
+        # Live accepted sockets, so stop() is a REAL stop: without
+        # this, shutdown() only closes the ACCEPT loop while handler
+        # threads keep serving pooled keep-alive connections
+        # (round 5's native shim holds one per client connection) —
+        # a "stopped" server that still answers is exactly the
+        # half-dead state the fail-open machinery must detect.
+        self._conns: set = set()
+        self._conns_lock = threading.Lock()
         outer = self
 
         class Handler(socketserver.BaseRequestHandler):
+            def setup(self) -> None:
+                with outer._conns_lock:
+                    outer._conns.add(self.request)
+
+            def finish(self) -> None:
+                with outer._conns_lock:
+                    outer._conns.discard(self.request)
+
             def handle(self) -> None:
                 while True:
                     frame = _read_frame(self.request)
@@ -95,6 +111,21 @@ class ScorerServer:
     def stop(self) -> None:
         self._server.shutdown()
         self._server.server_close()
+        # Close LIVE connections too (see _conns above): their
+        # handler threads see EOF and exit; pooled clients observe a
+        # genuinely dead backend instead of a lame duck.
+        with self._conns_lock:
+            conns = list(self._conns)
+            self._conns.clear()
+        for sock in conns:
+            try:
+                sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                sock.close()
+            except OSError:
+                pass
         self._handlers.close()  # releases the batcher's finisher thread
         if os.path.exists(self.uds_path):
             os.unlink(self.uds_path)

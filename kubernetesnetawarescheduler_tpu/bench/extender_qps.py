@@ -236,7 +236,15 @@ def main(argv=None) -> None:
     ap.add_argument("--write", nargs="?", const="", default=None,
                     help="persist to PATH (default: the repo's "
                          "bench_artifacts/extender_qps.json)")
+    ap.add_argument("--tpu", action="store_true",
+                    help="do NOT force the CPU backend (hardware "
+                         "runs go through tools/tpu_legs.py "
+                         "serving_qps, which also asserts the "
+                         "backend; without a live chip the axon "
+                         "sitecustomize hangs PJRT init forever)")
     args = ap.parse_args(argv)
+    if not args.tpu:
+        jax.config.update("jax_platforms", "cpu")
     doc = run_qps().to_dict()
     doc["backend"] = jax.default_backend()
     try:

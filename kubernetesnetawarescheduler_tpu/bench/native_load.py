@@ -116,6 +116,111 @@ class _Client:
         conn.close()
 
 
+def _backend_kill_under_load(conc_clients: int,
+                             requests_per_client: int) -> dict:
+    """SIGKILL a real backend PROCESS with the full client fleet
+    live.  An in-process ScorerServer.stop() cannot model this since
+    round 5's pooled backend connections: stop() only closes the
+    ACCEPT loop while live handler threads keep serving the pooled
+    sockets, so nothing ever failed.  A separate serve.py process
+    (--cluster fake:N, --uds) dies for real — the kernel closes every
+    pooled socket, the shim's reconnect finds no listener, and every
+    response from that instant must fail OPEN (200-neutral for
+    /prioritize), with /healthz still live and the thread fleet
+    drained.  N is small: kill semantics are N-independent and the
+    subprocess pays its own XLA compiles."""
+    import sys
+    import tempfile
+
+    uds = os.path.join(tempfile.mkdtemp(), "kill.sock")
+    backend = subprocess.Popen(
+        [sys.executable, "-c",
+         "import jax; jax.config.update('jax_platforms', 'cpu'); "
+         "from kubernetesnetawarescheduler_tpu import serve; "
+         f"serve.main(['--cluster', 'fake:128', '--uds', {uds!r}])"],
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+    port = _free_port()
+    shim = subprocess.Popen(
+        [os.path.join(_REPO, "native", "netaware_extender"),
+         str(port), uds],
+        stderr=subprocess.DEVNULL)
+    try:
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            if not os.path.exists(uds):
+                time.sleep(0.1)
+                continue
+            try:
+                c = http.client.HTTPConnection("127.0.0.1", port,
+                                               timeout=0.5)
+                c.request("GET", "/healthz")
+                if c.getresponse().status == 200:
+                    c.close()
+                    break
+                c.close()
+            except OSError:
+                time.sleep(0.1)
+        else:
+            raise SystemExit("kill-phase shim/backend did not come up")
+        # Warm the backend's compile shapes so the kill lands during
+        # steady serving, not during the first compile.
+        warm = _Client(port, 2, 4000)
+        warm.run()
+        if warm.ok == 0:
+            raise SystemExit("kill-phase backend never scored")
+
+        clients = [_Client(port, requests_per_client, 1000 + i)
+                   for i in range(conc_clients)]
+        threads = [threading.Thread(target=c.run) for c in clients]
+        total = conc_clients * requests_per_client
+        for t in threads:
+            t.start()
+        # Kill once the run is observably MID-flight (some responses
+        # in, most still outstanding) — a fixed sleep either misses a
+        # fast fleet entirely or lands inside warmup of a slow one.
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            done_now = sum(cl.ok + cl.neutral for cl in clients)
+            if done_now >= max(1, total // 10):
+                break
+            time.sleep(0.005)
+        backend.kill()  # SIGKILL mid-flight: sockets die with it
+        for t in threads:
+            t.join()
+        neutral = sum(c.neutral for c in clients)
+        errors2 = sum(c.errors for c in clients)
+        # Settle-poll: the C++ per-connection threads exit on client
+        # EOF, which lags the Python-side join; one instant sample
+        # would read teardown-in-progress as a leak.
+        after = _proc_stats(shim.pid)
+        settle = time.time() + 5
+        while after.get("threads", 0) > 4 and time.time() < settle:
+            time.sleep(0.05)
+            after = _proc_stats(shim.pid)
+        c = http.client.HTTPConnection("127.0.0.1", port, timeout=5)
+        c.request("GET", "/healthz")
+        healthz = c.getresponse().status
+        c.close()
+        return {
+            "neutral_responses": neutral,
+            # Scored before the SIGKILL landed.
+            "scored_responses": sum(cl.ok for cl in clients),
+            "errors": errors2,
+            "requests": conc_clients * requests_per_client,
+            "healthz_after": healthz,
+            "shim_after": after,
+            "fail_open": (errors2 == 0 and healthz == 200
+                          and neutral > 0),
+        }
+    finally:
+        for proc in (shim, backend):
+            proc.kill()
+            try:
+                proc.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                pass
+
+
 def run_native_load(num_nodes: int = 5120, max_pods: int = 256,
                     conc_clients: int = 128,
                     requests_per_client: int = 16,
@@ -232,40 +337,8 @@ def run_native_load(num_nodes: int = 5120, max_pods: int = 256,
         }
 
         if kill_backend_midway:
-            # Kill the backend WITH the full client fleet live: the
-            # shim must keep answering 200-neutral, never wedge or
-            # leak threads.
-            clients2 = [_Client(port, requests_per_client, 1000 + i)
-                        for i in range(conc_clients)]
-            threads2 = [threading.Thread(target=c.run)
-                        for c in clients2]
-            for t in threads2:
-                t.start()
-            time.sleep(0.2)
-            server.stop()  # backend gone mid-flight
-            for t in threads2:
-                t.join()
-            neutral = sum(c.neutral for c in clients2)
-            errors2 = sum(c.errors for c in clients2)
-            after = _proc_stats(shim.pid)
-            # Shim itself must still be alive and answering.
-            c = http.client.HTTPConnection("127.0.0.1", port,
-                                           timeout=5)
-            c.request("GET", "/healthz")
-            healthz = c.getresponse().status
-            c.close()
-            result["backend_kill"] = {
-                "neutral_responses": neutral,
-                # Responses scored BEFORE the stop landed (the shim
-                # keeps pooled backend connections; the listener
-                # close only starves NEW ones, in-flight work drains).
-                "scored_responses": sum(c.ok for c in clients2),
-                "errors": errors2,
-                "requests": conc_clients * requests_per_client,
-                "healthz_after": healthz,
-                "shim_after": after,
-                "fail_open": errors2 == 0 and healthz == 200,
-            }
+            result["backend_kill"] = _backend_kill_under_load(
+                conc_clients, requests_per_client)
         return result
     finally:
         try:
@@ -294,6 +367,13 @@ def main(argv=None) -> None:
     args = ap.parse_args(argv)
 
     import jax
+
+    # This artifact is the CPU reference (the chip's serving numbers
+    # come from tools/tpu_legs.py serving_qps).  Forcing CPU also
+    # keeps the CLI usable while the axon tunnel is wedged — the
+    # sitecustomize otherwise routes backend init at the TPU and
+    # hangs PJRT init indefinitely.
+    jax.config.update("jax_platforms", "cpu")
 
     doc = run_native_load(num_nodes=args.nodes,
                           conc_clients=args.clients,

@@ -886,21 +886,37 @@ def run_soft_affinity_config(out_dir: str | None = None,
                 "default": b == batch,
             })
         metrics["zone_pref_batch_sweep"] = bsweep
-        metrics["zone_pref_conclusion"] = (
-            "The unsatisfied quarter of attainable zone preferences "
-            "is a DELIBERATE weighted trade won by the network-"
-            "affinity term, this scheduler's headline capability: "
-            "zone_pref_trade shows the misses' chosen nodes beat the "
-            "preferred zone's best by margin_p50 score units (the "
-            "pull toward already-placed service peers), most flip "
-            "into the zone when peer_bw/peer_lat are zeroed "
-            "(traded_to_network), and the peers-off control entries "
-            "(sequential_vs_optimum_peers_off; the network_term=off "
-            "sweep row) recover ~the attainable optimum.  Batching "
-            "is NOT the cause (zone_pref_batch_sweep: rate flat in "
-            "batch size; per-batch instrumentation shows placed==≈"
-            "argmax).  Operators weight the trade via "
-            "ScoreWeights.peer_* vs soft_affinity.")
+        # The conclusion is DERIVED from this run's own measurements,
+        # not asserted: a seed/shape where the network term is not
+        # the dominant outbidder must not ship the round-5 narrative
+        # verbatim next to numbers that contradict it.
+        trade = metrics["zone_pref_trade"]
+        net_frac = (trade["sequential_traded_to_network"]
+                    / trade["sequential_traded"]
+                    if trade["sequential_traded"] else 1.0)
+        rates = [r["zone_pref_vs_optimum"] for r in bsweep]
+        batch_flat = (max(rates) - min(rates) < 0.1) if rates else True
+        if net_frac >= 0.9:
+            concl = (f"{net_frac:.0%} of unsatisfied attainable zone "
+                     "preferences flip into their zone when "
+                     "peer_bw/peer_lat are zeroed: the misses are "
+                     "deliberate weighted trades won by the network-"
+                     "affinity term (margin_p50 "
+                     f"{trade['sequential_margin_p50']} score units "
+                     "vs zone bonus "
+                     f"{trade['sequential_zone_bonus_mean']}); the "
+                     "peers-off controls recover ~the attainable "
+                     "optimum.  Knob: ScoreWeights.peer_* vs "
+                     "soft_affinity.")
+        else:
+            concl = (f"only {net_frac:.0%} of misses are network-"
+                     "term trades this run — see zone_pref_trade "
+                     "margins and the weight sweep for the rest.")
+        concl += (" Batching is not a factor (vs_optimum flat across "
+                  "batch sizes)." if batch_flat else
+                  " Batch size MATTERS this run — see "
+                  "zone_pref_batch_sweep.")
+        metrics["zone_pref_conclusion"] = concl
     artifacts = []
     if out_dir:
         path = os.path.join(out_dir, "soft_affinity_audit.json")

@@ -94,8 +94,12 @@ int backend_connect(const char* uds_path) {
 
 enum class ExchangeResult {
   kOk,
-  kSendFailed,  // request may never have reached the backend
-  kRecvFailed,  // request was sent; the backend may have APPLIED it
+  kSendFailed,    // request may never have reached the backend
+  kRecvFailed,    // no response bytes: stale socket OR backend death
+  kBackendError,  // a response FRAME arrived (delivery proven) but it
+                  // signals failure: empty "handler failed" frame,
+                  // oversized length, or truncated body — NEVER
+                  // retried, the backend already saw the request
 };
 
 // One framed round-trip on an already-open backend connection.
@@ -108,17 +112,21 @@ ExchangeResult backend_exchange(int fd, const std::string& path,
               write_full(fd, body.data(), body.size());
   if (!sent) return ExchangeResult::kSendFailed;
   uint32_t resp_len = 0;
-  bool ok = read_u32(fd, &resp_len);
-  if (ok && resp_len > (64u << 20)) ok = false;  // sanity cap 64 MB
-  // An empty frame is the backend's "handler failed" signal -> treat
-  // as an error so the shim fails open instead of relaying 200 "".
-  if (ok && resp_len == 0) ok = false;
-  if (ok) {
-    response->resize(resp_len);
-    ok = read_full(fd, response->empty() ? nullptr : &(*response)[0],
-                   resp_len) == static_cast<ssize_t>(resp_len);
+  if (!read_u32(fd, &resp_len)) return ExchangeResult::kRecvFailed;
+  // The length header arrived: the backend received and processed
+  // the request.  Everything below is kBackendError, not retryable —
+  // an empty frame is the explicit "handler failed" signal
+  // (api/server.py sends it when a handler raises AFTER possibly
+  // applying a /bind), and replaying a delivered non-idempotent
+  // request would dodge the backend's conflict detection.
+  if (resp_len == 0) return ExchangeResult::kBackendError;
+  if (resp_len > (64u << 20)) return ExchangeResult::kBackendError;
+  response->resize(resp_len);
+  if (read_full(fd, &(*response)[0], resp_len) !=
+      static_cast<ssize_t>(resp_len)) {
+    return ExchangeResult::kBackendError;
   }
-  return ok ? ExchangeResult::kOk : ExchangeResult::kRecvFailed;
+  return ExchangeResult::kOk;
 }
 
 // One round-trip to the Python scorer, over a PERSISTENT per-client-
@@ -155,6 +163,10 @@ bool backend_call(const char* uds_path, const std::string& path,
     if (r == ExchangeResult::kOk) return true;
     ::close(*backend_fd);
     *backend_fd = -1;
+    if (r == ExchangeResult::kBackendError) {
+      // Delivery proven: never replay (any route) — fail open.
+      return false;
+    }
     if (r == ExchangeResult::kRecvFailed && !idempotent && fresh) {
       return false;
     }

@@ -378,3 +378,62 @@ def test_batcher_candidate_gather_matches_full_row():
     assert results["full"].shape == full.shape
     np.testing.assert_allclose(results["gathered"],
                                results["full"][idx[:4]], rtol=1e-6)
+
+
+def test_native_extender_reconnects_after_backend_restart(
+        native_build, tmp_path):
+    """Pooled backend connections (round 5) must survive a backend
+    RESTART: the stale socket's recv failure on a reused connection
+    retries on a fresh connect (kubeclient's _StaleConnection rule in
+    C++), so the client sees scored responses again without
+    reconnecting itself — not a permanent fail-open."""
+    cluster, loop = make_loop(num_nodes=12)
+    handlers = ExtenderHandlers(loop)
+    uds = str(tmp_path / "scorer.sock")
+    server = ScorerServer(handlers, uds)
+    server.start()
+    port = _free_port()
+    proc = subprocess.Popen(
+        [str(NATIVE / "netaware_extender"), str(port), uds],
+        stderr=subprocess.PIPE)
+    try:
+        deadline = time.time() + 5
+        while time.time() < deadline:
+            try:
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{port}/healthz",
+                        timeout=0.5):
+                    break
+            except (urllib.error.URLError, ConnectionError, OSError):
+                time.sleep(0.05)
+        names = [n.name for n in cluster.list_nodes()][:4]
+        import http.client as hc
+
+        conn = hc.HTTPConnection("127.0.0.1", port, timeout=10)
+
+        def prioritize():
+            conn.request("POST", "/prioritize",
+                         body=json.dumps(extender_args(names)).encode(),
+                         headers={"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            return resp.status, json.loads(resp.read())
+
+        status, out = prioritize()
+        assert status == 200 and [p["host"] for p in out] == names
+
+        # Restart the backend at the same path: the shim's pooled
+        # socket to the OLD server is now stale.
+        server.stop()
+        handlers2 = ExtenderHandlers(loop)
+        server2 = ScorerServer(handlers2, uds)
+        server2.start()
+        try:
+            status, out = prioritize()
+            assert status == 200
+            assert [p["host"] for p in out] == names, \
+                "stale pooled connection was not retried"
+        finally:
+            server2.stop()
+    finally:
+        proc.terminate()
+        proc.wait(timeout=5)

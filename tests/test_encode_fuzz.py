@@ -295,3 +295,49 @@ def test_unschedulable_pods_are_genuinely_unschedulable():
         else:
             assert not has_match, f"{p.name} unschedulable but a " \
                                   "matching untainted node exists"
+
+
+def test_batch_and_solo_encode_score_identically():
+    """A pod's score row must not depend on WHO ELSE is in its encode
+    batch (round-5 diagnostic invariant): for pods without required
+    group affinity, encoding alone vs inside a full batch yields
+    bit-identical rows.  Group-affinity pods are exempt BY DESIGN —
+    the first-member escape drops the term when no member is placed
+    anywhere, and in-batch members make it bind to the batch's joint
+    placement (core/encode.py group_bit machinery)."""
+    import jax
+
+    import numpy as np
+
+    from kubernetesnetawarescheduler_tpu.bench import suite
+    from kubernetesnetawarescheduler_tpu.bench.fakecluster import (
+        ClusterSpec,
+        WorkloadSpec,
+        generate_workload,
+    )
+    from kubernetesnetawarescheduler_tpu.config import ScoreWeights
+    from kubernetesnetawarescheduler_tpu.core.score import score_pods
+
+    loop, cfg = suite._make_loop(128, 3, ScoreWeights(), batch=32,
+                                 queue=256)
+    pods = generate_workload(
+        WorkloadSpec(num_pods=32, soft_zone_fraction=0.4,
+                     soft_spread_fraction=0.3,
+                     zones=ClusterSpec().zones, seed=3),
+        scheduler_name=cfg.scheduler_name)
+    score_j = jax.jit(lambda s, b: score_pods(s, b, cfg))
+    enc_all = loop.encoder.encode_pods(pods, node_of=lambda n: "",
+                                       lenient=True)
+    st = loop.encoder.snapshot()
+    rows = np.asarray(score_j(st, enc_all))
+    checked = 0
+    for j, p in enumerate(pods):
+        if p.affinity_groups:
+            continue  # exempt: group escape is batch-context-aware
+        solo = loop.encoder.encode_pods([p], node_of=lambda n: "",
+                                        lenient=True)
+        row1 = np.asarray(score_j(st, solo))[0]
+        np.testing.assert_array_equal(rows[j], row1,
+                                      err_msg=f"pod {p.name}")
+        checked += 1
+    assert checked >= 16  # the invariant actually ran
