@@ -40,13 +40,14 @@ ART = os.path.join(os.path.dirname(__file__), "..", "bench_artifacts",
 # legs on any leg-specific bug): the loop re-probes after a failure
 # and only stops when the tunnel itself is gone.
 LEG_ORDER = ["compile", "device_latency", "density_small",
-             "serving_qps", "serve_smoke", "pallas_equal",
-             "serving_host", "scale_probe", "density_full"]
+             "serving_qps", "native_qps", "serve_smoke",
+             "pallas_equal", "serving_host", "scale_probe",
+             "density_full"]
 LEG_TIMEOUT_S = {"compile": 900, "pallas_equal": 1200,
                  "density_small": 1800, "serving_qps": 1800,
-                 "device_latency": 900, "serve_smoke": 1800,
-                 "serving_host": 1800, "scale_probe": 1800,
-                 "density_full": 5400}
+                 "native_qps": 1800, "device_latency": 900,
+                 "serve_smoke": 1800, "serving_host": 1800,
+                 "scale_probe": 1800, "density_full": 5400}
 PROBE_TIMEOUT_S = 120
 PROBE_INTERVAL_S = 120
 REFRESH_INTERVAL_S = 1800   # sleep cadence once every leg is green
