@@ -78,15 +78,10 @@ def _persisted_tpu_density() -> dict | None:
     (same BENCH_NODES) and be younger than BENCH_TPU_ART_MAX_AGE_S
     (default 24 h — one round).  The recorded git SHA is surfaced in
     the provenance so a reviewer can diff artifact-code vs HEAD."""
-    path = os.path.join(_TPU_ART_DIR, "density_full.json")
-    try:
-        with open(path) as f:
-            leg = json.load(f)
-        age_s = time.time() - os.path.getmtime(path)
-    except (OSError, ValueError):
+    loaded = _load_green_leg("density_full")
+    if loaded is None:
         return None
-    if not leg.get("ok"):
-        return None
+    leg, age_s = loaded
     max_age = float(os.environ.get("BENCH_TPU_ART_MAX_AGE_S", "86400"))
     if age_s > max_age:
         return None
@@ -123,18 +118,29 @@ def _persisted_tpu_density() -> dict | None:
     return doc
 
 
-def _persisted_device_latency(backend: str) -> dict | None:
-    """The watcher's ``device_latency`` leg for one score backend
-    (tools/tpu_legs.leg_device_latency), with the capturing git SHA
-    attached; None when absent/failed."""
-    path = os.path.join(_TPU_ART_DIR, "device_latency.json")
+def _load_green_leg(name: str) -> tuple[dict, float] | None:
+    """A watcher-captured leg artifact that reported ok=True, with
+    its age in seconds; None when absent, unparseable, or failed."""
+    path = os.path.join(_TPU_ART_DIR, f"{name}.json")
     try:
         with open(path) as f:
             leg = json.load(f)
+        age_s = time.time() - os.path.getmtime(path)
     except (OSError, ValueError):
         return None
     if not leg.get("ok"):
         return None
+    return leg, age_s
+
+
+def _persisted_device_latency(backend: str) -> dict | None:
+    """The watcher's ``device_latency`` leg for one score backend
+    (tools/tpu_legs.leg_device_latency), with the capturing git SHA
+    attached; None when absent/failed."""
+    loaded = _load_green_leg("device_latency")
+    if loaded is None:
+        return None
+    leg, _age = loaded
     sub = leg.get("detail", {}).get(backend)
     if not isinstance(sub, dict) or "p99_ms" not in sub:
         return None
