@@ -180,17 +180,22 @@ class FakeCluster(ClusterClient):
 
     def delete_pod(self, name: str, namespace: str = "default",
                    grace_seconds: int | None = None) -> None:
-        """Remove a pod; if it was bound, fan out to on_pod_deleted
-        handlers (the usage-release signal).  ``grace_seconds`` is
-        accepted for interface parity (deletion is immediate here)."""
+        """Remove a pod and fan out to on_pod_deleted handlers.
+        Real watches deliver DELETED for PENDING pods too (kubeclient
+        does), and the loop's lifecycle cleanup — parked-queue purge,
+        assume-cache eviction — depends on seeing them; round 5
+        aligned this fake with that semantic (bound-only delivery let
+        deleted-but-parked pods linger).  For never-bound pods the
+        usage-release half is a no-op (uid-keyed ledger).
+        ``grace_seconds`` is accepted for interface parity (deletion
+        is immediate here)."""
         with self._lock:
             pod = self._pods.pop(name, None)
             handlers = list(self._deleted_handlers)
         if pod is None:
             raise KeyError(name)
-        if pod.node_name:
-            for h in handlers:
-                h(pod)
+        for h in handlers:
+            h(pod)
 
     def add_pdb(self, pdb) -> None:
         """Upsert a PodDisruptionBudget (keyed by uid or name); fans

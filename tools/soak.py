@@ -1,0 +1,174 @@
+"""Long-running churn soak: the daemon's lifecycle paths under
+sustained add/bind/delete cycling, with drift metering.
+
+The per-drain benches prove throughput; what they cannot prove is
+that a daemon serving for HOURS doesn't leak — encoder slots must
+recycle through delete/release, the assume caches (_assumed_uids /
+_assumed_node / _bare_ns) must stay bounded by live pods, the parked
+queue must purge deletions, and the weighted PhaseTimer must grow
+O(cycles), not O(cycles x burst).  All of those were touched in
+round 5; this harness cycles a FakeCluster through
+add -> schedule -> bind -> delete waves for ``--minutes`` and samples
+RSS, thread count, cache sizes and timer lengths throughout.
+
+Pass criteria (asserted, not just recorded): every wave fully binds,
+cache sizes return to ~zero after each drain+delete cycle, and RSS
+growth from the 25th-percentile sample to the final sample stays
+under ``--rss-slack-mb``.
+
+Run: ``python tools/soak.py --minutes 20 --write``
+->  ``bench_artifacts/soak.json``
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+
+def _rss_bytes() -> int:
+    with open("/proc/self/statm") as f:
+        return int(f.read().split()[1]) * os.sysconf("SC_PAGE_SIZE")
+
+
+def run_soak(minutes: float = 20.0, num_nodes: int = 256,
+             wave_pods: int = 192, seed: int = 0,
+             rss_slack_mb: float = 256.0) -> dict:
+    import threading
+
+    import numpy as np
+
+    from kubernetesnetawarescheduler_tpu.bench.fakecluster import (
+        ClusterSpec,
+        WorkloadSpec,
+        build_fake_cluster,
+        feed_metrics,
+        generate_workload,
+    )
+    from kubernetesnetawarescheduler_tpu.config import SchedulerConfig
+    from kubernetesnetawarescheduler_tpu.core.loop import SchedulerLoop
+
+    cfg = SchedulerConfig(max_nodes=num_nodes, max_pods=64,
+                          max_peers=4,
+                          queue_capacity=wave_pods + 64)
+    cluster, lat, bw = build_fake_cluster(
+        ClusterSpec(num_nodes=num_nodes, seed=seed))
+    loop = SchedulerLoop(cluster, cfg, method="parallel",
+                         async_bind=True)
+    loop.encoder.set_network(lat, bw)
+    feed_metrics(cluster, loop.encoder, np.random.default_rng(seed + 1))
+
+    deadline = time.monotonic() + minutes * 60.0
+    wave = 0
+    samples: list[dict] = []
+    bound_total = 0
+    t_start = time.time()
+    while time.monotonic() < deadline:
+        wave += 1
+        pods = generate_workload(
+            WorkloadSpec(num_pods=wave_pods, seed=seed + wave,
+                         services=8, peer_fraction=0.4,
+                         soft_zone_fraction=0.3,
+                         zones=ClusterSpec().zones),
+            scheduler_name=cfg.scheduler_name)
+        cluster.add_pods(pods)
+        loop.run_until_drained()
+        loop.flush_binds()
+        bound = sum(1 for p in pods if cluster.node_of(p.name))
+        if bound < len(pods) * 0.95:
+            raise SystemExit(
+                f"wave {wave}: only {bound}/{len(pods)} bound")
+        bound_total += bound
+        # Full churn: every pod terminates (frees usage + slots,
+        # drives _on_pod_gone through the round-5 purge paths).
+        for p in pods:
+            cluster.delete_pod(p.name, p.namespace)
+        # The FAKE's instrumentation logs (bindings/events lists every
+        # test asserts on) grow forever by design; a soak meters the
+        # PRODUCT's memory, so truncate them out of the RSS signal.
+        cluster.bindings.clear()
+        cluster.events.clear()
+        samples.append({
+            "t_s": round(time.time() - t_start, 1),
+            "wave": wave,
+            "rss_mb": round(_rss_bytes() / 1e6, 1),
+            "threads": threading.active_count(),
+            "assumed_uids": len(loop._assumed_uids),
+            "assumed_node": len(loop._assumed_node),
+            "bare_ns": len(loop._bare_ns),
+            "parked": len(loop._unsched_parked),
+            "timer_entries": sum(
+                len(v) for v in loop.timer._samples.values()),
+        })
+    loop.stop_bind_worker()
+
+    # Drift assertions.  RSS: compare the final sample to the 25th-
+    # percentile sample so early allocator/jit warm-up is excluded.
+    rss = [s["rss_mb"] for s in samples]
+    rss_q1 = sorted(rss)[len(rss) // 4]
+    rss_growth = rss[-1] - rss_q1
+    caches_drained = all(
+        s["assumed_uids"] == 0 and s["assumed_node"] == 0
+        and s["bare_ns"] == 0 and s["parked"] == 0
+        for s in samples[1:])
+    threads_flat = max(s["threads"] for s in samples[1:]) <= \
+        samples[0]["threads"] + 2
+    ok = (rss_growth < rss_slack_mb and caches_drained
+          and threads_flat)
+    return {
+        "ok": ok,
+        "minutes": round((time.time() - t_start) / 60.0, 1),
+        "waves": wave,
+        "pods_bound_total": bound_total,
+        "rss_first_mb": rss[0],
+        "rss_q1_mb": rss_q1,
+        "rss_final_mb": rss[-1],
+        "rss_growth_mb": round(rss_growth, 1),
+        "caches_drained_every_wave": caches_drained,
+        "threads_flat": threads_flat,
+        "timer_entries_final": samples[-1]["timer_entries"],
+        "samples_head": samples[:2],
+        "samples_tail": samples[-2:],
+    }
+
+
+def main(argv=None) -> None:
+    import argparse
+    import subprocess
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--minutes", type=float, default=20.0)
+    ap.add_argument("--write", nargs="?", const=os.path.join(
+        _REPO, "bench_artifacts", "soak.json"))
+    args = ap.parse_args(argv)
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")  # long-idle tool; the
+    # wedged-tunnel sitecustomize must not hang it (hardware soaks
+    # would go through a tpu_legs leg)
+
+    doc = run_soak(minutes=args.minutes)
+    doc["ts"] = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+    try:
+        git = subprocess.run(["git", "rev-parse", "--short", "HEAD"],
+                             capture_output=True, cwd=_REPO, timeout=10)
+        if git.returncode == 0:
+            doc["git"] = git.stdout.decode().strip()
+    except (OSError, subprocess.TimeoutExpired):
+        pass
+    print(json.dumps(doc))
+    if args.write:
+        with open(args.write, "w") as f:
+            json.dump(doc, f, indent=1)
+    sys.exit(0 if doc["ok"] else 1)
+
+
+if __name__ == "__main__":
+    main()
