@@ -144,6 +144,7 @@ def main(argv=None) -> None:
 
     ap = argparse.ArgumentParser()
     ap.add_argument("--minutes", type=float, default=20.0)
+    ap.add_argument("--nodes", type=int, default=256)
     ap.add_argument("--write", nargs="?", const=os.path.join(
         _REPO, "bench_artifacts", "soak.json"))
     args = ap.parse_args(argv)
@@ -154,7 +155,8 @@ def main(argv=None) -> None:
     # wedged-tunnel sitecustomize must not hang it (hardware soaks
     # would go through a tpu_legs leg)
 
-    doc = run_soak(minutes=args.minutes)
+    doc = run_soak(minutes=args.minutes, num_nodes=args.nodes)
+    doc["num_nodes"] = args.nodes
     doc["ts"] = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
     try:
         git = subprocess.run(["git", "rev-parse", "--short", "HEAD"],
