@@ -186,25 +186,33 @@ def test_density_replay_smoke():
 def test_bind_phase_overlaps_api_latency_at_batch_128():
     """VERDICT #6 done-criterion: with 1 ms of per-bind API latency at
     batch=128, the bind phase must land well under the 128 ms a serial
-    client would pay (target < 20 ms; allow scheduler-side slack on
-    slow CI).  FakeCluster emulates an 8-way-concurrent API server."""
+    client would pay.  FakeCluster emulates an 8-way-concurrent API
+    server.  The assertion is RELATIVE to a serial control run in the
+    same process, so machine load (co-run jit compiles on shared CI
+    cores) inflates both sides instead of tripping an absolute bound."""
     from kubernetesnetawarescheduler_tpu.config import SchedulerConfig
     from kubernetesnetawarescheduler_tpu.core.loop import SchedulerLoop
     from kubernetesnetawarescheduler_tpu.k8s.client import FakeCluster
     from kubernetesnetawarescheduler_tpu.k8s.types import Node, Pod
 
-    cfg = SchedulerConfig(max_nodes=16, max_pods=128, max_peers=2)
-    fc = FakeCluster(bind_latency_s=0.001, api_concurrency=8)
-    for i in range(16):
-        fc.add_node(Node(name=f"n{i}",
-                         capacity={"cpu": 64.0, "mem": 128.0}))
-    loop = SchedulerLoop(fc, cfg)
-    fc.add_pods([Pod(name=f"p{i}", requests={"cpu": 0.5})
-                 for i in range(128)])
-    assert loop.run_until_drained() == 128
-    bind_p99_ms = loop.timer.percentile("bind", 99) * 1e3
-    # Serial would be >= 128 ms of pure latency; concurrent should be
-    # ~16 ms plus bookkeeping.  90 ms keeps 1-core-CI noise out
-    # (co-run jit compile pressure measured 61.8 ms once) while still
-    # proving the overlap against the >=128 ms serial floor.
-    assert bind_p99_ms < 90.0, f"bind_p99 {bind_p99_ms:.1f} ms"
+    def drain_bind_p99_ms(api_concurrency):
+        cfg = SchedulerConfig(max_nodes=16, max_pods=128, max_peers=2)
+        fc = FakeCluster(bind_latency_s=0.001,
+                         api_concurrency=api_concurrency)
+        for i in range(16):
+            fc.add_node(Node(name=f"n{i}",
+                             capacity={"cpu": 64.0, "mem": 128.0}))
+        loop = SchedulerLoop(fc, cfg)
+        fc.add_pods([Pod(name=f"p{i}", requests={"cpu": 0.5})
+                     for i in range(128)])
+        assert loop.run_until_drained() == 128
+        return loop.timer.percentile("bind", 99) * 1e3
+
+    serial_ms = drain_bind_p99_ms(1)       # >= 128 ms of pure latency
+    concurrent_ms = drain_bind_p99_ms(8)   # ~16 ms + bookkeeping
+    # The serial floor is hard (128 sleeps of 1 ms cannot compress);
+    # 8-way overlap must reclaim at least half of it even with all
+    # scheduler-side bookkeeping slowed by a loaded box.
+    assert serial_ms >= 100.0, f"serial control broke: {serial_ms:.1f} ms"
+    assert concurrent_ms < serial_ms / 2, \
+        f"bind_p99 {concurrent_ms:.1f} ms vs serial {serial_ms:.1f} ms"
