@@ -115,6 +115,26 @@ def _persisted_tpu_density() -> dict | None:
             d["score_p99_artifact_git"] = dl.get("git", "")
         else:
             d["score_p99_source"] = "host_observed"
+    elif (doc["detail"].get("score_p99_source") == "device_boundary"
+          and "score_p99_methodology" not in doc["detail"]):
+        # r5-era artifact: labeled device_boundary, but that round's
+        # measure_device_latency passed HOST-numpy inputs into the
+        # jitted step, re-uploading the N-node snapshot every rep —
+        # its p99 is dominated by transfer, not the kernel (the
+        # BENCH_r05 87.44 ms vs device_latency.json 3.4 ms
+        # contradiction).  Re-label so the number can't be read as a
+        # device-boundary latency; swap in the watcher's clean
+        # device-latency artifact when one exists.
+        d = doc["detail"]
+        d["score_p99_source"] = "device_boundary_host_inputs"
+        dl = _persisted_device_latency(d.get("score_backend", "pallas"))
+        if dl is not None:
+            d["host_upload_score_p99_ms"] = d.get("score_p99_ms")
+            d["score_p50_ms"] = dl["p50_ms"]
+            d["score_p99_ms"] = dl["p99_ms"]
+            d["score_samples"] = dl["reps"]
+            d["score_p99_source"] = "device_boundary_artifact"
+            d["score_p99_artifact_git"] = dl.get("git", "")
     return doc
 
 
@@ -320,6 +340,13 @@ def _assemble_doc(res, *, num_nodes: int, batch: int, method: str,
             "score_samples": device_lat["reps"],
             "score_static_prep_ms": device_lat.get("static_prep_ms"),
             "score_p99_source": "device_boundary",
+            # Methodology marker: inputs are device_put ONCE before
+            # the timing loop (bench/density.measure_device_latency).
+            # Absent in r5-era artifacts, whose "device_boundary"
+            # numbers re-uploaded the host snapshot every rep and read
+            # transfer time as kernel latency (87 ms vs the true
+            # 3.4 ms at N=5120 through the dev tunnel).
+            "score_p99_methodology": "device_resident_inputs",
             # What the host sees beyond the device's own latency:
             # dispatch/fetch transport (the dev tunnel's RTT when
             # present; near zero co-located).
@@ -341,6 +368,20 @@ def _assemble_doc(res, *, num_nodes: int, batch: int, method: str,
                              2),
         "detail": detail,
     }
+
+
+def _attach_bench_env(doc: dict) -> None:
+    """Machine/tree provenance on every emitted doc (host, cores,
+    1-min loadavg, git sha) — the block every artifact carries so a
+    number traces to where it was produced."""
+    try:
+        from kubernetesnetawarescheduler_tpu.bench.envinfo import (
+            bench_env,
+        )
+
+        doc.setdefault("detail", {})["bench_env"] = bench_env()
+    except Exception:  # noqa: BLE001 — provenance must not fail a run
+        pass
 
 
 def _attach_north_star(doc: dict) -> None:
@@ -424,8 +465,16 @@ def main() -> None:
         ndev = os.environ.get("BENCH_CPU_DEVICES", "")
         if ndev:
             # Virtual multi-device CPU: exercises the BENCH_MESH path
-            # without hardware (mirrors tests/conftest.py).
-            jax.config.update("jax_num_cpu_devices", int(ndev))
+            # without hardware (mirrors tests/conftest.py — including
+            # the fallback for jax versions without the config option,
+            # which works because the backend is not initialized yet).
+            try:
+                jax.config.update("jax_num_cpu_devices", int(ndev))
+            except AttributeError:
+                flags = os.environ.get("XLA_FLAGS", "")
+                os.environ["XLA_FLAGS"] = (
+                    f"{flags} --xla_force_host_platform_device_count="
+                    f"{int(ndev)}").strip()
     elif os.environ.get("BENCH_SKIP_TPU_PROBE", "") != "1" \
             and not _tpu_reachable_with_retries():
         persisted = _persisted_tpu_density()
@@ -446,6 +495,7 @@ def main() -> None:
                 # env plumbing.
                 _attach_north_star(persisted)
                 _attach_cpu_density(persisted)
+            _attach_bench_env(persisted)
             print(json.dumps(persisted))
             return
         # Degrade to CPU instead of hanging the driver: the JSON line
@@ -622,6 +672,7 @@ def main() -> None:
                 persisted["detail"][f"{backend}_error"] = err
             _attach_north_star(persisted)
             _attach_cpu_density(persisted)
+            _attach_bench_env(persisted)
             print(json.dumps(persisted))
             return
         print(f"WARNING: all TPU legs failed ({errors}); falling back "
@@ -660,6 +711,7 @@ def main() -> None:
             # record as proof the tunnel was tried continuously, not
             # just at startup.
             detail.update(_probe_log_stats())
+    _attach_bench_env(doc)
     print(json.dumps(doc))
 
 

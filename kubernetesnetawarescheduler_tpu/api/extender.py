@@ -45,7 +45,13 @@ def _pod_from_k8s(obj: Mapping[str, Any]) -> Pod:
     Resource requests come from the max over containers' requests
     (scheduling-relevant aggregate); netaware extensions ride in
     annotations: ``netaware/peers`` (JSON {pod: traffic}),
-    ``netaware/group``, ``netaware/affinity``, ``netaware/anti``.
+    ``netaware/group``, ``netaware/affinity``, ``netaware/anti``, and
+    the gang contract (core/gang.py): ``netaware/pod-group`` (name),
+    ``netaware/pod-group-min-member`` (int; the gang gates until this
+    many members arrive) and ``netaware/pod-group-timeout-s`` (float;
+    0 = cfg.gang_timeout_s).  Malformed numbers degrade to 0 rather
+    than rejecting the pod — a gang with min_member <= 1 schedules
+    independently, the safe direction.
     """
     meta = obj.get("metadata") or {}
     spec = obj.get("spec") or {}
@@ -85,7 +91,26 @@ def _pod_from_k8s(obj: Mapping[str, Any]) -> Pod:
         anti_groups=frozenset(
             g for g in annotations.get("netaware/anti", "").split(",") if g),
         priority=float(spec.get("priority", 0) or 0),
+        pod_group=str(annotations.get("netaware/pod-group", "")),
+        gang_min_member=_parse_int(
+            annotations.get("netaware/pod-group-min-member", 0)),
+        gang_timeout_s=_parse_float(
+            annotations.get("netaware/pod-group-timeout-s", 0.0)),
     )
+
+
+def _parse_int(text: Any) -> int:
+    try:
+        return int(float(text))
+    except (TypeError, ValueError):
+        return 0
+
+
+def _parse_float(text: Any) -> float:
+    try:
+        return float(text)
+    except (TypeError, ValueError):
+        return 0.0
 
 
 def _parse_cpu(text: str) -> float:
@@ -478,6 +503,20 @@ class ExtenderHandlers:
             return self._json(self.bind(json.loads(body or b"{}")))
         if path == "/health":
             return b'{"ok": true}'
+        if path == "/gangs":
+            # Gang observability (core/gang.py): gated groups with
+            # arrival progress, recent terminal phases, lifetime
+            # counters.  Read-only; safe to poll.
+            gangs = getattr(self._loop, "gangs", None)
+            if gangs is None:
+                return self._json({"enabled": False})
+            snap = dict(gangs.snapshot())
+            snap["enabled"] = True
+            snap["bound_total"] = int(
+                getattr(self._loop, "gangs_bound", 0))
+            snap["rolled_back_total"] = int(
+                getattr(self._loop, "gangs_rolled_back", 0))
+            return self._json(snap)
         if path == "/metrics":
             # Self-metrics in Prometheus exposition format (SURVEY.md
             # §5 observability row) — the scheduler is scrapeable the

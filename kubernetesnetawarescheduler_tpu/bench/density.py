@@ -583,6 +583,16 @@ def measure_device_latency(num_nodes: int, batch_size: int,
         a = assign_parallel(s, b, cfg, st)
         return a, commit_assignments(s, b, a)
 
+    # Device-resident inputs, put ONCE before the timing loop:
+    # ``snapshot()``/``encode_pods`` return HOST numpy, and without an
+    # explicit put every timed rep re-uploads the full N-node snapshot
+    # (tens of MB at N=5120) — on a remote-attached chip that transfer
+    # masquerades as kernel latency (the r5 artifact contradiction:
+    # score_p99_ms 87 ms from this path vs 3.4 ms from tpu_legs'
+    # already-device-resident inputs measuring the SAME program).
+    state = jax.device_put(state)
+    batch = jax.device_put(batch)
+    static = jax.device_put(static)
     step = jax.jit(_step)
     for _ in range(max(1, warmup_reps)):
         jax.block_until_ready(step(state, batch, static))
@@ -601,4 +611,7 @@ def measure_device_latency(num_nodes: int, batch_size: int,
         "batch_size": batch_size,
         "score_backend": score_backend,
         "backend": jax.default_backend(),
+        # One timing methodology, named: block_until_ready on the
+        # device output of the jitted step, inputs device-resident.
+        "p99_source": "device_boundary",
     }

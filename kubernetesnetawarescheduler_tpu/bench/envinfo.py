@@ -1,0 +1,45 @@
+"""Shared bench-environment fingerprint for every emitted artifact.
+
+Every benchmark JSON this repo writes carries a ``bench_env`` block so
+a number can be traced to the machine and tree that produced it.  The
+earlier shape read env vars the harness never set (``BENCH_HOST`` et
+al.), leaving ``{}`` in every artifact — this computes the facts
+directly and falls back to empty strings only where the platform
+genuinely cannot answer.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import subprocess
+
+
+def git_sha(repo_dir: str | None = None) -> str:
+    """Short commit sha of the tree that produced the run ('' outside
+    a checkout or without git)."""
+    if repo_dir is None:
+        repo_dir = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+    try:
+        out = subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"], cwd=repo_dir,
+            capture_output=True, text=True, timeout=5)
+        return out.stdout.strip() if out.returncode == 0 else ""
+    except (OSError, subprocess.SubprocessError):
+        return ""
+
+
+def bench_env() -> dict:
+    """{host, cpu_count, loadavg_1m, git_sha} — the provenance block
+    every bench artifact embeds as ``bench_env``."""
+    try:
+        load1 = round(os.getloadavg()[0], 2)
+    except OSError:  # platforms without getloadavg
+        load1 = -1.0
+    return {
+        "host": socket.gethostname(),
+        "cpu_count": os.cpu_count() or 0,
+        "loadavg_1m": load1,
+        "git_sha": git_sha(),
+    }

@@ -262,6 +262,54 @@ class FaultyExporterFleet:
         return synth_exporter_body(sample_metrics(rng), nan=nan)
 
 
+def generate_gang_workload(num_gangs: int = 12,
+                           member_counts: Sequence[int] = (8, 16, 32),
+                           filler_pods: int = 0,
+                           seed: int = 0,
+                           cpu: float = 7.0,
+                           mem: float = 12.0,
+                           netbw: float = 1.0,
+                           scheduler_name: str = "netAwareScheduler"
+                           ) -> list[Pod]:
+    """TPU-slice-job shaped workload: ``num_gangs`` pod groups cycling
+    through ``member_counts`` members each (the gang annotation
+    contract, core/gang.py), plus ``filler_pods`` independent pods,
+    interleaved so the gang gate actually absorbs partial groups.
+    Members are homogeneous (one slice = identical workers) and
+    node-sized — a real TPU slice runs ~one worker per host, so the
+    defaults request enough cpu/mem that a gang CANNOT collapse onto
+    one node and placement quality is decided by which rack/zone the
+    members spread across — the regime the group objective exists
+    for."""
+    rng = np.random.default_rng(seed)
+    pods: list[Pod] = []
+    for g in range(num_gangs):
+        m = int(member_counts[g % len(member_counts)])
+        group = f"slice-{g:03d}"
+        for i in range(m):
+            pods.append(Pod(
+                name=f"{group}-w{i:03d}",
+                scheduler_name=scheduler_name,
+                requests={"cpu": cpu, "mem": mem, "net_bw": netbw},
+                pod_group=group,
+                gang_min_member=m,
+                priority=5.0,
+            ))
+    for i in range(filler_pods):
+        pods.append(Pod(
+            name=f"filler-{i:05d}",
+            scheduler_name=scheduler_name,
+            requests={
+                "cpu": float(rng.uniform(0.1, 1.0)),
+                "mem": float(rng.uniform(0.2, 2.0)),
+                "net_bw": float(rng.uniform(0.02, 0.5)),
+            },
+            priority=float(rng.uniform(0, 10)),
+        ))
+    order = rng.permutation(len(pods))
+    return [pods[int(j)] for j in order]
+
+
 def generate_workload(spec: WorkloadSpec,
                       scheduler_name: str = "netAwareScheduler"
                       ) -> list[Pod]:

@@ -331,7 +331,11 @@ def run_native_load(num_nodes: int = 5120, max_pods: int = 256,
             "requests": conc_clients * requests_per_client,
             "scored_responses": scored,
             "errors": errors,
-            "conc_qps": round(qps, 1),
+            # One timed pass here, so best == mean; both keys are
+            # emitted to keep the schema aligned with extender_qps
+            # (whose headline is best-of-N, named as such).
+            "conc_qps_best": round(qps, 1),
+            "conc_qps_mean": round(qps, 1),
             "wall_s": round(wall, 2),
             "shim_peak": peak,
         }
@@ -378,18 +382,13 @@ def main(argv=None) -> None:
     doc = run_native_load(num_nodes=args.nodes,
                           conc_clients=args.clients,
                           requests_per_client=args.requests)
+    from kubernetesnetawarescheduler_tpu.bench.envinfo import bench_env
+
     doc["backend"] = jax.default_backend()
     doc["ts"] = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
-    try:
-        git = subprocess.run(
-            ["git", "rev-parse", "--short", "HEAD"],
-            capture_output=True, cwd=_REPO, timeout=10)
-        if git.returncode == 0:
-            # Omit the key rather than write a blank SHA (the
-            # extender_qps provenance rule).
-            doc["git"] = git.stdout.decode().strip()
-    except (OSError, subprocess.TimeoutExpired):
-        pass
+    doc["bench_env"] = bench_env()
+    if doc["bench_env"].get("git_sha"):
+        doc["git"] = doc["bench_env"]["git_sha"]  # legacy key
     print(json.dumps(doc))
     if args.write:
         with open(args.write, "w") as f:

@@ -1216,6 +1216,212 @@ def run_zone_affinity_config(out_dir: str | None = None,
     return SuiteResult("zone_affinity", metrics, artifacts)
 
 
+def run_gang_config(out_dir: str | None = None, num_nodes: int = 5120,
+                    num_gangs: int = 24,
+                    member_counts: Sequence[int] = (8, 16, 32),
+                    filler_pods: int = 256, batch: int = 128,
+                    overhead_pods: int = 512,
+                    seed: int = 0) -> SuiteResult:
+    """Gang scheduling leg (core/gang.py): mixed 8/16/32-member TPU
+    slice jobs at N=5120, interleaved with independent filler pods.
+
+    Reports three falsifiable bars:
+
+    - atomicity: every submitted gang ends fully Bound (no strict
+      subset — the fake apiserver's ``bind_gang`` transaction plus the
+      loop's rollback path make a partial gang a bug, not a tail);
+    - network quality: mean intra-gang pairwise bandwidth (ground-
+      truth ``bw`` matrix, loopback for co-located pairs) must be
+      STRICTLY higher than an independent baseline — the same pods
+      with their gang annotations stripped, on an identical fresh
+      cluster;
+    - gate overhead: a gang-free workload through a gang-enabled loop
+      must stay within 10% of the same workload with
+      ``enable_gang_scheduling=False`` (the gate is a per-pod
+      annotation probe; pods without it must not pay for the feature).
+
+    Gang latency p50/p99 comes from polling each gang's registry phase
+    between scheduling cycles — latency is measured from workload
+    submission to the cycle after the gang's atomic bind lands.
+    """
+    import dataclasses as _dc
+
+    from kubernetesnetawarescheduler_tpu.bench.envinfo import bench_env
+    from kubernetesnetawarescheduler_tpu.bench.fakecluster import (
+        generate_gang_workload,
+    )
+    from kubernetesnetawarescheduler_tpu.core.gang import (
+        BOUND,
+        gang_key_of,
+        mean_intra_gang_bw,
+    )
+
+    def _gang_loop(sd: int, enable: bool = True
+                   ) -> tuple[SchedulerLoop, SchedulerConfig, np.ndarray]:
+        cfg = SchedulerConfig(
+            max_nodes=_round_up(num_nodes, 128),
+            max_pods=batch,
+            max_peers=4,
+            weights=BW_LAT,
+            queue_capacity=max(300, 4 * batch
+                               + num_gangs * max(member_counts)
+                               + filler_pods + overhead_pods),
+            enable_gang_scheduling=enable,
+        )
+        cluster, lat, bw = build_fake_cluster(
+            ClusterSpec(num_nodes=num_nodes, seed=sd))
+        loop = SchedulerLoop(cluster, cfg, method="parallel")
+        loop.encoder.set_network(lat, bw)
+        feed_metrics(cluster, loop.encoder, np.random.default_rng(sd + 1))
+        return loop, cfg, bw
+
+    def _drain_tracking_gangs(loop: SchedulerLoop, pods: Sequence[Pod],
+                              keys: Sequence[str]
+                              ) -> tuple[float, dict[str, float]]:
+        t0 = time.perf_counter()
+        loop.client.add_pods(pods)
+        bound_at: dict[str, float] = {}
+        for _ in range(10_000):
+            n = loop.run_once(timeout=0.0)
+            if loop.gangs is not None:
+                now = time.perf_counter() - t0
+                for key in keys:
+                    if key not in bound_at \
+                            and loop.gangs.phase_of(key) == BOUND:
+                        bound_at[key] = now
+            if n == 0 and len(loop.queue) == 0:
+                loop.flush_binds()
+                if len(loop.queue) == 0:
+                    break
+        return time.perf_counter() - t0, bound_at
+
+    def _member_node_idx(loop: SchedulerLoop,
+                         members: Sequence[Pod]) -> np.ndarray:
+        idx = []
+        for p in members:
+            node = loop.client.node_of(p.name)
+            idx.append(loop.encoder.node_index(node) if node else -1)
+        return np.asarray(idx, np.int32)
+
+    pods = generate_gang_workload(
+        num_gangs=num_gangs, member_counts=member_counts,
+        filler_pods=filler_pods, seed=seed)
+    by_gang: dict[str, list[Pod]] = {}
+    for p in pods:
+        key = gang_key_of(p)
+        if key:
+            by_gang.setdefault(key, []).append(p)
+    gang_keys = sorted(by_gang)
+
+    # Warm the jit cache for this EXACT cfg on a throwaway loop so the
+    # timed drains (and the gang latency percentiles) measure
+    # scheduling, not XLA compilation.
+    wloop, wcfg, _ = _gang_loop(seed + 777)
+    for n_warm in (2 * batch, min(batch, 8)):
+        wpods = generate_workload(
+            WorkloadSpec(num_pods=n_warm, seed=seed + 888),
+            scheduler_name=wcfg.scheduler_name)
+        wloop.client.add_pods(wpods)
+        wloop.run_until_drained()
+    # One gang per member size: the biased re-score pass is a distinct
+    # jit program per padded gang shape.
+    wgang = generate_gang_workload(
+        num_gangs=len(member_counts), member_counts=member_counts,
+        seed=seed + 999, scheduler_name=wcfg.scheduler_name)
+    wloop.client.add_pods(wgang)
+    wloop.run_until_drained()
+
+    # --- gang-aware run ----------------------------------------------
+    loop, cfg, bw = _gang_loop(seed)
+    pods = [_dc.replace(p, scheduler_name=cfg.scheduler_name)
+            for p in pods]
+    for key in by_gang:
+        by_gang[key] = [p for p in pods if gang_key_of(p) == key]
+    wall, bound_at = _drain_tracking_gangs(loop, pods, gang_keys)
+    fully_bound = [k for k in gang_keys
+                   if all(loop.client.node_of(p.name)
+                          for p in by_gang[k])]
+    partial = [k for k in gang_keys
+               if k not in fully_bound
+               and any(loop.client.node_of(p.name) for p in by_gang[k])]
+    gang_bw = [mean_intra_gang_bw(bw, _member_node_idx(loop, by_gang[k]))
+               for k in fully_bound]
+    lat_ms = [bound_at[k] * 1e3 for k in gang_keys if k in bound_at]
+
+    # --- independent baseline: annotations stripped ------------------
+    # node_name must be cleared too: the fake apiserver binds by
+    # mutating the SHARED Pod object, so after the gang run the
+    # originals already carry their placement.
+    base_pods = [_dc.replace(p, pod_group="", gang_min_member=0,
+                             gang_timeout_s=0.0, node_name="")
+                 for p in pods]
+    bloop, _, _ = _gang_loop(seed)
+    bwall = _drain(bloop, base_pods)
+    base_bw = []
+    for k in fully_bound:
+        names = {p.name for p in by_gang[k]}
+        members = [p for p in base_pods if p.name in names]
+        if all(bloop.client.node_of(p.name) for p in members):
+            base_bw.append(
+                mean_intra_gang_bw(bw, _member_node_idx(bloop, members)))
+    mean_gang = float(np.mean(gang_bw)) if gang_bw else 0.0
+    mean_base = float(np.mean(base_bw)) if base_bw else 0.0
+
+    # --- gate overhead on a gang-free workload -----------------------
+    # Both loops are warmed with an untimed wave first so the gated/
+    # ungated walls compare scheduling, not XLA compilation (the two
+    # cfgs are distinct jit cache keys).
+    over = generate_workload(
+        WorkloadSpec(num_pods=overhead_pods, seed=seed + 5),
+        scheduler_name=cfg.scheduler_name)
+    walls = {}
+    for label, enable in (("gated", True), ("ungated", False)):
+        oloop, ocfg, _ = _gang_loop(seed + 9, enable=enable)
+        warm = generate_workload(
+            WorkloadSpec(num_pods=2 * batch, seed=seed + 6),
+            scheduler_name=ocfg.scheduler_name)
+        oloop.client.add_pods(warm)
+        oloop.run_until_drained()
+        before = oloop.scheduled
+        w = _drain(oloop, [_dc.replace(p, name=f"o-{p.name}")
+                           for p in over])
+        walls[label] = (oloop.scheduled - before) / w if w else 0.0
+    overhead_ratio = (round(walls["gated"] / walls["ungated"], 4)
+                      if walls["ungated"] else 0.0)
+
+    metrics = {
+        "num_nodes": num_nodes,
+        "gangs_submitted": len(gang_keys),
+        "gangs_fully_bound": len(fully_bound),
+        "gangs_partially_bound": len(partial),  # MUST stay 0
+        "gang_members_total": sum(len(v) for v in by_gang.values()),
+        "filler_pods": filler_pods,
+        "gang_latency_p50_ms": (round(float(np.percentile(lat_ms, 50)), 2)
+                                if lat_ms else 0.0),
+        "gang_latency_p99_ms": (round(float(np.percentile(lat_ms, 99)), 2)
+                                if lat_ms else 0.0),
+        "mean_intra_gang_bw_gbps": round(mean_gang / 1e9, 4),
+        "baseline_intra_gang_bw_gbps": round(mean_base / 1e9, 4),
+        "intra_gang_bw_gain": (round(mean_gang / mean_base, 4)
+                               if mean_base else 0.0),
+        "gang_bw_strictly_higher": bool(mean_gang > mean_base),
+        "pods_per_sec": round(loop.scheduled / wall, 1) if wall else 0.0,
+        "baseline_pods_per_sec": (round(bloop.scheduled / bwall, 1)
+                                  if bwall else 0.0),
+        "gate_overhead_pods_per_sec": {
+            k: round(v, 1) for k, v in walls.items()},
+        "gate_overhead_ratio": overhead_ratio,  # >= 0.9 required
+        "bench_env": bench_env(),
+    }
+    artifacts = []
+    if out_dir:
+        path = os.path.join(out_dir, "gang_scheduling.json")
+        with open(path, "w", encoding="utf-8") as fh:
+            json.dump(metrics, fh, indent=2)
+        artifacts.append(path)
+    return SuiteResult("gang", metrics, artifacts)
+
+
 CONFIGS: dict[str, Callable[..., SuiteResult]] = {
     "density": run_density_config,
     "custom_network": run_custom_network_config,
@@ -1225,6 +1431,7 @@ CONFIGS: dict[str, Callable[..., SuiteResult]] = {
     "zone_affinity": run_zone_affinity_config,
     "binpack": run_binpack_config,
     "sidecar": run_sidecar_config,
+    "gang": run_gang_config,
 }
 
 # Reduced shapes for smoke runs / CPU CI.
@@ -1238,6 +1445,8 @@ SMALL = {
     "zone_affinity": dict(num_nodes=64, num_pods=256, batch=32),
     "binpack": dict(num_nodes=64, num_pods=256, batch=32),
     "sidecar": dict(num_nodes=128, num_apps=48, batch=32),
+    "gang": dict(num_nodes=128, num_gangs=6, member_counts=(4, 8),
+                 filler_pods=32, batch=32, overhead_pods=64),
 }
 
 

@@ -247,6 +247,25 @@ class SchedulerConfig:
     # analog) so a wedged victim cannot hold capacity hostage.
     preemption_wait_s: float = 120.0
 
+    # Gang scheduling (core/gang.py): pods annotated with a pod-group
+    # are gated until minMember have arrived, scored jointly for
+    # intra-gang pairwise bandwidth, and bound all-or-nothing.  On by
+    # default because it only engages for pods that carry the
+    # annotation — annotation-free workloads pay nothing.
+    enable_gang_scheduling: bool = True
+
+    # Default time an incomplete gang may sit gated before its members
+    # are released with a FailedScheduling event; a pod-group's own
+    # timeout annotation overrides this per gang.
+    gang_timeout_s: float = 300.0
+
+    # Strength of the group objective's co-placement bias: the joint
+    # scoring pass adds ``gang_weight * mean_j C[n, m_j]`` (the mean
+    # net-desirability column over the gang's tentative member nodes)
+    # to every member's score row.  0 disables the second pass — gangs
+    # still bind atomically but members place independently.
+    gang_weight: float = 1.0
+
     def __post_init__(self) -> None:
         if self.max_nodes <= 0 or self.max_pods <= 0 or self.max_peers <= 0:
             raise ValueError("shape limits must be positive")
@@ -264,6 +283,10 @@ class SchedulerConfig:
                 f"got {self.score_backend!r}")
         if self.extender_batch_window_s < 0:
             raise ValueError("extender_batch_window_s must be >= 0")
+        if self.gang_timeout_s <= 0:
+            raise ValueError("gang_timeout_s must be > 0")
+        if self.gang_weight < 0:
+            raise ValueError("gang_weight must be >= 0")
 
 
 # ---------------------------------------------------------------------------

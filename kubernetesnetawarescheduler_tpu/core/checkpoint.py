@@ -171,9 +171,19 @@ def save_checkpoint(path: str, encoder: Encoder) -> None:
                       int(rec.zone), int(rec.zanti_bits),
                       int(rec.member_bits),
                       (sorted(rec.labels) if rec.labels is not None
-                       else None)]
+                       else None),
+                      rec.gang_key]
                 for uid, rec in encoder._committed.items()
             },
+            # Gangs inside their assume->bind window at snapshot time:
+            # restore ROLLS THESE BACK (all-or-nothing must hold
+            # across a crash — the bind outcome is unknown, and a
+            # half-bound gang resurrected from the ledger would
+            # violate the atomicity invariant).  Optional key, read
+            # via .get: no format bump needed.
+            "gangs_inflight": {
+                key: [list(e) for e in entries]
+                for key, entries in encoder._inflight_gangs.items()},
             # Zone interner (topology-spread domains).
             "zones": dict(encoder._zone_index),
             # Numeric-label columns (v5): Gt/Lt key -> column of
@@ -278,11 +288,12 @@ def load_checkpoint(path: str,
         labels = (frozenset(entry[12])
                   if len(entry) > 12 and entry[12] is not None
                   else None)
+        gang_key = str(entry[13]) if len(entry) > 13 and entry[13] else ""
         return CommitRecord(int(idx), np.asarray(req, np.float32), 0.0,
                             prio, ns, name, gbit, abits, pdb,
                             group_slot=gslot, zone=zone,
                             zanti_bits=zanti, member_bits=member,
-                            labels=labels)
+                            labels=labels, gang_key=gang_key)
 
     enc._committed = {uid: _rec(entry)
                       for uid, entry in meta.get("committed", {}).items()}
@@ -329,6 +340,16 @@ def load_checkpoint(path: str,
                 if refs[row, pos] == 0:
                     refs[row, pos] = 1
                 unaccounted ^= b
+    # Gangs that were inside their assume->bind window when the
+    # checkpoint was taken: the bind's outcome is unknown (the process
+    # died holding it), so the all-or-nothing contract says ROLL BACK
+    # every member — deterministically, via the same ledger-driven
+    # release the live rollback path uses (refcounts above are already
+    # rebuilt, so _release_record reverses them consistently).  The
+    # members' pods are still Pending on the API server and re-arrive
+    # through the informer's initial resync to re-gate.
+    for key, entries in meta.get("gangs_inflight", {}).items():
+        enc.rollback_gang_members(e[0] for e in entries)
     # Everything is freshly loaded: first snapshot() must upload all.
     for key in enc._dirty:
         enc._dirty[key] = True

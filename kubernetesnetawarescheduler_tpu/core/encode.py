@@ -27,6 +27,7 @@ from kubernetesnetawarescheduler_tpu.config import (
     Resource,
     SchedulerConfig,
 )
+from kubernetesnetawarescheduler_tpu.core.gang import gang_key_of
 from kubernetesnetawarescheduler_tpu.core.state import ClusterState, PodBatch
 from kubernetesnetawarescheduler_tpu.k8s.types import Node, Pod
 
@@ -209,6 +210,12 @@ class CommitRecord(NamedTuple):
     # genuinely label-less pod, which negative selectors (NotIn /
     # DoesNotExist) do match.
     labels: frozenset | None = None
+    # Gang membership: the ``namespace/pod-group`` key this pod was
+    # committed under ("" = not gang-scheduled).  Preemption consumes
+    # this to expand one victim into its whole gang (all-or-nothing
+    # holds for eviction too), and the loop uses it to release the
+    # rest of a gang when a member vanishes.
+    gang_key: str = ""
 
 
 class Encoder:
@@ -315,6 +322,13 @@ class Encoder:
         # oldest-first (release()).
         self._committed: dict[str, CommitRecord] = {}
         self._early_releases: dict[str, None] = {}
+        # Gangs whose members are ASSUMED (usage committed) but whose
+        # all-or-nothing bind has not confirmed: gang key -> member
+        # [uid, namespace, name, node_name] entries.  Persisted by the
+        # checkpoint so a crash inside the bind window rolls the whole
+        # gang back deterministically on restore (no member of a gang
+        # may survive in the ledger without the rest).
+        self._inflight_gangs: dict[str, list[list]] = {}
 
         # Nominations (kube's nominatedNodeName analog): a preemptor
         # whose victims are terminating holds a capacity reservation on
@@ -652,6 +666,49 @@ class Encoder:
         with self._lock:
             return uid in self._committed
 
+    def note_gang_inflight(self, gang_key: str,
+                           entries: list[list]) -> None:
+        """Record a gang entering its assume->bind window (entries:
+        ``[uid, namespace, name, node_name]`` per member).  A
+        checkpoint taken inside the window persists this so restore
+        rolls the gang back instead of resurrecting a half-bound
+        subset."""
+        with self._lock:
+            self._inflight_gangs[gang_key] = [list(e) for e in entries]
+
+    def clear_gang_inflight(self, gang_key: str) -> None:
+        """The gang's bind resolved (bound or rolled back)."""
+        with self._lock:
+            self._inflight_gangs.pop(gang_key, None)
+
+    def rollback_gang_members(self, uids: Iterable[str]) -> int:
+        """Ledger-driven rollback of gang member commits by uid (the
+        restore path; the live path goes through ``release`` with the
+        member Pod in hand).  Returns how many records were reversed."""
+        n = 0
+        with self._lock:
+            for uid in uids:
+                rec = self._committed.pop(uid, None)
+                if rec is not None:
+                    self._release_record(rec)
+                    n += 1
+            if n:
+                self._dirty["alloc"] = True
+        return n
+
+    def gang_members(self, gang_key: str) -> list[tuple[str, "CommitRecord"]]:
+        """Committed ledger entries belonging to one gang (by the
+        ``namespace/pod-group`` key recorded at commit time) — the
+        preemption planner's victim-expansion surface: evicting one
+        slice-job member strands the rest, so the whole gang goes.
+        Host dict scan; preemption planning is rare and already does
+        a full ledger pass."""
+        if not gang_key:
+            return []
+        with self._lock:
+            return [(uid, rec) for uid, rec in self._committed.items()
+                    if rec.gang_key == gang_key]
+
     def known_node_names(self) -> list[str]:
         """Currently registered node names (copy, lock-consistent)."""
         with self._lock:
@@ -847,7 +904,8 @@ class Encoder:
                     group_slot=gslot, zone=zone, zanti_bits=zanti,
                     member_bits=member,
                     labels=frozenset(getattr(pod, "labels", None)
-                                     or ()))
+                                     or ()),
+                    gang_key=gang_key_of(pod))
                 # Zone presence + member counts for EVERY membership
                 # bit (selector groups included), not just the own
                 # group: gz_counts is what zone affinity and spread

@@ -24,6 +24,11 @@ from kubernetesnetawarescheduler_tpu.core.assign import (
     assign_parallel,
 )
 from kubernetesnetawarescheduler_tpu.core.encode import Encoder
+from kubernetesnetawarescheduler_tpu.core.gang import (
+    GangRegistry,
+    gang_key_of,
+    place_gang,
+)
 from kubernetesnetawarescheduler_tpu.k8s.client import ClusterClient
 from kubernetesnetawarescheduler_tpu.k8s.informer import Informer, PodQueue
 from kubernetesnetawarescheduler_tpu.k8s.types import (
@@ -197,6 +202,14 @@ class SchedulerLoop:
                 name="bind-worker")
             self._bind_worker.start()
 
+        # Gang scheduling (core/gang.py): annotated pods are diverted
+        # into the registry's gate by run_once and scheduled as whole
+        # groups through _schedule_gang once minMember have arrived.
+        self.gangs = (GangRegistry(cfg)
+                      if cfg.enable_gang_scheduling else None)
+        self.gangs_bound = 0
+        self.gangs_rolled_back = 0
+
         self.round_samples: deque = deque(maxlen=256)
         # Appends happen on the serving thread while /metrics scrapes
         # from the UDS/gRPC threads; iterating a deque mid-append
@@ -206,10 +219,15 @@ class SchedulerLoop:
         # that is waiting for victim confirmation out of the queue —
         # scoring it early would drop its reservation and burn its
         # attempt budget against usage the victims still hold.
+        # is_parked also covers _parked_uids: gang members parked
+        # after a rollback (and async-mode unschedulable pods) are
+        # woken by _requeue_parked, not resync — a resync re-delivery
+        # would duplicate them in the queue while parked.
         self.informer = Informer(
             client, self.queue, cfg.scheduler_name,
             on_node=self._on_node,
-            is_parked=lambda p: p.uid in self._awaiting_preemption)
+            is_parked=lambda p: (p.uid in self._awaiting_preemption
+                                 or p.uid in self._parked_uids))
         # Usage release on pod termination/deletion: without this a
         # long-running daemon's committed usage grows monotonically
         # until every node looks full.  Clients deliver at most once
@@ -258,6 +276,10 @@ class SchedulerLoop:
 
     def _on_pod_gone(self, pod: Pod) -> None:
         self._preempt_attempts.pop(pod.uid, None)
+        # A gated gang member deleted before its gang completed must
+        # not count toward minMember forever.
+        if self.gangs is not None:
+            self.gangs.pod_gone(pod)
         # Keep the assume-dedup set bounded by live-pod lifetime.
         self._assumed_uids.discard(pod.uid)
         self._drop_assumed_node(pod)
@@ -318,19 +340,50 @@ class SchedulerLoop:
                 and len(self.queue) >= 2 * batch):
             pods = self.queue.pop_batch(self.burst_batches * batch,
                                         timeout)
+            pods, ready = self._gang_gate(pods)
+            bound = 0
             if len(pods) > batch:
-                return self.schedule_pods_burst(pods)
-            if pods:  # raced down to a single batch: normal path
-                return self.schedule_pods(pods)
+                bound = self.schedule_pods_burst(pods)
+            elif pods:  # raced down to a single batch: normal path
+                bound = self.schedule_pods(pods)
+            for key, members in ready:
+                bound += self._schedule_gang(key, members)
+            return bound
         pods = self.queue.pop_batch(batch, timeout)
-        if not pods:
+        pods, ready = self._gang_gate(pods)
+        if not pods and not ready:
             # Still drain degradation records: in extender-only
             # deployments the watch queue stays empty while the
             # webhook/bind paths keep encoding (and possibly
             # degrading) pods.
             self._emit_degraded_events()
             return 0
-        return self.schedule_pods(pods)
+        bound = self.schedule_pods(pods) if pods else 0
+        for key, members in ready:
+            bound += self._schedule_gang(key, members)
+        return bound
+
+    def _gang_gate(self, pods: Sequence[Pod]
+                   ) -> tuple[list[Pod], list[tuple[str, list[Pod]]]]:
+        """The gang gate AHEAD of per-pod scheduling: pods carrying a
+        pod-group annotation are absorbed into the registry instead of
+        scheduled; a pod that completes its gang releases the whole
+        group as a ``(key, members)`` unit for :meth:`_schedule_gang`.
+        Annotation-free pods pass through untouched (and pay nothing —
+        one ``gang_key_of`` string probe each)."""
+        if self.gangs is None:
+            return list(pods), []
+        passthrough: list[Pod] = []
+        ready: list[tuple[str, list[Pod]]] = []
+        for pod in pods:
+            key = gang_key_of(pod)
+            if not key:
+                passthrough.append(pod)
+                continue
+            members = self.gangs.admit(pod)
+            if members is not None:
+                ready.append((key, members))
+        return passthrough, ready
 
     def schedule_pods_burst(self, pods: Sequence[Pod]) -> int:
         """Schedule several batches' worth of pods in ONE device
@@ -477,6 +530,174 @@ class SchedulerLoop:
             self._static_val = compute_assign_static(state, self.cfg)
             self._static_version = version
         return self._static_val
+
+    def _schedule_gang(self, key: str, members: list[Pod]) -> int:
+        """Jointly place and ATOMICALLY commit one complete gang.
+
+        Score: two-pass joint placement (:func:`core.gang.place_gang`)
+        — the normal batched assigner, then a re-score of every member
+        row with the C[N, N]-derived co-placement bias, keeping the
+        pass that wins the group objective.  Commit: assume-all (usage
+        into the encoder up front, in-flight record for the
+        checkpoint) then bind-all through the client's transactional
+        ``bind_gang``; ANY member failure rolls back EVERY member.
+        Returns members bound (the whole gang, or 0)."""
+        comp = self.cfg.scheduler_name
+        if len(members) > self.cfg.max_pods:
+            # A gang wider than the batch shape cannot be scored
+            # jointly in one dispatch: degrade LOUDLY to independent
+            # placement rather than deadlock the job in the gate.
+            from kubernetesnetawarescheduler_tpu.k8s.types import Event
+
+            self.client.create_event(Event(
+                message=(f"pod group {key} has {len(members)} members "
+                         f"> max_pods={self.cfg.max_pods}; placed "
+                         "independently (no all-or-nothing guarantee)"),
+                reason="GangDegraded", involved_pod=members[0].name,
+                namespace=members[0].namespace, component=comp,
+                type="Warning"))
+            total = 0
+            for i in range(0, len(members), self.cfg.max_pods):
+                total += self.schedule_pods(
+                    members[i:i + self.cfg.max_pods])
+            return total
+        with self.timer.phase("encode"):
+            batch = self.encoder.encode_pods(
+                members, node_of=self._peer_node, lenient=True)
+            state, static_version = self.encoder.snapshot_versioned()
+            node_table = self.encoder.node_table()
+        self._emit_degraded_events()
+        with self.timer.phase("score_assign"):
+            if self._assign_takes_static:
+                static = self._static_for(state, static_version)
+                assign_fn = self._assign
+            else:
+                # Mesh path: serving_fns' closures take no static —
+                # gang re-scoring needs the {"raw","ok"} seam, so fall
+                # back to the single-device assigners for the (rare,
+                # small) gang batches.
+                static = None
+                assign_fn = {"greedy": assign_greedy,
+                             "parallel": assign_parallel}[self.method]
+            assignment = place_gang(state, batch, self.cfg, static,
+                                    assign_fn, len(members))
+        with self.timer.phase("bind"):
+            return self._commit_gang(key, members, assignment,
+                                     node_table)
+
+    def _commit_gang(self, key: str, members: list[Pod],
+                     assignment: np.ndarray, node_table) -> int:
+        """Assume-all-then-bind-all with full rollback (see
+        :meth:`_schedule_gang`)."""
+        comp = self.cfg.scheduler_name
+        table_names, table_gens = node_table
+        events: list = []
+        idxs = [int(assignment[i]) for i in range(len(members))]
+        feasible = all(i >= 0 for i in idxs)
+        if feasible:
+            # Any member slot whose generation moved (node vanished
+            # mid-cycle) aborts the WHOLE gang before anything binds.
+            feasible = all(
+                self.encoder.slot_generation(i) == table_gens[i]
+                for i in idxs)
+        if not feasible:
+            if self.decision_log is not None:
+                for pod in members:
+                    self.decision_log.append(pod.name, "")
+            self.unschedulable += len(members)
+            for pod in members:
+                events.append(failed_event(
+                    pod, comp,
+                    f"gang {key}: no feasible all-or-nothing "
+                    "placement"))
+            self.client.create_events(events)
+            if self.gangs is not None:
+                self.gangs.note_rolled_back(key)
+            self._park_gang(members)
+            return 0
+        names = [table_names[i] for i in idxs]
+        if self.decision_log is not None:
+            for pod, name in zip(members, names):
+                self.decision_log.append(pod.name, name)
+        # ---- assume all -------------------------------------------------
+        fresh = [(p, i) for p, i in zip(members, idxs)
+                 if not self.encoder.is_committed(p.uid)]
+        self.encoder.commit_many([p for p, _ in fresh],
+                                 [i for _, i in fresh])
+        assumed = {p.uid for p, _ in fresh}
+        self._assumed_uids |= assumed
+        for pod, name in zip(members, names):
+            self._publish_assumed_node(pod, name)
+        if self.gangs is not None:
+            self.gangs.note_assumed(key)
+        self.encoder.note_gang_inflight(
+            key, [[p.uid, p.namespace, p.name, n]
+                  for p, n in zip(members, names)])
+        # ---- bind all (transactional) -----------------------------------
+        outcomes = self.client.bind_gang([
+            Binding(pod_name=p.name, namespace=p.namespace,
+                    node_name=n)
+            for p, n in zip(members, names)])
+        self.encoder.clear_gang_inflight(key)
+        if all(o is None for o in outcomes):
+            for pod, name in zip(members, names):
+                events.append(scheduled_event(pod, name, comp))
+            self.client.create_events(events)
+            self.scheduled += len(members)
+            self.gangs_bound += 1
+            if self.gangs is not None:
+                self.gangs.note_bound(key)
+            if self._bind_retries:
+                for pod in members:
+                    self._bind_retries.pop(
+                        f"{pod.namespace}/{pod.name}", None)
+            return len(members)
+        # ---- rollback all ----------------------------------------------
+        self.bind_failures += sum(1 for o in outcomes if o is not None)
+        for pod, name in zip(members, names):
+            if pod.uid in assumed:
+                self.encoder.release(pod, name, rollback=True)
+            self._assumed_uids.discard(pod.uid)
+            self._drop_assumed_node(pod)
+        self.gangs_rolled_back += 1
+        if self.gangs is not None:
+            self.gangs.note_rolled_back(key)
+        first = next(o for o in outcomes if o is not None)
+        for pod in members:
+            events.append(failed_event(
+                pod, comp, f"gang {key} rolled back: {first}"))
+        self.client.create_events(events)
+        # Park for the unblocked-gang wakeup (node add / rollback):
+        # re-delivery re-gates the members, and the gang retries as a
+        # whole.  Members the API server no longer knows stay parked
+        # harmlessly (their deletion purges them via _on_pod_gone).
+        self._park_gang(members)
+        return 0
+
+    def _park_gang(self, members: list[Pod]) -> None:
+        with self._parked_lock:
+            for pod in members:
+                if pod.uid not in self._parked_uids:
+                    self._unsched_parked.append(pod)
+                    self._parked_uids.add(pod.uid)
+
+    def _flush_gang_timeouts(self) -> None:
+        """Expire incomplete gangs whose gate deadline passed: emit a
+        FailedScheduling event per stranded member and return them to
+        the queue (they re-gate with a fresh deadline on the next
+        pop — kube co-scheduling's retry shape)."""
+        if self.gangs is None:
+            return
+        comp = self.cfg.scheduler_name
+        for key, members in self.gangs.flush_timeouts():
+            self.client.create_events([
+                failed_event(
+                    pod, comp,
+                    f"gang {key} timed out waiting for members "
+                    f"({len(members)} arrived)")
+                for pod in members])
+            for pod in members:
+                self.queue.push(pod)  # full queue drops; resync heals
 
     def _emit_degraded_events(self) -> None:
         """Per-pod Warning events for constraint degradation on
@@ -1020,6 +1241,7 @@ class SchedulerLoop:
         except Exception:  # noqa: BLE001 — retried next tick
             pass
         self._flush_preemption_waits()
+        self._flush_gang_timeouts()
         self.encoder.expire_nominations(self.cfg.preemption_wait_s)
 
     def _flush_preemption_waits(self) -> None:

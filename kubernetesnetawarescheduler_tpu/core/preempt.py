@@ -30,6 +30,11 @@ Semantics notes (documented deltas vs kube-scheduler):
   is outright unevictable.  Percentage bounds resolve against live
   member counts (kube uses the controller's expected scale — a
   documented delta);
+- gangs (core/gang.py) are evicted all-or-nothing, mirroring how they
+  are placed: a gang with any member at >= the preemptor's priority
+  contributes NO victim candidates, and choosing any member of an
+  evictable gang expands the plan to every live co-member (on any
+  node) so no partially-placed gang survives a preemption;
 - eviction is graceful (``cfg.preemption_grace_s`` becomes
   DeleteOptions.gracePeriodSeconds) and the preemptor is requeued only
   after every victim's deletion is CONFIRMED through the watch (or
@@ -214,6 +219,24 @@ def plan_preemption(encoder: Encoder, pod: Pod) -> PreemptionPlan | None:
         # (Encoder.set_pdb — selector-group member counting).  A
         # groupless pod with the annotation is simply not a candidate
         # (it protects itself).
+        # Gang all-or-nothing holds for eviction too (core/gang.py): a
+        # bound gang is evictable only as a UNIT.  Pre-pass: collect
+        # live members per gang key and decide evictability — every
+        # member must be strictly lower priority than the preemptor
+        # and not self-protecting, else evicting any subset would
+        # leave a partially-placed gang, the exact state gang
+        # scheduling exists to prevent.  Members of a non-evictable
+        # gang are simply not victim candidates.
+        gang_members_all: dict[str, list[tuple[str, object]]] = {}
+        for uid, rec in encoder._committed.items():
+            if rec.gang_key and uid not in terminating:
+                gang_members_all.setdefault(rec.gang_key, []).append(
+                    (uid, rec))
+        gang_evictable = {
+            key: all(r.priority < prio
+                     and not (r.pdb_min and not r.group_bit)
+                     for _, r in mem)
+            for key, mem in gang_members_all.items()}
         victims_by_node: dict[int, list] = {}
         members_by_slot: dict[int, int] = {}
         ann_min_by_slot: dict[int, int] = {}
@@ -236,6 +259,9 @@ def plan_preemption(encoder: Encoder, pod: Pod) -> PreemptionPlan | None:
             if rec.priority < prio and rec.node < n_real:
                 if rec.pdb_min and not rec.group_bit:
                     continue  # self-protecting singleton
+                if rec.gang_key and not gang_evictable.get(
+                        rec.gang_key, True):
+                    continue  # gang holds a non-evictable member
                 victims_by_node.setdefault(rec.node, []).append((uid, rec))
         # Allowed disruptions per protected slot (never negative: an
         # already-underprovisioned group cannot be disrupted at all).
@@ -283,6 +309,7 @@ def plan_preemption(encoder: Encoder, pod: Pod) -> PreemptionPlan | None:
 
     best: tuple[float, int, int] | None = None  # (max_vprio, count, node)
     best_set: list[Victim] = []
+    best_gangs: list[str] = []
     for node in range(n_real):
         if not static_ok[node]:
             continue
@@ -448,10 +475,29 @@ def plan_preemption(encoder: Encoder, pod: Pod) -> PreemptionPlan | None:
         if best is None or key < best:
             best = key
             best_set = chosen
+            best_gangs = sorted({rec.gang_key for _, rec in chosen_recs
+                                 if rec.gang_key})
     if best is None:
         return None
+    # Preempting one gang member releases the WHOLE gang: expand the
+    # winning set with every live co-member (wherever it is bound) so
+    # the survivors don't linger as a partially-placed gang burning
+    # capacity without their peers.  Co-members re-arrive through the
+    # informer and re-gate as a fresh gang.  The plan key above counts
+    # only node-local victims — a documented approximation: gang
+    # expansion is a consequence of the choice, not a cost the
+    # node-ranking trades off.
+    victims = list(best_set)
+    have = {v.uid for v in victims}
+    for gkey in best_gangs:
+        for uid, rec in gang_members_all.get(gkey, []):
+            if uid not in have and rec.node < n_real:
+                have.add(uid)
+                victims.append(Victim(uid, rec.namespace, rec.name,
+                                      rec.priority,
+                                      node_names[rec.node]))
     return PreemptionPlan(pod.name, node_names[best[2]],
-                          tuple(best_set))
+                          tuple(victims))
 
 
 def execute_preemption(client, encoder: Encoder,

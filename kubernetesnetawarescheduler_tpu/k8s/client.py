@@ -96,6 +96,44 @@ class ClusterClient:
         for e in events:
             self.create_event(e)
 
+    def bind_gang(self, bindings: Sequence[Binding]
+                  ) -> list[Exception | None]:
+        """All-or-nothing bind of one gang's bindings: on success every
+        outcome is None; on ANY failure NO binding is left applied and
+        each failed slot carries its exception (succeeded-then-undone
+        slots carry None — the caller treats any non-None as a whole-
+        gang failure).
+
+        Default implementation for transports without a transactional
+        surface (the real API server has none): bind sequentially and,
+        on first failure, COMPENSATE by deleting the already-bound
+        members (best-effort — kube cannot unbind, so deletion +
+        controller-recreate is the rollback primitive).  In-memory
+        clients override with a true validate-all-then-apply-all
+        transaction."""
+        out: list[Exception | None] = [None] * len(bindings)
+        done: list[int] = []
+        failed = False
+        for i, b in enumerate(bindings):
+            if failed:
+                out[i] = RuntimeError("gang aborted: earlier member "
+                                      "failed to bind")
+                continue
+            try:
+                self.bind(b)
+                done.append(i)
+            except Exception as exc:  # noqa: BLE001 — per-slot outcome
+                out[i] = exc
+                failed = True
+        if failed:
+            for i in done:
+                try:
+                    self.delete_pod(bindings[i].pod_name,
+                                    bindings[i].namespace)
+                except Exception:  # noqa: BLE001 — best-effort undo
+                    pass
+        return out
+
     def list_pending_pods(self) -> Sequence[Pod]:
         """Re-listable pending pods — the recovery path the reference
         lacks (queued pods are lost on restart; it only ever enqueues
@@ -326,6 +364,44 @@ class FakeCluster(ClusterClient):
     def create_events(self, events: Sequence[Event]) -> None:
         with self._lock:
             self.events.extend(events)
+
+    def bind_gang(self, bindings: Sequence[Binding]
+                  ) -> list[Exception | None]:
+        """True all-or-nothing transaction: validate EVERY binding
+        under the lock, apply only when all pass.  On any failure
+        nothing is mutated — no compensating deletes, no pod ever
+        observable bound to a strict subset of its gang (the atomicity
+        invariant the gang tests pin).  Duplicate pod names within one
+        gang are rejected as a conflict (the second apply would
+        double-bind)."""
+        self._simulate_latency()
+        with self._lock:
+            out: list[Exception | None] = [None] * len(bindings)
+            seen: set[str] = set()
+            failed = False
+            for i, b in enumerate(bindings):
+                try:
+                    pod = self._pods.get(b.pod_name)
+                    if pod is None:
+                        raise KeyError(f"unknown pod {b.pod_name}")
+                    if b.node_name not in self._nodes:
+                        raise KeyError(f"unknown node {b.node_name}")
+                    if pod.node_name:
+                        raise ValueError(
+                            f"pod {pod.name} already bound to "
+                            f"{pod.node_name}")
+                    if b.pod_name in seen:
+                        raise ValueError(
+                            f"duplicate pod {b.pod_name} in gang")
+                    seen.add(b.pod_name)
+                except (KeyError, ValueError) as exc:
+                    out[i] = exc
+                    failed = True
+            if failed:
+                return out
+            for b in bindings:
+                self._bind_locked(b)
+            return out
 
     def list_pending_pods(self) -> Sequence[Pod]:
         with self._lock:

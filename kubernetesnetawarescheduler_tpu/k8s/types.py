@@ -146,6 +146,16 @@ class Pod:
     # below it.  With no group, a nonzero value protects the pod
     # itself from preemption outright.
     pdb_min_available: int = 0
+    # Gang scheduling (multi-host slice jobs): pods sharing a
+    # ``pod_group`` are placed all-or-nothing.  ``gang_min_member`` is
+    # the gang size the group gates on (the pod-group annotation's
+    # minMember); 0 or 1 means the pod schedules independently.
+    # ``gang_timeout_s`` bounds how long an incomplete gang may sit
+    # gated before its members are released back with a
+    # FailedScheduling event (0 = the scheduler config default).
+    pod_group: str = ""
+    gang_min_member: int = 0
+    gang_timeout_s: float = 0.0
 
 
 @dataclasses.dataclass(frozen=True, slots=True)

@@ -6,7 +6,18 @@ interpreter start and wins over ``JAX_PLATFORMS``; overriding through
 ``jax.config`` before first device use is the reliable path.
 """
 
+import os
+
 import jax
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+try:
+    jax.config.update("jax_num_cpu_devices", 8)
+except AttributeError:
+    # Older jax (< 0.5) has no jax_num_cpu_devices option; the XLA
+    # flag does the same and is read at backend initialization, which
+    # has not happened yet at conftest import.
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()

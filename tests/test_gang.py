@@ -1,0 +1,352 @@
+"""Gang scheduling properties (core/gang.py, ISSUE gang tentpole).
+
+The invariant under test is ATOMICITY: the API server must never hold
+a bound strict subset of a gang — not under member-bind failures, not
+when a node vanishes mid-assume, not across a crash/restart inside the
+assume->bind window.  Plus the gate lifecycle (timeout returns members
+to the queue, re-delivery re-gates) and an oracle check that the group
+objective ranks the bandwidth-optimal node set first.
+"""
+
+from __future__ import annotations
+
+import itertools
+import time
+
+import numpy as np
+
+from kubernetesnetawarescheduler_tpu.bench.fakecluster import (
+    ClusterSpec,
+    build_fake_cluster,
+    feed_metrics,
+)
+from kubernetesnetawarescheduler_tpu.config import SchedulerConfig
+from kubernetesnetawarescheduler_tpu.core import gang as gang_lib
+from kubernetesnetawarescheduler_tpu.core.checkpoint import (
+    load_checkpoint,
+    save_checkpoint,
+)
+from kubernetesnetawarescheduler_tpu.core.gang import (
+    BOUND,
+    GATED,
+    PENDING,
+    ROLLED_BACK,
+    TIMED_OUT,
+    GangRegistry,
+    gang_key_of,
+    intra_gang_pair_score,
+    mean_intra_gang_bw,
+)
+from kubernetesnetawarescheduler_tpu.core.loop import SchedulerLoop
+from kubernetesnetawarescheduler_tpu.k8s.client import FakeCluster
+from kubernetesnetawarescheduler_tpu.k8s.types import Binding, Node, Pod
+
+
+def make_loop(num_nodes=24, **cfg_kw):
+    cfg = SchedulerConfig(max_nodes=32, max_pods=16, max_peers=4,
+                          **cfg_kw)
+    cluster, lat, bw = build_fake_cluster(ClusterSpec(num_nodes=num_nodes,
+                                                      seed=3))
+    loop = SchedulerLoop(cluster, cfg, method="parallel")
+    loop.encoder.set_network(lat, bw)
+    feed_metrics(cluster, loop.encoder, np.random.default_rng(0))
+    return cluster, loop, bw
+
+
+def gang_pods(group, n, min_member=None, cpu=0.25, timeout_s=0.0):
+    return [Pod(name=f"{group}-w{i}", requests={"cpu": cpu, "mem": 0.25},
+                pod_group=group,
+                gang_min_member=min_member or n,
+                gang_timeout_s=timeout_s)
+            for i in range(n)]
+
+
+def bound_members(cluster, pods):
+    with cluster._lock:
+        return [p.name for p in pods
+                if cluster._pods.get(p.name) is not None
+                and cluster._pods[p.name].node_name]
+
+
+# -- identity + gate ------------------------------------------------------
+
+
+def test_gang_key_rules():
+    assert gang_key_of(Pod(name="a")) == ""
+    # A gang of one is just a pod.
+    assert gang_key_of(Pod(name="a", pod_group="g",
+                           gang_min_member=1)) == ""
+    assert gang_key_of(Pod(name="a", pod_group="g", gang_min_member=0)) == ""
+    assert gang_key_of(Pod(name="a", namespace="ns", pod_group="g",
+                           gang_min_member=2)) == "ns/g"
+
+
+def test_registry_gates_until_min_member():
+    reg = GangRegistry(SchedulerConfig())
+    pods = gang_pods("slice", 3)
+    assert reg.admit(pods[0]) is None
+    assert reg.phase_of("default/slice") == PENDING
+    assert reg.admit(pods[1]) is None
+    members = reg.admit(pods[2])
+    assert members is not None
+    assert {p.name for p in members} == {p.name for p in pods}
+    assert reg.admitted == 1
+    assert reg.phase_of("default/slice") == GATED
+
+
+# -- happy path: atomic bind + joint placement ---------------------------
+
+
+def test_complete_gang_binds_atomically_and_colocates():
+    """A complete gang binds all-or-nothing, and the joint re-scoring
+    pass co-locates the (tiny, peer-less) members: the loopback pin in
+    the C-matrix bias makes a shared node the pairwise-bandwidth
+    optimum, which independent placement (balance weight spreads
+    peer-less pods) does not reach."""
+    cluster, loop, bw = make_loop()
+    pods = gang_pods("slice-a", 4)
+    cluster.add_pods(pods)
+    assert loop.run_until_drained() == 4
+    assert sorted(bound_members(cluster, pods)) == sorted(
+        p.name for p in pods)
+    assert loop.gangs_bound == 1
+    assert loop.gangs.phase_of("default/slice-a") == BOUND
+    snap = loop.gangs.snapshot()
+    assert snap["counters"] == {"admitted": 1, "bound": 1,
+                                "rolled_back": 0, "timed_out": 0}
+    nodes = {cluster.node_of(p.name) for p in pods}
+    assert len(nodes) == 1, f"gang scattered across {nodes}"
+    # Achieved intra-gang bandwidth is the matrix's best link
+    # (loopback), by construction of mean_intra_gang_bw.
+    name_to_idx = {n.name: i
+                   for i, n in enumerate(cluster.list_nodes())}
+    idx = [name_to_idx[cluster.node_of(p.name)] for p in pods]
+    assert mean_intra_gang_bw(bw, idx) == float(np.max(bw))
+
+
+def test_incomplete_gang_binds_nothing():
+    cluster, loop, _ = make_loop()
+    pods = gang_pods("slice-b", 4)
+    cluster.add_pods(pods[:3])  # one member never arrives
+    assert loop.run_until_drained() == 0
+    assert bound_members(cluster, pods) == []
+    assert loop.gangs.phase_of("default/slice-b") == PENDING
+    assert len(loop.queue) == 0  # gated in the registry, not queued
+
+
+# -- atomicity under injected faults -------------------------------------
+
+
+def test_member_bind_failure_rolls_back_whole_gang():
+    """Inject a mid-flight bind race: one member gets bound externally
+    (to a node the scheduler never learned about) between gating and
+    bind.  The transactional bind_gang must reject the WHOLE gang —
+    zero scheduler-made bindings, encoder usage fully restored."""
+    cluster, loop, _ = make_loop()
+    used_before = np.asarray(loop.encoder._used).copy()
+    pods = gang_pods("slice-c", 4)
+    cluster.add_pods(pods)
+    with cluster._lock:
+        cluster._nodes["hidden"] = Node(name="hidden",
+                                        capacity={"cpu": 64.0})
+    cluster.bind(Binding(pod_name=pods[0].name, namespace="default",
+                         node_name="hidden"))
+    loop.run_until_drained()
+    # The only binding on the API server is the external one: the
+    # scheduler never left a strict subset of the gang bound.
+    gang_binds = [b for b in cluster.bindings
+                  if b.pod_name.startswith("slice-c-")]
+    assert [(b.pod_name, b.node_name) for b in gang_binds] == [
+        (pods[0].name, "hidden")]
+    assert loop.gangs_rolled_back == 1
+    assert loop.bind_failures >= 1
+    assert loop.gangs.phase_of("default/slice-c") == ROLLED_BACK
+    for p in pods:
+        assert not loop.encoder.is_committed(p.uid)
+    np.testing.assert_allclose(np.asarray(loop.encoder._used),
+                               used_before, atol=1e-5)
+    assert any("rolled back" in e.message for e in cluster.events)
+
+
+def test_bind_gang_transaction_leaves_nothing_on_failure():
+    """Client-level half of the invariant: bind_gang with one invalid
+    member binding mutates NOTHING (validate-all-then-apply-all)."""
+    fc = FakeCluster()
+    fc.add_node(Node(name="n0", capacity={"cpu": 8.0}))
+    pods = [Pod(name=f"t{i}", requests={"cpu": 0.1}) for i in range(3)]
+    fc.add_pods(pods)
+    outcomes = fc.bind_gang([
+        Binding(pod_name="t0", namespace="default", node_name="n0"),
+        Binding(pod_name="t1", namespace="default", node_name="ghost"),
+        Binding(pod_name="t2", namespace="default", node_name="n0"),
+    ])
+    assert outcomes[1] is not None
+    assert fc.bindings == []
+    assert all(fc.node_of(p.name) == "" for p in pods)
+    # The same gang binds cleanly once every member is valid.
+    outcomes = fc.bind_gang([
+        Binding(pod_name=p.name, namespace="default", node_name="n0")
+        for p in pods])
+    assert outcomes == [None, None, None]
+    assert len(fc.bindings) == 3
+
+
+def test_node_vanish_mid_assume_aborts_whole_gang():
+    """A member's target node vanishing inside the scheduling cycle
+    (slot generation moved between node_table() and commit) aborts the
+    gang BEFORE anything binds."""
+    cluster, loop, _ = make_loop(num_nodes=8)
+    used_before = np.asarray(loop.encoder._used).copy()
+    members = gang_pods("slice-d", 3)
+    cluster.add_pods(members)
+    node_table = loop.encoder.node_table()
+    names, _ = node_table
+    targets = [i for i, n in enumerate(names) if n][:3]
+    cluster.delete_node(names[targets[1]])  # bumps that slot's gen
+    bound = loop._commit_gang("default/slice-d", members,
+                              np.asarray(targets, np.int64), node_table)
+    assert bound == 0
+    assert cluster.bindings == []
+    assert loop.unschedulable == 3
+    assert loop.gangs.phase_of("default/slice-d") == ROLLED_BACK
+    for p in members:
+        assert not loop.encoder.is_committed(p.uid)
+    np.testing.assert_allclose(np.asarray(loop.encoder._used),
+                               used_before, atol=1e-5)
+
+
+# -- crash/restart inside the assume->bind window ------------------------
+
+
+def test_checkpoint_restore_rolls_back_inflight_gang():
+    """A checkpoint taken inside a gang's assume->bind window restores
+    with the gang ROLLED BACK: the bind's outcome is unknown, so
+    all-or-nothing says reverse every member deterministically."""
+    _, loop, _ = make_loop(num_nodes=8)
+    enc = loop.encoder
+    used_before = np.asarray(enc._used).copy()
+    members = gang_pods("slice-r", 4)
+    enc.commit_many(members, [0, 1, 2, 3])
+    enc.note_gang_inflight(
+        "default/slice-r",
+        [[p.uid, p.namespace, p.name, f"n{i}"]
+         for i, p in enumerate(members)])
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as tmp:
+        save_checkpoint(f"{tmp}/ckpt", enc)
+        enc2 = load_checkpoint(f"{tmp}/ckpt")
+    for p in members:
+        assert not enc2.is_committed(p.uid)
+    assert enc2._inflight_gangs == {}
+    np.testing.assert_allclose(np.asarray(enc2._used), used_before,
+                               atol=1e-5)
+
+
+def test_checkpoint_preserves_bound_gang_membership():
+    """A gang whose bind RESOLVED before the snapshot (in-flight record
+    cleared) survives restore intact, gang_key included — preemption's
+    evict-as-a-unit expansion depends on it after a restart."""
+    _, loop, _ = make_loop(num_nodes=8)
+    enc = loop.encoder
+    members = gang_pods("slice-s", 3)
+    enc.commit_many(members, [0, 1, 2])  # stamps gang_key from the pod
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as tmp:
+        save_checkpoint(f"{tmp}/ckpt", enc)
+        enc2 = load_checkpoint(f"{tmp}/ckpt")
+    got = enc2.gang_members("default/slice-s")
+    assert sorted(uid for uid, _ in got) == sorted(
+        p.uid for p in members)
+    assert all(rec.gang_key == "default/slice-s" for _, rec in got)
+
+
+# -- gate timeout --------------------------------------------------------
+
+
+def test_gang_timeout_returns_members_to_queue_then_rebinds():
+    cluster, loop, _ = make_loop()
+    pods = gang_pods("slice-t", 4)
+    cluster.add_pods(pods[:3])
+    assert loop.run_until_drained() == 0
+    assert loop.gangs.phase_of("default/slice-t") == PENDING
+    # Push the registry clock past the gate deadline and flush (the
+    # maintain() path) — members must come back with an event each.
+    loop.gangs._now = lambda: time.monotonic() + loop.cfg.gang_timeout_s + 1
+    loop._flush_gang_timeouts()
+    assert loop.gangs.phase_of("default/slice-t") == TIMED_OUT
+    assert loop.gangs.timed_out == 1
+    assert len(loop.queue) == 3
+    timeouts = [e for e in cluster.events if "timed out" in e.message]
+    assert len(timeouts) == 3
+    # Requeued members re-gate with a fresh deadline...
+    loop.gangs._now = time.monotonic
+    assert loop.run_until_drained() == 0
+    assert bound_members(cluster, pods) == []
+    # ...and the late member completes the gang, which then binds.
+    cluster.add_pod(pods[3])
+    assert loop.run_until_drained() == 4
+    assert loop.gangs.phase_of("default/slice-t") == BOUND
+    assert sorted(bound_members(cluster, pods)) == sorted(
+        p.name for p in pods)
+
+
+# -- group objective oracle ----------------------------------------------
+
+
+def test_group_objective_picks_bandwidth_optimal_node_set():
+    """Brute-force oracle on an unambiguous topology: nodes 0-3 form a
+    full-bandwidth/low-latency clique, everything else is a thin link.
+    Over every 4-node subset, intra_gang_pair_score must rank the
+    clique first, and mean_intra_gang_bw must agree."""
+    n = 8
+    thin, fat = 1e9, 100e9
+    bw = np.full((n, n), thin)
+    lat = np.full((n, n), 5e-3)
+    bw[:4, :4] = fat
+    lat[:4, :4] = 1e-4
+    np.fill_diagonal(bw, fat)
+    np.fill_diagonal(lat, 0.0)
+    cfg = SchedulerConfig(max_nodes=16, max_pods=8, max_peers=2)
+    fc = FakeCluster()
+    for i in range(n):
+        fc.add_node(Node(name=f"n{i}", capacity={"cpu": 8.0,
+                                                 "mem": 16.0}))
+    loop = SchedulerLoop(fc, cfg)
+    loop.encoder.set_network(lat, bw)
+    state, _ = loop.encoder.snapshot_versioned()
+
+    scored = [(intra_gang_pair_score(state, subset, cfg), subset)
+              for subset in itertools.combinations(range(n), 4)]
+    best_score, best_set = max(scored)
+    assert set(best_set) == {0, 1, 2, 3}, (best_score, best_set)
+    # Strictly better than any set leaving the clique (no tie the
+    # argmax could silently lose).
+    runner_up = max(s for s, sub in scored if set(sub) != {0, 1, 2, 3})
+    assert best_score > runner_up
+    assert mean_intra_gang_bw(bw, best_set) == fat
+    assert all(mean_intra_gang_bw(bw, sub) < fat
+               for _, sub in scored if set(sub) != {0, 1, 2, 3})
+
+
+def test_gang_bias_favors_member_adjacent_nodes():
+    """gang_bias is the C-matrix column gather: with members tentatively
+    on the clique, clique nodes (fat links + the loopback pin) must
+    out-bias thin-link nodes."""
+    n = 8
+    bw = np.full((n, n), 1e9)
+    lat = np.full((n, n), 5e-3)
+    bw[:4, :4] = 100e9
+    lat[:4, :4] = 1e-4
+    np.fill_diagonal(bw, 100e9)
+    np.fill_diagonal(lat, 0.0)
+    cfg = SchedulerConfig(max_nodes=16, max_pods=8, max_peers=2)
+    fc = FakeCluster()
+    for i in range(n):
+        fc.add_node(Node(name=f"n{i}", capacity={"cpu": 8.0}))
+    loop = SchedulerLoop(fc, cfg)
+    loop.encoder.set_network(lat, bw)
+    state, _ = loop.encoder.snapshot_versioned()
+    bias = np.asarray(gang_lib.gang_bias(state, [0, 1, 2], cfg))
+    assert bias[:4].min() > bias[4:n].max()

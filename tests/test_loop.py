@@ -188,8 +188,12 @@ def test_bind_phase_overlaps_api_latency_at_batch_128():
     batch=128, the bind phase must land well under the 128 ms a serial
     client would pay.  FakeCluster emulates an 8-way-concurrent API
     server.  The assertion is RELATIVE to a serial control run in the
-    same process, so machine load (co-run jit compiles on shared CI
-    cores) inflates both sides instead of tripping an absolute bound."""
+    same process — but the legs run SEQUENTIALLY, so a load spike can
+    still hit one leg and not the other; the serial floor is hard
+    (128 sleeps of 1 ms cannot compress) while the concurrent leg's
+    p99 is one bad GIL stall away from doubling.  The concurrent leg
+    is therefore best-of-2: a transiently-loaded box gets a second
+    chance, a real loss of bind overlap still fails both passes."""
     from kubernetesnetawarescheduler_tpu.config import SchedulerConfig
     from kubernetesnetawarescheduler_tpu.core.loop import SchedulerLoop
     from kubernetesnetawarescheduler_tpu.k8s.client import FakeCluster
@@ -209,7 +213,9 @@ def test_bind_phase_overlaps_api_latency_at_batch_128():
         return loop.timer.percentile("bind", 99) * 1e3
 
     serial_ms = drain_bind_p99_ms(1)       # >= 128 ms of pure latency
-    concurrent_ms = drain_bind_p99_ms(8)   # ~16 ms + bookkeeping
+    # Best-of-2: ~16 ms + bookkeeping when healthy; a load spike during
+    # exactly one pass must not fail the run.
+    concurrent_ms = min(drain_bind_p99_ms(8) for _ in range(2))
     # The serial floor is hard (128 sleeps of 1 ms cannot compress);
     # 8-way overlap must reclaim at least half of it even with all
     # scheduler-side bookkeeping slowed by a loaded box.

@@ -181,42 +181,27 @@ def leg_native_qps() -> dict:
 
 def leg_device_latency() -> dict:
     """The north star's p99 Score() < 5 ms, measured at the DEVICE
-    boundary on hardware: one jitted schedule_batch (score + conflict
-    resolution + commit — the full per-batch decision) at the bench
-    shape (N=5120, batch 128, constraints on), 200 reps, host-timed
-    with block_until_ready.  No bulk device->host transfer is
-    involved, so the tunnel's ~65 ms fetch RTT — which dominates the
-    HOST-observed per-chunk percentiles in density_full — does not
-    mask the device's own latency."""
-    jax = _require_tpu()
-    import numpy as np
+    boundary on hardware, for both score backends.
 
-    from kubernetesnetawarescheduler_tpu.config import SchedulerConfig
-    from kubernetesnetawarescheduler_tpu.core.assign import schedule_batch
-    from tests import gen
+    Delegates to :func:`bench.density.measure_device_latency` — ONE
+    timing methodology shared with the density replay's device leg
+    (bench.py), so the two artifacts can never disagree on what "p99"
+    means again.  (They did in r5: this leg hand-rolled its own timer
+    over device-resident inputs and read 3.4 ms while the density
+    path re-uploaded the host snapshot every rep and read 87 ms for
+    the same program — a 26x methodology artifact, not a perf delta.)
+    The shared helper device_puts the inputs once before timing and
+    stamps ``p99_source: device_boundary``."""
+    _require_tpu()
+    from kubernetesnetawarescheduler_tpu.bench.density import (
+        measure_device_latency,
+    )
 
     out = {}
     for backend in ("pallas", "xla"):
-        cfg = SchedulerConfig(max_nodes=5120, max_pods=128, max_peers=4,
-                              score_backend=backend)
-        rng = np.random.default_rng(7)
-        state_np, pods_np = gen.random_instance(rng, cfg, n_nodes=5120,
-                                                n_pods=128)
-        state, pods = gen.to_pytrees(cfg, state_np, pods_np)
-        step = jax.jit(lambda s, p, c=cfg: schedule_batch(s, p, c))
-        jax.block_until_ready(step(state, pods))  # compile
-        times = []
-        for _ in range(200):
-            t0 = time.perf_counter()
-            jax.block_until_ready(step(state, pods))
-            times.append((time.perf_counter() - t0) * 1e3)
-        times.sort()
-        out[backend] = {
-            "p50_ms": round(times[len(times) // 2], 3),
-            "p99_ms": round(times[int(len(times) * 0.99) - 1], 3),
-            "max_ms": round(times[-1], 3),
-            "reps": len(times),
-        }
+        out[backend] = measure_device_latency(
+            num_nodes=5120, batch_size=128, score_backend=backend,
+            reps=200, seed=7)
     return out
 
 
@@ -336,6 +321,14 @@ def _git_sha() -> str:
         return ""
 
 
+def _bench_env() -> dict:
+    try:
+        from kubernetesnetawarescheduler_tpu.bench.envinfo import bench_env
+        return bench_env()
+    except Exception:  # noqa: BLE001 — provenance must never fail a leg
+        return {}
+
+
 def main() -> None:
     leg = sys.argv[1]
     t0 = time.perf_counter()
@@ -351,12 +344,14 @@ def main() -> None:
         "leg": leg, "ok": ok, "error": err,
         "wall_s": round(time.perf_counter() - t0, 1),
         "ts": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
-        # Provenance: the code version and bench config this leg ran
-        # at, so a later replay of the persisted artifact can be
-        # gated/attributed (code-review r4 finding on bench.py:74).
+        # Provenance: the code version and machine this leg ran at, so
+        # a later replay of the persisted artifact can be gated/
+        # attributed (code-review r4 finding on bench.py:74).  The
+        # earlier BENCH_* env-var filter matched nothing the harness
+        # ever set, leaving {} in every artifact — bench_env() computes
+        # host/cores/loadavg/sha directly.
         "git": _git_sha(),
-        "bench_env": {k: v for k, v in os.environ.items()
-                      if k.startswith("BENCH_")},
+        "bench_env": _bench_env(),
         "detail": detail,
     }))
     # Flush, then skip interpreter teardown: legs that ran serve.main
