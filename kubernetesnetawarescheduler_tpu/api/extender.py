@@ -183,9 +183,17 @@ class _ScoreBatcher:
         self._window = window_s
         self._adaptive_max = adaptive_max_s
         self._adaptive_tick = adaptive_tick_s
-        self._lock = threading.Lock()          # guards _queue
+        self._lock = threading.Lock()          # guards _queue/_active
         self._dispatch_lock = threading.Lock()  # one kernel at a time
         self._queue: list[list] = []  # [pod, event, row|exc, cand_idx]
+        # Requests currently inside score() (enqueued, not yet
+        # returned).  The full-occupancy gather's doorbell signal: a
+        # silent tick only ends a wave when NO other client is active
+        # — under concurrency the window keeps absorbing until the
+        # batch is FULL or the deadline fires (the 512-client
+        # regression in serving_qps.json was waves breaking at the
+        # first GIL-scheduling hiccup: mean_batch 62/256).
+        self._active = 0
         self.dispatches = 0  # kernel dispatch count (observability)
         self.requests = 0    # score requests served (observability)
         # Finisher: delivers a dispatched wave's results once its
@@ -244,34 +252,40 @@ class _ScoreBatcher:
         entry = [pod, threading.Event(), None, cand_idx]
         with self._lock:
             self.requests += 1  # under the lock: threaded servers
+            self._active += 1
             self._queue.append(entry)
             lead = len(self._queue) == 1
-        if self._window:
-            time.sleep(self._window)
-        if lead:
-            time.sleep(self._adaptive_tick)  # let the wave gather
-            with self._dispatch_lock:
-                if not entry[1].is_set():
-                    self._drain_locked()
-        # Park until delivery (drains return at DISPATCH time; results
-        # land via the finisher thread once the async device->host
-        # copy completes).  Non-leaders park here directly: a leader
-        # exists (theirs, or the in-flight dispatch that will claim
-        # them).  The non-blocking re-drain is a pure liveness
-        # backstop — it cannot strand anyone (an entry appended after
-        # a claim makes the next empty-queue arrival lead) — and it
-        # lets a delivered-to thread lead the NEXT wave while a prior
-        # one is still in flight.
-        while not entry[1].wait(timeout=0.05):
-            if self._dispatch_lock.acquire(blocking=False):
-                try:
+        try:
+            if self._window:
+                time.sleep(self._window)
+            if lead:
+                time.sleep(self._adaptive_tick)  # let the wave gather
+                with self._dispatch_lock:
                     if not entry[1].is_set():
                         self._drain_locked()
-                finally:
-                    self._dispatch_lock.release()
-        if isinstance(entry[2], BaseException):
-            raise entry[2]
-        return entry[2]
+            # Park until delivery (drains return at DISPATCH time;
+            # results land via the finisher thread once the async
+            # device->host copy completes).  Non-leaders park here
+            # directly: a leader exists (theirs, or the in-flight
+            # dispatch that will claim them).  The non-blocking
+            # re-drain is a pure liveness backstop — it cannot strand
+            # anyone (an entry appended after a claim makes the next
+            # empty-queue arrival lead) — and it lets a delivered-to
+            # thread lead the NEXT wave while a prior one is still in
+            # flight.
+            while not entry[1].wait(timeout=0.05):
+                if self._dispatch_lock.acquire(blocking=False):
+                    try:
+                        if not entry[1].is_set():
+                            self._drain_locked()
+                    finally:
+                        self._dispatch_lock.release()
+            if isinstance(entry[2], BaseException):
+                raise entry[2]
+            return entry[2]
+        finally:
+            with self._lock:
+                self._active -= 1
 
     def _drain_locked(self) -> None:
         """Dispatch everything queued (caller holds _dispatch_lock)."""
@@ -280,14 +294,19 @@ class _ScoreBatcher:
             self._queue = []
         if not batch:
             return
-        # Adaptive gather: keep absorbing while arrivals continue.  A
-        # silent tick ends the wait, so an idle server adds one tick
-        # (~0.5 ms) of latency; the deadline bounds the worst case.
-        # (Deliberately NOT extended while a prior wave's fetch is in
-        # flight: transfers PIPELINE on the device link — measured
-        # 38 ms/dispatch at a 65 ms fetch RTT — so many small
-        # overlapping waves beat fewer merged ones; an A/B of a
-        # merge-while-inflight wait scored 743 vs 988 conc_qps.)
+        # FULL-OCCUPANCY adaptive gather: keep absorbing until the
+        # batch is full or the deadline doorbell fires.  A silent tick
+        # only ends the wave when no OTHER client is mid-request —
+        # round 5's break-on-first-silent-tick ended waves at every
+        # GIL-scheduling hiccup under 512 clients (mean_batch 62/256,
+        # and the 512-client conc_qps REGRESSED below the 128-client
+        # figure, serving_qps.json), while a lone request still pays
+        # just one ~0.5 ms tick.  (Deliberately NOT extended past the
+        # deadline while a prior wave's fetch is in flight: transfers
+        # PIPELINE on the device link — measured 38 ms/dispatch at a
+        # 65 ms fetch RTT — so bounded waves that overlap beat fewer
+        # merged ones; an A/B of an unbounded merge-while-inflight
+        # wait scored 743 vs 988 conc_qps.)
         if self._adaptive_max > 0:
             deadline = time.perf_counter() + self._adaptive_max
             while (len(batch) < self._loop.cfg.max_pods
@@ -296,7 +315,13 @@ class _ScoreBatcher:
                 with self._lock:
                     fresh = self._queue
                     self._queue = []
-                if not fresh:
+                    # Active requests not yet riding THIS batch:
+                    # clients between delivery and their next enqueue
+                    # (or in the enqueue GIL scrum).  While any exist,
+                    # a silent tick is a scheduling hiccup, not an
+                    # idle server.
+                    others = self._active - len(batch) - len(fresh)
+                if not fresh and others <= 0:
                     break
                 batch.extend(fresh)
         loop = self._loop

@@ -119,6 +119,38 @@ class Interner:
         return out
 
 
+class PreparedStream(NamedTuple):
+    """Encode-ahead product of :meth:`Encoder.encode_stream_prepare`:
+    host numpy arrays with every field filled EXCEPT peer slots, which
+    :meth:`Encoder.finalize_stream` resolves against live placements
+    just before dispatch."""
+
+    pods: tuple
+    arrays: dict
+    stream_index: dict
+    pristine: dict
+
+    def __len__(self) -> int:
+        return len(self.pods)
+
+
+def _stream_index(pods: Sequence[Pod]) -> dict[str, int]:
+    """Indexed under both the bare name and "namespace/name": fake
+    workloads reference peers by bare name, KubeClient-sourced pods
+    carry namespace-qualified references."""
+    idx = {pod.name: i for i, pod in enumerate(pods)}
+    idx.update({f"{pod.namespace}/{pod.name}": i
+                for i, pod in enumerate(pods)})
+    return idx
+
+
+def _stream_slice(ar: Mapping[str, np.ndarray], a: int, b: int):
+    from kubernetesnetawarescheduler_tpu.core.replay import PodStream
+
+    return PodStream(**{name: jnp.asarray(arr[a:b])
+                        for name, arr in ar.items()})
+
+
 def _res_names(r: int) -> list[tuple[int, str]]:
     """Pre-enumerated resource names for allocation-free row fills."""
     return list(enumerate(Resource.NAMES[:r]))
@@ -1913,50 +1945,9 @@ class Encoder:
         ``max_peers`` when an earlier-batch peer ends up unschedulable
         (the host frees its slot, the stream cannot know in advance).
         """
-        from kubernetesnetawarescheduler_tpu.core.replay import PodStream
-
-        cfg = self.cfg
-        s, k, r = len(pods), cfg.max_peers, cfg.num_resources
-        w = cfg.mask_words
-        # Indexed under both the bare name and "namespace/name": fake
-        # workloads reference peers by bare name, KubeClient-sourced
-        # pods carry namespace-qualified references.
-        stream_index = {pod.name: i for i, pod in enumerate(pods)}
-        stream_index.update(
-            {f"{pod.namespace}/{pod.name}": i
-             for i, pod in enumerate(pods)})
-        req = np.zeros((s, r), np.float32)
-        peer_pods = np.full((s, k), -1, np.int32)
-        peer_nodes = np.full((s, k), -1, np.int32)
-        traffic = np.zeros((s, k), np.float32)
-        tol = np.zeros((s, w), np.uint32)
-        sel = np.zeros((s, w), np.uint32)
-        aff = np.zeros((s, w), np.uint32)
-        anti = np.zeros((s, w), np.uint32)
-        gbit = np.zeros((s, w), np.uint32)
-        prio = np.zeros((s,), np.float32)
-        valid = np.zeros((s,), bool)
-        t_soft = cfg.max_soft_terms
-        ssel = np.zeros((s, t_soft, w), np.uint32)
-        ssel_w = np.zeros((s, t_soft), np.float32)
-        sgrp = np.zeros((s, t_soft, w), np.uint32)
-        sgrp_w = np.zeros((s, t_soft), np.float32)
-        szone = np.zeros((s, t_soft, w), np.uint32)
-        szone_w = np.zeros((s, t_soft), np.float32)
-        gidx = np.full((s,), -1, np.int32)
-        sp_skew = np.zeros((s,), np.int32)
-        sp_hard = np.zeros((s,), bool)
-        t2, e_ns = cfg.max_ns_terms, cfg.max_ns_exprs
-        ns_any = np.zeros((s, t2, e_ns, w), np.uint32)
-        ns_forb = np.zeros((s, t2, w), np.uint32)
-        ns_used = np.zeros((s, t2), bool)
-        ns_ncol = np.full((s, t2, cfg.max_ns_num), -1, np.int32)
-        ns_nlo = np.full((s, t2, cfg.max_ns_num), -np.inf, np.float32)
-        ns_nhi = np.full((s, t2, cfg.max_ns_num), np.inf, np.float32)
-        zaff = np.zeros((s, w), np.uint32)
-        zanti = np.zeros((s, w), np.uint32)
-        batch = self.cfg.max_pods
-        res_names = _res_names(r)
+        ar = self._alloc_stream_arrays(len(pods))
+        stream_index = _stream_index(pods)
+        res_names = _res_names(self.cfg.num_resources)
         # First-pod escape: ``granted`` accumulates member slots of
         # every pod already encoded this pass, so only the genuinely
         # FIRST member of a group can take the waiver — later pods
@@ -1969,90 +1960,197 @@ class Encoder:
         if chunk_pods < 1:
             raise ValueError(f"chunk_pods must be >= 1, got {chunk_pods}")
 
-        def _slice(a: int, b: int) -> PodStream:
-            return PodStream(
-                req=jnp.asarray(req[a:b]),
-                peer_pods=jnp.asarray(peer_pods[a:b]),
-                peer_nodes=jnp.asarray(peer_nodes[a:b]),
-                peer_traffic=jnp.asarray(traffic[a:b]),
-                tol_bits=jnp.asarray(tol[a:b]),
-                sel_bits=jnp.asarray(sel[a:b]),
-                affinity_bits=jnp.asarray(aff[a:b]),
-                anti_bits=jnp.asarray(anti[a:b]),
-                group_bit=jnp.asarray(gbit[a:b]),
-                priority=jnp.asarray(prio[a:b]),
-                pod_valid=jnp.asarray(valid[a:b]),
-                soft_sel_bits=jnp.asarray(ssel[a:b]),
-                soft_sel_w=jnp.asarray(ssel_w[a:b]),
-                soft_grp_bits=jnp.asarray(sgrp[a:b]),
-                soft_grp_w=jnp.asarray(sgrp_w[a:b]),
-                soft_zone_bits=jnp.asarray(szone[a:b]),
-                soft_zone_w=jnp.asarray(szone_w[a:b]),
-                group_idx=jnp.asarray(gidx[a:b]),
-                spread_maxskew=jnp.asarray(sp_skew[a:b]),
-                spread_hard=jnp.asarray(sp_hard[a:b]),
-                ns_anyof=jnp.asarray(ns_any[a:b]),
-                ns_forbid=jnp.asarray(ns_forb[a:b]),
-                ns_term_used=jnp.asarray(ns_used[a:b]),
-                ns_num_col=jnp.asarray(ns_ncol[a:b]),
-                ns_num_lo=jnp.asarray(ns_nlo[a:b]),
-                ns_num_hi=jnp.asarray(ns_nhi[a:b]),
-                zaff_bits=jnp.asarray(zaff[a:b]),
-                zanti_bits=jnp.asarray(zanti[a:b]))
-
+        s = len(pods)
         pos = 0
         while True:
             end = min(pos + chunk_pods, s)
             with self._lock:
                 for i in range(pos, end):
                     pod = pods[i]
-                    _fill_requests_row(req[i], pod.requests, res_names)
-                    slot = 0
-                    for peer_name, vol in pod.peers.items():
-                        if slot >= k:
-                            break
-                        j = stream_index.get(peer_name)
-                        if j is not None:
-                            if j // batch >= i // batch:
-                                # Same/later batch: unresolvable at
-                                # scoring time, exactly as the host
-                                # loop sees it — don't burn a slot.
-                                continue
-                            peer_pods[i, slot] = j
-                        else:
-                            peer_node = node_of(peer_name)
-                            idx = (self._node_index.get(peer_node)
-                                   if peer_node else None)
-                            if idx is None:
-                                continue
-                            peer_nodes[i, slot] = idx
-                        traffic[i, slot] = vol
-                        slot += 1
-                    self._pod_constraint_rows(pod, lenient, (
-                        tol[i], sel[i], aff[i], anti[i], gbit[i],
-                        ssel[i], ssel_w[i], sgrp[i], sgrp_w[i],
-                        szone[i], szone_w[i], ns_any[i], ns_forb[i],
-                        ns_used[i], ns_ncol[i], ns_nlo[i], ns_nhi[i],
-                        zaff[i], zanti[i]))
-                    self._apply_first_pod_escape(aff[i], zaff[i],
-                                                 gbit[i], granted)
-                    m = words_to_int(gbit[i])
-                    while m:
-                        b = m & -m
-                        m ^= b
-                        granted.add(b.bit_length() - 1)
-                    gidx[i] = self._spread_slot(pod)
-                    sp_skew[i] = int(getattr(pod, "spread_maxskew", 0))
-                    sp_hard[i] = bool(getattr(pod, "spread_hard", True))
-                    if sp_skew[i] > 0 and gidx[i] < 0:
-                        # A spread constraint with no countable group
-                        # is inert — a DoNotSchedule pod would
-                        # silently schedule anywhere.  Flag it like
-                        # every other constraint degradation.
-                        self._record_degraded(pod, 1)
-                    prio[i] = pod.priority
-                    valid[i] = True
-            yield _slice(pos, end)
+                    self._fill_stream_row(i, pod, ar, granted,
+                                          lenient, res_names)
+                    self._resolve_peer_slots(i, pod, stream_index,
+                                             ar, node_of)
+            yield _stream_slice(ar, pos, end)
             pos = end
             if pos >= s:
                 return
+
+    def _alloc_stream_arrays(self, s: int) -> dict[str, np.ndarray]:
+        """Zero-initialized host-side arrays for a ``s``-pod stream,
+        keyed by :class:`PodStream` field name."""
+        cfg = self.cfg
+        k, r, w = cfg.max_peers, cfg.num_resources, cfg.mask_words
+        t_soft = cfg.max_soft_terms
+        t2, e_ns = cfg.max_ns_terms, cfg.max_ns_exprs
+        return {
+            "req": np.zeros((s, r), np.float32),
+            "peer_pods": np.full((s, k), -1, np.int32),
+            "peer_nodes": np.full((s, k), -1, np.int32),
+            "peer_traffic": np.zeros((s, k), np.float32),
+            "tol_bits": np.zeros((s, w), np.uint32),
+            "sel_bits": np.zeros((s, w), np.uint32),
+            "affinity_bits": np.zeros((s, w), np.uint32),
+            "anti_bits": np.zeros((s, w), np.uint32),
+            "group_bit": np.zeros((s, w), np.uint32),
+            "priority": np.zeros((s,), np.float32),
+            "pod_valid": np.zeros((s,), bool),
+            "soft_sel_bits": np.zeros((s, t_soft, w), np.uint32),
+            "soft_sel_w": np.zeros((s, t_soft), np.float32),
+            "soft_grp_bits": np.zeros((s, t_soft, w), np.uint32),
+            "soft_grp_w": np.zeros((s, t_soft), np.float32),
+            "soft_zone_bits": np.zeros((s, t_soft, w), np.uint32),
+            "soft_zone_w": np.zeros((s, t_soft), np.float32),
+            "group_idx": np.full((s,), -1, np.int32),
+            "spread_maxskew": np.zeros((s,), np.int32),
+            "spread_hard": np.zeros((s,), bool),
+            "ns_anyof": np.zeros((s, t2, e_ns, w), np.uint32),
+            "ns_forbid": np.zeros((s, t2, w), np.uint32),
+            "ns_term_used": np.zeros((s, t2), bool),
+            "ns_num_col": np.full((s, t2, cfg.max_ns_num), -1,
+                                  np.int32),
+            "ns_num_lo": np.full((s, t2, cfg.max_ns_num), -np.inf,
+                                 np.float32),
+            "ns_num_hi": np.full((s, t2, cfg.max_ns_num), np.inf,
+                                 np.float32),
+            "zaff_bits": np.zeros((s, w), np.uint32),
+            "zanti_bits": np.zeros((s, w), np.uint32),
+        }
+
+    def _fill_stream_row(self, i: int, pod: Pod,
+                         ar: dict[str, np.ndarray],
+                         granted: set[int] | None, lenient: bool,
+                         res_names) -> None:
+        """Everything about row ``i`` EXCEPT peer resolution — the
+        placement-independent share of the encode (requests,
+        constraint bitmaps, spread slots).  With ``granted`` given,
+        also applies the first-pod escape inline (the serial path);
+        ``granted=None`` defers it to :meth:`finalize_stream`, which
+        must re-judge it against the member counts current at dispatch
+        time (commits mutate them).  Caller holds ``self._lock``."""
+        _fill_requests_row(ar["req"][i], pod.requests, res_names)
+        self._pod_constraint_rows(pod, lenient, (
+            ar["tol_bits"][i], ar["sel_bits"][i],
+            ar["affinity_bits"][i], ar["anti_bits"][i],
+            ar["group_bit"][i],
+            ar["soft_sel_bits"][i], ar["soft_sel_w"][i],
+            ar["soft_grp_bits"][i], ar["soft_grp_w"][i],
+            ar["soft_zone_bits"][i], ar["soft_zone_w"][i],
+            ar["ns_anyof"][i], ar["ns_forbid"][i],
+            ar["ns_term_used"][i], ar["ns_num_col"][i],
+            ar["ns_num_lo"][i], ar["ns_num_hi"][i],
+            ar["zaff_bits"][i], ar["zanti_bits"][i]))
+        if granted is not None:
+            self._apply_first_pod_escape(ar["affinity_bits"][i],
+                                         ar["zaff_bits"][i],
+                                         ar["group_bit"][i], granted)
+            m = words_to_int(ar["group_bit"][i])
+            while m:
+                b = m & -m
+                m ^= b
+                granted.add(b.bit_length() - 1)
+        ar["group_idx"][i] = self._spread_slot(pod)
+        ar["spread_maxskew"][i] = int(getattr(pod, "spread_maxskew", 0))
+        ar["spread_hard"][i] = bool(getattr(pod, "spread_hard", True))
+        if ar["spread_maxskew"][i] > 0 and ar["group_idx"][i] < 0:
+            # A spread constraint with no countable group is inert — a
+            # DoNotSchedule pod would silently schedule anywhere.
+            # Flag it like every other constraint degradation.
+            self._record_degraded(pod, 1)
+        ar["priority"][i] = pod.priority
+        ar["pod_valid"][i] = True
+
+    def _resolve_peer_slots(self, i: int, pod: Pod,
+                            stream_index: dict[str, int],
+                            ar: dict[str, np.ndarray],
+                            node_of: Callable[[str], str]) -> None:
+        """Peer-slot allocation for row ``i`` — the only
+        placement-DEPENDENT share of the encode (``node_of`` consults
+        live placements).  Caller holds ``self._lock``."""
+        k = self.cfg.max_peers
+        batch = self.cfg.max_pods
+        peer_pods = ar["peer_pods"]
+        peer_nodes = ar["peer_nodes"]
+        traffic = ar["peer_traffic"]
+        slot = 0
+        for peer_name, vol in pod.peers.items():
+            if slot >= k:
+                break
+            j = stream_index.get(peer_name)
+            if j is not None:
+                if j // batch >= i // batch:
+                    # Same/later batch: unresolvable at scoring time,
+                    # exactly as the host loop sees it — don't burn a
+                    # slot.
+                    continue
+                peer_pods[i, slot] = j
+            else:
+                peer_node = node_of(peer_name)
+                idx = (self._node_index.get(peer_node)
+                       if peer_node else None)
+                if idx is None:
+                    continue
+                peer_nodes[i, slot] = idx
+            traffic[i, slot] = vol
+            slot += 1
+
+    def encode_stream_prepare(self, pods: Sequence[Pod],
+                              lenient: bool = False
+                              ) -> "PreparedStream":
+        """Placement-independent half of :meth:`encode_stream` — the
+        encode-ahead stage of the pipelined serving loop.
+
+        Fills every stream array EXCEPT peer slots (requests,
+        constraint bitmaps, spread, first-pod escape) on the calling
+        thread, typically while the PREVIOUS burst's device step is in
+        flight.  :meth:`finalize_stream` completes peer resolution
+        against the placements visible at that moment (after the
+        previous burst's assume has published its nodes) and returns
+        the :class:`PodStream`; the composition is field-for-field
+        identical to a serial :meth:`encode_stream` call made at
+        finalize time."""
+        ar = self._alloc_stream_arrays(len(pods))
+        res_names = _res_names(self.cfg.num_resources)
+        with self._lock:
+            for i, pod in enumerate(pods):
+                # granted=None: the first-pod escape consults LIVE
+                # group member counts (mutated by commits), so it is
+                # deferred to finalize alongside peer resolution.
+                self._fill_stream_row(i, pod, ar, None,
+                                      lenient, res_names)
+        pristine = {"affinity_bits": ar["affinity_bits"].copy(),
+                    "zaff_bits": ar["zaff_bits"].copy()}
+        return PreparedStream(pods=tuple(pods), arrays=ar,
+                              stream_index=_stream_index(pods),
+                              pristine=pristine)
+
+    def finalize_stream(self, prepared: "PreparedStream",
+                        node_of: Callable[[str], str]):
+        """Resolve the placement-dependent leftovers of a prepared
+        stream — peer slots and the first-pod escape — against the
+        CURRENT placements, and return the device :class:`PodStream`.
+        Cheap relative to prepare: the peer/escape loops plus the
+        host→device transfer.  Idempotent: every placement-dependent
+        field is rebuilt from a clean slate (fault/restart paths may
+        retry it)."""
+        ar = prepared.arrays
+        with self._lock:
+            ar["affinity_bits"][...] = prepared.pristine[
+                "affinity_bits"]
+            ar["zaff_bits"][...] = prepared.pristine["zaff_bits"]
+            granted: set[int] = set()
+            for i, pod in enumerate(prepared.pods):
+                self._apply_first_pod_escape(
+                    ar["affinity_bits"][i], ar["zaff_bits"][i],
+                    ar["group_bit"][i], granted)
+                m = words_to_int(ar["group_bit"][i])
+                while m:
+                    b = m & -m
+                    m ^= b
+                    granted.add(b.bit_length() - 1)
+                ar["peer_pods"][i] = -1
+                ar["peer_nodes"][i] = -1
+                ar["peer_traffic"][i] = 0.0
+                self._resolve_peer_slots(i, pod, prepared.stream_index,
+                                         ar, node_of)
+        return _stream_slice(ar, 0, len(prepared.pods))

@@ -48,10 +48,23 @@ class SchedulerLoop:
                  method: str = "parallel", decision_log=None,
                  encoder: Encoder | None = None, mesh=None,
                  async_bind: bool = False,
-                 burst_batches: int = 8) -> None:
+                 burst_batches: int = 8,
+                 pipelined: bool = False) -> None:
         self.cfg = cfg
         self.client = client
         self.method = method
+        # Three-stage software pipeline over the burst cycle: encode
+        # of burst k+1 (host thread) overlaps the device step of burst
+        # k, whose assignments are only fetched when the NEXT cycle
+        # starts, while the network bind of burst k-1 drains on the
+        # async-bind worker.  Commit/assume semantics are unchanged —
+        # usage is committed at retire time, after the fetch, exactly
+        # as the serial burst does — so assignments are bit-identical
+        # to serial mode on the same feed (tests/test_pipeline.py).
+        # Implies async_bind: without the bind worker the third stage
+        # would re-serialize behind the cycle.
+        self.pipelined = bool(pipelined)
+        async_bind = async_bind or self.pipelined
         # Backlog burst mode: when the queue holds at least two full
         # batches, drain up to ``burst_batches`` of them through ONE
         # device dispatch (the replay's scanned per-batch step) and
@@ -193,6 +206,17 @@ class SchedulerLoop:
         # and _on_pod_gone rebuilds — same mid-iteration RuntimeError
         # hazard _round_lock documents for round_samples.
         self._parked_lock = threading.Lock()
+        # In-flight pipelined burst: (pods, device out, with_stats,
+        # node_table, n_real, dispatch t0).  Owned by the cycle thread
+        # (run_once / flush_binds callers); retired before any state
+        # read that must see its placements.
+        self._pipe_inflight: tuple | None = None
+        self._encode_pool = None
+        if self.pipelined:
+            import concurrent.futures
+
+            self._encode_pool = concurrent.futures.ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="encode-ahead")
         if async_bind:
             # Bounded: a dead/slow API server must apply backpressure
             # to the cycle, not buffer unbounded assumed state.
@@ -343,12 +367,26 @@ class SchedulerLoop:
             pods, ready = self._gang_gate(pods)
             bound = 0
             if len(pods) > batch:
-                bound = self.schedule_pods_burst(pods)
+                if self.pipelined:
+                    bound = self._pipeline_cycle(pods)
+                    if ready:
+                        # A gang's joint placement snapshots the
+                        # encoder itself; retire the burst just
+                        # dispatched so the gang never races its
+                        # uncommitted placements.
+                        bound += self._retire_inflight()
+                else:
+                    bound = self.schedule_pods_burst(pods)
             elif pods:  # raced down to a single batch: normal path
-                bound = self.schedule_pods(pods)
+                bound = self._retire_inflight()
+                bound += self.schedule_pods(pods)
             for key, members in ready:
                 bound += self._schedule_gang(key, members)
             return bound
+        # Shallow queue: a pipelined burst still in flight is retired
+        # first — its placements must land before (or instead of) any
+        # per-batch cycle.
+        bound = self._retire_inflight()
         pods = self.queue.pop_batch(batch, timeout)
         pods, ready = self._gang_gate(pods)
         if not pods and not ready:
@@ -357,8 +395,9 @@ class SchedulerLoop:
             # webhook/bind paths keep encoding (and possibly
             # degrading) pods.
             self._emit_degraded_events()
-            return 0
-        bound = self.schedule_pods(pods) if pods else 0
+            return bound
+        if pods:
+            bound += self.schedule_pods(pods)
         for key, members in ready:
             bound += self._schedule_gang(key, members)
         return bound
@@ -468,6 +507,107 @@ class SchedulerLoop:
         self.timer.record("burst_wall",
                           time.perf_counter() - cycle_t0)
         self.burst_cycles += 1
+        return bound
+
+    def _pipeline_cycle(self, pods: Sequence[Pod]) -> int:
+        """One pipelined burst cycle: encode-prepare of THIS burst on
+        the host thread overlaps the retire (fetch + assume + bind
+        enqueue) of the PREVIOUS burst, whose device step has been
+        running since its own cycle dispatched it.  Returns pods
+        assumed from the retired burst; this burst's own count is
+        returned by the cycle that retires it.
+
+        Ordering (the determinism contract, tests/test_pipeline.py):
+        peers and the first-pod escape are finalized AFTER the
+        previous burst's assume publishes its placements, and the
+        snapshot is taken after the same point — exactly what a
+        serial burst cycle would have seen."""
+        from kubernetesnetawarescheduler_tpu.core.replay import (
+            pad_stream,
+            replay_stream_static,
+        )
+
+        n_real = -(-len(pods) // self.cfg.max_pods)
+
+        def _timed_prepare():
+            t = time.perf_counter()
+            prep = self.encoder.encode_stream_prepare(pods,
+                                                      lenient=True)
+            return prep, time.perf_counter() - t
+
+        fut = self._encode_pool.submit(_timed_prepare)
+        # Stage overlap: previous burst's retire runs while the encode
+        # worker prepares this burst's arrays.
+        bound = self._retire_inflight()
+        prepared, encode_s = fut.result()
+        self.timer.record("encode", encode_s / n_real, count=n_real)
+        t0 = time.perf_counter()
+        stream = self.encoder.finalize_stream(prepared,
+                                              self._peer_node)
+        # Full burst shape for one stable XLA compile — same
+        # reasoning as schedule_pods_burst.
+        stream = pad_stream(stream,
+                            self.burst_batches * self.cfg.max_pods)
+        state, version = self.encoder.snapshot_versioned()
+        node_table = self.encoder.node_table()
+        self._emit_degraded_events()
+        if self._sharded_burst is not None:
+            out, with_stats = self._sharded_burst(state, stream)
+        else:
+            with_stats = self.method == "parallel"
+            static = self._static_for(state, version)
+            out = replay_stream_static(state, stream, static,
+                                       self.cfg, self.method,
+                                       with_stats=with_stats)
+        # JAX async dispatch: the device step runs from here until
+        # the fetch in _retire_inflight; "dispatch" records only the
+        # host-side cost of getting it launched (finalize + snapshot
+        # + trace/launch), the pipeline's exposed serial share.
+        self.timer.record("dispatch",
+                          (time.perf_counter() - t0) / n_real,
+                          count=n_real)
+        self._pipe_inflight = (pods, out, with_stats, node_table,
+                               n_real, time.perf_counter())
+        self.burst_cycles += 1
+        return bound
+
+    def _retire_inflight(self) -> int:
+        """Fetch the in-flight pipelined burst's assignments and run
+        the assume/bind-enqueue tail.  No-op without one.  Usage is
+        committed HERE — never at dispatch — so a crash between
+        encode-ahead/dispatch and retire leaves no committed residue
+        to double-commit after a checkpoint restore."""
+        inflight = self._pipe_inflight
+        if inflight is None:
+            return 0
+        self._pipe_inflight = None
+        pods, out, with_stats, node_table, n_real, t_dispatch = \
+            inflight
+        t0 = time.perf_counter()
+        if with_stats:
+            assignment_dev, _final_state, rounds_dev = out
+            assignment = np.asarray(jax_block(assignment_dev))
+            rounds = np.asarray(rounds_dev)
+            with self._round_lock:
+                self.round_samples.extend(
+                    int(r) for r in rounds[:n_real])
+        else:
+            assignment_dev, _final_state = out
+            assignment = np.asarray(jax_block(assignment_dev))
+        # The exposed device wait: whatever of the step did NOT
+        # overlap host work since dispatch.  Feeds the same
+        # score_assign percentile stream as the serial cycle.
+        self.timer.record("score_assign",
+                          (time.perf_counter() - t0) / n_real,
+                          count=n_real)
+        assignment = assignment[:len(pods)]
+        t0 = time.perf_counter()
+        bound = self._assume_and_enqueue(pods, assignment, node_table)
+        self.timer.record("bind",
+                          (time.perf_counter() - t0) / n_real,
+                          count=n_real)
+        self.timer.record("burst_wall",
+                          time.perf_counter() - t_dispatch)
         return bound
 
     def schedule_pods(self, pods: Sequence[Pod]) -> int:
@@ -1147,7 +1287,14 @@ class SchedulerLoop:
         """Block until every queued bind batch has been processed
         (assume-then-bind mode; no-op otherwise), then re-raise the
         first worker error if one occurred.  Call before reading
-        bind-dependent state (checkpoints, tests, shutdown)."""
+        bind-dependent state (checkpoints, tests, shutdown).
+
+        Pipelined mode: retires any in-flight burst first — its
+        assumes must land before the queue can be considered
+        drained.  (Same cycle-thread ownership contract as
+        run_once.)"""
+        if self._pipe_inflight is not None:
+            self._retire_inflight()
         if self._bind_q is None:
             return
         deadline = (None if timeout is None
@@ -1163,6 +1310,9 @@ class SchedulerLoop:
     def stop_bind_worker(self, timeout: float | None = 30.0) -> None:
         """Drain outstanding binds and stop the worker (shutdown
         path; the loop cannot schedule in async mode afterwards)."""
+        if self._encode_pool is not None:
+            self._encode_pool.shutdown(wait=True)
+            self._encode_pool = None
         if self._bind_q is None:
             return
         self.flush_binds(timeout)

@@ -55,7 +55,7 @@ def _agent_reachable(host: str, port: int, timeout_s: float = 3.0) -> bool:
 
 def build_fake(num_nodes: int, seed: int, cfg: SchedulerConfig,
                mesh=None, async_bind: bool = False,
-               burst_batches: int = 8):
+               burst_batches: int = 8, pipelined: bool = False):
     from kubernetesnetawarescheduler_tpu.bench.fakecluster import (
         ClusterSpec,
         build_fake_cluster,
@@ -68,7 +68,8 @@ def build_fake(num_nodes: int, seed: int, cfg: SchedulerConfig,
     cluster, lat, bw = build_fake_cluster(
         ClusterSpec(num_nodes=num_nodes, seed=seed))
     loop = SchedulerLoop(cluster, cfg, mesh=mesh, async_bind=async_bind,
-                         burst_batches=burst_batches)
+                         burst_batches=burst_batches,
+                         pipelined=pipelined)
     loop.encoder.set_network(lat, bw)
     feed_metrics(cluster, loop.encoder, np.random.default_rng(seed + 1))
     return loop, lat, bw
@@ -141,6 +142,13 @@ def main(argv=None) -> int:
                          "worker thread, keeping API-server RTT off "
                          "the scheduling cycle; rejected binds roll "
                          "back")
+    ap.add_argument("--pipeline", action="store_true",
+                    help="three-stage pipelined burst cycle: encode "
+                         "of burst k+1 on a host thread overlaps the "
+                         "device step of burst k and the network "
+                         "binds of burst k-1 (implies --async-bind); "
+                         "assignments are identical to the serial "
+                         "cycle on the same feed")
     ap.add_argument("--mesh", action="store_true",
                     help="shard the watch-loop's score+assign kernels "
                          "over ALL LOCAL devices via the (dp, tp) "
@@ -234,7 +242,8 @@ def main(argv=None) -> int:
         loop, lat_truth, bw_truth = build_fake(
             int(param or "128"), args.seed, cfg, mesh=mesh,
             async_bind=args.async_bind,
-            burst_batches=args.burst_batches)
+            burst_batches=args.burst_batches,
+            pipelined=args.pipeline)
     elif kind in ("incluster", "kube"):
         from kubernetesnetawarescheduler_tpu.core.loop import SchedulerLoop
         from kubernetesnetawarescheduler_tpu.k8s.kubeclient import KubeClient
@@ -247,7 +256,8 @@ def main(argv=None) -> int:
         # re-list the reference lacked — ADD-only, scheduler.go:165).
         loop = SchedulerLoop(client, cfg, mesh=mesh,
                              async_bind=args.async_bind,
-                             burst_batches=args.burst_batches)
+                             burst_batches=args.burst_batches,
+                             pipelined=args.pipeline)
         loop.informer.resync()
     else:
         ap.error(f"unknown cluster kind {kind!r} "

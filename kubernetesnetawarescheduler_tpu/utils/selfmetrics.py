@@ -157,4 +157,20 @@ def render_metrics(loop) -> str:
             f'netaware_phase_latency_seconds_sum{{phase="{phase}"}} '
             f"{_fmt(stats['total_s'])}")
 
+    # Pipeline stage budgets (pipelined serving datapath): the live
+    # counterpart of the bench artifact's pipeline_budgets block —
+    # encode / dispatch / device_wait / bind, so overlap health is
+    # scrapeable, not just benchable.  Empty until a pipelined burst
+    # has run.
+    budgets = loop.timer.pipeline_budgets()
+    if budgets:
+        lines.append("# HELP netaware_pipeline_stage_ms Per-stage "
+                     "serving-pipeline budget in milliseconds")
+        lines.append("# TYPE netaware_pipeline_stage_ms gauge")
+        for stage, b in sorted(budgets.items()):
+            for stat in ("mean_ms", "p50_ms", "p99_ms"):
+                lines.append(
+                    f'netaware_pipeline_stage_ms{{stage="{stage}",'
+                    f'stat="{stat[:-3]}"}} {_fmt(b[stat])}')
+
     return "\n".join(lines) + "\n"

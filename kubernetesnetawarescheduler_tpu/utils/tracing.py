@@ -9,10 +9,19 @@ target is expressed as one (p99 Score() < 5 ms, BASELINE.json).
 
 from __future__ import annotations
 
+import collections
 import contextlib
 import threading
 import time
-from typing import Iterator, Mapping
+from typing import Deque, Iterator, Mapping
+
+# Per-phase retention ceiling for the percentile window.  The 25-minute
+# soak (soak.json r5) accumulated 208,210 timer entries — 28.5 MB of RSS
+# growth — because the sample list was O(cycles).  Percentiles only need
+# a recent window; counts and totals are kept as exact running
+# aggregates that never evict.  8192 weighted pairs cover >2h of serving
+# cycles at the soak's wave rate while bounding each phase to ~200 KB.
+MAX_SAMPLES_PER_PHASE = 8192
 
 
 class PhaseTimer:
@@ -21,15 +30,25 @@ class PhaseTimer:
     Thread-safe: the serving cycle, the async bind worker and the
     /metrics scrape thread all touch one timer — an unsynchronized
     first ``phase()`` from the worker would insert a dict key mid-
-    ``summary()`` iteration on the scrape thread."""
+    ``summary()`` iteration on the scrape thread.
 
-    def __init__(self) -> None:
+    Memory-bounded: each phase retains at most
+    ``MAX_SAMPLES_PER_PHASE`` weighted ``(seconds, count)`` pairs for
+    percentile queries (a sliding window over the most recent
+    observations); ``count()`` and ``total()`` are exact running
+    aggregates unaffected by eviction."""
+
+    def __init__(self,
+                 max_samples: int = MAX_SAMPLES_PER_PHASE) -> None:
         # (seconds, weight) pairs: a burst cycle records its
         # per-batch-normalized sample once with weight n_batches
-        # instead of n_batches identical floats, so storage stays
-        # O(cycles) in a long-lived daemon while the percentile math
-        # still gives each batch full weight.
-        self._samples: dict[str, list[tuple[float, int]]] = {}
+        # instead of n_batches identical floats, so the window holds
+        # cycles, not pods, while the percentile math still gives each
+        # batch full weight.
+        self.max_samples = int(max_samples)
+        self._samples: dict[str, Deque[tuple[float, int]]] = {}
+        self._counts: dict[str, int] = {}
+        self._totals: dict[str, float] = {}
         self._lock = threading.Lock()
 
     @contextlib.contextmanager
@@ -46,20 +65,34 @@ class PhaseTimer:
         if count < 1:
             return
         with self._lock:
-            self._samples.setdefault(name, []).append((seconds, count))
+            buf = self._samples.get(name)
+            if buf is None:
+                buf = collections.deque(maxlen=self.max_samples)
+                self._samples[name] = buf
+            buf.append((seconds, count))
+            self._counts[name] = self._counts.get(name, 0) + count
+            self._totals[name] = (self._totals.get(name, 0.0)
+                                  + seconds * count)
 
     def count(self, name: str) -> int:
         with self._lock:
-            return sum(c for _, c in self._samples.get(name, ()))
+            return self._counts.get(name, 0)
 
     def total(self, name: str) -> float:
         with self._lock:
-            return sum(s * c for s, c in self._samples.get(name, ()))
+            return self._totals.get(name, 0.0)
+
+    def samples_len(self, name: str) -> int:
+        """Retained (seconds, count) pairs in the percentile window —
+        bounded by ``max_samples`` regardless of record() volume."""
+        with self._lock:
+            return len(self._samples.get(name, ()))
 
     def percentile(self, name: str, q: float) -> float:
         """q in [0, 100]; nearest-rank on the weight-expanded sorted
         samples (identical to materializing each pair ``count``
-        times)."""
+        times).  Computed over the retained window — the most recent
+        ``max_samples`` weighted pairs."""
         with self._lock:
             samples = sorted(self._samples.get(name, ()))
         if not samples:
@@ -86,6 +119,39 @@ class PhaseTimer:
             }
         return out
 
+    def pipeline_budgets(self, phases: Mapping[str, str] | None = None,
+                         ) -> dict[str, dict[str, float]]:
+        """Per-stage budget block for bench artifacts: for each pipeline
+        stage (encode / dispatch / bind by default) report mean, p50,
+        p99 in ms plus the total seconds, so artifacts carry the
+        overlap structure on their face."""
+        if phases is None:
+            # encode: host array prep (overlaps the device step in
+            # pipelined mode); dispatch: host-side launch cost
+            # (finalize+snapshot+trace, pipelined mode only);
+            # device_wait: the blocking fetch — in pipelined mode only
+            # the NON-overlapped residue of the device step; bind: the
+            # network fanout on the async-bind worker.
+            phases = {"encode": "encode", "dispatch": "dispatch",
+                      "device_wait": "score_assign",
+                      "bind": "bind_net"}
+        out: dict[str, dict[str, float]] = {}
+        for stage, name in phases.items():
+            c = self.count(name)
+            if not c:
+                continue
+            tot = self.total(name)
+            out[stage] = {
+                "count": float(c),
+                "mean_ms": round(tot / c * 1e3, 3),
+                "p50_ms": round(self.percentile(name, 50) * 1e3, 3),
+                "p99_ms": round(self.percentile(name, 99) * 1e3, 3),
+                "total_s": round(tot, 3),
+            }
+        return out
+
     def reset(self) -> None:
         with self._lock:
             self._samples.clear()
+            self._counts.clear()
+            self._totals.clear()

@@ -1,0 +1,102 @@
+"""Artifact linter (tools/bench_check.py).
+
+The repo's committed bench JSON must lint clean (the linter's rules
+are calibrated against exactly that corpus, with pre-r6 history
+grandfathered), and each rule must actually fire on the failure shape
+that motivated it — r4's empty bench_env, r5's two-methodologies-one-
+label contradiction, a self-certifying north_star that disagrees with
+its own numbers, and a single-sample CPU canary claiming a regression
+flag.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import os
+
+_TOOL = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "tools", "bench_check.py")
+_spec = importlib.util.spec_from_file_location("bench_check", _TOOL)
+bench_check = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(bench_check)
+
+
+def _headline(**overrides):
+    """A minimal round-6-shaped density headline doc."""
+    detail = {
+        "score_p99_ms": 3.4,
+        "score_p99_source": "device_scan_amortized",
+        "bench_env": {"host": "x", "git_sha": "abc1234"},
+        "north_star": {
+            "pods_per_sec_target": 10000.0,
+            "p99_bar_ms": 5.0,
+            "pods_per_sec_met": True,
+            "p99_met": True,
+            "p99_source": "device_scan_amortized",
+        },
+    }
+    detail.update(overrides.pop("detail", {}))
+    doc = {"metric": "density_pods_per_sec_n5120", "value": 12000.0,
+           "unit": "pods/s", "detail": detail}
+    doc.update(overrides)
+    return doc
+
+
+def test_committed_artifacts_lint_clean():
+    fails = bench_check.run()
+    assert fails == [], fails
+
+
+def test_clean_doc_passes():
+    assert bench_check.check_doc("BENCH_r06.json", _headline()) == []
+
+
+def test_missing_bench_env_fails():
+    doc = _headline()
+    del doc["detail"]["bench_env"]
+    fails = bench_check.check_doc("BENCH_r06.json", doc)
+    assert any("bench_env" in f for f in fails), fails
+    # ...but immutable pre-r6 history is grandfathered.
+    assert bench_check.check_doc("BENCH_r05_extra_probe.json",
+                                 {"leg": "probe", "ok": True,
+                                  "git": "9d48239"}) == []
+
+
+def test_mixed_methodology_fails():
+    # A post-r5 doc whose primary label is the r5-era device one.
+    doc = _headline()
+    doc["detail"]["score_p99_source"] = "device_boundary"
+    doc["detail"]["north_star"]["p99_source"] = "device_boundary"
+    fails = bench_check.check_doc("BENCH_r06.json", doc)
+    assert any("mixed methodologies" in f for f in fails), fails
+    # Two labels inside ONE doc disagree (the r5 failure shape).
+    doc2 = _headline()
+    doc2["detail"]["north_star"]["p99_source"] = "host_observed"
+    fails2 = bench_check.check_doc("BENCH_r06.json", doc2)
+    assert any("north_star.p99_source" in f for f in fails2), fails2
+
+
+def test_north_star_disagreement_fails():
+    doc = _headline()
+    doc["detail"]["score_p99_ms"] = 87.44  # > 5 ms bar
+    # ...but the block still claims p99_met.
+    fails = bench_check.check_doc("BENCH_r06.json", doc)
+    assert any("p99_met" in f for f in fails), fails
+    doc2 = _headline(value=9000.0)  # below the 10k target
+    fails2 = bench_check.check_doc("BENCH_r06.json", doc2)
+    assert any("pods_per_sec_met" in f for f in fails2), fails2
+
+
+def test_cpu_canary_shape_enforced():
+    ok = _headline(detail={"cpu_density": {
+        "pods_per_sec": {"mean": 900.0, "min": 850.0, "max": 960.0,
+                         "runs": 3}}})
+    assert bench_check.check_doc("BENCH_r06.json", ok) == []
+    single = _headline(detail={"cpu_density": {"pods_per_sec": 900.0}})
+    fails = bench_check.check_doc("BENCH_r06.json", single)
+    assert any("single sample" in f for f in fails), fails
+    bad_stats = _headline(detail={"cpu_density": {
+        "pods_per_sec": {"mean": 2000.0, "min": 850.0, "max": 960.0,
+                         "runs": 3}}})
+    fails2 = bench_check.check_doc("BENCH_r06.json", bad_stats)
+    assert any("inconsistent" in f for f in fails2), fails2

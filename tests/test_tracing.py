@@ -69,6 +69,50 @@ def test_weighted_records_match_materialized_duplicates():
             expanded.percentile("x", q), f"q={q}"
 
 
+def test_sample_buffer_bounded():
+    """The 25-minute soak accumulated 208k O(cycles) timer entries
+    (28.5 MB RSS residue, soak.json r5).  The percentile window must
+    stay bounded while count/total remain exact running aggregates."""
+    t = PhaseTimer(max_samples=64)
+    for i in range(10_000):
+        t.record("z", i * 1e-6, count=2)
+    assert t.samples_len("z") == 64
+    assert t.count("z") == 20_000
+    assert abs(t.total("z") - sum(2 * i * 1e-6
+                                  for i in range(10_000))) < 1e-6
+    # Percentiles reflect the retained (most recent) window.
+    assert t.percentile("z", 0) >= (10_000 - 64) * 1e-6
+    assert t.percentile("z", 100) == 9_999 * 1e-6
+
+
+def test_default_ceiling_is_finite():
+    from kubernetesnetawarescheduler_tpu.utils.tracing import (
+        MAX_SAMPLES_PER_PHASE,
+    )
+
+    t = PhaseTimer()
+    assert t.max_samples == MAX_SAMPLES_PER_PHASE
+    assert 0 < MAX_SAMPLES_PER_PHASE <= 65_536
+    for _ in range(MAX_SAMPLES_PER_PHASE + 500):
+        t.record("w", 0.001)
+    assert t.samples_len("w") == MAX_SAMPLES_PER_PHASE
+    assert t.count("w") == MAX_SAMPLES_PER_PHASE + 500
+
+
+def test_pipeline_budgets_block():
+    t = PhaseTimer()
+    t.record("encode", 0.002, count=4)
+    t.record("score_assign", 0.005, count=4)
+    t.record("bind_net", 0.001, count=2)
+    budgets = t.pipeline_budgets()
+    assert set(budgets) == {"encode", "device_wait", "bind"}
+    assert budgets["device_wait"]["mean_ms"] == 5.0
+    assert budgets["encode"]["count"] == 4.0
+    # Phases with no samples are omitted, not zero-filled.
+    t2 = PhaseTimer()
+    assert t2.pipeline_budgets() == {}
+
+
 def test_weighted_record_edge_cases():
     t = PhaseTimer()
     t.record("y", 0.5, count=0)   # ignored

@@ -1,0 +1,205 @@
+"""Committed-artifact linter: the bench JSON a round publishes must be
+internally consistent BEFORE a reviewer reads it.
+
+Three rounds of bench archaeology motivated each rule:
+
+* r4's leg artifacts recorded ``bench_env: {}`` (an env-var filter that
+  matched nothing), so numbers could not be attributed to a machine or
+  git SHA — every new artifact must carry a non-empty ``bench_env``.
+* r5 published two contradictory "device" p99s for the same program
+  (87.44 ms in BENCH_r05 vs 3.4 ms in device_latency.json) because two
+  call sites timed with different methodologies under one label — a doc
+  may carry only ONE primary methodology, and every label in the doc
+  (detail vs north_star) must agree.
+* r5's ``north_star`` block was correct, but nothing enforced that
+  ``p99_met``/``pods_per_sec_met`` actually follow from the doc's own
+  numbers — the block self-certifies, so the linter re-derives it.
+
+Pre-round-6 artifacts are grandfathered by name (they predate the
+rules and are immutable history); the linter's job is to keep NEW
+artifacts honest.  Run as ``python tools/bench_check.py [paths...]``
+(default: every committed bench JSON); exit 1 on any failure.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# Immutable pre-r6 history: no bench_env key, and r5's device label
+# predates the scan-amortized methodology.  New rounds (BENCH_r06+)
+# get no such pass.
+GRANDFATHERED = {f"BENCH_r{n:02d}.json" for n in range(1, 6)}
+
+# Leg artifacts captured by pre-r6 watcher code, identified by the
+# capturing commit: that code's bench_env() emitted {} (the env-var
+# filter bug tpu_legs.py:350 documents).  Legs re-captured this round
+# carry a new SHA and must have a real bench_env.
+GRANDFATHERED_CAPTURE_SHAS = {"9d48239", "e29de44"}
+
+# The one primary device-latency methodology since round 6
+# (bench/density.measure_device_latency): scan_k chained steps in one
+# jitted lax.scan, wall / scan_k.  "*_artifact" marks a persisted-leg
+# promotion of the same measurement (bench.py relabel path).
+SCAN_SOURCES = {"device_scan_amortized", "device_scan_amortized_artifact"}
+# Labels older rounds used; legal only in grandfathered files or as
+# explicitly-relabeled history ("device_boundary_host_inputs" is the
+# honest r5 relabel, "host_observed" the no-microbench fallback).
+LEGACY_SOURCES = {"device_boundary", "device_boundary_artifact",
+                  "device_boundary_host_inputs", "host_observed"}
+
+
+def _load(path: str) -> dict | None:
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, ValueError):
+        return None
+    return doc if isinstance(doc, dict) else None
+
+
+def _headline_doc(path: str, doc: dict) -> dict | None:
+    """The bench.py headline doc inside an artifact, wherever the
+    wrapper put it: BENCH_r*.json stores it at ``.parsed``, the
+    watcher's density_full leg at ``.detail``, a raw doc at top
+    level.  None when the file is not a density headline."""
+    for candidate in (doc.get("parsed"), doc.get("detail"), doc):
+        if (isinstance(candidate, dict)
+                and str(candidate.get("metric", "")).startswith("density_")
+                and isinstance(candidate.get("detail"), dict)):
+            return candidate
+    return None
+
+
+def check_doc(path: str, doc: dict) -> list[str]:
+    """Lint one artifact file; returns failure strings (empty = ok)."""
+    name = os.path.basename(path)
+    grandfathered = (name in GRANDFATHERED
+                     or doc.get("git") in GRANDFATHERED_CAPTURE_SHAS)
+    fails: list[str] = []
+
+    # Rule 1 — provenance: every non-grandfathered artifact that is a
+    # leg wrapper or headline doc must carry a NON-EMPTY bench_env.
+    is_leg = "leg" in doc and "ok" in doc
+    headline = _headline_doc(path, doc)
+    if not grandfathered and (is_leg or headline is not None):
+        env = doc.get("bench_env")
+        if env is None and headline is not None:
+            env = headline["detail"].get("bench_env")
+        if not env:
+            fails.append(f"{name}: missing/empty bench_env")
+
+    if headline is None:
+        return fails
+    detail = headline["detail"]
+    src = detail.get("score_p99_source")
+
+    # Rule 2 — one methodology per doc: the primary label must be a
+    # known label, must be the scan-amortized one for new rounds, and
+    # every other label in the doc must agree with it.
+    if src is not None:
+        if src not in SCAN_SOURCES | LEGACY_SOURCES:
+            fails.append(f"{name}: unknown score_p99_source {src!r}")
+        elif not grandfathered and src not in SCAN_SOURCES \
+                and src != "host_observed":
+            # host_observed is the honest no-microbench fallback;
+            # anything claiming "device" must be scan-amortized now.
+            fails.append(
+                f"{name}: non-scan device methodology {src!r} in a "
+                "post-r5 artifact (mixed methodologies)")
+        ns = detail.get("north_star")
+        if isinstance(ns, dict) and ns.get("p99_source") != src:
+            fails.append(
+                f"{name}: north_star.p99_source "
+                f"{ns.get('p99_source')!r} != detail.score_p99_source "
+                f"{src!r} (mixed methodologies in one doc)")
+
+    # Rule 3 — self-certification must follow from the doc's own
+    # numbers: re-derive north_star from value / score_p99_ms.
+    ns = detail.get("north_star")
+    if isinstance(ns, dict):
+        try:
+            value = float(headline["value"])
+            target = float(ns["pods_per_sec_target"])
+            bar = float(ns["p99_bar_ms"])
+            p99 = float(detail.get("score_p99_ms", 1e9))
+        except (KeyError, TypeError, ValueError):
+            fails.append(f"{name}: north_star block not numeric")
+        else:
+            if bool(ns.get("pods_per_sec_met")) != (value >= target):
+                fails.append(
+                    f"{name}: north_star.pods_per_sec_met="
+                    f"{ns.get('pods_per_sec_met')} disagrees with "
+                    f"value {value} vs target {target}")
+            if bool(ns.get("p99_met")) != (p99 < bar):
+                fails.append(
+                    f"{name}: north_star.p99_met={ns.get('p99_met')} "
+                    f"disagrees with score_p99_ms {p99} vs bar {bar}")
+
+    # Rule 4 — the CPU canary block (round 6+) must be multi-run:
+    # a single sample cannot support its own regression flag.
+    cpu = detail.get("cpu_density")
+    if isinstance(cpu, dict) and not grandfathered:
+        pps = cpu.get("pods_per_sec")
+        if isinstance(pps, dict):
+            missing = {"mean", "min", "max", "runs"} - set(pps)
+            if missing:
+                fails.append(f"{name}: cpu_density.pods_per_sec "
+                             f"missing {sorted(missing)}")
+            elif not (pps["min"] <= pps["mean"] <= pps["max"]):
+                fails.append(f"{name}: cpu_density stats inconsistent "
+                             f"({pps})")
+        # scalar pods_per_sec = pre-r6 block shape; those docs are
+        # grandfathered by filename, so reaching here means a NEW
+        # artifact regressed to the single-run shape.
+        elif pps is not None:
+            fails.append(f"{name}: cpu_density.pods_per_sec is a "
+                         "single sample; round-6 canary requires "
+                         "{mean,min,max,runs}")
+    return fails
+
+
+def default_paths() -> list[str]:
+    pats = ("BENCH_r*.json", "bench_artifacts/*.json",
+            "bench_artifacts/tpu/*.json")
+    out: list[str] = []
+    for pat in pats:
+        out.extend(sorted(glob.glob(os.path.join(_REPO, pat))))
+    return out
+
+
+def run(paths: list[str] | None = None) -> list[str]:
+    """Lint ``paths`` (default: every committed bench JSON); returns
+    all failure strings."""
+    fails: list[str] = []
+    for path in paths or default_paths():
+        doc = _load(path)
+        if doc is None:
+            # .data files / probe logs aren't JSON docs; only flag
+            # unparseable .json.
+            if path.endswith(".json"):
+                fails.append(f"{os.path.basename(path)}: unparseable")
+            continue
+        fails.extend(check_doc(path, doc))
+    return fails
+
+
+def main() -> None:
+    paths = sys.argv[1:] or None
+    fails = run(paths)
+    checked = paths or default_paths()
+    if fails:
+        for f in fails:
+            print(f"FAIL {f}")
+        print(f"bench_check: {len(fails)} failure(s) across "
+              f"{len(checked)} artifact(s)")
+        raise SystemExit(1)
+    print(f"bench_check: {len(checked)} artifact(s) ok")
+
+
+if __name__ == "__main__":
+    main()

@@ -180,18 +180,23 @@ def leg_native_qps() -> dict:
 
 
 def leg_device_latency() -> dict:
-    """The north star's p99 Score() < 5 ms, measured at the DEVICE
-    boundary on hardware, for both score backends.
+    """The north star's p99 Score() < 5 ms, scan-amortized on
+    hardware, for both score backends.
 
     Delegates to :func:`bench.density.measure_device_latency` — ONE
-    timing methodology shared with the density replay's device leg
+    timing methodology shared with the density headline's device leg
     (bench.py), so the two artifacts can never disagree on what "p99"
     means again.  (They did in r5: this leg hand-rolled its own timer
     over device-resident inputs and read 3.4 ms while the density
     path re-uploaded the host snapshot every rep and read 87 ms for
-    the same program — a 26x methodology artifact, not a perf delta.)
-    The shared helper device_puts the inputs once before timing and
-    stamps ``p99_source: device_boundary``."""
+    the same program — a 26x methodology artifact, not a perf delta;
+    root cause in docs/ROUND_NOTES.md round 6.)  Since round 6 the
+    shared helper times ``scan_k`` chained steps inside one jitted
+    ``lax.scan`` and divides by ``scan_k``, stamping
+    ``p99_source: device_scan_amortized``.  50 samples x scan_k=32 =
+    1,600 chained device steps per backend — more device work than
+    r5's 200 isolated reps, with per-dispatch transport amortized to
+    1/32."""
     _require_tpu()
     from kubernetesnetawarescheduler_tpu.bench.density import (
         measure_device_latency,
@@ -201,32 +206,47 @@ def leg_device_latency() -> dict:
     for backend in ("pallas", "xla"):
         out[backend] = measure_device_latency(
             num_nodes=5120, batch_size=128, score_backend=backend,
-            reps=200, seed=7)
+            reps=50, seed=7)
     return out
 
 
 def leg_serving_host() -> dict:
-    """The live serving loop's throughput on hardware (mode="host":
-    real per-cycle encode -> dispatch -> fetch -> bind, backlog
-    bursts on) at the bench shape.  This is the number a watch-driven
-    deployment sustains — distinct from the replay pipeline
-    (density_full) and from the HTTP-bound daemon smoke
-    (serve_smoke).  Round-4 CPU reference: ~2,000-2,300 pods/s; the
-    burst's one-fetch-per-8-batches is what keeps the tunnel's ~65 ms
-    fetch RTT off the per-batch critical path."""
+    """The live serving loop's throughput on hardware (mode="host",
+    pipelined: encode-ahead on a host thread ∥ device step ∥ async
+    bind, backlog bursts on) at the bench shape.  This is the number
+    a watch-driven deployment sustains — distinct from the replay
+    pipeline (density_full) and from the HTTP-bound daemon smoke
+    (serve_smoke).  r5 serial-loop reference: 981.6 pods/s on the
+    tunneled chip; the pipelined datapath hides encode and the
+    tunnel's fetch RTT behind the device step, and the per-stage
+    ``pipeline_budgets`` block proves the overlap on the artifact's
+    face.  A serial A/B point (pipelined=False) rides along so the
+    speedup is measured, not asserted."""
     _require_tpu()
     from kubernetesnetawarescheduler_tpu.bench.density import run_density
 
     res = run_density(num_nodes=5120, num_pods=16384, batch_size=128,
                       method="parallel", mode="host",
-                      score_backend="pallas")
-    return {
+                      score_backend="pallas", pipelined=True)
+    out = {
         "pods_per_sec": round(res.pods_per_sec, 1),
         "pods_bound": res.pods_bound,
         "score_p50_ms": round(res.score_p50_ms, 3),
         "score_p99_ms": round(res.score_p99_ms, 3),
         "score_samples": res.score_samples,
+        "bind_p99_ms": round(res.bind_p99_ms, 3),
+        "pipelined": True,
+        "pipeline_budgets": res.pipeline_budgets,
     }
+    serial = run_density(num_nodes=5120, num_pods=4096, batch_size=128,
+                         method="parallel", mode="host",
+                         score_backend="pallas", pipelined=False)
+    out["serial_ab"] = {
+        "pods_per_sec": round(serial.pods_per_sec, 1),
+        "pods_bound": serial.pods_bound,
+        "num_pods": 4096,
+    }
+    return out
 
 
 def leg_scale_probe() -> dict:
