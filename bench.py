@@ -509,7 +509,48 @@ def _attach_cpu_density(doc: dict) -> None:
               file=sys.stderr)
 
 
+def _run_chaos_bench() -> None:
+    """``bench.py --chaos``: control-plane brownout soak ->
+    ``bench_artifacts/chaos.json``.
+
+    No device work — the soak exercises the chaos proxy, circuit
+    breaker, degraded mode and relist audit on virtual time — so it
+    pins jax to CPU (like tools/soak.py) and never touches the TPU
+    probe/ownership machinery.  The headline value is brownout
+    throughput: pods assumed per cycle WHILE a fault window was
+    active (degraded mode must keep scoring, not stall).  Exit 1
+    when an invariant is violated or recovery never happened, so the
+    driver fails loudly instead of committing a sick artifact."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from kubernetesnetawarescheduler_tpu.k8s.chaos import (
+        run_chaos_soak,
+    )
+
+    doc = run_chaos_soak(
+        seed=int(os.environ.get("BENCH_CHAOS_SEED", "0")),
+        num_nodes=int(os.environ.get("BENCH_CHAOS_NODES", "32")),
+        num_pods=int(os.environ.get("BENCH_CHAOS_PODS", "192")))
+    doc["value"] = doc["detail"]["brownout"]["assumed_per_cycle"]
+    doc["unit"] = "pods_assumed_per_cycle_during_brownout"
+    _attach_bench_env(doc)
+    out = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "bench_artifacts", "chaos.json")
+    with open(out, "w") as f:
+        json.dump(doc, f, indent=1)
+    print(json.dumps(doc))
+    bad = {k: v for k, v in doc["invariants"].items() if v}
+    if bad or not doc.get("recovered"):
+        print(f"WARNING: chaos soak unhealthy: invariants={bad} "
+              f"recovered={doc.get('recovered')}", file=sys.stderr)
+        sys.exit(1)
+
+
 def main() -> None:
+    if "--chaos" in sys.argv[1:]:
+        _run_chaos_bench()
+        return
     tpu_ok = True
     force_cpu = os.environ.get("BENCH_FORCE_CPU", "") == "1"
     if "BENCH_CHILD" not in os.environ and not force_cpu:

@@ -526,8 +526,14 @@ class ExtenderHandlers:
             return self._json(self.prioritize(json.loads(body or b"{}")))
         if path == "/bind":
             return self._json(self.bind(json.loads(body or b"{}")))
-        if path == "/health":
+        if path in ("/health", "/healthz"):
+            # Liveness: the serving threads are up.  Stays true in
+            # degraded mode — a browned-out API server must not get
+            # the scorer restarted (that would drop the parked
+            # backlog and the warm ledger).
             return b'{"ok": true}'
+        if path == "/readyz":
+            return self._json(self.readyz())
         if path == "/gangs":
             # Gang observability (core/gang.py): gated groups with
             # arrival progress, recent terminal phases, lifetime
@@ -551,6 +557,27 @@ class ExtenderHandlers:
             )
             return render_metrics(self._loop).encode()
         raise ValueError(f"unknown op {path!r}")
+
+    def readyz(self) -> dict:
+        """Readiness with degraded-mode visibility: the breaker state
+        (open = degraded: scoring/encode continue, binds parked), the
+        checkpoint-restore decision ("fresh" | "restored" |
+        "ignored"), and the recovery counters.  ``ready`` stays true
+        while degraded — the scorer still serves filter/prioritize —
+        so probes alert on ``degraded`` rather than evicting the
+        warm ledger."""
+        loop = self._loop
+        breaker = getattr(loop, "breaker", None)
+        state = breaker.state if breaker is not None else "closed"
+        return {
+            "ready": True,
+            "degraded": state == "open",
+            "breaker": state,
+            "checkpoint": getattr(loop, "checkpoint_state", "fresh"),
+            "parked_binds": len(getattr(loop, "_parked_binds", ())),
+            "watch_gaps": int(getattr(loop, "watch_gaps", 0)),
+            "relists": int(getattr(loop, "relists", 0)),
+        }
 
     @staticmethod
     def _json(obj: Any) -> bytes:

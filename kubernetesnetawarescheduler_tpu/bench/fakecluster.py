@@ -97,13 +97,21 @@ class WorkloadSpec:
 
 
 def build_fake_cluster(spec: ClusterSpec, client_cls=FakeCluster,
+                       chaos=None,
                        **client_kw) -> tuple[FakeCluster, np.ndarray,
                                              np.ndarray]:
     """Create a populated :class:`FakeCluster` plus its ground-truth
     ``(lat_ms, bw_bps)`` matrices (what a perfect probe pipeline would
     measure).  ``client_cls``/``client_kw`` let tests swap in a
     fault-injecting subclass or an emulated API RTT
-    (``bind_latency_s``)."""
+    (``bind_latency_s``).
+
+    ``chaos`` wraps the populated cluster in a fault-injecting
+    :class:`~kubernetesnetawarescheduler_tpu.k8s.chaos.ChaosKubeProxy`:
+    pass a :class:`~kubernetesnetawarescheduler_tpu.k8s.chaos.ChaosSchedule`
+    for full control, or an int seed to generate the default schedule.
+    The returned client is then the proxy (its ``.inner`` is the bare
+    cluster)."""
     rng = np.random.default_rng(spec.seed)
     cluster = client_cls(**client_kw)
     n = spec.num_nodes
@@ -140,6 +148,14 @@ def build_fake_cluster(spec: ClusterSpec, client_cls=FakeCluster,
     bw = bw / noise
     np.fill_diagonal(lat, 0.0)
     np.fill_diagonal(bw, bw.max())
+    if chaos is not None:
+        from kubernetesnetawarescheduler_tpu.k8s.chaos import (
+            ChaosKubeProxy,
+            ChaosSchedule,
+        )
+        schedule = (chaos if isinstance(chaos, ChaosSchedule)
+                    else ChaosSchedule.generate(int(chaos)))
+        cluster = ChaosKubeProxy(cluster, schedule)
     return cluster, lat, bw
 
 

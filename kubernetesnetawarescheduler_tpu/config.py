@@ -266,6 +266,26 @@ class SchedulerConfig:
     # still bind atomically but members place independently.
     gang_weight: float = 1.0
 
+    # ---- control-plane brownout resilience (k8s/kubeclient.py) ----
+    # Circuit breaker over API-server health: this many brownout
+    # failures (5xx/429/connection errors) within breaker_window_s
+    # trips the breaker OPEN; after breaker_cooldown_s it offers
+    # HALF-OPEN (one probe).  Open flips the loop into degraded mode:
+    # scoring/encode continue, binds park until the probe succeeds.
+    breaker_failure_threshold: int = 5
+    breaker_window_s: float = 30.0
+    breaker_cooldown_s: float = 5.0
+
+    # Shared per-cycle retry pool: ALL API retries in one scheduling
+    # cycle draw from this one allowance, bounding the worst-case
+    # latency a browned-out API server can inject into a cycle.
+    api_retry_budget: int = 8
+
+    # Jittered exponential backoff between retries:
+    # min(max, base * 2^attempt) * uniform(0.5, 1.5).
+    api_backoff_base_s: float = 0.05
+    api_backoff_max_s: float = 2.0
+
     def __post_init__(self) -> None:
         if self.max_nodes <= 0 or self.max_pods <= 0 or self.max_peers <= 0:
             raise ValueError("shape limits must be positive")
@@ -287,6 +307,16 @@ class SchedulerConfig:
             raise ValueError("gang_timeout_s must be > 0")
         if self.gang_weight < 0:
             raise ValueError("gang_weight must be >= 0")
+        if self.breaker_failure_threshold < 1:
+            raise ValueError("breaker_failure_threshold must be >= 1")
+        if self.breaker_window_s <= 0 or self.breaker_cooldown_s <= 0:
+            raise ValueError("breaker window/cooldown must be > 0")
+        if self.api_retry_budget < 0:
+            raise ValueError("api_retry_budget must be >= 0")
+        if (self.api_backoff_base_s <= 0
+                or self.api_backoff_max_s < self.api_backoff_base_s):
+            raise ValueError("api backoff must satisfy "
+                             "0 < base <= max")
 
 
 # ---------------------------------------------------------------------------

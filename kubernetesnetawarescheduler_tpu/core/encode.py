@@ -698,6 +698,19 @@ class Encoder:
         with self._lock:
             return uid in self._committed
 
+    def committed_node(self, uid: str) -> str | None:
+        """Node NAME the ledger holds this pod's usage at, or None.
+        A checkpoint-restored commit must bind at this node — the
+        assume already happened in a previous process life, and a
+        re-score (whose snapshot includes the pod's own usage) can
+        land anywhere else, stranding the recorded usage."""
+        with self._lock:
+            rec = self._committed.get(uid)
+            if rec is None:
+                return None
+            name = self._node_names[rec.node]
+            return name or None
+
     def note_gang_inflight(self, gang_key: str,
                            entries: list[list]) -> None:
         """Record a gang entering its assume->bind window (entries:

@@ -103,6 +103,26 @@ class SchedulerLoop:
         self.burst_cycles = 0  # backlog bursts served (observability)
         self.bind_failures = 0
         self.preemptions = 0
+        # Control-plane brownout resilience (see k8s/chaos.py and
+        # docs/OPERATIONS.md "Failure modes & runbook"): the
+        # transport's circuit breaker (None for plain in-memory
+        # clients — every degraded-mode path is then dormant).  OPEN
+        # means degraded mode: scoring/encode continue, decided binds
+        # PARK (usage stays committed at assume, so later cycles score
+        # exactly what the serial oracle would), and the backlog
+        # drains FIFO on half-open/closed.
+        self.breaker = getattr(client, "breaker", None)
+        self.parked_dropped = 0    # _unsched_parked maxlen evictions
+        self.watch_gaps = 0        # gap notifications from the client
+        self.relists = 0           # relist audits run
+        self.relist_repairs = 0    # drift items repaired by audits
+        self.binds_parked_total = 0  # pods whose bind parked (breaker)
+        self.binds_adopted = 0     # bound-elsewhere conflicts adopted
+        self.binds_redirected = 0  # re-routed to the ledger's node
+        self._relist_needed = False
+        # "fresh" | "restored" | "ignored": serve.py records its
+        # checkpoint-restore decision here; /readyz reports it.
+        self.checkpoint_state = "fresh"
         self.max_bind_retries = 3
         self._bind_retries: dict[str, int] = {}
         self._preempt_attempts: dict[str, int] = {}
@@ -206,6 +226,12 @@ class SchedulerLoop:
         # and _on_pod_gone rebuilds — same mid-iteration RuntimeError
         # hazard _round_lock documents for round_samples.
         self._parked_lock = threading.Lock()
+        # Bind batches parked under an OPEN breaker (degraded mode):
+        # complete _bind_q items whose usage is already assumed.
+        # Unbounded on purpose — backpressure comes from the queue and
+        # _bind_q bounds upstream, and dropping an ASSUMED batch would
+        # leak committed usage.  Guarded by _parked_lock.
+        self._parked_binds: deque = deque()
         # In-flight pipelined burst: (pods, device out, with_stats,
         # node_table, n_real, dispatch t0).  Owned by the cycle thread
         # (run_once / flush_binds callers); retired before any state
@@ -260,6 +286,16 @@ class SchedulerLoop:
         # Node scale-down: free the encoder slot (round 1 leaked slots
         # and kept binding to deleted nodes).
         client.on_node_deleted(self._on_node_gone)
+        # Watch-gap detection -> relist audit: clients that can tell
+        # us a stream lost events (410 Gone, reset resourceVersion)
+        # arm a full relist on the next cycle.  getattr-guarded for
+        # third-party ClusterClients predating the surface.
+        gap_reg = getattr(client, "on_watch_gap", None)
+        if callable(gap_reg):
+            try:
+                gap_reg(self._on_watch_gap)
+            except Exception:  # noqa: BLE001 — optional surface
+                pass
         # Real policy/v1 PodDisruptionBudgets: watch + initial sync
         # (events missed while down), feeding the preemption planner.
         # Optional per ClusterClient contract, and defensive: a
@@ -359,6 +395,15 @@ class SchedulerLoop:
         ``burst_batches`` > 1), pops up to ``burst_batches`` batches
         and drains them through one device dispatch + one fetch
         (see __init__)."""
+        budget = getattr(self.client, "retry_budget", None)
+        if budget is not None:
+            # Shared per-cycle retry allowance: whatever list-GET
+            # retries this cycle spends, it spends from one pool.
+            budget.begin_cycle()
+        if self._relist_needed:
+            self.relist_audit()
+        if self._parked_binds:
+            self._drain_parked_binds()
         batch = self.cfg.max_pods
         if (self.burst_batches > 1
                 and len(self.queue) >= 2 * batch):
@@ -815,11 +860,16 @@ class SchedulerLoop:
         return 0
 
     def _park_gang(self, members: list[Pod]) -> None:
-        with self._parked_lock:
-            for pod in members:
-                if pod.uid not in self._parked_uids:
-                    self._unsched_parked.append(pod)
-                    self._parked_uids.add(pod.uid)
+        evicted_events: list = []
+        for pod in members:
+            evicted = self._park_pod(pod)
+            if evicted is not None:
+                evicted_events.append(failed_event(
+                    evicted, self.cfg.scheduler_name,
+                    "dropped from the parked-pod backlog (capacity "
+                    "1024 exceeded); recovered by the next resync"))
+        if evicted_events:
+            self.client.create_events(evicted_events)
 
     def _flush_gang_timeouts(self) -> None:
         """Expire incomplete gangs whose gate deadline passed: emit a
@@ -1070,9 +1120,13 @@ class SchedulerLoop:
                 # slow periodic resync.  kube-scheduler's own
                 # unschedulable-queue flush on cluster events.
                 if self.async_bind:
-                    with self._parked_lock:
-                        self._unsched_parked.append(pod)
-                        self._parked_uids.add(pod.uid)
+                    evicted = self._park_pod(pod)
+                    if evicted is not None:
+                        events.append(failed_event(
+                            evicted, comp,
+                            "dropped from the parked-pod backlog "
+                            "(capacity 1024 exceeded); recovered by "
+                            "the next resync"))
                 continue
             name = table_names[idx]
             if self.decision_log is not None:
@@ -1080,7 +1134,32 @@ class SchedulerLoop:
             bindable.append(pod)
             node_idxs.append(idx)
             names.append(name)
+        self._redirect_committed(bindable, node_idxs, names)
         return bindable, node_idxs, names
+
+    def _redirect_committed(self, bindable: list, node_idxs: list,
+                            names: list) -> None:
+        """Rewrite bind targets for pods whose usage is ALREADY in
+        the ledger to the ledger's recorded node.  The assume for
+        such a pod happened before (earlier cycle, or a previous
+        process life via checkpoint restore) against a snapshot that
+        did NOT contain its own usage; re-scoring it now sees that
+        usage and can pick a different node — binding there would
+        strand the recorded usage (ledger says node A, server says
+        node B).  The ledger is authoritative for committed pods."""
+        for j, pod in enumerate(bindable):
+            where = self.encoder.committed_node(pod.uid)
+            if where is None or where == names[j]:
+                continue
+            try:
+                ridx = self.encoder.node_index(where)
+            except KeyError:
+                # Recorded node left the cluster; the scored target
+                # stands and node-reconcile releases the stale record.
+                continue
+            names[j] = where
+            node_idxs[j] = ridx
+            self.binds_redirected += 1
 
     def _finish_bind(self, bindable: list, node_idxs: list, names: list,
                      table_gens: list, events: list, comp: str,
@@ -1100,6 +1179,7 @@ class SchedulerLoop:
 
         ok_pods: list[Pod] = []
         ok_idxs: list[int] = []
+        adopted = 0
         for pod, idx, name, exc in zip(bindable, node_idxs, names,
                                        outcomes):
             if exc is None:
@@ -1143,9 +1223,30 @@ class SchedulerLoop:
                     self._rollback_assumed(pod, name, assumed)
                     self._requeue_transient(pod, exc, events, comp)
                     continue
-                # Permanent rejection (pod gone / bound elsewhere):
-                # event + drop, batch continues.
+                # The pod IS bound, just not where this attempt chose
+                # — often our own earlier bind whose acknowledgement
+                # was lost (connection reset after the server applied
+                # it), retried after intervening commits shifted the
+                # placement.  Adopt the server's truth into the ledger
+                # instead of dropping it: an unaccounted running pod
+                # would overload its node forever (and the usage
+                # ledger must reconverge to server truth after a
+                # fault clears).
                 self._rollback_assumed(pod, name, assumed)
+                widx = None
+                try:
+                    widx = self.encoder.node_index(where)
+                except KeyError:
+                    pass
+                if widx is not None and \
+                        not self.encoder.is_committed(pod.uid):
+                    self.encoder.commit_many([pod], [widx])
+                    adopted += 1
+                    self.binds_adopted += 1
+                    events.append(scheduled_event(pod, where, comp))
+                    self._bind_retries.pop(
+                        f"{pod.namespace}/{pod.name}", None)
+                    continue
                 self.bind_failures += 1
                 events.append(failed_event(
                     pod, comp, f"bind rejected: {exc}"))
@@ -1172,8 +1273,8 @@ class SchedulerLoop:
             self.encoder.commit_many([p for p, _ in fresh],
                                      [i for _, i in fresh])
         self.client.create_events(events)
-        self.scheduled += len(ok_pods)
-        return len(ok_pods)
+        self.scheduled += len(ok_pods) + adopted
+        return len(ok_pods) + adopted
 
     def _rollback_assumed(self, pod: Pod, name: str,
                           assumed: set | None) -> None:
@@ -1210,6 +1311,146 @@ class SchedulerLoop:
                 parked = self._unsched_parked.popleft()
                 self._parked_uids.discard(parked.uid)
                 self.queue.push(parked)  # full queue drops; resync heals
+
+    def _park_pod(self, pod: Pod) -> Pod | None:
+        """Park one unschedulable pod on the bounded backlog.  Returns
+        the pod EVICTED to make room when the deque was full (callers
+        emit its FailedScheduling event outside the lock) — the silent
+        ``deque(maxlen=...)`` eviction used to lose the oldest parked
+        pod with no trace (recovered only by a later resync, and never
+        counted)."""
+        evicted: Pod | None = None
+        with self._parked_lock:
+            if pod.uid in self._parked_uids:
+                return None
+            maxlen = self._unsched_parked.maxlen
+            if maxlen is not None and \
+                    len(self._unsched_parked) >= maxlen:
+                evicted = self._unsched_parked.popleft()
+                self._parked_uids.discard(evicted.uid)
+                self.parked_dropped += 1
+            self._unsched_parked.append(pod)
+            self._parked_uids.add(pod.uid)
+        return evicted
+
+    # -- degraded mode (breaker-open bind parking) ---------------------
+
+    def _dispatch_bind(self, item: tuple) -> None:
+        """Hand one assumed bind batch to the bind worker — unless the
+        breaker is OPEN (degraded mode) or older parked batches exist
+        (FIFO: a fresh batch must never overtake the parked backlog),
+        in which case the batch parks.  Usage is committed at assume
+        time either way, so parking changes WHEN the API server sees
+        the binds, never what later cycles score against — the
+        no-re-ordering-vs-serial-oracle contract."""
+        breaker = self.breaker
+        if breaker is not None:
+            with self._parked_lock:
+                if breaker.state == "open" or self._parked_binds:
+                    self._parked_binds.append(item)
+                    self.binds_parked_total += len(item[0])
+                    return
+        self._bind_q.put(item)
+
+    def _drain_parked_binds(self) -> int:
+        """Release parked bind batches per breaker state: none while
+        OPEN, ONE probe batch per call while HALF-OPEN (its outcome
+        closes or re-opens the breaker), everything FIFO once CLOSED.
+        Runs on the cycle thread; batches drain through the normal
+        bind worker with unchanged retire/rollback semantics."""
+        breaker = self.breaker
+        released = 0
+        while True:
+            state = "closed" if breaker is None else breaker.state
+            if state == "open":
+                break
+            with self._parked_lock:
+                if not self._parked_binds:
+                    break
+                item = self._parked_binds.popleft()
+            self._bind_q.put(item)
+            released += 1
+            if state == "half_open":
+                break  # one probe; its outcome decides the rest
+        return released
+
+    @property
+    def degraded(self) -> bool:
+        """True while the control-plane breaker is open (binds parked,
+        scoring still live) — the /healthz // readyz signal."""
+        breaker = self.breaker
+        return breaker is not None and breaker.state == "open"
+
+    # -- watch-gap relist audit ---------------------------------------
+
+    def _on_watch_gap(self, reason: str = "") -> None:
+        """Watch-thread callback: a stream could not resume from its
+        resourceVersion, so events may be lost.  Arms a relist audit
+        for the CYCLE thread — relisting inline here would hang the
+        watch thread on the same browned-out server that caused the
+        gap."""
+        self.watch_gaps += 1
+        self._relist_needed = True
+
+    def relist_audit(self) -> int:
+        """Full relist after a watch gap: diff informer/encoder state
+        against the server and repair the drift — nodes added or
+        removed while the stream was dark, pending pods never
+        delivered, ledger entries for pods that vanished.  Emits one
+        summary repair event when anything moved.  A failing listing
+        re-arms the audit (the gap is not healed until the server
+        answers a full relist)."""
+        self._relist_needed = False
+        repairs = 0
+        complete = True
+        listed_at = time.monotonic()
+        try:
+            server_nodes = self.client.list_nodes()
+        except Exception:  # noqa: BLE001 — server still dark: retry
+            self._relist_needed = True
+            return 0
+        fresh_nodes = 0
+        for node in server_nodes:
+            try:
+                self.encoder.node_index(node.name)
+            except KeyError:
+                fresh_nodes += 1
+            # Upsert; genuinely new nodes also wake parked pods
+            # (missed node-ADDED is exactly a gap symptom).
+            self._on_node(node)
+        repairs += fresh_nodes
+        repairs += self.encoder.reconcile_nodes(
+            [n.name for n in server_nodes], listed_at)
+        # The informer's own node cache misses deletions too (it only
+        # grows via watch events): prune ghosts against the same
+        # authoritative listing.
+        repairs += self.informer.reconcile_nodes(
+            [n.name for n in server_nodes])
+        try:
+            repairs += self.informer.resync()
+        except Exception:  # noqa: BLE001 — partial audit: re-arm
+            self._relist_needed = True
+            complete = False
+        try:
+            repairs += self.reconcile_usage()
+        except Exception:  # noqa: BLE001 — partial audit: re-arm
+            self._relist_needed = True
+            complete = False
+        self.relists += 1
+        self.relist_repairs += repairs
+        if repairs or not complete:
+            from kubernetesnetawarescheduler_tpu.k8s.types import Event
+
+            self.client.create_event(Event(
+                message=(f"watch gap: relist audit repaired {repairs} "
+                         "drift item(s)"
+                         + ("" if complete
+                            else "; audit incomplete, re-armed")),
+                reason="WatchGapRelist",
+                involved_pod=self.cfg.scheduler_name,
+                namespace="default",
+                component=self.cfg.scheduler_name, type="Warning"))
+        return repairs
 
     def _assume_and_enqueue(self, pods: Sequence[Pod],
                             assignment: np.ndarray,
@@ -1251,17 +1492,22 @@ class SchedulerLoop:
         assumed = {p.uid for p, _ in fresh}
         self._assumed_uids |= assumed
         for pod, idx, name in keep:
-            if pod.uid in assumed:
+            if self.encoder.is_committed(pod.uid):
                 # Under BOTH the bare and namespace-qualified names:
                 # KubeClient peer references arrive qualified
                 # ("ns/name", kubeclient pod_from_json), annotation
                 # peers and the fake cluster use bare names — the
                 # same dual indexing the stream encode uses.
+                # Committed-but-not-assumed pods (checkpoint-restored
+                # ledger entries, redirected to their recorded node by
+                # _plan_bind) publish too: peers must resolve against
+                # the ledger's placement, not race the bind worker
+                # through the server-truth fallback.
                 self._publish_assumed_node(pod, name)
-        self._bind_q.put(([p for p, _, _ in keep],
-                          [i for _, i, _ in keep],
-                          [n for _, _, n in keep],
-                          table_gens, events, comp, assumed))
+        self._dispatch_bind(([p for p, _, _ in keep],
+                             [i for _, i, _ in keep],
+                             [n for _, _, n in keep],
+                             table_gens, events, comp, assumed))
         return len(fresh)
 
     def _bind_worker_main(self) -> None:
@@ -1297,6 +1543,12 @@ class SchedulerLoop:
             self._retire_inflight()
         if self._bind_q is None:
             return
+        if self._parked_binds:
+            # A recovered breaker releases the parked backlog here too
+            # (shutdown/checkpoint callers flush without cycling); an
+            # OPEN breaker keeps it parked — degraded state is not
+            # "drained", and the checkpoint carries the assumes.
+            self._drain_parked_binds()
         deadline = (None if timeout is None
                     else time.monotonic() + timeout)
         while self._bind_q.unfinished_tasks:

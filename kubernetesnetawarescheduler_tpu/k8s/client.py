@@ -62,6 +62,15 @@ class ClusterClient:
         planner real PDB objects; the annotation surface still
         works)."""
 
+    def on_watch_gap(self, handler) -> None:
+        """Register ``handler(reason: str)`` for watch-gap detection —
+        a dropped stream, a 410 Gone resourceVersion expiry, or any
+        reconnect that could not resume from the last seen rv.  The
+        scheduler answers a gap with a full relist audit
+        (SchedulerLoop.relist_audit).  Optional, like
+        :meth:`on_pod_deleted`: the default is no signal, and callers
+        then rely on periodic reconciliation alone."""
+
     def list_pdbs(self):
         """All policy/v1 PodDisruptionBudgets, or ``None`` when the
         client cannot provide them (initial sync for restarts — watch

@@ -98,6 +98,7 @@ class Informer:
         # watch re-deliveries must not enqueue them early.
         self._is_parked = is_parked
         self._nodes: dict[str, Node] = {}
+        self.resyncs = 0  # full pod re-lists (restart + relist audit)
         self._lock = threading.Lock()
         client.on_pod_added(self._handle_pod)
         client.on_node_added(self._handle_node)
@@ -133,4 +134,20 @@ class Informer:
         for pod in self._client.list_pending_pods():
             if self._wants(pod) and self._queue.push(pod):
                 count += 1
+        self.resyncs += 1
         return count
+
+    def reconcile_nodes(self, live_names) -> int:
+        """Drop cached nodes absent from a full server listing.
+
+        The node cache only ever GROWS through watch events; a
+        node-DELETED missed during a watch gap leaves a ghost entry
+        that ``nodes()`` keeps serving forever.  The relist audit
+        passes the authoritative listing here; returns how many
+        ghosts were pruned."""
+        live = set(live_names)
+        with self._lock:
+            ghosts = [n for n in self._nodes if n not in live]
+            for name in ghosts:
+                del self._nodes[name]
+        return len(ghosts)

@@ -94,6 +94,153 @@ class _WatchExpired(Exception):
     """Internal: the server reported the watch resourceVersion stale
     (410 Gone) — reconnect from scratch."""
 
+
+class ApiServerError(RuntimeError):
+    """A non-2xx API-server response that is neither a 404 (KeyError)
+    nor a 409 (ValueError).  Subclasses RuntimeError so every existing
+    transient-error handler keeps working; ``status`` lets resilience
+    code distinguish a browned-out control plane (5xx, 429) from a
+    request the server understood and rejected (4xx)."""
+
+    def __init__(self, message: str, status: int = 0) -> None:
+        super().__init__(message)
+        self.status = status
+
+
+def _brownout_error(exc: BaseException) -> bool:
+    """Does this exception say the control plane itself is unhealthy?
+    5xx and 429 (server overloaded) count; connection-level failures
+    count; 4xx semantic rejections do NOT — the server answered."""
+    if isinstance(exc, ApiServerError):
+        return exc.status >= 500 or exc.status == 429
+    return isinstance(exc, (OSError, http.client.HTTPException))
+
+
+class CircuitBreaker:
+    """closed -> open -> half_open breaker over API-server health.
+
+    ``record_failure`` within a sliding ``window_s`` trips the breaker
+    at ``failure_threshold``; after ``cooldown_s`` the breaker offers
+    HALF-OPEN (one probe's worth of traffic); a success there closes
+    it, a failure re-opens it.  ``clock`` is injectable so chaos soaks
+    can drive it on virtual time.  Thread-safe: the bind worker, watch
+    threads and the cycle thread all record into it."""
+
+    _CODES = {"closed": 0, "half_open": 1, "open": 2}
+
+    def __init__(self, failure_threshold: int = 5,
+                 window_s: float = 30.0, cooldown_s: float = 5.0,
+                 clock: Callable[[], float] = time.monotonic) -> None:
+        self.failure_threshold = max(1, int(failure_threshold))
+        self.window_s = float(window_s)
+        self.cooldown_s = float(cooldown_s)
+        self.clock = clock
+        self._lock = threading.Lock()
+        self._state = "closed"
+        self._failures: list[float] = []
+        self._opened_at = 0.0
+        self.opens_total = 0
+        self.failures_total = 0
+
+    def _state_locked(self) -> str:
+        if (self._state == "open"
+                and self.clock() - self._opened_at >= self.cooldown_s):
+            self._state = "half_open"
+        return self._state
+
+    @property
+    def state(self) -> str:
+        with self._lock:
+            return self._state_locked()
+
+    @property
+    def state_code(self) -> int:
+        """0=closed, 1=half_open, 2=open (the gauge encoding)."""
+        return self._CODES[self.state]
+
+    def allow(self) -> bool:
+        """May a request be attempted right now?  half_open allows
+        (that IS the probe); open refuses."""
+        return self.state != "open"
+
+    def _open_locked(self, now: float) -> None:
+        self._state = "open"
+        self._opened_at = now
+        self._failures.clear()
+        self.opens_total += 1
+
+    def record_success(self) -> None:
+        # Successes do NOT erase the failure window while closed: a
+        # 50%-failing server is still browned out, and interleaved
+        # successes must not keep the breaker from tripping.  Only the
+        # half-open probe's success clears state (the server answered
+        # after a full cooldown).
+        with self._lock:
+            if self._state_locked() == "half_open":
+                self._state = "closed"
+                self._failures.clear()
+
+    def record_failure(self) -> None:
+        now = self.clock()
+        with self._lock:
+            self.failures_total += 1
+            state = self._state_locked()
+            if state == "open":
+                return
+            if state == "half_open":
+                # The probe failed: straight back to open, fresh
+                # cooldown.
+                self._open_locked(now)
+                return
+            self._failures.append(now)
+            cutoff = now - self.window_s
+            self._failures = [t for t in self._failures if t >= cutoff]
+            if len(self._failures) >= self.failure_threshold:
+                self._open_locked(now)
+
+
+class RetryBudget:
+    """A shared per-cycle retry allowance: every retry across every
+    call path draws from ONE pool, reset by the scheduler cycle via
+    :meth:`begin_cycle`.  Bounds the worst-case added latency a
+    browned-out API server can inject into one cycle (N retries total,
+    not N per request)."""
+
+    def __init__(self, per_cycle: int = 8) -> None:
+        self.per_cycle = max(0, int(per_cycle))
+        self._left = self.per_cycle
+        self._lock = threading.Lock()
+        self.retries_total = 0
+        self.exhausted_total = 0
+
+    def begin_cycle(self) -> None:
+        with self._lock:
+            self._left = self.per_cycle
+
+    def take(self) -> bool:
+        with self._lock:
+            if self._left > 0:
+                self._left -= 1
+                self.retries_total += 1
+                return True
+            self.exhausted_total += 1
+            return False
+
+
+def backoff_delay(attempt: int, base_s: float = 0.05,
+                  max_s: float = 2.0,
+                  rand: Callable[[], float] | None = None) -> float:
+    """Jittered exponential backoff: ``base * 2^attempt`` capped at
+    ``max_s``, scaled by a uniform [0.5, 1.5) jitter so a fleet of
+    retrying clients cannot re-synchronize into thundering herds."""
+    if rand is None:
+        import random
+
+        rand = random.random
+    ceiling = min(max_s, base_s * (2.0 ** max(0, attempt)))
+    return ceiling * (0.5 + rand())
+
+
 ANN_PEERS = "netaware.io/peers"
 ANN_GROUP = "netaware.io/group"
 ANN_AFFINITY = "netaware.io/affinity"
@@ -852,6 +999,34 @@ class KubeClient(ClusterClient):
         self._idle_conns: list[http.client.HTTPConnection] = []
         self._conn_sem = threading.BoundedSemaphore(self._pool_size)
         self._executor: ThreadPoolExecutor | None = None
+        # Control-plane brownout resilience: list GETs retry with
+        # jittered exponential backoff under a shared per-cycle budget;
+        # every call path records outcomes into the breaker, whose
+        # state the SchedulerLoop reads to enter degraded mode (binds
+        # parked, scoring continues).  serve.py re-tunes these from
+        # SchedulerConfig via configure_resilience.
+        self.breaker = CircuitBreaker()
+        self.retry_budget = RetryBudget()
+        self._backoff_base_s = 0.05
+        self._backoff_max_s = 2.0
+        self._sleep = time.sleep  # injectable for tests
+        self._gap_handlers: list[Callable[[str], None]] = []
+        self.watch_gaps = 0
+
+    def configure_resilience(self, failure_threshold: int = 5,
+                             window_s: float = 30.0,
+                             cooldown_s: float = 5.0,
+                             retry_budget: int = 8,
+                             backoff_base_s: float = 0.05,
+                             backoff_max_s: float = 2.0) -> None:
+        """Re-tune breaker/backoff knobs (SchedulerConfig's
+        breaker_* / api_* fields); replaces the default objects, so
+        call before serving starts."""
+        self.breaker = CircuitBreaker(failure_threshold, window_s,
+                                      cooldown_s)
+        self.retry_budget = RetryBudget(retry_budget)
+        self._backoff_base_s = backoff_base_s
+        self._backoff_max_s = backoff_max_s
 
     @staticmethod
     def pod_key(namespace: str, name: str) -> str:
@@ -963,19 +1138,59 @@ class KubeClient(ClusterClient):
         if resp.status == 409:
             raise ValueError(f"{method} {path}: 409 {data[:200]!r}")
         if resp.status >= 300:
-            raise RuntimeError(
-                f"{method} {path}: {resp.status} {data[:200]!r}")
+            raise ApiServerError(
+                f"{method} {path}: {resp.status} {data[:200]!r}",
+                status=resp.status)
         return json.loads(data) if data else {}
+
+    def _get_with_retry(self, path: str) -> Mapping:
+        """A list/read GET with brownout handling: outcomes feed the
+        breaker; brownout-class failures (5xx/429/network) retry with
+        jittered exponential backoff while the shared per-cycle budget
+        and the breaker allow; semantic rejections propagate
+        immediately.  GETs are idempotent, so replays are always
+        safe."""
+        attempt = 0
+        while True:
+            try:
+                out = self._request("GET", path)
+            except Exception as exc:  # noqa: BLE001 — classified below
+                if not _brownout_error(exc):
+                    # The server answered (404/409/other 4xx): healthy
+                    # control plane, unhealthy request.
+                    self.breaker.record_success()
+                    raise
+                self.breaker.record_failure()
+                if not self.breaker.allow() \
+                        or not self.retry_budget.take():
+                    raise
+                self._sleep(backoff_delay(attempt,
+                                          self._backoff_base_s,
+                                          self._backoff_max_s))
+                attempt += 1
+                continue
+            self.breaker.record_success()
+            return out
+
+    def _record_write_outcome(self, exc: Exception | None) -> None:
+        """Feed a write's outcome into the breaker.  Writes are never
+        blindly replayed here (a sent POST may have been applied —
+        the loop's requeue/409-heal machinery owns retries); the
+        breaker only needs to LEARN from them."""
+        if exc is None or not _brownout_error(exc):
+            self.breaker.record_success()
+        else:
+            self.breaker.record_failure()
 
     # -- ClusterClient ------------------------------------------------
 
     def list_nodes(self) -> Sequence[Node]:
-        obj = self._request("GET", "/api/v1/nodes")
+        obj = self._get_with_retry("/api/v1/nodes")
         return [node_from_json(it) for it in obj.get("items", [])]
 
     def list_pending_pods(self) -> Sequence[Pod]:
-        obj = self._request(
-            "GET", "/api/v1/pods?fieldSelector=spec.nodeName%3D")
+        obj = self._get_with_retry(
+            "/api/v1/pods?fieldSelector=spec.nodeName%3D")
         pods = [pod_from_json(it) for it in obj.get("items", [])]
         with self._lock:
             for p in pods:
@@ -983,7 +1198,7 @@ class KubeClient(ClusterClient):
         return pods
 
     def list_all_pods(self) -> Sequence[Pod]:
-        obj = self._request("GET", "/api/v1/pods")
+        obj = self._get_with_retry("/api/v1/pods")
         return [pod_from_json(it) for it in obj.get("items", [])]
 
     @staticmethod
@@ -1006,11 +1221,16 @@ class KubeClient(ClusterClient):
     def bind(self, binding: Binding) -> None:
         """POST the Binding subresource — the reference's exact call
         shape (scheduler.go:196-206)."""
-        self._request(
-            "POST",
-            f"/api/v1/namespaces/{binding.namespace}/pods/"
-            f"{binding.pod_name}/binding",
-            body=self._binding_body(binding))
+        try:
+            self._request(
+                "POST",
+                f"/api/v1/namespaces/{binding.namespace}/pods/"
+                f"{binding.pod_name}/binding",
+                body=self._binding_body(binding))
+        except Exception as exc:
+            self._record_write_outcome(exc)
+            raise
+        self._record_write_outcome(None)
         self._record_bound(binding)
 
     def _bind_one(self, binding: Binding) -> Exception | None:
@@ -1020,8 +1240,10 @@ class KubeClient(ClusterClient):
                 f"/api/v1/namespaces/{binding.namespace}/pods/"
                 f"{binding.pod_name}/binding",
                 body=self._binding_body(binding))
+            self._record_write_outcome(None)
             return None
         except Exception as exc:  # noqa: BLE001 — per-pod outcome
+            self._record_write_outcome(exc)
             return exc
 
     def bind_many(self, bindings: Sequence[Binding]
@@ -1072,8 +1294,10 @@ class KubeClient(ClusterClient):
             self._request(
                 "POST", f"/api/v1/namespaces/{event.namespace}/events",
                 body=self._event_body(event))
-        except Exception:  # noqa: BLE001 — best-effort
-            pass
+            self._record_write_outcome(None)
+        except Exception as exc:  # noqa: BLE001 — best-effort, but a
+            # 5xx here is still brownout evidence the breaker wants.
+            self._record_write_outcome(exc)
 
     def create_events(self, events: Sequence[Event]) -> None:
         """Batched events over the connection pool, best-effort."""
@@ -1117,6 +1341,25 @@ class KubeClient(ClusterClient):
             return self._pods.get(key)
 
     # -- watches (informer layer) -------------------------------------
+
+    def on_watch_gap(self, handler: Callable[[str], None]) -> None:
+        """Register ``handler(reason)`` for watch-gap detection: a
+        stream whose resourceVersion had to be RESET (410 Gone /
+        ERROR event, or a non-2xx watch response) may have lost
+        events between the last delivery and the fresh watch — the
+        SchedulerLoop answers with a full relist audit."""
+        with self._lock:
+            self._gap_handlers.append(handler)
+
+    def _notify_watch_gap(self, reason: str) -> None:
+        self.watch_gaps += 1
+        with self._lock:
+            handlers = list(self._gap_handlers)
+        for h in handlers:
+            try:
+                h(reason)
+            except Exception:  # noqa: BLE001 — a handler must not
+                pass  # kill the watch thread
 
     def on_pod_added(self, handler: PodHandler) -> None:
         with self._lock:
@@ -1201,8 +1444,8 @@ class KubeClient(ClusterClient):
             self._deliver_pdb, name="pdb-watch")
 
     def list_pdbs(self):
-        doc = self._request(
-            "GET", "/apis/policy/v1/poddisruptionbudgets")
+        doc = self._get_with_retry(
+            "/apis/policy/v1/poddisruptionbudgets")
         out = []
         for item in doc.get("items", []) or []:
             pdb = pdb_from_json(item)
@@ -1273,6 +1516,11 @@ class KubeClient(ClusterClient):
                 if resp.status >= 300:
                     conn.close()
                     self._stop.wait(1.0)
+                    if rv:
+                        # Events between the tracked rv and the fresh
+                        # watch may be lost — a gap, not a mere retry.
+                        self._notify_watch_gap(
+                            f"watch {path}: HTTP {resp.status}")
                     rv = ""  # stale resourceVersion: start fresh
                     continue
                 buf = b""
@@ -1296,8 +1544,13 @@ class KubeClient(ClusterClient):
                             # compaction: the rv is stale.  Reset it so
                             # the reconnect starts a fresh watch
                             # instead of hot-looping on the same
-                            # stale version forever.
+                            # stale version forever.  This IS a gap:
+                            # everything between the compacted rv and
+                            # the fresh list is unseen.
                             rv = ""
+                            self._notify_watch_gap(
+                                f"watch {path}: ERROR/410 "
+                                f"{obj.get('code', '')}")
                             raise _WatchExpired()
                         rv = (obj.get("metadata", {})
                               .get("resourceVersion", rv))

@@ -263,6 +263,19 @@ def main(argv=None) -> int:
         ap.error(f"unknown cluster kind {kind!r} "
                  "(fake:<N> | incluster | kube:<url>)")
 
+    # Brownout-resilience knobs (circuit breaker + retry budget) from
+    # config; configure_resilience replaces the breaker object, so
+    # re-point the loop's reference at the live one.
+    resil = getattr(loop.client, "configure_resilience", None)
+    if callable(resil):
+        resil(failure_threshold=cfg.breaker_failure_threshold,
+              window_s=cfg.breaker_window_s,
+              cooldown_s=cfg.breaker_cooldown_s,
+              retry_budget=cfg.api_retry_budget,
+              backoff_base_s=cfg.api_backoff_base_s,
+              backoff_max_s=cfg.api_backoff_max_s)
+        loop.breaker = loop.client.breaker
+
     if args.checkpoint_dir and os.path.exists(
             os.path.join(args.checkpoint_dir, "meta.json")):
         from kubernetesnetawarescheduler_tpu.core.checkpoint import (
@@ -284,12 +297,14 @@ def main(argv=None) -> int:
         # onto a phantom subset and break ingest-by-name.  Shape checks
         # alone (load_checkpoint) cannot catch that.
         if restored is None:
-            pass
+            loop.checkpoint_state = "ignored"
         elif restored._node_names == loop.encoder._node_names:
             loop.encoder = restored
+            loop.checkpoint_state = "restored"
             print(f"restored checkpoint from {args.checkpoint_dir}",
                   file=sys.stderr)
         else:
+            loop.checkpoint_state = "ignored"
             print(f"IGNORING checkpoint {args.checkpoint_dir}: node "
                   f"table mismatch ({len(restored._node_names)} stored "
                   f"vs {len(loop.encoder._node_names)} live nodes)",

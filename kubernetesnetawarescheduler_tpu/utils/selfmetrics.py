@@ -89,6 +89,60 @@ def render_metrics(loop) -> str:
             "bounded, so evictions recount shapes — a high and "
             "growing miss RATE means mostly-unique constraint sets)")
 
+    # Control-plane brownout resilience (k8s/kubeclient.py,
+    # ISSUE 4): breaker state, retry spend, watch-gap/relist audit
+    # activity and the degraded-mode parking counters.
+    breaker = getattr(loop, "breaker", None)
+    if breaker is not None:
+        gauge("netaware_apiserver_breaker_state",
+              float(breaker.state_code),
+              "Circuit breaker over API-server health "
+              "(0=closed, 1=half_open, 2=open/degraded)")
+        counter("netaware_apiserver_breaker_opens_total",
+                float(breaker.opens_total),
+                "Times the breaker tripped open (brownout onsets)")
+        counter("netaware_apiserver_failures_total",
+                float(breaker.failures_total),
+                "Brownout-class API failures observed (5xx/429/"
+                "connection errors)")
+    budget = getattr(getattr(loop, "client", None), "retry_budget",
+                     None)
+    if budget is not None:
+        counter("netaware_api_retries_total",
+                float(budget.retries_total),
+                "API request retries taken from the per-cycle budget")
+        counter("netaware_api_retry_budget_exhausted_total",
+                float(budget.exhausted_total),
+                "Retries denied because the cycle's budget was spent")
+    counter("netaware_watch_gaps_total",
+            float(getattr(loop, "watch_gaps", 0)),
+            "Watch-stream gaps detected (drops, 410 Gone)")
+    counter("netaware_relists_total",
+            float(getattr(loop, "relists", 0)),
+            "Full relist reconciliation audits run after watch gaps")
+    counter("netaware_relist_repairs_total",
+            float(getattr(loop, "relist_repairs", 0)),
+            "Drift items repaired by relist audits (missed nodes, "
+            "re-enqueued pods, released ledger entries)")
+    counter("netaware_parked_dropped_total",
+            float(getattr(loop, "parked_dropped", 0)),
+            "Parked pods evicted from the unschedulable backlog at "
+            "capacity (each also gets a FailedScheduling event)")
+    counter("netaware_binds_parked_total",
+            float(getattr(loop, "binds_parked_total", 0)),
+            "Pod binds parked by an open breaker (degraded mode)")
+    counter("netaware_binds_adopted_total",
+            float(getattr(loop, "binds_adopted", 0)),
+            "Bound-elsewhere conflicts adopted into the ledger "
+            "(our earlier bind applied but unacknowledged)")
+    counter("netaware_binds_redirected_total",
+            float(getattr(loop, "binds_redirected", 0)),
+            "Binds re-routed to the ledger's recorded node (pod was "
+            "already committed, e.g. restored from a checkpoint)")
+    gauge("netaware_parked_binds_backlog",
+          float(len(getattr(loop, "_parked_binds", ()))),
+          "Bind batches currently parked awaiting breaker recovery")
+
     # Extender webhook micro-batcher (api/extender._ScoreBatcher):
     # dispatch count exposes the coalescing rate (requests served /
     # dispatches = mean batch).

@@ -10,6 +10,12 @@ import os
 
 import jax
 
+def pytest_configure(config):
+    # tier-1 runs with -m 'not slow'; long chaos soaks opt out of it.
+    config.addinivalue_line(
+        "markers", "slow: long-running soak; excluded from tier-1")
+
+
 jax.config.update("jax_platforms", "cpu")
 try:
     jax.config.update("jax_num_cpu_devices", 8)

@@ -100,3 +100,51 @@ def test_cpu_canary_shape_enforced():
                          "runs": 3}}})
     fails2 = bench_check.check_doc("BENCH_r06.json", bad_stats)
     assert any("inconsistent" in f for f in fails2), fails2
+
+
+def _chaos_doc(**overrides):
+    """A minimal healthy chaos_soak doc (bench.py --chaos shape)."""
+    doc = {
+        "metric": "chaos_soak",
+        "seed": 7,
+        "fault_classes": ["http_5xx", "watch_410", "bind_partial",
+                          "bind_blackhole"],
+        "invariants": {"pods_double_bound": 0, "pods_lost": 0,
+                       "ledger_orphans": 0, "ledger_missing": 0},
+        "recovered": True,
+        "detail": {"bench_env": {"host": "x", "git_sha": "abc1234"}},
+    }
+    doc.update(overrides)
+    return doc
+
+
+def test_chaos_soak_clean_doc_passes():
+    assert bench_check.check_doc("chaos.json", _chaos_doc()) == []
+
+
+def test_chaos_soak_rules_fire():
+    # Missing seed: the schedule cannot be replayed.
+    fails = bench_check.check_doc(
+        "chaos.json", _chaos_doc(seed=None))
+    assert any("seed" in f for f in fails), fails
+    # A nonzero invariant is the headline failure.
+    fails = bench_check.check_doc("chaos.json", _chaos_doc(
+        invariants={"pods_double_bound": 0, "pods_lost": 2,
+                    "ledger_orphans": 0, "ledger_missing": 0}))
+    assert any("pods_lost" in f for f in fails), fails
+    # Missing the invariants block entirely is just as bad.
+    fails = bench_check.check_doc(
+        "chaos.json", _chaos_doc(invariants={}))
+    assert any("invariants" in f for f in fails), fails
+    # Never recovering (breaker open / backlog left) must fail.
+    fails = bench_check.check_doc(
+        "chaos.json", _chaos_doc(recovered=False))
+    assert any("recovered" in f for f in fails), fails
+    # Unattributable artifact (r4's empty-bench_env failure shape).
+    fails = bench_check.check_doc(
+        "chaos.json", _chaos_doc(detail={"bench_env": {}}))
+    assert any("bench_env" in f for f in fails), fails
+    # No fault classes recorded -> the soak proved nothing.
+    fails = bench_check.check_doc(
+        "chaos.json", _chaos_doc(fault_classes=[]))
+    assert any("fault" in f for f in fails), fails

@@ -1,0 +1,448 @@
+"""Control-plane brownout resilience (k8s/chaos.py + the breaker).
+
+Three layers under test:
+
+1. The resilience primitives — CircuitBreaker state machine on a
+   virtual clock, the shared per-cycle RetryBudget, jittered backoff.
+2. Fault injection — every ChaosKubeProxy fault class observably
+   fires and feeds the breaker, watch suppression surfaces as a gap
+   that triggers the relist reconciliation audit.
+3. Degraded mode end to end — an OPEN breaker keeps the scoring cycle
+   producing (binds parked, throughput > 0), the parked backlog
+   drains through half-open WITHOUT re-ordering vs the serial oracle,
+   and the seeded soak's invariant checker comes back all-zero across
+   fault classes including watch 410 and mid-retire bind-fanout
+   failure (the acceptance criteria of ISSUE 4).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from kubernetesnetawarescheduler_tpu.bench.fakecluster import (
+    ClusterSpec,
+    WorkloadSpec,
+    build_fake_cluster,
+    feed_metrics,
+    generate_workload,
+)
+from kubernetesnetawarescheduler_tpu.config import SchedulerConfig
+from kubernetesnetawarescheduler_tpu.core.loop import SchedulerLoop
+from kubernetesnetawarescheduler_tpu.k8s.chaos import (
+    FAULT_CLASSES,
+    ChaosFault,
+    ChaosKubeProxy,
+    ChaosSchedule,
+    check_invariants,
+    run_chaos_soak,
+)
+from kubernetesnetawarescheduler_tpu.k8s.kubeclient import (
+    ApiServerError,
+    CircuitBreaker,
+    RetryBudget,
+    backoff_delay,
+)
+from kubernetesnetawarescheduler_tpu.k8s.types import Pod
+
+
+# ---- layer 1: primitives -------------------------------------------
+
+
+class _Clock:
+    def __init__(self) -> None:
+        self.t = 0.0
+
+    def __call__(self) -> float:
+        return self.t
+
+
+def test_breaker_lifecycle_on_virtual_clock():
+    clk = _Clock()
+    br = CircuitBreaker(failure_threshold=3, window_s=10.0,
+                        cooldown_s=5.0, clock=clk)
+    assert br.state == "closed" and br.allow()
+    br.record_failure()
+    br.record_failure()
+    assert br.state == "closed"
+    br.record_failure()
+    assert br.state == "open" and not br.allow()
+    assert br.opens_total == 1
+    # Cooldown elapses -> half-open offers one probe.
+    clk.t = 5.0
+    assert br.state == "half_open" and br.allow()
+    # Probe fails -> straight back to open, fresh cooldown.
+    br.record_failure()
+    assert br.state == "open"
+    clk.t = 10.0
+    assert br.state == "half_open"
+    br.record_success()
+    assert br.state == "closed"
+    assert br.state_code == 0
+
+
+def test_breaker_interleaved_successes_do_not_mask_brownout():
+    # A 50%-failing server IS browned out: successes between failures
+    # must not reset the window count.
+    clk = _Clock()
+    br = CircuitBreaker(failure_threshold=3, window_s=10.0, clock=clk)
+    for _ in range(3):
+        br.record_success()
+        br.record_failure()
+    assert br.state == "open"
+
+
+def test_breaker_window_ages_out_old_failures():
+    clk = _Clock()
+    br = CircuitBreaker(failure_threshold=3, window_s=10.0, clock=clk)
+    br.record_failure()
+    clk.t = 11.0  # first failure now outside the window
+    br.record_failure()
+    br.record_failure()
+    assert br.state == "closed"
+
+
+def test_retry_budget_is_shared_per_cycle():
+    budget = RetryBudget(per_cycle=2)
+    assert budget.take() and budget.take()
+    assert not budget.take()
+    assert budget.exhausted_total == 1
+    budget.begin_cycle()
+    assert budget.take()
+    assert budget.retries_total == 3
+
+
+def test_backoff_is_exponential_capped_and_jittered():
+    lo = [backoff_delay(a, base_s=0.05, max_s=2.0, rand=lambda: 0.0)
+          for a in range(8)]
+    hi = [backoff_delay(a, base_s=0.05, max_s=2.0, rand=lambda: 1.0)
+          for a in range(8)]
+    assert lo[0] == pytest.approx(0.025) and hi[0] == pytest.approx(0.075)
+    assert all(b >= a for a, b in zip(lo, lo[1:]))
+    assert max(hi) <= 2.0 * 1.5  # cap * max jitter factor
+
+
+def test_schedule_is_seed_deterministic():
+    a = ChaosSchedule.generate(11)
+    b = ChaosSchedule.generate(11)
+    c = ChaosSchedule.generate(12)
+    assert a.to_dicts() == b.to_dicts()
+    assert a.to_dicts() != c.to_dicts()
+    assert set(a.classes) == set(FAULT_CLASSES)
+    with pytest.raises(ValueError):
+        ChaosSchedule.generate(0, classes=("no_such_fault",))
+
+
+# ---- layer 2: injection --------------------------------------------
+
+
+def _cfg(num_pods: int = 64) -> SchedulerConfig:
+    return SchedulerConfig(max_nodes=32, max_pods=16, max_peers=4,
+                           queue_capacity=num_pods + 32)
+
+
+def _chaos_loop(schedule: ChaosSchedule, num_pods: int = 64,
+                seed: int = 5, **loop_kw):
+    cfg = _cfg(num_pods)
+    proxy, lat, bw = build_fake_cluster(
+        ClusterSpec(num_nodes=24, seed=seed), chaos=schedule)
+    loop = SchedulerLoop(proxy, cfg, method="parallel", **loop_kw)
+    loop.encoder.set_network(lat, bw)
+    feed_metrics(proxy.inner, loop.encoder,
+                 np.random.default_rng(seed + 1))
+    pods = generate_workload(
+        WorkloadSpec(num_pods=num_pods, seed=seed + 2, services=6,
+                     peer_fraction=0.3),
+        scheduler_name=cfg.scheduler_name)
+    return loop, proxy, pods
+
+
+def test_5xx_burst_raises_and_trips_breaker():
+    schedule = ChaosSchedule(seed=0, faults=(
+        ChaosFault(kind="http_5xx", start_s=0.0, duration_s=60.0,
+                   probability=1.0),))
+    proxy, _, _ = build_fake_cluster(ClusterSpec(num_nodes=4, seed=1),
+                                     chaos=schedule)
+    for _ in range(5):
+        with pytest.raises(ApiServerError) as ei:
+            proxy.list_nodes()
+        assert ei.value.status == 503
+    assert proxy.breaker.state == "open"
+    assert proxy.injected["http_5xx"] == 5
+
+
+def test_conn_reset_and_latency_classes_inject():
+    schedule = ChaosSchedule(seed=0, faults=(
+        ChaosFault(kind="conn_reset", start_s=0.0, duration_s=1.0,
+                   probability=1.0),
+        ChaosFault(kind="latency", start_s=2.0, duration_s=1.0,
+                   latency_s=0.2),))
+    proxy, _, _ = build_fake_cluster(ClusterSpec(num_nodes=4, seed=1),
+                                     chaos=schedule)
+    with pytest.raises(ConnectionResetError):
+        proxy.list_pending_pods()
+    proxy.advance(2.5)  # into the latency window
+    proxy.list_pending_pods()  # succeeds, but slow
+    assert proxy.injected_latency_s == pytest.approx(0.2)
+    proxy.advance(2.0)  # all windows over
+    proxy.list_pending_pods()
+    assert proxy.breaker.failures_total == 1
+
+
+def test_watch_drop_suppresses_then_gap_relist_recovers():
+    schedule = ChaosSchedule(seed=0, faults=(
+        ChaosFault(kind="watch_410", start_s=1.0, duration_s=2.0),))
+    loop, proxy, pods = _chaos_loop(schedule, num_pods=16)
+    proxy.advance(1.5)  # inside the blackout
+    proxy.add_pods(pods)
+    assert len(loop.queue) == 0  # ADDs were suppressed
+    assert proxy.dropped_watch_events >= len(pods)
+    proxy.advance(2.0)  # window ends -> gap handler fires
+    assert loop.watch_gaps == 1
+    bound = loop.run_until_drained()
+    loop.flush_binds()
+    loop.stop_bind_worker()
+    # The relist audit refilled the queue and the pods got scheduled.
+    assert loop.relists >= 1 and loop.relist_repairs >= len(pods)
+    assert bound > 0 and len(proxy.inner.bindings) == bound
+    inv = check_invariants(loop, proxy.inner)
+    assert inv == {k: 0 for k in inv}
+
+
+def test_bind_partial_failure_lands_mid_retire_and_heals():
+    # Pipelined loop + a bind_partial window covering the whole run:
+    # every retire's bind fanout sees injected mid-batch failures;
+    # rollbacks + retries must still converge with zero invariant
+    # violations once the window closes.
+    schedule = ChaosSchedule(seed=0, faults=(
+        ChaosFault(kind="bind_partial", start_s=0.0, duration_s=3.0,
+                   fail_fraction=0.5),))
+    loop, proxy, pods = _chaos_loop(schedule, num_pods=48,
+                                    pipelined=True, burst_batches=4)
+    proxy.add_pods(pods)
+    for _ in range(40):
+        loop.run_once()
+        proxy.advance(0.25)
+        if (len(loop.queue) == 0 and loop._pipe_inflight is None
+                and not loop._parked_binds
+                and proxy.clock() > schedule.end_s):
+            break
+    loop.flush_binds()
+    loop.maintain()
+    loop.run_until_drained(max_cycles=30)
+    loop.flush_binds()
+    loop.stop_bind_worker()
+    assert proxy.injected["bind_partial"] > 0
+    inv = check_invariants(loop, proxy.inner)
+    assert inv == {k: 0 for k in inv}
+
+
+def test_bind_blackhole_applied_but_unacked_heals_without_double_bind():
+    schedule = ChaosSchedule(seed=0, faults=(
+        ChaosFault(kind="bind_blackhole", start_s=0.0, duration_s=2.0,
+                   fail_fraction=1.0),))
+    loop, proxy, pods = _chaos_loop(schedule, num_pods=24,
+                                    async_bind=True)
+    proxy.add_pods(pods)
+    for _ in range(30):
+        loop.run_once()
+        loop.flush_binds()
+        proxy.advance(0.25)
+        if len(loop.queue) == 0 and proxy.clock() > schedule.end_s:
+            break
+    loop.maintain()
+    loop.run_until_drained(max_cycles=30)
+    loop.flush_binds()
+    loop.stop_bind_worker()
+    assert proxy.blackholed_binds > 0
+    names = [b.pod_name for b in proxy.inner.bindings]
+    assert len(names) == len(set(names)) and names
+    inv = check_invariants(loop, proxy.inner)
+    assert inv == {k: 0 for k in inv}
+
+
+# ---- layer 3: degraded mode + the soak -----------------------------
+
+
+def _quiet_proxy(num_pods: int = 48, seed: int = 9):
+    """A chaos proxy with an EMPTY schedule: no injected faults, but
+    the loop gets a breaker we can trip by hand."""
+    schedule = ChaosSchedule(seed=0, faults=())
+    cfg = _cfg(num_pods)
+    proxy, lat, bw = build_fake_cluster(
+        ClusterSpec(num_nodes=24, seed=seed), chaos=schedule)
+    loop = SchedulerLoop(proxy, cfg, method="parallel",
+                         async_bind=True)
+    loop.encoder.set_network(lat, bw)
+    feed_metrics(proxy.inner, loop.encoder,
+                 np.random.default_rng(seed + 1))
+    pods = generate_workload(
+        WorkloadSpec(num_pods=num_pods, seed=seed + 2, services=6,
+                     peer_fraction=0.3),
+        scheduler_name=cfg.scheduler_name)
+    return loop, proxy, pods
+
+
+def test_degraded_mode_parks_binds_and_drains_in_oracle_order():
+    # Serial oracle: same cluster/workload seeds, never degraded.
+    oracle_loop, oracle, pods_o = _quiet_proxy()
+    for start in range(0, len(pods_o), 12):
+        oracle.add_pods(pods_o[start:start + 12])
+        for _ in range(10):
+            oracle_loop.run_once()
+            if len(oracle_loop.queue) == 0:
+                break
+    oracle_loop.run_until_drained()
+    oracle_loop.flush_binds()
+    oracle_loop.stop_bind_worker()
+    oracle_seq = [(b.pod_name, b.node_name)
+                  for b in oracle.inner.bindings]
+    assert oracle_seq
+
+    loop, proxy, pods = _quiet_proxy()
+    # Trip the breaker OPEN before any pod arrives (cooldown is 2s of
+    # virtual time; the clock stays at 0 until we advance it).
+    for _ in range(proxy.breaker.failure_threshold):
+        proxy.breaker.record_failure()
+    assert loop.degraded
+    # Feed in waves so multiple bind batches park (one giant burst
+    # would park as a single item and trivialize the order check).
+    assumed_total = 0
+    for start in range(0, len(pods), 12):
+        proxy.add_pods(pods[start:start + 12])
+        for _ in range(10):
+            assumed_total += loop.run_once()
+            if len(loop.queue) == 0:
+                break
+    # Degraded-mode acceptance: the cycle kept producing (scoring +
+    # encode alive), every bind parked, nothing reached the server.
+    assert assumed_total == len(oracle_seq)
+    assert loop.binds_parked_total == assumed_total
+    assert len(loop._parked_binds) > 1
+    assert not proxy.inner.bindings
+    assert loop.breaker.state == "open"
+
+    # Recovery: cooldown elapses -> half-open releases ONE probe
+    # batch; its success closes the breaker and the backlog follows.
+    proxy.advance(2.5)
+    assert loop.breaker.state == "half_open"
+    loop.run_once()
+    loop.flush_binds()
+    assert proxy.inner.bindings  # the probe batch landed
+    assert loop.breaker.state == "closed"
+    loop.run_until_drained()
+    loop.flush_binds()
+    loop.stop_bind_worker()
+    assert not loop._parked_binds
+    # No re-ordering vs the serial oracle: identical bind SEQUENCE,
+    # not just the same set.
+    got_seq = [(b.pod_name, b.node_name)
+               for b in proxy.inner.bindings]
+    assert got_seq == oracle_seq
+    inv = check_invariants(loop, proxy.inner)
+    assert inv == {k: 0 for k in inv}
+
+
+def test_parked_pod_eviction_is_counted_not_silent():
+    loop, _, _ = _quiet_proxy(num_pods=4)
+    first = Pod(name="p-first", namespace="default", uid="uid-first")
+    assert loop._park_pod(first) is None
+    evicted = None
+    for i in range(loop._unsched_parked.maxlen):
+        evicted = loop._park_pod(
+            Pod(name=f"p-{i}", namespace="default", uid=f"uid-{i}"))
+        if evicted is not None:
+            break
+    assert evicted is first  # oldest out, returned for its event
+    assert loop.parked_dropped == 1
+    assert first.uid not in loop._parked_uids
+    from kubernetesnetawarescheduler_tpu.utils.selfmetrics import (
+        render_metrics,
+    )
+    assert "netaware_parked_dropped_total 1.0" in render_metrics(loop)
+    loop.stop_bind_worker()
+
+
+def test_readyz_and_healthz_reflect_breaker_and_checkpoint():
+    import json as _json
+
+    from kubernetesnetawarescheduler_tpu.api.extender import (
+        ExtenderHandlers,
+    )
+
+    loop, proxy, _ = _quiet_proxy(num_pods=4)
+    handlers = ExtenderHandlers(loop)
+    try:
+        assert _json.loads(handlers.handle("/healthz", b""))["ok"]
+        ready = _json.loads(handlers.handle("/readyz", b""))
+        assert ready["ready"] and not ready["degraded"]
+        assert ready["breaker"] == "closed"
+        assert ready["checkpoint"] == "fresh"
+        for _ in range(proxy.breaker.failure_threshold):
+            proxy.breaker.record_failure()
+        loop.checkpoint_state = "restored"
+        ready = _json.loads(handlers.handle("/readyz", b""))
+        assert ready["degraded"] and ready["breaker"] == "open"
+        assert ready["checkpoint"] == "restored"
+        assert ready["ready"]  # scoring still serves while degraded
+    finally:
+        handlers.close()
+        loop.stop_bind_worker()
+
+
+def test_fast_seeded_soak_invariants_hold():
+    # Tier-1 acceptance: >= 4 distinct fault classes including
+    # watch 410 and mid-retire bind-fanout failure, invariants all
+    # zero, recovery recorded.
+    doc = run_chaos_soak(
+        seed=7, num_nodes=16, num_pods=64,
+        classes=("http_5xx", "watch_410", "bind_partial",
+                 "bind_blackhole"),
+        cycle_s=0.25, spacing_s=4.0, base_duration_s=1.5)
+    assert doc["metric"] == "chaos_soak" and doc["seed"] == 7
+    assert len(doc["fault_classes"]) >= 4
+    assert "watch_410" in doc["fault_classes"]
+    assert "bind_partial" in doc["fault_classes"]
+    assert doc["recovered"] and doc["time_to_recover_s"] is not None
+    assert doc["invariants"] == {k: 0 for k in doc["invariants"]}
+    detail = doc["detail"]
+    assert detail["brownout"]["assumed"] > 0  # throughput under fault
+    assert detail["watch_gaps"] >= 1 and detail["relists"] >= 1
+    assert detail["breaker_opens"] >= 1
+    assert detail["bound"] > 0
+    # Determinism: the same seed replays the same schedule.
+    assert doc["schedule"] == ChaosSchedule.generate(
+        7, classes=("http_5xx", "watch_410", "bind_partial",
+                    "bind_blackhole"),
+        spacing_s=4.0, base_duration_s=1.5).to_dicts()
+
+
+@pytest.mark.slow
+def test_long_soak_all_fault_classes_multi_seed():
+    for seed in (3, 17):
+        doc = run_chaos_soak(seed=seed, num_nodes=32, num_pods=192,
+                             classes=FAULT_CLASSES, cycle_s=0.25)
+        assert doc["recovered"], doc
+        assert doc["invariants"] == {k: 0 for k in doc["invariants"]}, doc
+        assert doc["detail"]["brownout"]["assumed"] > 0
+
+
+def test_relist_prunes_informer_ghost_nodes():
+    """A node deleted while the watch was dark leaves a ghost in the
+    informer's node cache (it only grows via watch events); the
+    relist audit must prune it against the authoritative listing."""
+    loop, proxy, _ = _quiet_proxy()
+    try:
+        victim = sorted(n.name for n in loop.informer.nodes())[-1]
+        # Server-side removal with the deletion event LOST (what a
+        # watch gap does): reach into the fake's state directly.
+        with proxy.inner._lock:
+            del proxy.inner._nodes[victim]
+        assert victim in {n.name for n in loop.informer.nodes()}
+        loop._on_watch_gap("test")
+        loop.run_once()
+        assert victim not in {n.name for n in loop.informer.nodes()}
+        assert loop.relists == 1 and loop.relist_repairs >= 1
+        assert loop.informer.resyncs >= 1
+    finally:
+        loop.stop_bind_worker()

@@ -214,3 +214,52 @@ def test_namespaced_selector_defs_roundtrip(tmp_path):
     # The restored resident still carries the scoped membership bit.
     bit = enc2.groups.bit(key, lenient=True)
     assert bit and (enc2._committed[resident.uid].member_bits & bit)
+
+
+def test_restored_commit_binds_at_recorded_node(tmp_path):
+    """A checkpoint-restored ledger commit is authoritative for WHERE
+    its pod binds.  The restart re-scores the re-delivered pod against
+    a snapshot that already contains the pod's OWN usage, so the
+    scored node can differ from the recorded one — binding there would
+    strand the recorded usage (ledger says A, server says B).  The
+    bind planner must redirect to the ledger's node instead."""
+    pods = generate_workload(
+        WorkloadSpec(num_pods=4, seed=11, services=2),
+        scheduler_name=CFG.scheduler_name)
+    pod = pods[0]
+
+    # Probe run on an identically-seeded cluster: where does a fresh
+    # score put this pod?
+    probe_cluster, probe_loop = _warm_encoder(seed=5)
+    probe_cluster.add_pod(pod)
+    probe_loop.run_once()
+    assert probe_cluster.bindings
+    scored = probe_cluster.bindings[-1].node_name
+
+    # Same build, but the ledger already holds the pod's usage at a
+    # DIFFERENT node — a pre-crash assume whose parked bind died with
+    # the process (control-plane brownout crash window).  Regenerate
+    # the workload: binding MUTATES the pod object (node_name), and a
+    # restart delivers a fresh, still-pending object with the same
+    # uid.
+    pod = generate_workload(
+        WorkloadSpec(num_pods=4, seed=11, services=2),
+        scheduler_name=CFG.scheduler_name)[0]
+    cluster, loop = _warm_encoder(seed=5)
+    other = next(n for n in loop.encoder.known_node_names()
+                 if n and n != scored)
+    loop.encoder.commit_many([pod], [loop.encoder.node_index(other)])
+    save_checkpoint(str(tmp_path / "ckpt"), loop.encoder)
+
+    enc2 = load_checkpoint(str(tmp_path / "ckpt"))
+    assert enc2.committed_node(pod.uid) == other
+    loop2 = SchedulerLoop(cluster, CFG, encoder=enc2)
+    cluster.add_pod(pod)
+    loop2.run_once()
+    assert [b.node_name for b in cluster.bindings
+            if b.pod_name == pod.name] == [other]
+    assert loop2.binds_redirected == 1
+    # Exactly-once accounting: the sync success path deduped against
+    # the restored commit instead of double-committing.
+    assert set(enc2._committed) == {pod.uid}
+    assert loop2.scheduled == 1

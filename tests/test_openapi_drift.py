@@ -1,0 +1,145 @@
+"""Drift test: conformance schemas vs vendored upstream OpenAPI.
+
+``k8s/conformance.py``'s hand-written schemas are the independent
+authority the client AND fakes are validated against — but they are
+themselves hand-written, so they could drift from the real Kubernetes
+API (inventing a field upstream doesn't have, or failing to require a
+field upstream requires).  ``k8s/openapi/slices.json`` vendors the
+upstream property/required tables (swagger.json v1.29 + the extender
+contract's Go JSON tags); this module pins conformance.py to them:
+
+- every property a STRICT emitted-body schema enumerates must exist
+  upstream (a typo'd/hallucinated field in our schema fails here even
+  though client+fake+schema all agree on it);
+- every field upstream REQUIRES must be required by our schema (we
+  cannot emit a body the apiserver would reject as incomplete);
+- the extender wire structs match field-for-field — the stock
+  kube-scheduler parses these, so extra fields are drift too.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import pytest
+
+from kubernetesnetawarescheduler_tpu.k8s import conformance
+
+_SLICES_PATH = os.path.join(
+    os.path.dirname(conformance.__file__), "openapi", "slices.json")
+
+
+@pytest.fixture(scope="module")
+def slices() -> dict:
+    with open(_SLICES_PATH, encoding="utf-8") as fh:
+        return json.load(fh)
+
+
+def _defn(slices: dict, name: str) -> dict:
+    return slices["definitions"][name]
+
+
+def _props(schema: dict) -> set[str]:
+    return set(schema.get("properties", {}))
+
+
+def _assert_subset_of_upstream(ours: dict, upstream: dict,
+                               what: str) -> None:
+    extra = _props(ours) - set(upstream["properties"])
+    assert not extra, (
+        f"{what}: schema enumerates fields the upstream spec does not "
+        f"have (drift!): {sorted(extra)}")
+    missing_required = set(upstream["required"]) - set(
+        ours.get("required", []))
+    assert not missing_required, (
+        f"{what}: upstream requires fields our schema does not: "
+        f"{sorted(missing_required)}")
+
+
+def test_binding_matches_upstream(slices):
+    _assert_subset_of_upstream(
+        conformance.BINDING_SCHEMA,
+        _defn(slices, "io.k8s.api.core.v1.Binding"), "Binding")
+    meta = conformance.BINDING_SCHEMA["properties"]["metadata"]
+    _assert_subset_of_upstream(
+        meta,
+        _defn(slices, "io.k8s.apimachinery.pkg.apis.meta.v1."
+                      "ObjectMeta"),
+        "Binding.metadata")
+    target = conformance.BINDING_SCHEMA["properties"]["target"]
+    _assert_subset_of_upstream(
+        target, _defn(slices, "io.k8s.api.core.v1.ObjectReference"),
+        "Binding.target")
+
+
+def test_event_matches_upstream(slices):
+    _assert_subset_of_upstream(
+        conformance.EVENT_SCHEMA,
+        _defn(slices, "io.k8s.api.core.v1.Event"), "Event")
+    meta = conformance.EVENT_SCHEMA["properties"]["metadata"]
+    _assert_subset_of_upstream(
+        meta,
+        _defn(slices, "io.k8s.apimachinery.pkg.apis.meta.v1."
+                      "ObjectMeta"),
+        "Event.metadata")
+    involved = conformance.EVENT_SCHEMA["properties"]["involvedObject"]
+    _assert_subset_of_upstream(
+        involved, _defn(slices, "io.k8s.api.core.v1.ObjectReference"),
+        "Event.involvedObject")
+    source = conformance.EVENT_SCHEMA["properties"]["source"]
+    _assert_subset_of_upstream(
+        source, _defn(slices, "io.k8s.api.core.v1.EventSource"),
+        "Event.source")
+
+
+def test_delete_options_matches_upstream(slices):
+    _assert_subset_of_upstream(
+        conformance.DELETE_OPTIONS_SCHEMA,
+        _defn(slices, "io.k8s.apimachinery.pkg.apis.meta.v1."
+                      "DeleteOptions"),
+        "DeleteOptions")
+
+
+def test_watch_event_matches_upstream(slices):
+    upstream = _defn(
+        slices, "io.k8s.apimachinery.pkg.apis.meta.v1.WatchEvent")
+    ours = conformance.WATCH_EVENT_SCHEMA
+    assert set(upstream["required"]) <= set(ours["required"])
+    assert _props(ours) <= set(upstream["properties"])
+
+
+def test_extender_args_match_contract(slices):
+    upstream = slices["extender_v1"]["ExtenderArgs"]
+    _assert_subset_of_upstream(
+        conformance.EXTENDER_ARGS_SCHEMA, upstream, "ExtenderArgs")
+
+
+def test_extender_filter_result_matches_contract(slices):
+    # The stock kube-scheduler PARSES this body, so the match is
+    # exact in both directions: a field we emit that the contract
+    # lacks is drift, and a contract field we cannot emit means the
+    # schema would reject a legal response.
+    upstream = slices["extender_v1"]["ExtenderFilterResult"]
+    ours = conformance.EXTENDER_FILTER_RESULT_SCHEMA
+    assert _props(ours) == set(upstream["properties"]), (
+        "ExtenderFilterResult fields diverge from the extender/v1 "
+        "contract")
+
+
+def test_host_priority_matches_contract(slices):
+    upstream = slices["extender_v1"]["HostPriority"]
+    ours = conformance.HOST_PRIORITY_LIST_SCHEMA["items"]
+    assert _props(ours) == set(upstream["properties"])
+    assert set(upstream["required"]) <= set(ours["required"])
+
+
+def test_strict_schemas_stay_strict():
+    # The drift guarantees above only bite for schemas that enumerate
+    # their fields: a future edit flipping additionalProperties would
+    # quietly defeat both this test and conformance itself.
+    for name in ("BINDING_SCHEMA", "EVENT_SCHEMA",
+                 "DELETE_OPTIONS_SCHEMA",
+                 "EXTENDER_FILTER_RESULT_SCHEMA"):
+        schema = getattr(conformance, name)
+        assert schema.get("additionalProperties") is False, name

@@ -143,6 +143,92 @@ def test_crash_between_dispatch_and_retire_no_double_commit(tmp_path):
         np.asarray(ref_loop.encoder.snapshot().used))
 
 
+def test_restart_under_brownout_drains_parked_binds_exactly_once(
+        tmp_path):
+    """Crash in the WORST window: breaker open (binds parked, their
+    usage committed at assume) AND a burst in flight (dispatched, not
+    retired).  The parked backlog dies with the process — only the
+    checkpoint's assumes survive.  Restore must (a) not double-commit,
+    (b) bind every surviving assume at EXACTLY the node the restored
+    ledger holds its usage at (no re-score drift), and (c) converge to
+    the undisturbed pipelined run's schedule."""
+    from kubernetesnetawarescheduler_tpu.core.checkpoint import (
+        load_checkpoint,
+        save_checkpoint,
+    )
+    from kubernetesnetawarescheduler_tpu.k8s.chaos import (
+        ChaosSchedule,
+        check_invariants,
+    )
+
+    # A quiet chaos proxy: no injected faults, but a real breaker the
+    # loop parks behind.
+    cluster, lat, bw = build_fake_cluster(
+        ClusterSpec(num_nodes=48, seed=61),
+        chaos=ChaosSchedule(seed=0, faults=()))
+    cfg = _cfg(96)
+    loop = SchedulerLoop(cluster, cfg, method="parallel",
+                         burst_batches=4, pipelined=True)
+    loop.encoder.set_network(lat, bw)
+    feed_metrics(cluster.inner, loop.encoder,
+                 np.random.default_rng(62))
+    pods = _workload()
+    cluster.add_pods(pods)
+
+    # Brownout before any bind leaves: every retired burst parks.
+    for _ in range(cluster.breaker.failure_threshold):
+        cluster.breaker.record_failure()
+    assert loop.degraded
+
+    loop.run_once()  # dispatch burst 1 (encode-ahead + launch)
+    loop.run_once()  # retire burst 1 -> binds PARK; dispatch burst 2
+    assert loop._pipe_inflight is not None
+    assert loop._parked_binds and loop.binds_parked_total > 0
+    assert not cluster.bindings  # nothing reached the server
+    committed_before = set(loop.encoder._committed)
+    assert committed_before
+
+    save_checkpoint(str(tmp_path / "ckpt"), loop.encoder)
+    # "Crash": loop abandoned mid-flight — no retire, no flush, the
+    # parked deque is gone.
+
+    enc2 = load_checkpoint(str(tmp_path / "ckpt"))
+    by_uid = {p.uid: p for p in pods}
+    want_node = {by_uid[uid].name: enc2.committed_node(uid)
+                 for uid in committed_before}
+    assert all(want_node.values())
+    loop2 = SchedulerLoop(cluster, cfg, method="parallel",
+                          burst_batches=4, pipelined=True,
+                          encoder=enc2)
+    # Restart against a healthy apiserver: the breaker's cooldown
+    # elapses, half-open probes succeed, traffic resumes.
+    cluster.advance(2.5)
+    for pod in pods:
+        loop2.queue.push(pod)
+    loop2.run_until_drained()
+    loop2.flush_binds()
+    loop2.stop_bind_worker()
+
+    # Exactly-once: every pod bound once, none twice.
+    names = [b.pod_name for b in cluster.bindings]
+    assert len(names) == len(set(names)) and names
+    # Surviving assumes bound at the ledger's recorded node — the
+    # restored commit is authoritative, not the restart's re-score
+    # (whose snapshot sees the pod's own usage).
+    bound = {b.pod_name: b.node_name for b in cluster.bindings}
+    for pod_name, node in want_node.items():
+        assert bound[pod_name] == node, pod_name
+    # And the recovered schedule equals an undisturbed pipelined
+    # run's, usage included.
+    ref_loop, ref = _drain(pipelined=True)
+    assert bound == {b.pod_name: b.node_name for b in ref.bindings}
+    assert np.array_equal(
+        np.asarray(loop2.encoder.snapshot().used),
+        np.asarray(ref_loop.encoder.snapshot().used))
+    inv = check_invariants(loop2, cluster)
+    assert all(v == 0 for v in inv.values()), inv
+
+
 def test_prepare_finalize_composes_to_encode_stream():
     loop, cluster = _fresh()
     pods = _workload()

@@ -93,6 +93,37 @@ def check_doc(path: str, doc: dict) -> list[str]:
         if not env:
             fails.append(f"{name}: missing/empty bench_env")
 
+    # Rule 5 — chaos_soak artifacts (control-plane brownout soak,
+    # bench.py --chaos): the soak is only evidence if it is
+    # REPLAYABLE (seed + fault classes recorded), HEALTHY (every
+    # invariant counter zero, recovery reached), and ATTRIBUTABLE
+    # (non-empty bench_env) — a chaos.json missing any of these reads
+    # as "resilience proven" while proving nothing.
+    if doc.get("metric") == "chaos_soak":
+        if not isinstance(doc.get("seed"), int):
+            fails.append(f"{name}: chaos_soak missing integer seed "
+                         "(schedule not replayable)")
+        if not doc.get("fault_classes"):
+            fails.append(f"{name}: chaos_soak records no fault "
+                         "classes")
+        inv = doc.get("invariants")
+        if not isinstance(inv, dict) or not inv:
+            fails.append(f"{name}: chaos_soak missing invariants")
+        else:
+            bad = {k: v for k, v in inv.items() if v}
+            if bad:
+                fails.append(
+                    f"{name}: chaos_soak invariants nonzero: {bad}")
+        if not doc.get("recovered"):
+            fails.append(f"{name}: chaos_soak never recovered "
+                         "(breaker open or backlog left at the end)")
+        cdetail = doc.get("detail")
+        if not (isinstance(cdetail, dict)
+                and cdetail.get("bench_env")):
+            fails.append(f"{name}: chaos_soak missing/empty "
+                         "bench_env")
+        return fails
+
     if headline is None:
         return fails
     detail = headline["detail"]
