@@ -547,9 +547,50 @@ def _run_chaos_bench() -> None:
         sys.exit(1)
 
 
+def _run_suite_bench(name: str) -> None:
+    """``bench.py --suite <config>``: run one bench-suite leg into
+    ``bench_artifacts/`` on CPU (the suite legs are replay harnesses,
+    not device benchmarks — CPU keeps them runnable anywhere and the
+    seeded artifacts reproducible).
+
+    For the ``topology`` leg the ISSUE bars are checked here: blended
+    gang placement must recover >= 80% of the oracle's bandwidth gain
+    with probes covering < 5% of pairs — exit 1 otherwise so the
+    driver fails loudly instead of committing a sick artifact."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from kubernetesnetawarescheduler_tpu.bench.suite import run_suite
+
+    out = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "bench_artifacts")
+    small = os.environ.get("BENCH_SUITE_SMALL", "") == "1"
+    (res,) = run_suite([name], out_dir=out, small=small)
+    print(json.dumps(res.to_dict()))
+    # Small shapes deliberately over-probe (coverage bar is a
+    # full-shape property); only full runs are held to the bars.
+    if name == "topology" and not small:
+        detail = res.metrics.get("detail", {})
+        if not (detail.get("gain_target_met")
+                and detail.get("coverage_under_5pct")):
+            print("WARNING: topology bars unmet: "
+                  f"gain_ratio={detail.get('gain_ratio')} "
+                  f"coverage={detail.get('coverage_fraction')}",
+                  file=sys.stderr)
+            sys.exit(1)
+
+
 def main() -> None:
     if "--chaos" in sys.argv[1:]:
         _run_chaos_bench()
+        return
+    argv = sys.argv[1:]
+    if "--suite" in argv:
+        idx = argv.index("--suite")
+        if idx + 1 >= len(argv):
+            print("ERROR: --suite needs a config name", file=sys.stderr)
+            sys.exit(2)
+        _run_suite_bench(argv[idx + 1])
         return
     tpu_ok = True
     force_cpu = os.environ.get("BENCH_FORCE_CPU", "") == "1"

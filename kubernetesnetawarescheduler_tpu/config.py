@@ -266,6 +266,61 @@ class SchedulerConfig:
     # still bind atomically but members place independently.
     gang_weight: float = 1.0
 
+    # ---- learned network topology model (netmodel/) ----
+    # Off by default: with the model disabled the score/gang path
+    # consumes the raw probe matrices bit-identically to a build
+    # without the subsystem.
+    enable_netmodel: bool = False
+
+    # Vivaldi coordinate dimensionality (latency embedding) and the
+    # rank of the bandwidth factorization u[N,r] . v[N,r]^T.
+    netmodel_dim: int = 4
+    netmodel_rank: int = 8
+
+    # Ring buffer of recent probe observations the Adam step samples
+    # from (each probe inserts BOTH directed entries, so the ring holds
+    # ring/2 probes), mini-batch size, steps per fit() call and PEAK
+    # Adam learning rate (fit() applies an inverse-sqrt decay in total
+    # steps, floored at lr/8 — see TopologyModel.fit).  ring >= batch
+    # is enforced so a batch never aliases.  The ring must cover the
+    # pair set the model is expected to generalize from: at 64
+    # probes/cycle the default retains ~9 hours of probes (~1.5 MB
+    # host memory); a too-small ring silently caps fit quality
+    # (measured at N=1024: an 8192 ring left the log-residual at 0.38
+    # where 65536 reaches 0.21).
+    netmodel_ring: int = 65536
+    netmodel_batch: int = 256
+    netmodel_steps: int = 8
+    netmodel_lr: float = 0.05
+
+    # Confidence saturation: a node's confidence is
+    # 1 - exp(-n_obs / conf_k) — after ~3*conf_k observations the
+    # model's estimates for that node count (almost) fully.
+    netmodel_conf_k: float = 4.0
+
+    # Probe-freshness horizon for the blend: a pair measured within
+    # ~tau seconds keeps its direct probe value; older pairs fade
+    # toward the model estimate (weight exp(-age/tau)).
+    netmodel_tau_s: float = 600.0
+
+    # Residual monitor: a fresh measurement whose |log1p-bandwidth
+    # residual| exceeds this threshold on a pair whose endpoint
+    # confidence product is at least resid_conf raises a
+    # link-degradation event.  0.7 in log1p space ~= a 2x bandwidth
+    # divergence.
+    netmodel_resid_threshold: float = 0.7
+    netmodel_resid_conf: float = 0.5
+
+    # Share of every probe budget the EIG planner still spends on
+    # pure stalest-first exploration (guards against confidently-wrong
+    # model regions never being re-measured).
+    netmodel_explore_frac: float = 0.25
+
+    # Probe bookkeeping forget horizon (seconds): per-pair last-probe
+    # entries older than this are pruned from the orchestrator
+    # (bounding its O(N^2) memory); <= 0 means never forget.
+    probe_forget_s: float = 0.0
+
     # ---- control-plane brownout resilience (k8s/kubeclient.py) ----
     # Circuit breaker over API-server health: this many brownout
     # failures (5xx/429/connection errors) within breaker_window_s
@@ -317,6 +372,26 @@ class SchedulerConfig:
                 or self.api_backoff_max_s < self.api_backoff_base_s):
             raise ValueError("api backoff must satisfy "
                              "0 < base <= max")
+        if self.netmodel_dim < 1 or self.netmodel_rank < 1:
+            raise ValueError("netmodel dim/rank must be >= 1")
+        if self.netmodel_batch < 1:
+            raise ValueError("netmodel_batch must be >= 1")
+        if self.netmodel_ring < self.netmodel_batch:
+            raise ValueError("netmodel_ring must be >= netmodel_batch")
+        if self.netmodel_steps < 0:
+            raise ValueError("netmodel_steps must be >= 0")
+        if self.netmodel_lr <= 0:
+            raise ValueError("netmodel_lr must be > 0")
+        if self.netmodel_conf_k <= 0 or self.netmodel_tau_s <= 0:
+            raise ValueError("netmodel conf_k/tau_s must be > 0")
+        if self.netmodel_resid_threshold <= 0:
+            raise ValueError("netmodel_resid_threshold must be > 0")
+        if not 0.0 <= self.netmodel_resid_conf <= 1.0:
+            raise ValueError("netmodel_resid_conf must be in [0, 1]")
+        if not 0.0 <= self.netmodel_explore_frac <= 1.0:
+            raise ValueError("netmodel_explore_frac must be in [0, 1]")
+        if self.probe_forget_s < 0:
+            raise ValueError("probe_forget_s must be >= 0")
 
 
 # ---------------------------------------------------------------------------

@@ -209,6 +209,16 @@ def save_checkpoint(path: str, encoder: Encoder) -> None:
     with open(tmp, "w", encoding="utf-8") as fh:
         json.dump(meta, fh, indent=2)
     os.replace(tmp, os.path.join(path, "meta.json"))
+    # Learned topology model (netmodel/): its own atomic .npz beside
+    # the encoder state, so restarts resume learning instead of
+    # re-learning 54 hours of probes from scratch.  Written only when
+    # attached; a stale file from a since-detached model is removed so
+    # restore cannot resurrect it.
+    npz = os.path.join(path, "netmodel.npz")
+    if encoder.netmodel is not None:
+        encoder.netmodel.save(npz)
+    elif os.path.exists(npz):
+        os.remove(npz)
 
 
 def load_checkpoint(path: str,
@@ -350,6 +360,22 @@ def load_checkpoint(path: str,
     # through the informer's initial resync to re-gate.
     for key, entries in meta.get("gangs_inflight", {}).items():
         enc.rollback_gang_members(e[0] for e in entries)
+    # Learned topology model: restore beside the encoder when the
+    # config wants one and the checkpoint carries it.  A shape mismatch
+    # (dims/rank/max_nodes changed) starts the model fresh rather than
+    # failing the whole restore — the encoder state is still good.
+    npz = os.path.join(path, "netmodel.npz")
+    if cfg.enable_netmodel and os.path.exists(npz):
+        from kubernetesnetawarescheduler_tpu.netmodel import TopologyModel
+
+        try:
+            enc.attach_netmodel(TopologyModel.load(npz, cfg))
+        except ValueError as exc:
+            import sys
+
+            print(f"WARNING: netmodel checkpoint not restored: {exc}; "
+                  "starting with a fresh model", file=sys.stderr)
+            enc.attach_netmodel(TopologyModel(cfg))
     # Everything is freshly loaded: first snapshot() must upload all.
     for key in enc._dirty:
         enc._dirty[key] = True

@@ -386,6 +386,12 @@ class Encoder:
         self.shape_cache_hits = 0
         self.shape_cache_misses = 0
 
+        # Optional learned topology model (netmodel.TopologyModel):
+        # when attached AND enabled, the net snapshot group uploads the
+        # confidence-blended matrices instead of the raw probe staging
+        # arrays.  None/disabled leaves the net path bit-identical.
+        self.netmodel = None
+
         # Dirty tracking per transfer group, so snapshot() uploads the
         # 100 MB-class N x N matrices only when the probe pipeline
         # actually moved them.
@@ -433,6 +439,12 @@ class Encoder:
 
     def node_index(self, name: str) -> int:
         return self._node_index[name]
+
+    def node_slot(self, name: str) -> int | None:
+        """Slot index of ``name``, or None if unregistered (probe
+        threads hold target lists that can lag a node removal)."""
+        with self._lock:
+            return self._node_index.get(name)
 
     def node_name(self, index: int) -> str:
         return self._node_names[index]
@@ -675,6 +687,11 @@ class Encoder:
             self._lat[:, idx] = 0.0
             self._bw[idx, :] = 0.0
             self._bw[:, idx] = 0.0
+            if self.netmodel is not None:
+                # Slot reuse must not inherit the old node's learned
+                # coordinates/factors (lock order: encoder, then
+                # model — the model never calls back in).
+                self.netmodel.reset_node(idx)
             self._cap[idx] = 0.0
             self._used[idx] = 0.0
             self._node_valid[idx] = False
@@ -849,6 +866,20 @@ class Encoder:
             k = lat_ms.shape[0]
             self._lat[:k, :k] = lat_ms
             self._bw[:k, :k] = bw_bps
+            self._dirty["net"] = True
+
+    def attach_netmodel(self, model) -> None:
+        """Attach a :class:`~..netmodel.TopologyModel`; the next net
+        snapshot flush blends its predictions (if enabled)."""
+        with self._lock:
+            self.netmodel = model
+            self._dirty["net"] = True
+
+    def touch_net(self) -> None:
+        """Mark the net group dirty without a probe write — used after
+        a model refit, whose new predictions change the BLENDED
+        matrices even though no staging entry moved."""
+        with self._lock:
             self._dirty["net"] = True
 
     # -- allocation ---------------------------------------------------
@@ -1209,8 +1240,13 @@ class Encoder:
                 self._cache["metrics"] = jnp.asarray(self._metrics)
                 self._cache["metrics_age"] = jnp.asarray(self._metrics_age)
             if self._dirty["net"]:
-                self._cache["lat"] = jnp.asarray(self._lat)
-                self._cache["bw"] = jnp.asarray(self._bw)
+                model = self.netmodel
+                if model is not None and model.enabled:
+                    lat_host, bw_host = model.blend(self._lat, self._bw)
+                else:
+                    lat_host, bw_host = self._lat, self._bw
+                self._cache["lat"] = jnp.asarray(lat_host)
+                self._cache["bw"] = jnp.asarray(bw_host)
             if self._dirty["alloc"]:
                 self._cache["cap"] = jnp.asarray(self._cap)
                 # Nominated reservations count as used: the scoring

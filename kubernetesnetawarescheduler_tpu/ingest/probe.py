@@ -40,18 +40,57 @@ class Prober(Protocol):
 
 class FakeProber:
     """Ground-truth matrices + multiplicative noise + injectable
-    failures (SURVEY.md 5's fault-injection mode)."""
+    failures (SURVEY.md 5's fault-injection mode).
+
+    ``asymmetry`` > 0 gives every directed pair a fixed, seeded
+    multiplicative skew (A->B vs B->A bandwidth differ), and
+    ``drift`` > 0 applies a seeded per-link random walk advanced by
+    :meth:`advance` — both exercise the topology model's tracking
+    behaviour.  Both default to 0 and draw from their own offset-seeded
+    generators, so the default configuration consumes the main RNG
+    stream identically to before (bit-identical probes for existing
+    tests)."""
 
     def __init__(self, names: Sequence[str], lat_ms: np.ndarray,
                  bw_bps: np.ndarray, noise: float = 0.02,
-                 fail_fraction: float = 0.0, seed: int = 0) -> None:
+                 fail_fraction: float = 0.0, seed: int = 0,
+                 asymmetry: float = 0.0, drift: float = 0.0) -> None:
         self._index = {n: i for i, n in enumerate(names)}
         self._lat = lat_ms
         self._bw = bw_bps
         self._noise = noise
         self._fail_fraction = fail_fraction
         self._rng = np.random.default_rng(seed)
+        self._asymmetry = float(asymmetry)
+        self._drift_scale = float(drift)
         self.calls = 0
+        n = len(self._index)
+        if self._asymmetry:
+            # Fixed antisymmetric skew in log space: A->B gets
+            # exp(+s), B->A gets exp(-s) — same seed, same skew.
+            arng = np.random.default_rng(seed + 1_000_003)
+            s = arng.standard_normal((n, n)).astype(np.float64)
+            self._asym = np.exp(self._asymmetry * (np.triu(s, 1)
+                                                   - np.triu(s, 1).T))
+        else:
+            self._asym = None
+        if self._drift_scale:
+            self._drift_rng = np.random.default_rng(seed + 2_000_003)
+            self._drift = np.zeros((n, n), np.float64)
+        else:
+            self._drift_rng = None
+            self._drift = None
+
+    def advance(self, steps: int = 1) -> None:
+        """Advance the seeded symmetric per-link bandwidth random walk
+        (no-op unless constructed with ``drift > 0``)."""
+        if self._drift is None:
+            return
+        n = self._drift.shape[0]
+        for _ in range(steps):
+            step = self._drift_rng.standard_normal((n, n))
+            step = np.triu(step, 1)
+            self._drift += self._drift_scale * (step + step.T)
 
     def probe(self, a: str, b: str) -> tuple[float, float]:
         self.calls += 1
@@ -59,7 +98,12 @@ class FakeProber:
             raise TimeoutError(f"probe {a}->{b} timed out")
         i, j = self._index[a], self._index[b]
         f = 1.0 + self._noise * float(self._rng.standard_normal())
-        return float(self._lat[i, j] * f), float(self._bw[i, j] / max(f, 0.5))
+        bw = float(self._bw[i, j])
+        if self._asym is not None:
+            bw *= float(self._asym[i, j])
+        if self._drift is not None:
+            bw *= float(np.exp(self._drift[i, j]))
+        return float(self._lat[i, j] * f), bw / max(f, 0.5)
 
 
 class Iperf3Prober:
@@ -151,20 +195,46 @@ class AgentProber:
 
 
 class ProbeOrchestrator:
-    """Budgeted stalest-pair-first probing into an Encoder."""
+    """Budgeted pair probing into an Encoder.
+
+    Pair selection is stalest-first by default; passing a ``planner``
+    (e.g. :class:`~..netmodel.EIGProbePlanner`) replaces it with
+    expected-information-gain selection (the stalest-first selector is
+    still handed to the planner for its exploration share).  A
+    ``model`` (:class:`~..netmodel.TopologyModel`) receives every
+    successful observation and is re-fit at the end of each cycle.
+
+    ``forget_s`` bounds the per-pair bookkeeping: entries whose last
+    probe is older than the horizon are pruned on ``advance_clock``
+    (they revert to "never probed" for selection purposes, which is
+    exactly how a probe that stale should be treated).  <= 0 keeps
+    entries forever (the pre-existing behaviour)."""
 
     def __init__(self, encoder: Encoder, prober: Prober,
-                 names: Sequence[str]) -> None:
+                 names: Sequence[str], planner=None, model=None,
+                 forget_s: float = 0.0) -> None:
         self._encoder = encoder
         self._prober = prober
         self._names = list(names)
+        self._planner = planner
+        self._model = model
+        self._forget_s = float(forget_s)
         self._last_probe: dict[tuple[int, int], float] = {}
         self._clock = 0.0
         self.failures = 0
         self.successes = 0
+        self.pruned_total = 0
 
     def advance_clock(self, dt_s: float) -> None:
         self._clock += dt_s
+        if self._model is not None:
+            self._model.advance_clock(dt_s)
+        if self._forget_s > 0:
+            horizon = self._clock - self._forget_s
+            stale = [p for p, t in self._last_probe.items() if t < horizon]
+            for p in stale:
+                del self._last_probe[p]
+            self.pruned_total += len(stale)
 
     def _stalest_pairs(self, budget: int) -> list[tuple[int, int]]:
         # O(P log budget) selection over a generator — never
@@ -175,13 +245,19 @@ class ProbeOrchestrator:
         return heapq.nsmallest(
             budget, pairs, key=lambda p: self._last_probe.get(p, -np.inf))
 
-    def run_cycle(self, budget: int = 64) -> int:
-        """Probe the ``budget`` stalest pairs; returns successes.
+    def _select_pairs(self, budget: int) -> list[tuple[int, int]]:
+        if self._planner is not None:
+            return self._planner.select_pairs(
+                len(self._names), budget, self._stalest_pairs)
+        return self._stalest_pairs(budget)
+
+    def run_cycle(self, budget: int = 64, fit: bool = True) -> int:
+        """Probe the selected ``budget`` pairs; returns successes.
         Failures are counted and skipped — the pair just stays stale
         (no crash, unlike the reference's nil-body read,
         scheduler.go:397-405)."""
         done = 0
-        for i, j in self._stalest_pairs(budget):
+        for i, j in self._select_pairs(budget):
             a, b = self._names[i], self._names[j]
             try:
                 lat_ms, bw_bps = self._prober.probe(a, b)
@@ -200,12 +276,39 @@ class ProbeOrchestrator:
                           "silently)", file=sys.stderr)
                 continue
             self._encoder.update_link(a, b, lat_ms=lat_ms, bw_bps=bw_bps)
+            if self._model is not None:
+                ia = self._encoder.node_slot(a)
+                ib = self._encoder.node_slot(b)
+                if ia is not None and ib is not None:
+                    self._model.observe(ia, ib, lat_ms, bw_bps, self._clock)
             self._last_probe[(i, j)] = self._clock
             self.successes += 1
             done += 1
+        if done and fit and self._model is not None:
+            if self._model.fit():
+                # Fresh model params change the blended snapshot even
+                # with no new direct probe on a given pair.
+                self._encoder.touch_net()
         return done
 
-    def staleness(self) -> dict[tuple[str, str], float]:
+    def staleness(self) -> dict[str, float]:
+        """Aggregate staleness stats — O(tracked pairs) time, O(1)
+        output (the old O(N^2) per-pair dict is
+        :meth:`staleness_pairs`)."""
+        n = len(self._names)
+        total = n * (n - 1) // 2
+        ages = [self._clock - t for t in self._last_probe.values()]
+        return {
+            "tracked_pairs": float(len(ages)),
+            "total_pairs": float(total),
+            "coverage_fraction": (len(ages) / total) if total else 0.0,
+            "mean_age_s": float(np.mean(ages)) if ages else float("nan"),
+            "max_age_s": float(np.max(ages)) if ages else float("nan"),
+        }
+
+    def staleness_pairs(self) -> dict[tuple[str, str], float]:
+        """Per-pair ages keyed by name pair.  O(N^2) worst case — debug
+        / small-cluster use only; prefer :meth:`staleness`."""
         return {
             (self._names[i], self._names[j]): self._clock - t
             for (i, j), t in self._last_probe.items()}

@@ -1,0 +1,15 @@
+"""Learned network-topology model (coordinate embedding + low-rank
+bandwidth completion) over the budgeted probe stream.
+
+See :mod:`.model` for the estimator and :mod:`.planner` for the
+expected-information-gain probe planner."""
+
+from kubernetesnetawarescheduler_tpu.netmodel.model import (
+    TopoParams,
+    TopologyModel,
+)
+from kubernetesnetawarescheduler_tpu.netmodel.planner import (
+    EIGProbePlanner,
+)
+
+__all__ = ("TopoParams", "TopologyModel", "EIGProbePlanner")

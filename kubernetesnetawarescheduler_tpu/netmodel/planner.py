@@ -1,0 +1,127 @@
+"""Expected-information-gain probe planning.
+
+Stalest-pair-first probing (the orchestrator's default) spends the
+budget uniformly over the pair space: at 5k nodes every pair gets
+re-probed every ~54 hours whether the model is certain about it or
+not.  Once a :class:`~.model.TopologyModel` is learning the topology,
+the budget is better spent where a measurement changes the most
+beliefs: pairs whose ENDPOINTS the model is uncertain about, weighted
+by how much placement actually cares about those nodes.
+
+The planner scores every candidate pair as::
+
+    EIG(i, j) ~ age_factor(i, j) * (uncert(i) + uncert(j))
+                * sqrt(relevance(i) * relevance(j))
+
+- ``age_factor = 1 - exp(-age / tau)`` — a just-probed pair carries no
+  new information; never-probed pairs saturate at 1.
+- ``uncert = 1 / (1 + n_obs / conf_k)`` — the complement of the
+  model's per-node confidence saturation; a node with many
+  observations pins its coordinates/factors, so further probes on it
+  are low-gain.
+- ``relevance`` — an EMA of placement activity per node
+  (:meth:`note_placements`), defaulting to uniform: probing decides
+  placements, so nodes that actually receive pods deserve sharper
+  estimates.
+
+A configurable ``explore_frac`` share of every budget still goes to
+pure stalest-first selection — the model's uncertainty estimate is
+itself learned, and a persistently-wrong confident region would
+otherwise never be re-measured (the classic active-learning echo
+chamber).
+
+The planner also reports the Shannon entropy of each cycle's selected
+score distribution (``last_entropy_bits``) — collapsing entropy means
+the planner is fixating on few pairs, a tuning signal exported via
+self-metrics.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from kubernetesnetawarescheduler_tpu.netmodel.model import TopologyModel
+
+
+class EIGProbePlanner:
+    """Uncertainty x placement-relevance pair selection for the
+    :class:`~..ingest.probe.ProbeOrchestrator` (its ``planner=`` hook).
+    """
+
+    def __init__(self, model: TopologyModel, explore_frac: float = 0.25,
+                 relevance_decay: float = 0.99, seed: int = 0) -> None:
+        if not 0.0 <= explore_frac <= 1.0:
+            raise ValueError("explore_frac must be in [0, 1]")
+        self._model = model
+        self._explore_frac = float(explore_frac)
+        self._decay = float(relevance_decay)
+        self._relevance = np.ones((model.cfg.max_nodes,), np.float32)
+        self.last_entropy_bits = 0.0
+        self.selections_total = 0
+
+    def note_placements(self, node_indices) -> None:
+        """Feed placement activity (encoder node slots of fresh binds);
+        bumps those nodes' relevance EMA."""
+        idx = np.asarray(list(node_indices), np.int64)
+        if idx.size == 0:
+            return
+        self._relevance *= self._decay
+        np.add.at(self._relevance, idx, 1.0)
+
+    def select_pairs(self, n: int, budget: int,
+                     stalest_fn) -> list[tuple[int, int]]:
+        """Pick ``budget`` index pairs among the first ``n`` nodes.
+        ``stalest_fn(k)`` is the orchestrator's stalest-first selector,
+        used for the exploration share."""
+        if budget <= 0 or n < 2:
+            return []
+        k_explore = min(budget, int(round(self._explore_frac * budget)))
+        explore = [tuple(p) for p in stalest_fn(k_explore)] \
+            if k_explore else []
+        k_exploit = budget - len(explore)
+        if k_exploit <= 0:
+            self.selections_total += len(explore)
+            return explore
+
+        m = self._model
+        cfg = m.cfg
+        with m._lock:
+            node_obs = m._node_obs[:n].copy()
+            age = m._clock - m._last_obs[:n, :n]
+        uncert = 1.0 / (1.0 + node_obs / cfg.netmodel_conf_k)
+        rel = np.sqrt(np.outer(self._relevance[:n],
+                               self._relevance[:n]))
+        age_f = 1.0 - np.exp(
+            -np.clip(age, 0.0, 1e12) / cfg.netmodel_tau_s)
+        score = age_f * (uncert[:, None] + uncert[None, :]) * rel
+
+        iu, ju = np.triu_indices(n, 1)
+        flat = score[iu, ju]
+        taken = set(explore)
+        # Over-select so dropping the exploration duplicates still
+        # leaves a full budget, then trim.
+        k = min(flat.size, k_exploit + len(taken))
+        top = np.argpartition(flat, flat.size - k)[flat.size - k:]
+        top = top[np.argsort(flat[top])[::-1]]
+        exploit: list[tuple[int, int]] = []
+        chosen_scores: list[float] = []
+        for t in top:
+            pair = (int(iu[t]), int(ju[t]))
+            if pair in taken:
+                continue
+            exploit.append(pair)
+            chosen_scores.append(float(flat[t]))
+            if len(exploit) >= k_exploit:
+                break
+
+        total = np.asarray(chosen_scores, np.float64)
+        mass = float(total.sum())
+        if total.size and mass > 0:
+            p = total / mass
+            nz = p > 0
+            self.last_entropy_bits = float(
+                -(p[nz] * np.log2(p[nz])).sum())
+        else:
+            self.last_entropy_bits = 0.0
+        self.selections_total += len(explore) + len(exploit)
+        return explore + exploit

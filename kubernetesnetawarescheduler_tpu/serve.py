@@ -399,15 +399,65 @@ def main(argv=None) -> int:
               "real cluster): lat/bw matrices stay empty and scoring "
               "degrades to metric-vote only", file=sys.stderr)
 
+    # Learned topology model: dense confidence-weighted lat/bw
+    # estimates fit on the probe stream (netmodel/).  A checkpoint
+    # restore may already have attached one (netmodel.npz); otherwise
+    # start fresh.  The EIG planner replaces stalest-first pair
+    # selection with uncertainty x placement-relevance selection.
+    netmodel = None
+    planner = None
+    if cfg.enable_netmodel:
+        from kubernetesnetawarescheduler_tpu.netmodel import (
+            EIGProbePlanner,
+            TopologyModel,
+        )
+
+        netmodel = getattr(loop.encoder, "netmodel", None)
+        if netmodel is None:
+            netmodel = TopologyModel(cfg, seed=args.seed)
+            loop.encoder.attach_netmodel(netmodel)
+        planner = EIGProbePlanner(
+            netmodel, explore_frac=cfg.netmodel_explore_frac,
+            seed=args.seed)
+        loop.probe_planner = planner
+        print("netmodel enabled: blending learned topology estimates "
+              "into lat/bw", file=sys.stderr)
+
     if args.probe_period_s > 0 and prober is not None:
         from kubernetesnetawarescheduler_tpu.ingest.probe import (
             ProbeOrchestrator,
         )
-        orch = ProbeOrchestrator(loop.encoder, prober, names)
+        from kubernetesnetawarescheduler_tpu.k8s.types import Event
+
+        orch = ProbeOrchestrator(loop.encoder, prober, names,
+                                 planner=planner, model=netmodel,
+                                 forget_s=cfg.probe_forget_s)
+        loop.probe_orchestrator = orch
 
         def probe_forever() -> None:
             while not stop.is_set():
                 orch.run_cycle(budget=64)
+                if netmodel is not None:
+                    for i, j, pred, meas, _t in \
+                            netmodel.drain_degradations():
+                        try:
+                            a = loop.encoder.node_name(i)
+                            b = loop.encoder.node_name(j)
+                            loop.client.create_event(Event(
+                                message=(
+                                    f"link {a}<->{b} measured "
+                                    f"{meas / 1e9:.2f} Gbps vs expected "
+                                    f"{pred / 1e9:.2f} Gbps"),
+                                reason="LinkDegraded",
+                                involved_pod="",
+                                namespace="default",
+                                component=cfg.scheduler_name,
+                                type="Warning"))
+                        except Exception:
+                            # Event emission is best-effort; the
+                            # degradation is already counted in
+                            # self-metrics.
+                            pass
                 orch.advance_clock(args.probe_period_s)
                 stop.wait(args.probe_period_s)
 

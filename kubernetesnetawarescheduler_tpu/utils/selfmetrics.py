@@ -143,6 +143,52 @@ def render_metrics(loop) -> str:
           float(len(getattr(loop, "_parked_binds", ()))),
           "Bind batches currently parked awaiting breaker recovery")
 
+    # Learned topology model (netmodel/): direct-probe pair coverage,
+    # prediction-residual quantiles, planner selection entropy and the
+    # residual monitor's degradation count.
+    netmodel = getattr(enc, "netmodel", None)
+    if netmodel is not None:
+        gauge("netaware_netmodel_pair_coverage_fraction",
+              netmodel.coverage_fraction(enc.num_nodes),
+              "Fraction of node pairs ever directly probed (the rest "
+              "ride model estimates)")
+        p50, p99 = netmodel.residual_quantiles()
+        gauge("netaware_netmodel_residual_p50", p50,
+              "Median |log-bandwidth residual| of fresh probes vs "
+              "model prediction")
+        gauge("netaware_netmodel_residual_p99", p99,
+              "p99 |log-bandwidth residual| of fresh probes vs model "
+              "prediction")
+        counter("netaware_netmodel_sgd_steps_total",
+                float(netmodel.steps_total),
+                "Jitted mini-batch SGD steps dispatched")
+        counter("netaware_netmodel_link_degradations_total",
+                float(netmodel.degradations_total),
+                "Fresh measurements diverging sharply from a confident "
+                "prediction (each also gets a LinkDegraded event)")
+    planner = getattr(loop, "probe_planner", None)
+    if planner is not None:
+        gauge("netaware_netmodel_probe_selection_entropy_bits",
+              float(planner.last_entropy_bits),
+              "Shannon entropy of the last probe cycle's EIG score "
+              "distribution (collapse = planner fixation)")
+    orch = getattr(loop, "probe_orchestrator", None)
+    if orch is not None:
+        stats = orch.staleness()
+        gauge("netaware_probe_pair_coverage_fraction",
+              float(stats["coverage_fraction"]),
+              "Fraction of node pairs with a tracked recent probe")
+        gauge("netaware_probe_mean_age_seconds",
+              float(stats["mean_age_s"]),
+              "Mean age of tracked pair probes")
+        gauge("netaware_probe_max_age_seconds",
+              float(stats["max_age_s"]),
+              "Max age of tracked pair probes")
+        counter("netaware_probe_pairs_pruned_total",
+                float(getattr(orch, "pruned_total", 0)),
+                "Per-pair probe bookkeeping entries pruned past the "
+                "forget horizon")
+
     # Extender webhook micro-batcher (api/extender._ScoreBatcher):
     # dispatch count exposes the coalescing rate (requests served /
     # dispatches = mean batch).

@@ -148,3 +148,60 @@ def test_chaos_soak_rules_fire():
     fails = bench_check.check_doc(
         "chaos.json", _chaos_doc(fault_classes=[]))
     assert any("fault" in f for f in fails), fails
+
+
+def _topology_doc(**overrides):
+    """A minimal healthy topology_model doc (bench.py --suite
+    topology shape)."""
+    detail = {
+        "pairs_total": 523776,
+        "pairs_probed": 17868,
+        "coverage_fraction": 17868 / 523776,
+        "coverage_under_5pct": True,
+        "oracle_bw_gbps": 26.0,
+        "sparse_bw_gbps": 15.0,
+        "blended_bw_gbps": 25.0,
+        "gain_ratio": 10.0 / 11.0,
+        "gain_target_met": True,
+        "bench_env": {"host": "x", "git_sha": "abc1234"},
+    }
+    detail.update(overrides.pop("detail", {}))
+    doc = {"metric": "topology_model", "value": round(10.0 / 11.0, 6),
+           "unit": "blended_gain_fraction_of_oracle", "seed": 0,
+           "detail": detail}
+    doc.update(overrides)
+    return doc
+
+
+def test_topology_clean_doc_passes():
+    assert bench_check.check_doc("topology.json", _topology_doc()) == []
+
+
+def test_topology_rules_fire():
+    # Missing seed: the run cannot be replayed.
+    fails = bench_check.check_doc(
+        "topology.json", _topology_doc(seed=None))
+    assert any("seed" in f for f in fails), fails
+    # Unattributable artifact (empty bench_env).
+    fails = bench_check.check_doc(
+        "topology.json", _topology_doc(detail={"bench_env": {}}))
+    assert any("bench_env" in f for f in fails), fails
+    # Coverage fraction must follow from the pair counts.
+    fails = bench_check.check_doc(
+        "topology.json", _topology_doc(detail={"coverage_fraction": 0.5}))
+    assert any("coverage_fraction" in f for f in fails), fails
+    # The under-5% flag must follow from the fraction.
+    fails = bench_check.check_doc(
+        "topology.json",
+        _topology_doc(detail={"coverage_under_5pct": False}))
+    assert any("coverage_under_5pct" in f for f in fails), fails
+    # gain_ratio must be re-derivable from the bandwidth fields.
+    fails = bench_check.check_doc(
+        "topology.json", _topology_doc(detail={"gain_ratio": 0.99}))
+    assert any("gain_ratio" in f for f in fails), fails
+    # The self-certifying pass flag must follow from the ratio.
+    fails = bench_check.check_doc(
+        "topology.json", _topology_doc(detail={
+            "blended_bw_gbps": 17.0, "gain_ratio": 2.0 / 11.0,
+            "gain_target_met": True}))
+    assert any("gain_target_met" in f for f in fails), fails

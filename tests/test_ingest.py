@@ -140,7 +140,11 @@ def test_probe_budget_and_staleness_priority():
     orch.advance_clock(60.0)
     # next cycle prefers never-probed pairs (15 total pairs, 10 left)
     assert orch.run_cycle(budget=10) == 10
-    assert len(orch.staleness()) == 15
+    stats = orch.staleness()
+    assert stats["tracked_pairs"] == 15.0
+    assert stats["total_pairs"] == 15.0
+    assert stats["coverage_fraction"] == 1.0
+    assert len(orch.staleness_pairs()) == 15
 
 
 def test_probe_failures_counted_not_fatal():

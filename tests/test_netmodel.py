@@ -1,0 +1,422 @@
+"""Learned topology model (netmodel/): convergence, blending,
+checkpointing, probe planning, and the no-recompilation bar.
+
+The convergence test is the subsystem's property test: on a 2-rack
+topology the low-rank bandwidth completion must recover the
+intra-vs-inter-rack ordering for pairs it has NEVER probed, from a
+probe budget covering only part of the pair space.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from kubernetesnetawarescheduler_tpu.bench.fakecluster import (
+    ClusterSpec,
+    build_fake_cluster,
+)
+from kubernetesnetawarescheduler_tpu.config import SchedulerConfig
+from kubernetesnetawarescheduler_tpu.core.checkpoint import (
+    load_checkpoint,
+    save_checkpoint,
+)
+from kubernetesnetawarescheduler_tpu.core.encode import Encoder
+from kubernetesnetawarescheduler_tpu.ingest.probe import (
+    FakeProber,
+    ProbeOrchestrator,
+)
+from kubernetesnetawarescheduler_tpu.k8s.types import Node
+from kubernetesnetawarescheduler_tpu.netmodel import (
+    EIGProbePlanner,
+    TopologyModel,
+)
+
+
+def _cfg(**kw):
+    base = dict(max_nodes=32, max_pods=4, max_peers=2,
+                enable_netmodel=True, netmodel_ring=4096,
+                netmodel_batch=128)
+    base.update(kw)
+    return SchedulerConfig(**base)
+
+
+def _make_encoder(cfg, names):
+    enc = Encoder(cfg)
+    for name in names:
+        enc.upsert_node(Node(name=name, capacity={"cpu": 4.0}))
+    return enc
+
+
+def _two_rack_setup(seed=0, num_nodes=32):
+    """One zone, two racks: truth bandwidth is bimodal (25 vs 10 Gbps
+    tiers), which is what the completion must separate."""
+    cluster, lat, bw = build_fake_cluster(
+        ClusterSpec(num_nodes=num_nodes, zones=1, racks_per_zone=2,
+                    jitter=0.05, seed=seed))
+    names = [n.name for n in cluster.list_nodes()]
+    return names, lat, bw
+
+
+def test_convergence_recovers_rack_structure():
+    """bw_hat must order intra-rack above inter-rack for >= 95% of the
+    pairs that were NEVER probed (pure generalization from the
+    embedding/factorization, not cache recall)."""
+    seed = 0
+    names, lat, bw = _two_rack_setup(seed=seed)
+    n = len(names)
+    cfg = _cfg()
+    enc = _make_encoder(cfg, names)
+    model = TopologyModel(cfg, seed=seed)
+    enc.attach_netmodel(model)
+    prober = FakeProber(names, lat, bw, noise=0.02, seed=seed)
+    orch = ProbeOrchestrator(enc, prober, names, model=model)
+    for _ in range(12):
+        orch.run_cycle(budget=16)
+        orch.advance_clock(60.0)
+    # Extra epochs over the same ring: the test pins generalization,
+    # not the per-cycle step budget.
+    for _ in range(10):
+        model.fit(40)
+
+    _lat_hat, bw_hat, _conf = model.predict()
+    probed = np.isfinite(model._last_obs[:n, :n])
+    intra = np.asarray(bw) > 15e9  # between the 25/10 Gbps tiers
+    iu, ju = np.triu_indices(n, 1)
+    unprobed = ~probed[iu, ju]
+    assert unprobed.sum() > 100  # the budget must NOT have swept all
+
+    # Threshold from PROBED pairs only (geometric mean of the two
+    # clusters' median predictions) — the unprobed side is held out.
+    pr_pred = bw_hat[iu, ju][~unprobed]
+    pr_intra = intra[iu, ju][~unprobed]
+    assert pr_intra.any() and (~pr_intra).any()
+    thresh = np.sqrt(np.median(pr_pred[pr_intra])
+                     * np.median(pr_pred[~pr_intra]))
+    pred_intra = bw_hat[iu, ju][unprobed] >= thresh
+    truth_intra = intra[iu, ju][unprobed]
+    accuracy = float((pred_intra == truth_intra).mean())
+    assert accuracy >= 0.95, f"unprobed-pair accuracy {accuracy:.3f}"
+
+
+def test_fit_reuses_one_compiled_step():
+    """Every refit must dispatch the SAME compiled program: static
+    batch shapes, no per-cycle recompilation."""
+    cfg = _cfg()
+    model = TopologyModel(cfg, seed=1)
+    rng = np.random.default_rng(0)
+    for k in range(50):
+        i, j = rng.integers(0, 16, 2)
+        if i != j:
+            model.observe(int(i), int(j), 0.5, 1e9, float(k))
+    for _ in range(10):
+        assert model.fit() == cfg.netmodel_steps
+    assert model._step._cache_size() == 1
+    assert model.steps_total == 10 * cfg.netmodel_steps
+
+
+def test_blend_fresh_probe_wins_and_unknown_keeps_raw():
+    cfg = _cfg()
+    model = TopologyModel(cfg, seed=2)
+    # Saturate confidence for nodes 0/1, leave 30/31 unknown.
+    for k in range(30):
+        model.observe(0, 1, 0.2, 20e9, float(k))
+    model.fit(50)
+    n = cfg.max_nodes
+    lat_p = np.zeros((n, n), np.float32)
+    bw_p = np.zeros((n, n), np.float32)
+    lat_p[0, 1] = lat_p[1, 0] = 7.0
+    bw_p[0, 1] = bw_p[1, 0] = 5e9
+    lat_b, bw_b = model.blend(lat_p, bw_p)
+    # (0, 1) was probed at the current clock: age 0 -> probe dominates.
+    assert abs(lat_b[0, 1] - 7.0) < 1e-3
+    assert abs(bw_b[0, 1] - 5e9) / 5e9 < 1e-3
+    # Never-probed pair between unknown nodes: raw value kept exactly.
+    assert bw_b[30, 31] == bw_p[30, 31] == 0.0
+    # Never-probed pair between KNOWN nodes: model fills it in.
+    for k in range(30):
+        model.observe(2, 3, 0.2, 20e9, float(k))
+        model.observe(0, 3, 0.2, 20e9, float(k))
+        model.observe(1, 2, 0.2, 20e9, float(k))
+    model.fit(100)
+    lat_b, bw_b = model.blend(lat_p, bw_p)
+    assert bw_b[0, 2] > 0.0  # (0, 2) never probed, both nodes known
+    # Diagonal stays the probe layer's.
+    assert bw_b[5, 5] == bw_p[5, 5]
+
+
+def test_disabled_model_is_bit_identical():
+    """enable_netmodel=False (the default) must leave snapshots
+    EXACTLY as they are without the subsystem."""
+    names = [f"n{i}" for i in range(8)]
+    cfg = SchedulerConfig(max_nodes=16, max_pods=4, max_peers=2)
+    assert not cfg.enable_netmodel
+    enc_plain = _make_encoder(cfg, names)
+    enc_model = _make_encoder(cfg, names)
+    model = TopologyModel(cfg, seed=3)
+    assert not model.enabled
+    enc_model.attach_netmodel(model)
+    rng = np.random.default_rng(0)
+    for _ in range(20):
+        i, j = rng.integers(0, 8, 2)
+        if i == j:
+            continue
+        lat, bw = float(rng.uniform(0.1, 2)), float(rng.uniform(1e9, 2e10))
+        for enc in (enc_plain, enc_model):
+            enc.update_link(names[i], names[j], lat_ms=lat, bw_bps=bw)
+    s_plain = enc_plain.snapshot()
+    s_model = enc_model.snapshot()
+    np.testing.assert_array_equal(np.asarray(s_plain.lat),
+                                  np.asarray(s_model.lat))
+    np.testing.assert_array_equal(np.asarray(s_plain.bw),
+                                  np.asarray(s_model.bw))
+
+
+def test_checkpoint_roundtrip_predicts_exactly(tmp_path):
+    """save -> restore -> predict must be EXACT (replicas restored from
+    the same checkpoint must agree bit-for-bit)."""
+    seed = 4
+    names, lat, bw = _two_rack_setup(seed=seed)
+    cfg = _cfg()
+    enc = _make_encoder(cfg, names)
+    model = TopologyModel(cfg, seed=seed)
+    enc.attach_netmodel(model)
+    prober = FakeProber(names, lat, bw, seed=seed)
+    orch = ProbeOrchestrator(enc, prober, names, model=model)
+    for _ in range(4):
+        orch.run_cycle(budget=24)
+        orch.advance_clock(60.0)
+
+    path = str(tmp_path / "ckpt")
+    save_checkpoint(path, enc)
+    enc2 = load_checkpoint(path, cfg)
+    model2 = enc2.netmodel
+    assert model2 is not None and model2 is not model
+    lat1, bw1, conf1 = model.predict()
+    lat2, bw2, conf2 = model2.predict()
+    np.testing.assert_array_equal(lat1, lat2)
+    np.testing.assert_array_equal(bw1, bw2)
+    np.testing.assert_array_equal(conf1, conf2)
+    # Blended snapshots agree too (same probe staging + same model).
+    s1, s2 = enc.snapshot(), enc2.snapshot()
+    np.testing.assert_array_equal(np.asarray(s1.bw), np.asarray(s2.bw))
+    assert model2.pairs_observed == model.pairs_observed
+    assert model2.steps_total == model.steps_total
+
+
+def test_checkpoint_shape_mismatch_starts_fresh(tmp_path):
+    cfg = _cfg()
+    model = TopologyModel(cfg, seed=0)
+    npz = str(tmp_path / "netmodel.npz")
+    model.save(npz)
+    with pytest.raises(ValueError):
+        TopologyModel.load(npz, _cfg(netmodel_rank=cfg.netmodel_rank + 1))
+
+
+def test_residual_monitor_flags_divergence():
+    """The two degradation channels (the serve.py Event feed):
+
+    - a measured pair whose NEW measurement moves sharply vs its
+      previous one flags on the measurement delta alone;
+    - a first-ever measurement is judged against the model, which
+      requires a doubled threshold AND a calibrated monitor —
+      node-count confidence saturates within a few probe cycles, so
+      without the calibration gate a freshly started model floods the
+      cluster with false LinkDegraded events.
+    """
+    cfg = _cfg(netmodel_resid_threshold=0.7, netmodel_resid_conf=0.5)
+    model = TopologyModel(cfg, seed=5)
+    for k in range(40):
+        model.observe(0, 1, 0.2, 20e9, float(k))
+        model.observe(1, 2, 0.2, 20e9, float(k))
+        model.observe(2, 3, 0.2, 20e9, float(k))
+        model.observe(0, 3, 0.2, 20e9, float(k))
+    model.fit(200)
+    assert model.drain_degradations() == []
+    # Nodes 1 and 3 are confident, but pair (1, 3) has never been
+    # measured and the monitor has seen too few post-fit residuals to
+    # know its own error level: a divergent first measurement must NOT
+    # flag (the first-minute false-positive storm guard).  It DOES
+    # give (1, 3) a last-measurement entry, so use a throwaway value
+    # close enough to the model that the later cliff still towers over
+    # both channels' thresholds.
+    model.observe(1, 3, 0.2, 20e9 / 8.0, 40.5)
+    assert model.drain_degradations() == []
+    # Accumulate a calibration window of healthy residuals against the
+    # fit model.
+    for k in range(43):
+        t = 41.0 + k
+        model.observe(0, 1, 0.2, 20e9, t)
+        model.observe(1, 2, 0.2, 20e9, t)
+        model.observe(2, 3, 0.2, 20e9, t)
+        model.fit(5)
+    assert model.drain_degradations() == []
+    before = model.degradations_total
+    # Channel 1: a measured pair falls off a cliff vs its previous
+    # measurement — flags with no model involvement.
+    model.observe(0, 1, 0.2, 20e9 / 50.0, 90.0)
+    records = model.drain_degradations()
+    assert len(records) == 1
+    i, j, pred_bps, meas_bps, _t = records[0]
+    assert (i, j) == (0, 1)
+    assert pred_bps > meas_bps
+    assert model.degradations_total == before + 1
+    assert model.drain_degradations() == []  # drained
+    # Channel 2: a calibrated model seeing a first measurement far
+    # below its prediction.  Pair (0, 2) was never measured; the model
+    # expects ~20 Gbps there (all training pairs sit at 20 Gbps).
+    model.observe(0, 2, 0.2, 20e9 / 50.0, 91.0)
+    records = model.drain_degradations()
+    assert len(records) == 1
+    assert (records[0][0], records[0][1]) == (0, 2)
+    p50, p99 = model.residual_quantiles()
+    assert np.isfinite(p50) and p99 >= p50
+
+
+def test_planner_prefers_uncertain_nodes():
+    """Exploit share must go to pairs among nodes the model has never
+    observed; the explore share comes from the stalest-first selector."""
+    cfg = _cfg(netmodel_explore_frac=0.25)
+    model = TopologyModel(cfg, seed=6)
+    # Nodes 0-3 heavily observed; 4-7 never.
+    for k in range(60):
+        for (i, j) in ((0, 1), (2, 3), (0, 2), (1, 3)):
+            model.observe(i, j, 0.2, 1e9, float(k))
+    model.advance_clock(600.0)
+    planner = EIGProbePlanner(model, explore_frac=0.25, seed=6)
+
+    def stalest(k):
+        return [(0, 1)][:k]
+
+    pairs = planner.select_pairs(8, 4, stalest)
+    assert len(pairs) == 4
+    assert len(set(pairs)) == 4
+    assert (0, 1) in pairs  # the explore share
+    exploit = [p for p in pairs if p != (0, 1)]
+    for (i, j) in exploit:
+        assert i >= 4 and j >= 4, f"picked low-uncertainty pair {(i, j)}"
+    assert planner.last_entropy_bits > 0.0
+    assert planner.selections_total == 4
+
+
+def test_planner_relevance_steers_selection():
+    cfg = _cfg(netmodel_explore_frac=0.0)
+    model = TopologyModel(cfg, seed=7)
+    model.advance_clock(600.0)
+    planner = EIGProbePlanner(model, explore_frac=0.0, seed=7)
+    for _ in range(20):
+        planner.note_placements([8, 9])
+    pairs = planner.select_pairs(12, 1, lambda k: [])
+    assert pairs == [(8, 9)]
+
+
+def test_orchestrator_planner_path_covers_budget():
+    names, lat, bw = _two_rack_setup(seed=8, num_nodes=16)
+    cfg = _cfg()
+    enc = _make_encoder(cfg, names)
+    model = TopologyModel(cfg, seed=8)
+    enc.attach_netmodel(model)
+    planner = EIGProbePlanner(model, explore_frac=0.25, seed=8)
+    prober = FakeProber(names, lat, bw, seed=8)
+    orch = ProbeOrchestrator(enc, prober, names,
+                             planner=planner, model=model)
+    assert orch.run_cycle(budget=10) == 10
+    orch.advance_clock(60.0)
+    assert orch.run_cycle(budget=10) == 10
+    stats = orch.staleness()
+    assert stats["tracked_pairs"] >= 10.0  # planner may re-pick pairs
+    assert 0.0 < stats["coverage_fraction"] <= 1.0
+    assert model.fits_total == 2
+
+
+def test_orchestrator_prunes_past_forget_horizon():
+    names = [f"n{i}" for i in range(6)]
+    cfg = SchedulerConfig(max_nodes=16, max_pods=4, max_peers=2)
+    enc = _make_encoder(cfg, names)
+    prober = FakeProber(names, np.ones((6, 6), np.float32),
+                        np.ones((6, 6), np.float32))
+    orch = ProbeOrchestrator(enc, prober, names, forget_s=100.0)
+    assert orch.run_cycle(budget=5) == 5
+    orch.advance_clock(60.0)
+    assert orch.staleness()["tracked_pairs"] == 5.0
+    assert orch.pruned_total == 0
+    orch.advance_clock(60.0)  # age 120 > 100: all five pruned
+    assert orch.staleness()["tracked_pairs"] == 0.0
+    assert orch.pruned_total == 5
+    assert np.isnan(orch.staleness()["mean_age_s"])
+
+
+def test_fake_prober_default_stream_unchanged_by_new_knobs():
+    """asymmetry/drift draw from offset-seeded generators: with the
+    knobs on, the MAIN noise stream (and so the latency sequence) must
+    be identical to the default prober's."""
+    names = ["a", "b", "c"]
+    lat = np.arange(9, dtype=np.float32).reshape(3, 3) + 1.0
+    bw = np.full((3, 3), 1e10, np.float32)
+    plain = FakeProber(names, lat, bw, seed=42)
+    fancy = FakeProber(names, lat, bw, seed=42, asymmetry=0.5, drift=0.1)
+    for (i, j) in ((0, 1), (1, 2), (0, 2), (0, 1)):
+        lp, bp = plain.probe(names[i], names[j])
+        lf, bf = fancy.probe(names[i], names[j])
+        assert lp == lf  # same main-RNG draws
+    assert plain.calls == fancy.calls
+
+
+def test_fake_prober_asymmetry_and_drift():
+    names = ["a", "b"]
+    lat = np.ones((2, 2), np.float32)
+    bw = np.full((2, 2), 1e10, np.float32)
+    p = FakeProber(names, lat, bw, noise=0.0, seed=1, asymmetry=0.4)
+    _, b_ab = p.probe("a", "b")
+    _, b_ba = p.probe("b", "a")
+    assert b_ab != b_ba  # directed skew
+    # Antisymmetric in log space: the skews cancel in the product.
+    assert abs(b_ab * b_ba - 1e20) / 1e20 < 1e-5
+    # Drift: deterministic under the seed, no-op before advance().
+    d1 = FakeProber(names, lat, bw, noise=0.0, seed=1, drift=0.2)
+    d2 = FakeProber(names, lat, bw, noise=0.0, seed=1, drift=0.2)
+    assert d1.probe("a", "b") == (1.0, 1e10)
+    d1.advance(3)
+    d2.advance(3)
+    assert d1.probe("a", "b") == d2.probe("a", "b") != (1.0, 1e10)
+
+
+def test_selfmetrics_exports_netmodel_series():
+    from kubernetesnetawarescheduler_tpu.bench.fakecluster import (
+        feed_metrics,
+    )
+    from kubernetesnetawarescheduler_tpu.core.loop import SchedulerLoop
+    from kubernetesnetawarescheduler_tpu.ingest.prometheus import (
+        parse_prometheus_text,
+    )
+    from kubernetesnetawarescheduler_tpu.utils.selfmetrics import (
+        render_metrics,
+    )
+
+    seed = 9
+    cfg = _cfg()
+    names, lat, bw = _two_rack_setup(seed=seed)
+    cluster, _, _ = build_fake_cluster(
+        ClusterSpec(num_nodes=len(names), seed=seed))
+    loop = SchedulerLoop(cluster, cfg)
+    feed_metrics(cluster, loop.encoder, np.random.default_rng(seed))
+    model = TopologyModel(cfg, seed=seed)
+    loop.encoder.attach_netmodel(model)
+    planner = EIGProbePlanner(model, seed=seed)
+    prober = FakeProber(names, lat, bw, seed=seed)
+    orch = ProbeOrchestrator(loop.encoder, prober, names,
+                             planner=planner, model=model)
+    loop.probe_planner = planner
+    loop.probe_orchestrator = orch
+    orch.run_cycle(budget=12)
+    body = render_metrics(loop)
+    parsed = parse_prometheus_text(body)
+    flat = {name: next(iter(series.values()))
+            for name, series in parsed.items() if len(series) == 1}
+    assert flat["netaware_netmodel_pair_coverage_fraction"] > 0.0
+    assert flat["netaware_netmodel_sgd_steps_total"] \
+        == model.steps_total > 0
+    assert "netaware_netmodel_probe_selection_entropy_bits" in flat
+    assert flat["netaware_probe_pair_coverage_fraction"] > 0.0
+    assert flat["netaware_probe_pairs_pruned_total"] == 0.0
+    assert flat["netaware_netmodel_link_degradations_total"] == 0.0

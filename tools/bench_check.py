@@ -124,6 +124,55 @@ def check_doc(path: str, doc: dict) -> list[str]:
                          "bench_env")
         return fails
 
+    # Rule 6 — topology_model artifacts (learned-topology leg,
+    # bench.py --suite topology): the headline gain_ratio is only
+    # evidence if it is REPLAYABLE (integer seed), ATTRIBUTABLE
+    # (non-empty bench_env), and SELF-CONSISTENT — the coverage
+    # fraction must follow from the recorded pair counts, and both
+    # pass/fail flags must follow from the doc's own numbers (the
+    # blocks self-certify, so the linter re-derives them).
+    if doc.get("metric") == "topology_model":
+        if not isinstance(doc.get("seed"), int):
+            fails.append(f"{name}: topology_model missing integer "
+                         "seed (run not replayable)")
+        tdetail = doc.get("detail")
+        if not isinstance(tdetail, dict) or not tdetail.get("bench_env"):
+            fails.append(f"{name}: topology_model missing/empty "
+                         "bench_env")
+            return fails
+        try:
+            probed = float(tdetail["pairs_probed"])
+            total = float(tdetail["pairs_total"])
+            cov = float(tdetail["coverage_fraction"])
+            oracle = float(tdetail["oracle_bw_gbps"])
+            sparse = float(tdetail["sparse_bw_gbps"])
+            blended = float(tdetail["blended_bw_gbps"])
+            ratio = float(tdetail["gain_ratio"])
+        except (KeyError, TypeError, ValueError):
+            fails.append(f"{name}: topology_model detail not numeric")
+            return fails
+        if total <= 0 or abs(cov - probed / total) > 1e-6:
+            fails.append(
+                f"{name}: coverage_fraction {cov} disagrees with "
+                f"pairs {probed}/{total}")
+        if bool(tdetail.get("coverage_under_5pct")) != (cov < 0.05):
+            fails.append(
+                f"{name}: coverage_under_5pct="
+                f"{tdetail.get('coverage_under_5pct')} disagrees "
+                f"with coverage_fraction {cov}")
+        denom = oracle - sparse
+        derived = ((blended - sparse) / denom) if denom > 0 else 1.0
+        if abs(derived - ratio) > 1e-3:
+            fails.append(
+                f"{name}: gain_ratio {ratio} disagrees with bw "
+                f"fields (derived {derived:.6f})")
+        if bool(tdetail.get("gain_target_met")) != (ratio >= 0.8):
+            fails.append(
+                f"{name}: gain_target_met="
+                f"{tdetail.get('gain_target_met')} disagrees with "
+                f"gain_ratio {ratio}")
+        return fails
+
     if headline is None:
         return fails
     detail = headline["detail"]
