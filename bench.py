@@ -362,6 +362,38 @@ def _assemble_doc(res, *, num_nodes: int, batch: int, method: str,
         # block from the serving loop's PhaseTimer: the artifact
         # carries the overlap structure on its face.
         detail["pipeline_budgets"] = budgets
+    if hasattr(res, "static_refresh_count"):
+        # Incremental device-resident state (r7): how the static was
+        # kept fresh during the measured window — refresh count +
+        # latency (off the serving critical path when async), the
+        # staleness of the static each Score() actually used vs its
+        # configured bound, and delta-vs-full snapshot upload bytes.
+        # count==1 with delta_bytes==0 means a churn-free run (the
+        # initial build only) — honest, not missing instrumentation.
+        detail["static_refresh"] = {
+            "count": int(res.static_refresh_count),
+            "p99_ms": round(res.static_refresh_p99_ms, 3),
+            "sync_builds": int(getattr(res, "static_sync_builds", 0)),
+            "staleness_at_score_p50_ms": round(
+                getattr(res, "staleness_at_score_p50_ms", 0.0), 3),
+            "staleness_at_score_p99_ms": round(
+                getattr(res, "staleness_at_score_p99_ms", 0.0), 3),
+            "staleness_bound_s": float(
+                getattr(res, "staleness_bound_s", 0.0)),
+            "delta_bytes": int(getattr(res, "delta_bytes", 0)),
+            "full_bytes": int(getattr(res, "full_bytes", 0)),
+        }
+    if hasattr(res, "bind_queue_wait_p99_ms"):
+        # Bind-tail split (r7): r5's 905.74 ms "bind_p99_ms" was drain
+        # serialization; this block says where bind time actually goes
+        # — queue wait (assignment fetched, binder busy), the
+        # un-normalized _bind_all round-trip, and transient retries.
+        detail["bind_split"] = {
+            "queue_wait_p99_ms": round(res.bind_queue_wait_p99_ms, 3),
+            "rtt_p99_ms": round(getattr(res, "bind_rtt_p99_ms", 0.0),
+                                3),
+            "retry_count": int(getattr(res, "bind_retry_count", 0)),
+        }
     if device_lat is not None:
         detail.update({
             "score_p50_ms": device_lat["p50_ms"],
@@ -673,6 +705,13 @@ def main() -> None:
     # non-tunneled deployment would see that directly).
     mode = os.environ.get("BENCH_MODE", "pipeline")
     chunk_batches = int(os.environ.get("BENCH_CHUNK_BATCHES", "16"))
+    # Seeded link-probe/metrics churn per serving cycle (r7): keeps
+    # static_version moving through the measured window so the
+    # artifact reports the incremental-refresh machinery under load
+    # (static_refresh block in detail).  BENCH_CHURN_LINKS=0 reverts
+    # to the churn-free drain.  Read from env by comparison-mode child
+    # legs too (env propagates through _run_backend_subprocess).
+    churn_links = int(os.environ.get("BENCH_CHURN_LINKS", "4"))
 
     # Score-kernel backend comparison (dense XLA vs tiled Pallas):
     # "both" runs the full workload under each and headlines the
@@ -784,7 +823,7 @@ def main() -> None:
                     num_nodes=num_nodes, num_pods=num_pods,
                     batch_size=batch, method=method, mode=mode,
                     chunk_batches=chunk_batches, score_backend=backend,
-                    mesh=mesh,
+                    mesh=mesh, churn_links=churn_links,
                     # Host mode defaults to the three-stage pipelined
                     # datapath (encode-ahead ∥ device step ∥ async
                     # bind); BENCH_HOST_PIPELINED=0 reverts to the

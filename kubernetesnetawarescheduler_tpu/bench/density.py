@@ -26,6 +26,7 @@ from kubernetesnetawarescheduler_tpu.bench.fakecluster import (
     build_fake_cluster,
     feed_metrics,
     generate_workload,
+    sample_metrics,
 )
 
 
@@ -62,6 +63,33 @@ class DensityResult:
     # from the serving loop's PhaseTimer — host mode only; artifacts
     # carry the overlap structure on their face.
     pipeline_budgets: dict = dataclasses.field(default_factory=dict)
+    # Incremental device-resident state (r7): static-refresh activity
+    # during the measured window.  With no churn (``churn_links=0``)
+    # only the initial build registers — static never moves after
+    # warmup and the near-zero count is the honest report, not a gap.
+    static_refresh_count: int = 0
+    static_refresh_p99_ms: float = 0.0
+    static_sync_builds: int = 0
+    # Staleness of the static actually served at each Score() call
+    # (0.0 for a current static; the async-refresh knobs bound it).
+    staleness_at_score_p50_ms: float = 0.0
+    staleness_at_score_p99_ms: float = 0.0
+    # The configured ceiling (cfg.static_max_staleness_s): breaching
+    # it forces a synchronous rebuild, so p99 above must sit under it.
+    staleness_bound_s: float = 0.0
+    # Host→device snapshot traffic: bytes moved by dirty-index scatter
+    # updates vs full-array re-uploads (the r5 regression was 100%
+    # full_bytes — one link probe re-uploaded the N×N matrices).
+    delta_bytes: int = 0
+    full_bytes: int = 0
+    # Bind-tail split (r7 satellite): r5 reported a 905.74 ms
+    # "bind_p99_ms" that was actually drain serialization.  Split the
+    # bind cost by cause: queue wait (assignment fetched, binder
+    # busy), client RTT (one _bind_all API round-trip, un-normalized),
+    # and transient-bind retries.
+    bind_queue_wait_p99_ms: float = 0.0
+    bind_rtt_p99_ms: float = 0.0
+    bind_retry_count: int = 0
 
     def to_dict(self) -> dict:
         return dataclasses.asdict(self)
@@ -78,6 +106,93 @@ def _percentile(samples, q: float) -> float:
 
 def _percentile_ms(samples, q: float) -> float:
     return _percentile(samples, q) * 1e3
+
+
+def _static_stats(loop: "SchedulerLoop") -> dict:
+    """Static-refresh and delta-ingest counters the serving loop and
+    its encoder accumulated over the run (all zero when static never
+    moved).  ``_static_refresh_ms`` samples are already milliseconds;
+    ``_staleness_samples`` are seconds."""
+    enc = loop.encoder
+    return {
+        "static_refresh_count": int(
+            getattr(loop, "static_refresh_total", 0)),
+        "static_refresh_p99_ms": round(_percentile(
+            list(getattr(loop, "_static_refresh_ms", ())), 99), 3),
+        "static_sync_builds": int(
+            getattr(loop, "static_sync_builds", 0)),
+        "staleness_at_score_p50_ms": round(_percentile_ms(
+            list(getattr(loop, "_staleness_samples", ())), 50), 3),
+        "staleness_at_score_p99_ms": round(_percentile_ms(
+            list(getattr(loop, "_staleness_samples", ())), 99), 3),
+        "delta_bytes": int(
+            getattr(enc, "snapshot_delta_bytes_total", 0)),
+        "full_bytes": int(
+            getattr(enc, "snapshot_full_bytes_total", 0)),
+    }
+
+
+def _churn_fn(encoder, node_names: list, rng: np.random.Generator,
+              churn_links: int):
+    """A zero-arg closure that perturbs ``churn_links`` random links
+    (probe results, ``update_link``) plus one node's metrics sample —
+    the steady measurement drizzle a live cluster sees, which keeps
+    ``static_version`` moving so the run exercises the delta-ingest +
+    incremental-refresh machinery instead of the churn-free drain
+    whose static is computed once and never again."""
+    n = len(node_names)
+
+    def tick() -> None:
+        for _ in range(churn_links):
+            i, j = rng.choice(n, size=2, replace=False)
+            encoder.update_link(
+                node_names[int(i)], node_names[int(j)],
+                lat_ms=float(rng.uniform(0.05, 2.0)),
+                bw_bps=float(rng.uniform(1e8, 1e10)))
+        encoder.update_metrics(node_names[int(rng.integers(n))],
+                               sample_metrics(rng))
+
+    return tick
+
+
+def _warm_churn_path(loop: "SchedulerLoop", churn_tick,
+                     ticks: int = 3) -> None:
+    """Pay the delta-ingest / incremental-refresh jit compiles outside
+    the timed window (pow2-padded scatter shapes, the delta static
+    path — distinct executables from the full-rebuild warmup), then
+    zero the refresh counters so the artifact's static_refresh block
+    covers the measured steady state only."""
+    for _ in range(ticks):
+        churn_tick()
+        st, ver = loop.encoder.snapshot_versioned()
+        loop._static_for(st, ver)
+    # Drain any queued async rebuild; the measured run restarts the
+    # worker on first use (_ensure_static_worker clears the stop flag).
+    loop.stop_static_refresher()
+    loop.static_refresh_total = 0
+    loop.static_sync_builds = 0
+    loop._static_refresh_ms.clear()
+    loop._staleness_samples.clear()
+    loop.encoder.snapshot_delta_bytes_total = 0
+    loop.encoder.snapshot_full_bytes_total = 0
+
+
+def _drain_with_churn(loop: "SchedulerLoop", churn_tick,
+                      max_cycles: int = 10_000) -> int:
+    """``run_until_drained`` with churn injected between cycles (host
+    mode): every serving cycle is preceded by one churn tick, so each
+    ``snapshot_versioned`` sees a moved static version and
+    ``_static_for`` runs its refresh path inside the timed window."""
+    total = 0
+    for _ in range(max_cycles):
+        churn_tick()
+        n = loop.run_once(timeout=0.0)
+        if n == 0 and len(loop.queue) == 0:
+            loop.flush_binds()
+            if len(loop.queue) == 0:
+                break
+        total += n
+    return total
 
 
 from kubernetesnetawarescheduler_tpu.core.state import round_up as _round_up
@@ -145,7 +260,8 @@ def run_density(num_nodes: int = 100, num_pods: int = 300,
                 chunk_batches: int = 2,
                 score_backend: str = "xla",
                 sampler=None, mesh=None,
-                pipelined: bool = False) -> DensityResult:
+                pipelined: bool = False,
+                churn_links: int = 0) -> DensityResult:
     """Schedule ``num_pods`` generated pods onto a ``num_nodes`` fake
     cluster; returns throughput/latency stats (compile excluded via a
     warmup cycle).
@@ -164,7 +280,19 @@ def run_density(num_nodes: int = 100, num_pods: int = 300,
     ``sampler``, if given, must have a ``start()`` method; it is started
     after warmup/compilation so resource sampling covers only the
     measured serving window (the clusterloader2 analogy: samples are of
-    the serving scheduler, not of XLA compiling)."""
+    the serving scheduler, not of XLA compiling).
+
+    ``churn_links`` > 0 injects seeded link-probe + metrics churn into
+    the measured window (one tick per serving cycle in host mode, one
+    per chunk arrival in pipeline mode, one per bind batch in device
+    mode), so ``static_version`` keeps moving and the run measures the
+    incremental-refresh machinery a live deployment exercises —
+    reported via the ``static_refresh_*``/``staleness_*``/``*_bytes``
+    result fields.  The default cfg then also turns on
+    ``enable_async_static`` (churn with synchronous rebuilds would put
+    every refresh back on the serving critical path — the exact r5
+    regression this bench exists to detect); an explicitly passed cfg
+    keeps its own setting."""
     if cfg is None:
         cfg = SchedulerConfig(
             max_nodes=_round_up(num_nodes, 128),
@@ -172,6 +300,7 @@ def run_density(num_nodes: int = 100, num_pods: int = 300,
             max_peers=4,
             queue_capacity=max(300, num_pods + batch_size),
             score_backend=score_backend,
+            enable_async_static=(churn_links > 0),
         )
     cluster, lat, bw = build_fake_cluster(ClusterSpec(num_nodes=num_nodes,
                                                       seed=seed))
@@ -194,7 +323,7 @@ def run_density(num_nodes: int = 100, num_pods: int = 300,
                                    num_nodes, seed, warmup, sampler,
                                    chunk_batches=chunk_batches,
                                    pipeline=(mode == "pipeline"),
-                                   mesh=mesh)
+                                   mesh=mesh, churn_links=churn_links)
 
     if warmup:
         wloop = _throwaway_loop(num_nodes, seed, cfg, method)
@@ -222,17 +351,31 @@ def run_density(num_nodes: int = 100, num_pods: int = 300,
             wloop.client.add_pods(warm)
             wloop.run_until_drained()
 
+    churn_tick = None
+    if churn_links > 0:
+        churn_tick = _churn_fn(
+            loop.encoder, [n.name for n in cluster.list_nodes()],
+            np.random.default_rng(seed + 13), churn_links)
+        if warmup:
+            _warm_churn_path(loop, churn_tick)
     if sampler is not None:
         sampler.start()
     start = time.perf_counter()
     cluster.add_pods(pods)
-    loop.run_until_drained()
+    if churn_tick is not None:
+        _drain_with_churn(loop, churn_tick)
+    else:
+        loop.run_until_drained()
     if pipelined:
         # Bind confirmations land on the worker; the drain above
         # already flushed, but make the completion explicit so wall
         # covers every bind.
         loop.flush_binds()
     wall = time.perf_counter() - start
+    # Quiesce the background refresher (off the timed window — its
+    # whole point is to be off the critical path) so the refresh
+    # counters below are final.
+    loop.stop_static_refresher()
 
     bound = loop.scheduled
     return DensityResult(
@@ -248,6 +391,10 @@ def run_density(num_nodes: int = 100, num_pods: int = 300,
         bind_p99_ms=loop.timer.percentile("bind", 99) * 1e3,
         score_samples=loop.timer.count("score_assign"),
         pipeline_budgets=loop.timer.pipeline_budgets(),
+        bind_rtt_p99_ms=loop.timer.percentile("bind", 99) * 1e3,
+        bind_retry_count=int(loop.bind_failures),
+        staleness_bound_s=float(cfg.static_max_staleness_s),
+        **_static_stats(loop),
     )
 
 
@@ -256,7 +403,8 @@ def _run_density_device(cluster, loop: SchedulerLoop, pods, cfg,
                         warmup: bool, sampler=None,
                         chunk_batches: int = 2,
                         pipeline: bool = False,
-                        mesh=None) -> DensityResult:
+                        mesh=None,
+                        churn_links: int = 0) -> DensityResult:
     """Device-resident drain, two strategies sharing one harness.
 
     ``pipeline=False`` — whole-workload replay: ONE dispatch, one
@@ -358,6 +506,23 @@ def _run_density_device(cluster, loop: SchedulerLoop, pods, cfg,
 
     jax.block_until_ready(state)
 
+    # Seeded churn (one tick per chunk arrival / bind batch): routes
+    # fresh probe results through the serving loop's own
+    # snapshot/_static_for path concurrently with the device drain, so
+    # the run measures delta ingest + incremental refresh under load.
+    # Assignments are unaffected — the replay consumes the state
+    # uploaded above.
+    churn_tick = None
+    if churn_links > 0:
+        churn_tick = _churn_fn(
+            loop.encoder, [n.name for n in cluster.list_nodes()],
+            np.random.default_rng(seed + 13), churn_links)
+
+    def _churn_refresh():
+        churn_tick()
+        st, ver = loop.encoder.snapshot_versioned()
+        loop._static_for(st, ver)
+
     if warmup:
         # Warm the host encode path against a throwaway ENCODER (so
         # the measured encode is warm Python, not first-touch
@@ -391,6 +556,8 @@ def _run_density_device(cluster, loop: SchedulerLoop, pods, cfg,
             wassign, _, _ = replay_stream(state, wstream, cfg, method,
                                           with_stats=True)
             np.asarray(wassign)
+        if churn_tick is not None:
+            _warm_churn_path(loop, churn_tick)
     if sampler is not None:
         sampler.start()
 
@@ -404,19 +571,26 @@ def _run_density_device(cluster, loop: SchedulerLoop, pods, cfg,
     # last fetch, which r5 reported as "bind_p99_ms" (905.74 ms at
     # N=5120: almost entirely drain serialization, not bind work).
     bind_times: list[float] = []
+    # Bind-tail split: time each chunk's assignment sat in the work
+    # queue before the binder picked it up, and the un-normalized wall
+    # of each _bind_all round-trip (the "client RTT" share).
+    queue_waits: list[float] = []
+    rtt_times: list[float] = []
 
     def binder():
         while True:
             item = work.get()
             if item is None:
                 return
-            chunk_pods, assignment = item
+            t_enq, chunk_pods, assignment = item
+            queue_waits.append(time.perf_counter() - t_enq)
             try:
                 tb = time.perf_counter()
                 bound_total[0] += loop._bind_all(chunk_pods, assignment)
+                rtt = time.perf_counter() - tb
+                rtt_times.append(rtt)
                 per_batch = max(1, -(-len(chunk_pods) // cfg.max_pods))
-                bind_times.append(
-                    (time.perf_counter() - tb) / per_batch)
+                bind_times.append(rtt / per_batch)
             except BaseException as exc:  # noqa: BLE001 — re-raised
                 # after join: a dead binder must fail the benchmark,
                 # not silently understate pods_bound.
@@ -506,10 +680,14 @@ def _run_density_device(cluster, loop: SchedulerLoop, pods, cfg,
             chunk_times.append((now - prev) / batches_in_chunk)
             prev = now
             end = min(pod_start + len(assignment), len(queued))
-            if pod_start >= end:
-                continue
-            work.put((queued[pod_start:end],
-                      assignment[:end - pod_start]))
+            if pod_start < end:
+                work.put((time.perf_counter(), queued[pod_start:end],
+                          assignment[:end - pod_start]))
+            if churn_tick is not None:
+                # Host-side ingest + refresh handoff between fetches —
+                # lands in the next chunk sample, exactly where a
+                # serving cycle pays it.
+                _churn_refresh()
         device_span = time.perf_counter() - start - encode_wall
         work.put(None)
         t.join()
@@ -534,8 +712,15 @@ def _run_density_device(cluster, loop: SchedulerLoop, pods, cfg,
             tb = time.perf_counter()
             bound += loop._bind_all(queued[a:a + cfg.max_pods],
                                     assignment[a:a + cfg.max_pods])
-            bind_times.append(time.perf_counter() - tb)
+            rtt = time.perf_counter() - tb
+            bind_times.append(rtt)
+            rtt_times.append(rtt)
+            if churn_tick is not None:
+                _churn_refresh()
     wall = time.perf_counter() - start
+    # Quiesce the background refresher off the timed window so the
+    # refresh counters below are final.
+    loop.stop_static_refresher()
 
     if chunk_times:
         score_p50 = _percentile_ms(chunk_times, 50)
@@ -562,6 +747,12 @@ def _run_density_device(cluster, loop: SchedulerLoop, pods, cfg,
         rounds_max=max(round_samples, default=0),
         bind_tail_ms=round(
             max(0.0, wall - device_span - encode_wall) * 1e3, 3),
+        bind_queue_wait_p99_ms=round(
+            _percentile_ms(queue_waits, 99), 3),
+        bind_rtt_p99_ms=round(_percentile_ms(rtt_times, 99), 3),
+        bind_retry_count=int(loop.bind_failures),
+        staleness_bound_s=float(cfg.static_max_staleness_s),
+        **_static_stats(loop),
     )
 
 

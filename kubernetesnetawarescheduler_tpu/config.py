@@ -341,6 +341,40 @@ class SchedulerConfig:
     api_backoff_base_s: float = 0.05
     api_backoff_max_s: float = 2.0
 
+    # ---- incremental device-resident state (core/encode.py,
+    # core/score.py, core/loop.py) ----
+    # Delta ingest + delta static refresh: the encoder tracks WHICH
+    # rows/(i, j) pairs each mutation touched and snapshot() scatter-
+    # updates just those indices into the previous device pytree;
+    # the assign-static rebuild likewise patches only dirty entries of
+    # the prepared N x N desirability pack, keeping the bw/lat
+    # normalizers as running extrema (full re-scan only when an
+    # extremum-holding entry retreats).  Both paths are bit-identical
+    # to a from-scratch rebuild (property-tested); False restores the
+    # full-group-transfer/full-rebuild behavior exactly.
+    enable_delta_state: bool = True
+
+    # Dirty-fraction escalation threshold: when more than this
+    # fraction of a snapshot group's rows (or, for net, N*N pairs) is
+    # dirty, upload the whole group instead of scattering — past that
+    # point one contiguous transfer beats many scattered ones.
+    delta_full_fraction: float = 0.25
+
+    # Off-critical-path assign-static refresh: when True, the serving
+    # loop's _static_for never blocks a batch on the O(N^2) static
+    # rebuild — batches keep scoring against the last static while a
+    # background thread builds the new one.  Off by default: serving
+    # output becomes (boundedly) stale-tolerant, which changes
+    # placement timing; benches and serve.py opt in explicitly.
+    enable_async_static: bool = False
+
+    # Staleness contract for the async refresh: a batch may score
+    # against a stale static for at most this many seconds / encoder
+    # static_versions, after which _static_for falls back to a
+    # synchronous (blocking) rebuild on the serving thread.
+    static_max_staleness_s: float = 0.25
+    static_max_versions_behind: int = 8
+
     def __post_init__(self) -> None:
         if self.max_nodes <= 0 or self.max_pods <= 0 or self.max_peers <= 0:
             raise ValueError("shape limits must be positive")
@@ -392,6 +426,12 @@ class SchedulerConfig:
             raise ValueError("netmodel_explore_frac must be in [0, 1]")
         if self.probe_forget_s < 0:
             raise ValueError("probe_forget_s must be >= 0")
+        if not 0.0 < self.delta_full_fraction <= 1.0:
+            raise ValueError("delta_full_fraction must be in (0, 1]")
+        if self.static_max_staleness_s <= 0:
+            raise ValueError("static_max_staleness_s must be > 0")
+        if self.static_max_versions_behind < 1:
+            raise ValueError("static_max_versions_behind must be >= 1")
 
 
 # ---------------------------------------------------------------------------

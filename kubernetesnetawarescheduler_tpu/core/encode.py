@@ -19,6 +19,7 @@ import threading
 import time
 from typing import Callable, Iterable, Mapping, NamedTuple, Sequence
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -30,6 +31,39 @@ from kubernetesnetawarescheduler_tpu.config import (
 from kubernetesnetawarescheduler_tpu.core.gang import gang_key_of
 from kubernetesnetawarescheduler_tpu.core.state import ClusterState, PodBatch
 from kubernetesnetawarescheduler_tpu.k8s.types import Node, Pod
+
+# Past this many dirty indices the per-index bookkeeping costs more
+# than it saves; the group collapses to the "full" sentinel.
+_DELTA_MAX_INDICES = 65536
+
+
+@jax.jit
+def _scatter_rows(dev, idx, vals):
+    """Patch rows ``idx`` of a device-resident array.  NOT donated:
+    previously returned snapshots alias the old buffer and must stay
+    readable (the serving loop may still be scoring against them)."""
+    return dev.at[idx].set(vals)
+
+
+@jax.jit
+def _scatter_pairs(dev, ii, jj, vals):
+    """Patch elements ``(ii, jj)`` of a device-resident matrix (same
+    aliasing contract as :func:`_scatter_rows`)."""
+    return dev.at[ii, jj].set(vals)
+
+
+def _pad_pow2(idx: np.ndarray) -> np.ndarray:
+    """Pad an index vector to the next power of two by repeating its
+    first element (duplicate scatter indices carrying the same value
+    are safe for ``.set``), bounding jit recompiles to O(log n) index
+    shapes per (array shape, dtype)."""
+    n = len(idx)
+    cap = 1
+    while cap < n:
+        cap *= 2
+    if cap == n:
+        return idx
+    return np.concatenate([idx, np.full(cap - n, idx[0], idx.dtype)])
 
 
 # The top bit of the last mask word is reserved: never assigned to a
@@ -397,11 +431,31 @@ class Encoder:
         # actually moved them.
         self._dirty = {"metrics": True, "net": True, "alloc": True,
                        "topo": True}
+        # Per-group dirty INDEX sets refining the booleans above: node
+        # rows for metrics/alloc/topo, (i, j) element pairs for net.
+        # ``None`` is the "full" sentinel — the whole group must be
+        # re-uploaded (bulk rewrite, overflow past _DELTA_MAX_INDICES,
+        # or a mutation whose footprint isn't row-shaped).  Start full:
+        # the first snapshot has no device cache to patch.
+        self._dirty_rows: dict = {"metrics": None, "alloc": None,
+                                  "topo": None}
+        self._dirty_pairs: "set | None" = None
         self._cache: dict[str, jnp.ndarray] = {}
+        # Host->device transfer accounting for the delta-ingest path
+        # (bytes actually shipped, padded scatter payloads included).
+        self.snapshot_delta_bytes_total = 0
+        self.snapshot_full_bytes_total = 0
         # Monotonic counter of static-score-input rebuilds (metrics/
         # net/topo snapshot groups); see snapshot() and
         # static_version.
         self._static_version = 0
+        # Per-version delta descriptors for static consumers
+        # (static_delta_since): one entry per static_version bump,
+        # capturing which static groups moved and, for net, WHICH
+        # (i, j) pairs (None = full).  Bounded: a consumer more than
+        # maxlen versions behind gets a gap -> full rebuild.
+        from collections import deque as _deque
+        self._static_deltas: "_deque" = _deque(maxlen=128)
         # Pods whose constraints were degraded by interner overflow
         # ((namespace, name, dropped_count) tuples, bounded), drained
         # by the loop into per-pod Warning events.  ``_degraded_seen``
@@ -492,8 +546,8 @@ class Encoder:
             _fill_words(self._taint_bits[idx],
                         self.taints.mask(node.taints))
             self._node_zone[idx] = self._intern_zone(node)
-            self._dirty["topo"] = True
-            self._dirty["alloc"] = True
+            self._mark_rows("topo", idx)
+            self._mark_rows("alloc", idx)
             return idx
 
     def _intern_zone(self, node: Node) -> int:
@@ -593,7 +647,7 @@ class Encoder:
         for idx in self._label_keys.get(key, ()):
             self._node_numeric[idx, col] = self._parse_numeric_label(
                 self._node_labels.get(idx, ()), key)
-        self._dirty["topo"] = True
+        self._mark_rows("topo", *self._label_keys.get(key, ()))
         return col
 
     def _selector_mask(self, keys: Iterable[str], lenient: bool) -> int:
@@ -615,7 +669,7 @@ class Encoder:
                     word, pos = divmod(table[key], 32)
                     for idx in carriers:
                         self._label_bits[idx, word] |= np.uint32(1 << pos)
-                    self._dirty["topo"] = True
+                    self._mark_rows("topo", *carriers)
         return out
 
     def _presence_mask(self, keys: Iterable[str], lenient: bool) -> int:
@@ -637,7 +691,7 @@ class Encoder:
                     word, pos = divmod(table[key], 32)
                     for idx in carriers:
                         self._label_bits[idx, word] |= np.uint32(1 << pos)
-                    self._dirty["topo"] = True
+                    self._mark_rows("topo", *carriers)
         return out
 
     def mark_unready(self, name: str) -> None:
@@ -650,7 +704,7 @@ class Encoder:
             if idx is None:
                 return
             self._node_valid[idx] = False
-            self._dirty["topo"] = True
+            self._mark_rows("topo", idx)
 
     def remove_node(self, name: str) -> None:
         """Node DELETED: free the slot for reuse.
@@ -706,8 +760,13 @@ class Encoder:
             self._node_names[idx] = ""
             self._node_gen[idx] += 1
             self._free_slots.append(idx)
-            for key in self._dirty:
-                self._dirty[key] = True
+            # Row-shaped dirt for the row groups; the net clear is a
+            # full row AND column — rare enough (node DELETED) that a
+            # full net re-upload beats tracking 2N pairs.
+            self._mark_rows("metrics", idx)
+            self._mark_rows("alloc", idx)
+            self._mark_rows("topo", idx)
+            self._mark_full("net")
 
     def is_committed(self, uid: str) -> bool:
         """Whether a pod's usage is in the ledger (cheap duplicate
@@ -753,9 +812,8 @@ class Encoder:
                 rec = self._committed.pop(uid, None)
                 if rec is not None:
                     self._release_record(rec)
+                    self._mark_rows("alloc", rec.node)
                     n += 1
-            if n:
-                self._dirty["alloc"] = True
         return n
 
     def gang_members(self, gang_key: str) -> list[tuple[str, "CommitRecord"]]:
@@ -814,7 +872,7 @@ class Encoder:
             if idx is None:
                 return
             self._node_valid[idx] = True
-            self._dirty["topo"] = True
+            self._mark_rows("topo", idx)
 
     # -- telemetry ----------------------------------------------------
 
@@ -838,12 +896,14 @@ class Encoder:
                         any_ok = True
             if any_ok:
                 self._metrics_age[idx] = age_s
-                self._dirty["metrics"] = True
+                self._mark_rows("metrics", idx)
 
     def age_metrics(self, dt_s: float) -> None:
         with self._lock:
+            # Every valid node's age moves: full-group dirt (the
+            # metrics group is O(N x M) small — not worth indexing).
             self._metrics_age[self._node_valid] += dt_s
-            self._dirty["metrics"] = True
+            self._mark_full("metrics")
 
     def update_link(self, a: str, b: str, lat_ms: float | None = None,
                     bw_bps: float | None = None) -> None:
@@ -858,7 +918,8 @@ class Encoder:
                 self._lat[i, j] = self._lat[j, i] = lat_ms
             if bw_bps is not None and np.isfinite(bw_bps) and bw_bps >= 0:
                 self._bw[i, j] = self._bw[j, i] = bw_bps
-            self._dirty["net"] = True
+            self._mark_pair(i, j)
+            self._mark_pair(j, i)
 
     def set_network(self, lat_ms: np.ndarray, bw_bps: np.ndarray) -> None:
         """Bulk-load full matrices (fake-cluster generator path)."""
@@ -866,21 +927,21 @@ class Encoder:
             k = lat_ms.shape[0]
             self._lat[:k, :k] = lat_ms
             self._bw[:k, :k] = bw_bps
-            self._dirty["net"] = True
+            self._mark_full("net")
 
     def attach_netmodel(self, model) -> None:
         """Attach a :class:`~..netmodel.TopologyModel`; the next net
         snapshot flush blends its predictions (if enabled)."""
         with self._lock:
             self.netmodel = model
-            self._dirty["net"] = True
+            self._mark_full("net")
 
     def touch_net(self) -> None:
         """Mark the net group dirty without a probe write — used after
         a model refit, whose new predictions change the BLENDED
         matrices even though no staging entry moved."""
         with self._lock:
-            self._dirty["net"] = True
+            self._mark_full("net")
 
     # -- allocation ---------------------------------------------------
     #
@@ -1016,7 +1077,7 @@ class Encoder:
                         rec.zanti_bits, w)
                     self._ref_add(self._az_anti_refs, rec.zone,
                                   rec.zanti_bits)
-            self._dirty["alloc"] = True
+            self._mark_rows("alloc", *(int(i) for i in idx[keep]))
 
     def release(self, pod: Pod, node_name: str = "",
                 rollback: bool = False) -> None:
@@ -1056,7 +1117,7 @@ class Encoder:
                         next(iter(self._early_releases))]
                 return
             self._release_record(rec)
-            self._dirty["alloc"] = True
+            self._mark_rows("alloc", rec.node)
 
     def _release_record(self, rec: CommitRecord) -> None:
         """Reverse one ledger record (caller holds the lock)."""
@@ -1100,11 +1161,13 @@ class Encoder:
             self._group_member_counts[slot] = max(
                 0, self._group_member_counts[slot] - 1)
         if member:
-            self._dirty["alloc"] = True
+            # Count-only dirt: gz/member counts ship whole whenever
+            # the alloc group is dirty, so no row index is needed.
+            self._mark_rows("alloc")
         if not rec.member_bits and rec.group_slot >= 0 and rec.zone >= 0:
             self._gz_counts[rec.group_slot, rec.zone] = max(
                 0, self._gz_counts[rec.group_slot, rec.zone] - 1)
-            self._dirty["alloc"] = True
+            self._mark_rows("alloc")
 
     @staticmethod
     def _ref_add(refs: np.ndarray, node: int, bits: int) -> None:
@@ -1144,7 +1207,7 @@ class Encoder:
             req = _requests_vector(requests, self.cfg.num_resources)
             self._nominations[uid] = (idx, req, time.monotonic())
             self._reserved[idx] += req
-            self._dirty["alloc"] = True
+            self._mark_rows("alloc", idx)
 
     def _drop_nomination_locked(self, uid: str) -> None:
         entry = self._nominations.pop(uid, None)
@@ -1152,7 +1215,7 @@ class Encoder:
             idx, req, _ = entry
             self._reserved[idx] = np.maximum(
                 self._reserved[idx] - req, 0.0)
-            self._dirty["alloc"] = True
+            self._mark_rows("alloc", idx)
 
     def _drop_nomination(self, uid: str) -> None:
         with self._lock:
@@ -1195,7 +1258,9 @@ class Encoder:
             stale = [u for u, rec in self._committed.items()
                      if u not in alive and rec.stamp < cutoff]
             for uid in stale:
-                self._release_record(self._committed.pop(uid))
+                rec = self._committed.pop(uid)
+                self._release_record(rec)
+                self._mark_rows("alloc", rec.node)
                 self._terminating.discard(uid)
                 released += 1
             # Terminating markers must track the ledger.
@@ -1205,9 +1270,113 @@ class Encoder:
             for uid in [u for u in self._early_releases
                         if u not in alive]:
                 del self._early_releases[uid]
-            if released:
-                self._dirty["alloc"] = True
         return released
+
+    # -- delta-ingest bookkeeping -------------------------------------
+
+    def _mark_rows(self, group: str, *rows: int) -> None:
+        """Mark ``group`` dirty at node rows ``rows``.  No rows means
+        flag-only dirt (e.g. the zone-count sidecars of the alloc
+        group, which are always shipped whole).  Caller holds the
+        lock."""
+        self._dirty[group] = True
+        s = self._dirty_rows[group]
+        if s is not None:
+            s.update(rows)
+            if len(s) > _DELTA_MAX_INDICES:
+                self._dirty_rows[group] = None
+
+    def _mark_full(self, group: str) -> None:
+        """Mark ``group`` dirty for a full re-upload: bulk rewrites
+        (interner backfill, set_network) or footprints the row/pair
+        protocol cannot express.  Caller holds the lock."""
+        self._dirty[group] = True
+        if group == "net":
+            self._dirty_pairs = None
+        else:
+            self._dirty_rows[group] = None
+
+    def _mark_pair(self, i: int, j: int) -> None:
+        """Mark net element (i, j) dirty.  DIRECTED — symmetric
+        writers mark both orientations.  Caller holds the lock."""
+        self._dirty["net"] = True
+        if self._dirty_pairs is not None:
+            self._dirty_pairs.add((int(i), int(j)))
+            if len(self._dirty_pairs) > _DELTA_MAX_INDICES:
+                self._dirty_pairs = None
+
+    def _rows_idx(self, group: str, n: int,
+                  delta_on: bool) -> "np.ndarray | None":
+        """Resolve a dirty group to a scatter row-index vector, or
+        None to force a full upload (delta disabled, no device cache
+        yet, full sentinel, or past the dirty-fraction escalation
+        knob — scattering most of the array costs more than one
+        contiguous transfer)."""
+        rows = self._dirty_rows[group]
+        if (not delta_on or rows is None
+                or len(rows) > self.cfg.delta_full_fraction * n):
+            return None
+        return np.array(sorted(rows), np.int32)
+
+    def _full_up(self, key: str, host) -> None:
+        """Full-group transfer of one cached array (+accounting)."""
+        arr = jnp.asarray(host)
+        self._cache[key] = arr
+        self.snapshot_full_bytes_total += int(arr.nbytes)
+
+    def _rows_up(self, key: str, idx: np.ndarray, host) -> None:
+        """Scatter-patch rows ``idx`` of one cached array from its
+        host staging twin (+accounting; ships the padded payload)."""
+        pidx = _pad_pow2(idx)
+        vals = jnp.asarray(np.ascontiguousarray(host[pidx]))
+        self._cache[key] = _scatter_rows(
+            self._cache[key], jnp.asarray(pidx), vals)
+        self.snapshot_delta_bytes_total += int(vals.nbytes + pidx.nbytes)
+
+    def _pairs_up(self, key: str, ii: np.ndarray, jj: np.ndarray,
+                  host) -> None:
+        """Scatter-patch elements (ii, jj) of one cached matrix."""
+        vals = jnp.asarray(np.ascontiguousarray(host[ii, jj]))
+        self._cache[key] = _scatter_pairs(
+            self._cache[key], jnp.asarray(ii), jnp.asarray(jj), vals)
+        self.snapshot_delta_bytes_total += int(
+            vals.nbytes + ii.nbytes + jj.nbytes)
+
+    def static_delta_since(self, version: int) -> "dict | None":
+        """Merged static-input dirty descriptor covering
+        ``(version, current_static_version]``.
+
+        Returns None when the bounded per-version history cannot prove
+        coverage (consumer too many versions behind, or delta tracking
+        disabled) — the caller must rebuild its static prep from
+        scratch.  Otherwise a dict with ``metrics``/``topo``/``net``
+        booleans and ``net_pairs``: the union of dirty (i, j) net
+        elements across the span, or None meaning the whole net group
+        moved (bulk rewrite / netmodel blend, which is global)."""
+        with self._lock:
+            cur = self._static_version
+            if version == cur:
+                return {"metrics": False, "topo": False, "net": False,
+                        "net_pairs": frozenset()}
+            ents = [(v, d) for v, d in self._static_deltas
+                    if v > version]
+            if version > cur or len(ents) != cur - version:
+                return None
+            metrics = topo = net = False
+            pairs: "set | None" = set()
+            for _, d in ents:
+                metrics = metrics or d["metrics"]
+                topo = topo or d["topo"]
+                if d["net"]:
+                    net = True
+                    if pairs is not None:
+                        if d["net_pairs"] is None:
+                            pairs = None
+                        else:
+                            pairs |= d["net_pairs"]
+            return {"metrics": metrics, "topo": topo, "net": net,
+                    "net_pairs": (None if pairs is None
+                                  else frozenset(pairs))}
 
     # -- snapshot -----------------------------------------------------
 
@@ -1233,42 +1402,118 @@ class Encoder:
             # batch-invariant score prep held by serving paths (the
             # extender batcher keys on this counter — an explicit
             # contract, not reliance on array-object reuse).
-            if (self._dirty["metrics"] or self._dirty["net"]
-                    or self._dirty["topo"]):
+            static_bumped = (self._dirty["metrics"] or self._dirty["net"]
+                             or self._dirty["topo"])
+            if static_bumped:
                 self._static_version += 1
+            model = self.netmodel
+            net_blend = model is not None and model.enabled
+            if static_bumped and self.cfg.enable_delta_state:
+                # Record this version's dirty footprint for static
+                # consumers (static_delta_since).  The netmodel blend
+                # mixes every element regardless of which probes moved,
+                # so its net footprint is always "full".
+                pairs = self._dirty_pairs
+                self._static_deltas.append((self._static_version, {
+                    "metrics": self._dirty["metrics"],
+                    "topo": self._dirty["topo"],
+                    "net": self._dirty["net"],
+                    # Empty pairs with the net flag up = boolean-only
+                    # dirt (external poke): record "whole group moved"
+                    # so static consumers rebuild, never skip.
+                    "net_pairs": ((None if (net_blend or not pairs)
+                                   else frozenset(pairs))
+                                  if self._dirty["net"] else frozenset()),
+                }))
+            # Delta ingest patches the previous device arrays in place
+            # of full transfers when the dirty footprint is small; the
+            # scattered values are computed by the SAME host formulas
+            # as the full path, so the resulting pytree is
+            # bit-identical (property-tested in test_static_delta).
+            delta_on = bool(self.cfg.enable_delta_state) and bool(self._cache)
+            n = self._node_valid.shape[0]
             if self._dirty["metrics"]:
-                self._cache["metrics"] = jnp.asarray(self._metrics)
-                self._cache["metrics_age"] = jnp.asarray(self._metrics_age)
-            if self._dirty["net"]:
-                model = self.netmodel
-                if model is not None and model.enabled:
-                    lat_host, bw_host = model.blend(self._lat, self._bw)
+                idx = self._rows_idx("metrics", n, delta_on)
+                # Dirty flag with NO recorded rows = someone set the
+                # boolean directly (the pre-delta contract, still used
+                # by tests poking staging arrays) — coverage is
+                # unprovable, so ship the whole group.  Internal
+                # writers always record rows, so this costs nothing in
+                # the steady state.
+                if idx is None or len(idx) == 0:
+                    self._full_up("metrics", self._metrics)
+                    self._full_up("metrics_age", self._metrics_age)
                 else:
-                    lat_host, bw_host = self._lat, self._bw
-                self._cache["lat"] = jnp.asarray(lat_host)
-                self._cache["bw"] = jnp.asarray(bw_host)
+                    self._rows_up("metrics", idx, self._metrics)
+                    self._rows_up("metrics_age", idx, self._metrics_age)
+            if self._dirty["net"]:
+                if net_blend:
+                    lat_host, bw_host = model.blend(self._lat, self._bw)
+                    self._full_up("lat", lat_host)
+                    self._full_up("bw", bw_host)
+                else:
+                    pairs = self._dirty_pairs
+                    # Empty pair set with the net flag up: boolean-only
+                    # dirt (see the metrics branch) — full upload.
+                    if (not delta_on or not pairs
+                            or len(pairs) >
+                            self.cfg.delta_full_fraction * n * n):
+                        self._full_up("lat", self._lat)
+                        self._full_up("bw", self._bw)
+                    else:
+                        srt = sorted(pairs)
+                        ii = _pad_pow2(np.array(
+                            [p[0] for p in srt], np.int32))
+                        jj = _pad_pow2(np.array(
+                            [p[1] for p in srt], np.int32))
+                        self._pairs_up("lat", ii, jj, self._lat)
+                        self._pairs_up("bw", ii, jj, self._bw)
             if self._dirty["alloc"]:
-                self._cache["cap"] = jnp.asarray(self._cap)
                 # Nominated reservations count as used: the scoring
                 # kernel must not hand a preemptor's freed space to
                 # someone else (the preemptor's own hold is dropped
-                # when it is encoded for scoring).
-                self._cache["used"] = jnp.asarray(
-                    self._used + self._reserved
-                    if self._nominations else self._used)
-                self._cache["group_bits"] = jnp.asarray(self._group_bits)
-                self._cache["resident_anti"] = jnp.asarray(self._resident_anti)
-                self._cache["gz_counts"] = jnp.asarray(self._gz_counts)
-                self._cache["az_anti"] = jnp.asarray(self._az_anti)
+                # when it is encoded for scoring).  Row-sliceable: the
+                # reservation array is zero except at nominated rows,
+                # and every row whose reservation moves is marked.
+                used_host = (self._used + self._reserved
+                             if self._nominations else self._used)
+                idx = self._rows_idx("alloc", n, delta_on)
+                if idx is None:
+                    self._full_up("cap", self._cap)
+                    self._full_up("used", used_host)
+                    self._full_up("group_bits", self._group_bits)
+                    self._full_up("resident_anti", self._resident_anti)
+                elif len(idx):
+                    self._rows_up("cap", idx, self._cap)
+                    self._rows_up("used", idx, used_host)
+                    self._rows_up("group_bits", idx, self._group_bits)
+                    self._rows_up("resident_anti", idx,
+                                  self._resident_anti)
+                # The zone-count sidecars are O(slots x zones) small
+                # and not row-shaped: shipped whole whenever the alloc
+                # group is dirty.
+                self._full_up("gz_counts", self._gz_counts)
+                self._full_up("az_anti", self._az_anti)
             if self._dirty["topo"]:
-                self._cache["node_valid"] = jnp.asarray(self._node_valid)
-                self._cache["label_bits"] = jnp.asarray(self._label_bits)
-                self._cache["taint_bits"] = jnp.asarray(self._taint_bits)
-                self._cache["node_zone"] = jnp.asarray(self._node_zone)
-                self._cache["node_numeric"] = jnp.asarray(
-                    self._node_numeric)
+                idx = self._rows_idx("topo", n, delta_on)
+                if idx is None or len(idx) == 0:
+                    self._full_up("node_valid", self._node_valid)
+                    self._full_up("label_bits", self._label_bits)
+                    self._full_up("taint_bits", self._taint_bits)
+                    self._full_up("node_zone", self._node_zone)
+                    self._full_up("node_numeric", self._node_numeric)
+                else:
+                    self._rows_up("node_valid", idx, self._node_valid)
+                    self._rows_up("label_bits", idx, self._label_bits)
+                    self._rows_up("taint_bits", idx, self._taint_bits)
+                    self._rows_up("node_zone", idx, self._node_zone)
+                    self._rows_up("node_numeric", idx,
+                                  self._node_numeric)
             for key in self._dirty:
                 self._dirty[key] = False
+            self._dirty_rows = {"metrics": set(), "alloc": set(),
+                                "topo": set()}
+            self._dirty_pairs = set()
             return ClusterState(**self._cache), self._static_version
 
     # -- pods ---------------------------------------------------------
@@ -1398,7 +1643,7 @@ class Encoder:
                 if rec.zone >= 0:
                     self._gz_counts[slot, rec.zone] += 1
                 self._group_member_counts[slot] += 1
-                self._dirty["alloc"] = True
+                self._mark_rows("alloc", rec.node)
         return degraded
 
     def _membership_mask(self, pod: Pod, lenient: bool) -> int:

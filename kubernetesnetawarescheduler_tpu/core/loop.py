@@ -163,6 +163,26 @@ class SchedulerLoop:
             # matrix (tens of ms at N=5120 on the CPU fallback).
             self._static_version: int | None = None
             self._static_val = None
+        # Incremental static refresh (cfg.enable_delta_state /
+        # cfg.enable_async_static): the running net extrema that make
+        # delta rebuilds exact, the background refresh worker, and the
+        # observability counters the bench/selfmetrics read.  The
+        # worker NEVER blocks a serving batch: _static_for hands the
+        # rebuild off and keeps scoring against the last static until
+        # the staleness contract (static_max_staleness_s /
+        # static_max_versions_behind) forces a synchronous build.
+        self._static_ex = None
+        self._static_lock = threading.Lock()
+        self._static_cv = threading.Condition(self._static_lock)
+        self._static_req: tuple | None = None
+        self._static_stop = False
+        self._static_thread: threading.Thread | None = None
+        self._static_stale_since: float | None = None
+        self.static_refresh_total = 0
+        self.static_sync_builds = 0
+        from collections import deque as _deque
+        self._static_refresh_ms: "_deque[float]" = _deque(maxlen=2048)
+        self._staleness_samples: "_deque[float]" = _deque(maxlen=8192)
         # The mesh serving fns keep their own leaf-placer transfer
         # cache; only the plain path threads an explicit static pair.
         self._assign_takes_static = mesh is None
@@ -706,15 +726,120 @@ class SchedulerLoop:
     def _static_for(self, state, version: int):
         """Version-keyed cache of the batch-invariant assign static
         (see __init__); ``version`` must come from the SAME
-        ``snapshot_versioned`` call that produced ``state``."""
-        if self._static_version != version:
-            from kubernetesnetawarescheduler_tpu.core.pallas_score import (
-                compute_assign_static,
-            )
+        ``snapshot_versioned`` call that produced ``state``.
 
-            self._static_val = compute_assign_static(state, self.cfg)
-            self._static_version = version
+        Refresh policy (the tentpole of the 5 ms Score() p99 work):
+
+        * Current version -> return the cached value, no device work.
+        * ``cfg.enable_async_static`` off (default): rebuild HERE, but
+          delta-aware — the encoder's dirty descriptor usually reduces
+          the O(N²) re-normalization to an O(|dirty|) patch that is
+          bit-identical to the full rebuild.
+        * Async on: hand the rebuild to a background worker and keep
+          serving the previous static, UNLESS the staleness contract
+          is breached (no static yet, more than
+          ``static_max_versions_behind`` versions or
+          ``static_max_staleness_s`` seconds behind) — then build
+          synchronously so staleness stays bounded even if the worker
+          wedges."""
+        if self._static_version == version:
+            if self.cfg.enable_async_static:
+                self._staleness_samples.append(0.0)
+            return self._static_val
+        if not self.cfg.enable_async_static:
+            self._static_rebuild(state, version)
+            return self._static_val
+        now = time.monotonic()
+        with self._static_cv:
+            if self._static_stale_since is None:
+                self._static_stale_since = now
+            behind = (version - self._static_version
+                      if self._static_version is not None else None)
+            staleness = now - self._static_stale_since
+        if (self._static_val is None or behind is None
+                or behind > self.cfg.static_max_versions_behind
+                or staleness > self.cfg.static_max_staleness_s):
+            self.static_sync_builds += 1
+            self._static_rebuild(state, version)
+            self._staleness_samples.append(0.0)
+            return self._static_val
+        self._ensure_static_worker()
+        with self._static_cv:
+            # Latest-wins: a newer snapshot supersedes any rebuild
+            # still queued (the worker always builds toward the
+            # freshest version it has seen).
+            self._static_req = (state, version)
+            self._static_cv.notify()
+        self._staleness_samples.append(staleness)
         return self._static_val
+
+    def _static_rebuild(self, state, version: int) -> None:
+        """Build (delta-aware when possible) and publish the static
+        for ``version`` on the calling thread."""
+        from kubernetesnetawarescheduler_tpu.core.pallas_score import (
+            compute_assign_static_incremental,
+        )
+
+        t0 = time.perf_counter()
+        dirty = None
+        if self.cfg.enable_delta_state and self._static_version is not None:
+            # The descriptor may span past ``version`` if the encoder
+            # moved again already; the extra indices just re-patch
+            # values ``state`` already holds — still bit-identical.
+            dirty = self.encoder.static_delta_since(self._static_version)
+        static, ex = compute_assign_static_incremental(
+            state, self.cfg, self._static_val, self._static_ex, dirty)
+        with self._static_cv:
+            # Version monotonicity: never replace a fresher static
+            # (the sync-fallback path can overtake a queued rebuild).
+            if (self._static_version is None
+                    or version > self._static_version):
+                self._static_val = static
+                self._static_ex = ex
+                self._static_version = version
+                self._static_stale_since = None
+        self.static_refresh_total += 1
+        self._static_refresh_ms.append(
+            (time.perf_counter() - t0) * 1e3)
+
+    def _ensure_static_worker(self) -> None:
+        t = self._static_thread
+        if t is None or not t.is_alive():
+            self._static_stop = False
+            self._static_thread = threading.Thread(
+                target=self._static_worker_loop,
+                name="static-refresh", daemon=True)
+            self._static_thread.start()
+
+    def _static_worker_loop(self) -> None:
+        while True:
+            with self._static_cv:
+                while self._static_req is None and not self._static_stop:
+                    self._static_cv.wait(0.5)
+                if self._static_stop:
+                    return
+                state, version = self._static_req
+                self._static_req = None
+            try:
+                self._static_rebuild(state, version)
+            except Exception:  # noqa: BLE001 — a wedged worker must
+                # not kill serving: the staleness contract routes
+                # batches to the synchronous fallback, which surfaces
+                # the error on the serving thread.
+                pass
+
+    def stop_static_refresher(self, timeout: float | None = 10.0) -> None:
+        """Stop the background static-refresh worker (shutdown path;
+        idempotent, no-op when async refresh never ran)."""
+        t = self._static_thread
+        if t is None:
+            return
+        with self._static_cv:
+            self._static_stop = True
+            self._static_req = None
+            self._static_cv.notify_all()
+        t.join(timeout)
+        self._static_thread = None
 
     def _schedule_gang(self, key: str, members: list[Pod]) -> int:
         """Jointly place and ATOMICALLY commit one complete gang.
@@ -1562,6 +1687,7 @@ class SchedulerLoop:
     def stop_bind_worker(self, timeout: float | None = 30.0) -> None:
         """Drain outstanding binds and stop the worker (shutdown
         path; the loop cannot schedule in async mode afterwards)."""
+        self.stop_static_refresher()
         if self._encode_pool is not None:
             self._encode_pool.shutdown(wait=True)
             self._encode_pool = None

@@ -27,6 +27,7 @@ import functools
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
@@ -609,6 +610,66 @@ def compute_assign_static(state: ClusterState, cfg: SchedulerConfig):
     if cfg.score_backend == "pallas":
         return static_replay_pack(state, cfg)
     return score_lib.static_node_scores(state, cfg)
+
+
+def static_replay_pack_delta(state: ClusterState, cfg: SchedulerConfig,
+                             prev, ex: "score_lib.NetExtrema",
+                             ii: np.ndarray, jj: np.ndarray):
+    """Delta rebuild of :func:`static_replay_pack`, bit-identical to
+    the full path.  Preconditions: since ``prev`` was packed, only net
+    elements ``(ii, jj)`` changed (both orientations listed) and
+    topology/validity did not; ``base`` (O(N*M)) is recomputed
+    outright.  Unlike the dense path, a moved normalizer does NOT
+    force O(N²) work here: the pack carries RAW padded bw/lat and the
+    normalizers live in the 8-scalar params vector."""
+    ex2 = score_lib.net_extrema_update(state, ex, ii, jj)
+    _, bw_p, lat_p, validk, nodes, nodei = prev
+    base = score_lib.metric_scores(state, cfg)
+    bw_max = jnp.maximum(jnp.float32(ex2.bw_m), _EPS)
+    lat_max = jnp.maximum(jnp.float32(ex2.lat_m), _EPS)
+    params = jnp.stack([
+        jnp.float32(cfg.weights.peer_bw), jnp.float32(cfg.weights.peer_lat),
+        1.0 / bw_max, 1.0 / lat_max,
+        jnp.float32(cfg.weights.balance), jnp.float32(_EPS),
+        jnp.float32(cfg.weights.soft_affinity / 100.0), jnp.float32(0)])
+    if len(ii):
+        iid = jnp.asarray(ii)
+        jjd = jnp.asarray(jj)
+        bw_p = bw_p.at[iid, jjd].set(state.bw[iid, jjd])
+        lat_p = lat_p.at[iid, jjd].set(state.lat[iid, jjd])
+    nodes = nodes.at[0, :state.num_nodes].set(base)
+    return (params, bw_p, lat_p, validk, nodes, nodei), ex2
+
+
+def compute_assign_static_incremental(
+        state: ClusterState, cfg: SchedulerConfig, prev,
+        ex: "score_lib.NetExtrema | None", dirty: "dict | None"):
+    """Incremental :func:`compute_assign_static`: returns
+    ``(static, extrema)``, patching ``prev`` when the dirty footprint
+    permits and falling back to a full rebuild otherwise.
+
+    ``dirty`` is the merged descriptor from
+    ``Encoder.static_delta_since`` (None = coverage unprovable).  Full
+    rebuild triggers on: no previous value, no descriptor, any topo
+    dirt (validity changes every mask and both normalizers), or a
+    whole-group net rewrite.  Metrics-only dirt recomputes just the
+    O(N*M) base; net pair dirt takes the O(|dirty|) patch path."""
+    pairs = None if dirty is None else dirty.get("net_pairs")
+    if (prev is None or ex is None or dirty is None
+            or dirty.get("topo")
+            or (dirty.get("net") and pairs is None)):
+        return (compute_assign_static(state, cfg),
+                score_lib.net_extrema_scan(state))
+    if pairs:
+        srt = sorted(pairs)
+        ii = np.array([p[0] for p in srt], np.int32)
+        jj = np.array([p[1] for p in srt], np.int32)
+    else:
+        ii = jj = np.zeros(0, np.int32)
+    if cfg.score_backend == "pallas":
+        return static_replay_pack_delta(state, cfg, prev, ex, ii, jj)
+    return score_lib.static_node_scores_delta(state, cfg, prev, ex,
+                                              ii, jj)
 
 
 # Jitted entry for the dense path: serving callers hit this once per

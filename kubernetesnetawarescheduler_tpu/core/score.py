@@ -31,6 +31,8 @@ control flow.
 
 from __future__ import annotations
 
+from typing import NamedTuple
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -166,6 +168,109 @@ def static_node_scores(state: ClusterState, cfg: SchedulerConfig
     scheduler.go:275-279)."""
     return (metric_scores(state, cfg),
             prep_net_matrix(net_cost_matrix(state, cfg), cfg))
+
+
+class NetExtrema(NamedTuple):
+    """Host-side running normalizers of :func:`net_cost_matrix`:
+    the masked maxima of ``bw``/``lat`` over valid pairs BEFORE the
+    ``_EPS`` clamp, plus the flat index of a pair currently holding
+    each maximum.  The tracked holder makes retreat detection exact:
+    as long as the holder pair is not in a dirty set, its value still
+    equals the recorded maximum, so a running ``max(old, dirty max)``
+    is bit-identical to a full re-scan; only when the holder itself is
+    dirtied can the true maximum retreat, forcing a re-scan."""
+    bw_m: float
+    lat_m: float
+    bw_arg: int
+    lat_arg: int
+
+
+def net_extrema_scan(state: ClusterState) -> NetExtrema:
+    """Full O(N^2) extrema scan (device reduce, host scalars).  The
+    float() round-trip through f64 is exact for f32 values, so feeding
+    these back through ``jnp.float32`` reconstructs the identical
+    normalizer scalars :func:`net_cost_matrix` derives on device."""
+    pv = state.node_valid[:, None] & state.node_valid[None, :]
+    bwm = jnp.where(pv, state.bw, 0.0)
+    latm = jnp.where(pv, state.lat, 0.0)
+    bi = int(jnp.argmax(bwm))
+    li = int(jnp.argmax(latm))
+    return NetExtrema(float(bwm.reshape(-1)[bi]),
+                      float(latm.reshape(-1)[li]), bi, li)
+
+
+def net_extrema_update(state: ClusterState, ex: NetExtrema,
+                       ii: np.ndarray, jj: np.ndarray) -> NetExtrema:
+    """Update :class:`NetExtrema` after only pairs ``(ii, jj)`` of
+    ``bw``/``lat`` changed.  Bit-identical to :func:`net_extrema_scan`
+    in the max VALUES (the tracked holder may differ from argmax's
+    first-index tie-break, which only affects when a future re-scan
+    triggers, never the normalizers)."""
+    if len(ii) == 0:
+        return ex
+    n = state.bw.shape[0]
+    flat = ii.astype(np.int64) * n + jj.astype(np.int64)
+    dirty = set(flat.tolist())
+    iid = jnp.asarray(ii)
+    jjd = jnp.asarray(jj)
+    pv = state.node_valid[iid] & state.node_valid[jjd]
+    vb = jnp.where(pv, state.bw[iid, jjd], 0.0)
+    vl = jnp.where(pv, state.lat[iid, jjd], 0.0)
+
+    def one(m, arg, vals):
+        if arg in dirty:
+            return None  # holder dirtied: the max may have retreated
+        k = int(jnp.argmax(vals))
+        v = float(vals[k])
+        return (v, int(flat[k])) if v > m else (m, arg)
+
+    nb = one(ex.bw_m, ex.bw_arg, vb)
+    nl = one(ex.lat_m, ex.lat_arg, vl)
+    if nb is None or nl is None:
+        full = net_extrema_scan(state)
+        return NetExtrema(full.bw_m if nb is None else nb[0],
+                          full.lat_m if nl is None else nl[0],
+                          full.bw_arg if nb is None else nb[1],
+                          full.lat_arg if nl is None else nl[1])
+    return NetExtrema(nb[0], nl[0], nb[1], nl[1])
+
+
+def static_node_scores_delta(
+        state: ClusterState, cfg: SchedulerConfig,
+        prev: tuple[jax.Array, jax.Array], ex: NetExtrema,
+        ii: np.ndarray, jj: np.ndarray,
+) -> tuple[tuple[jax.Array, jax.Array], NetExtrema]:
+    """Delta rebuild of :func:`static_node_scores`, bit-identical to
+    the full path (property-tested in test_static_delta).
+
+    Preconditions: since ``prev`` was built, only net elements
+    ``(ii, jj)`` changed (both orientations listed) and topology/
+    validity did not.  ``base`` is O(N*M) and recomputed outright —
+    the delta machinery only defends the O(N^2) matrix work.  When a
+    normalizer MOVES, every element of ``C`` rescales, so the matrix
+    falls back to a full rebuild; the common case (probe jitter below
+    the running maxima) patches just the dirty columns of ``C.T``."""
+    base = metric_scores(state, cfg)
+    ex2 = net_extrema_update(state, ex, ii, jj)
+    if ex2.bw_m != ex.bw_m or ex2.lat_m != ex.lat_m:
+        return (base, prep_net_matrix(net_cost_matrix(state, cfg),
+                                      cfg)), ex2
+    _, ct = prev
+    if len(ii) == 0:
+        return (base, ct), ex2
+    iid = jnp.asarray(ii)
+    jjd = jnp.asarray(jj)
+    bw_max = jnp.maximum(jnp.float32(ex2.bw_m), _EPS)
+    lat_max = jnp.maximum(jnp.float32(ex2.lat_m), _EPS)
+    vals = (cfg.weights.peer_bw * state.bw[iid, jjd] / bw_max
+            - cfg.weights.peer_lat * state.lat[iid, jjd] / lat_max)
+    vals = jnp.where(iid == jjd, cfg.weights.peer_bw, vals)
+    pv = state.node_valid[iid] & state.node_valid[jjd]
+    vals = jnp.where(pv, vals, 0.0)
+    if _use_bf16(cfg):
+        vals = vals.astype(jnp.bfloat16)
+    # prev holds C.T: element (i, j) of C lives at (j, i).
+    return (base, ct.at[jjd, iid].set(vals)), ex2
 
 
 def network_scores(state: ClusterState, pods: PodBatch,

@@ -51,6 +51,19 @@ def init_multihost(coordinator_address: str | None = None,
     is_init = getattr(jax.distributed, "is_initialized", None)
     if is_init is not None and is_init():
         return  # idempotent no-op, no fragile message matching
+    if (getattr(jax.config, "jax_platforms", None) or "").startswith("cpu"):
+        # CPU-backend multi-process needs the gloo collectives
+        # implementation: the default CPU client raises "Multiprocess
+        # computations aren't implemented on the CPU backend" at the
+        # first psum.  Must be set BEFORE initialize() (the
+        # distributed client binds its collectives at startup).  Gated
+        # on the flag existing so newer jax versions that drop it
+        # don't break TPU pods (where jax_platforms is unset anyway).
+        try:
+            jax.config.update("jax_cpu_collectives_implementation",
+                              "gloo")
+        except (AttributeError, ValueError):
+            pass
     try:
         jax.distributed.initialize(
             coordinator_address=coordinator_address,

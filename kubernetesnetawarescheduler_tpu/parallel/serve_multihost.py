@@ -176,15 +176,24 @@ def run_follower(cfg: SchedulerConfig, mesh, method: str = "parallel",
             break
         if int(header[1]):
             vals = _bcast(big_zeros)
-            big = dict(zip(BIG_FIELDS, map(np.asarray, vals)))
+            # Restore template dtypes: broadcast_one_to_all rides a
+            # psum, which upcasts bool leaves to int32 (values intact).
+            # Without the cast-back the follower compiles a DIFFERENT
+            # program than the controller (int32 masks vs bool) and the
+            # cross-process collective mismatches.
+            big = {f: np.asarray(v, dtype=z.dtype)
+                   for f, v, z in zip(BIG_FIELDS, vals, big_zeros)}
         mut = _bcast(mut_zeros)
         batch_np = _bcast(batch_zeros)
         state = dataclasses.replace(
             template,
             **{f: jnp.asarray(v) for f, v in big.items()},
-            **{f: jnp.asarray(np.asarray(v))
-               for f, v in zip(MUT_FIELDS, mut)})
-        pods = jax.tree_util.tree_map(jnp.asarray, batch_np)
+            **{f: jnp.asarray(np.asarray(v, dtype=z.dtype))
+               for f, v, z in zip(MUT_FIELDS, mut, mut_zeros)})
+        pods = jax.tree_util.tree_map(
+            lambda v, z: jnp.asarray(
+                np.asarray(v, dtype=np.asarray(z).dtype)),
+            batch_np, batch_zeros)
         # Same program as the controller: parallel runs the stats
         # variant (SchedulerLoop always asks for rounds with the
         # parallel assigner); a divergent choice here would hang the

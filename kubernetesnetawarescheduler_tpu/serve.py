@@ -149,6 +149,14 @@ def main(argv=None) -> int:
                          "binds of burst k-1 (implies --async-bind); "
                          "assignments are identical to the serial "
                          "cycle on the same feed")
+    ap.add_argument("--async-static", action="store_true",
+                    help="rebuild the batch-invariant static score "
+                         "prep on a background thread while batches "
+                         "keep scoring against the last one (bounded "
+                         "by cfg.static_max_staleness_s / "
+                         "static_max_versions_behind, with a "
+                         "synchronous fallback); equivalent to "
+                         "enable_async_static=true in --config")
     ap.add_argument("--mesh", action="store_true",
                     help="shard the watch-loop's score+assign kernels "
                          "over ALL LOCAL devices via the (dp, tp) "
@@ -221,6 +229,10 @@ def main(argv=None) -> int:
             return
 
     cfg = load_config(args.config) if args.config else SchedulerConfig()
+    if args.async_static and not cfg.enable_async_static:
+        import dataclasses
+
+        cfg = dataclasses.replace(cfg, enable_async_static=True)
 
     if args.compilation_cache_dir:
         # Persistent XLA compilation cache: must be configured BEFORE

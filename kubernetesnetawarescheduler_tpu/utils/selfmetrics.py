@@ -201,6 +201,68 @@ def render_metrics(loop) -> str:
                 float(batcher.requests),
                 "Webhook score requests (filter+prioritize)")
 
+    # Incremental device-resident state (core/loop._static_for +
+    # core/encode delta ingest): refresh activity, sync-fallback count
+    # (a growing share means the staleness contract keeps breaching —
+    # tune static_max_staleness_s / static_max_versions_behind, see
+    # OPERATIONS.md), delta-vs-full snapshot upload traffic, and the
+    # staleness of the static each Score() actually served.
+    counter("netaware_static_refresh_total",
+            float(getattr(loop, "static_refresh_total", 0)),
+            "Assign-static rebuilds (delta or full; async or sync)")
+    counter("netaware_static_sync_builds_total",
+            float(getattr(loop, "static_sync_builds", 0)),
+            "Static rebuilds forced synchronous by the staleness "
+            "contract (async mode's bounded fallback)")
+    counter("netaware_snapshot_delta_bytes_total",
+            float(getattr(enc, "snapshot_delta_bytes_total", 0)),
+            "Host-to-device snapshot bytes moved as dirty-index "
+            "scatter updates")
+    counter("netaware_snapshot_full_bytes_total",
+            float(getattr(enc, "snapshot_full_bytes_total", 0)),
+            "Host-to-device snapshot bytes moved as full-array "
+            "re-uploads")
+    # The serving thread and the async refresh worker append to these
+    # deques lock-free (appends are atomic; only iteration can see a
+    # mutation and raise RuntimeError) — retry the snapshot instead of
+    # intermittently 500ing the scrape.
+    def _snap_deque(name: str) -> np.ndarray:
+        dq = getattr(loop, name, ())
+        for _ in range(3):
+            try:
+                return np.asarray(tuple(dq), dtype=float)
+            except RuntimeError:
+                continue
+        return np.zeros((0,))
+
+    refresh_ms = _snap_deque("_static_refresh_ms")
+    stale_s = _snap_deque("_staleness_samples")
+    if refresh_ms.size:
+        lines.append("# HELP netaware_static_refresh_ms Wall time per "
+                     "assign-static rebuild (delta or full)")
+        lines.append("# TYPE netaware_static_refresh_ms summary")
+        for q in _QUANTILES:
+            lines.append(
+                f'netaware_static_refresh_ms{{quantile="{q:g}"}} '
+                f"{_fmt(float(np.quantile(refresh_ms, q)))}")
+        lines.append(f"netaware_static_refresh_ms_sum "
+                     f"{_fmt(float(refresh_ms.sum()))}")
+        lines.append(
+            f"netaware_static_refresh_ms_count {refresh_ms.size}")
+    if stale_s.size:
+        lines.append("# HELP netaware_static_staleness_s Age of the "
+                     "static each Score() call served (async refresh; "
+                     "0 = current)")
+        lines.append("# TYPE netaware_static_staleness_s summary")
+        for q in _QUANTILES:
+            lines.append(
+                f'netaware_static_staleness_s{{quantile="{q:g}"}} '
+                f"{_fmt(float(np.quantile(stale_s, q)))}")
+        lines.append(f"netaware_static_staleness_s_sum "
+                     f"{_fmt(float(stale_s.sum()))}")
+        lines.append(
+            f"netaware_static_staleness_s_count {stale_s.size}")
+
     # Conflict-round distribution over recent serving cycles (one
     # sample per batch, parallel assigner): whether score latency is
     # matmul-bound or round-bound — the bench's rounds_p50/p99, live.

@@ -34,6 +34,18 @@ def _headline(**overrides):
             "p99_met": True,
             "p99_source": "device_scan_amortized",
         },
+        # Rule 7 (round 7+): a met p99 bar must show its incremental-
+        # state provenance — refreshes actually ran, staleness held.
+        "static_refresh": {
+            "count": 12,
+            "p99_ms": 28.6,
+            "sync_builds": 0,
+            "staleness_at_score_p50_ms": 4.0,
+            "staleness_at_score_p99_ms": 31.0,
+            "staleness_bound_s": 0.25,
+            "delta_bytes": 15648,
+            "full_bytes": 309888,
+        },
     }
     detail.update(overrides.pop("detail", {}))
     doc = {"metric": "density_pods_per_sec_n5120", "value": 12000.0,
@@ -100,6 +112,45 @@ def test_cpu_canary_shape_enforced():
                          "runs": 3}}})
     fails2 = bench_check.check_doc("BENCH_r06.json", bad_stats)
     assert any("inconsistent" in f for f in fails2), fails2
+
+
+def test_static_refresh_provenance_enforced():
+    # p99_met without a static_refresh block: the r5 bug shape (a fast
+    # Score() p99 that cannot prove it wasn't serving frozen prep).
+    doc = _headline()
+    del doc["detail"]["static_refresh"]
+    fails = bench_check.check_doc("BENCH_r07.json", doc)
+    assert any("static_refresh block" in f for f in fails), fails
+    # ...but a doc that does NOT claim the bar may omit the block
+    # (CPU legs, north_star-less details).
+    doc2 = _headline()
+    del doc2["detail"]["static_refresh"]
+    doc2["detail"]["score_p99_ms"] = 87.44
+    doc2["detail"]["north_star"]["p99_met"] = False
+    assert bench_check.check_doc("BENCH_r07.json", doc2) == []
+    # Required keys are enforced.
+    doc3 = _headline()
+    del doc3["detail"]["static_refresh"]["staleness_bound_s"]
+    fails3 = bench_check.check_doc("BENCH_r07.json", doc3)
+    assert any("static_refresh missing" in f for f in fails3), fails3
+    # A staleness p99 past the declared bound breaks the contract the
+    # doc claims to have held.
+    doc4 = _headline(detail={"static_refresh": dict(
+        _headline()["detail"]["static_refresh"],
+        staleness_at_score_p99_ms=400.0)})
+    fails4 = bench_check.check_doc("BENCH_r07.json", doc4)
+    assert any("staleness" in f and "bound" in f for f in fails4), fails4
+    # Zero refreshes while claiming the bar: frozen-state serve.
+    doc5 = _headline(detail={"static_refresh": dict(
+        _headline()["detail"]["static_refresh"], count=0,
+        staleness_at_score_p99_ms=0.0)})
+    fails5 = bench_check.check_doc("BENCH_r07.json", doc5)
+    assert any("count=0" in f for f in fails5), fails5
+    # Pre-r6 history is exempt (by filename or capture SHA).
+    assert bench_check.check_doc("BENCH_r05.json", doc) == []
+    doc6 = _headline(git="e29de44")
+    del doc6["detail"]["static_refresh"]
+    assert bench_check.check_doc("legacy_leg.json", doc6) == []
 
 
 def _chaos_doc(**overrides):

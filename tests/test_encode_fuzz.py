@@ -341,3 +341,76 @@ def test_batch_and_solo_encode_score_identically():
                                       err_msg=f"pod {p.name}")
         checked += 1
     assert checked >= 16  # the invariant actually ran
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_delta_ingest_matches_full_uploads_under_node_churn(seed):
+    """The dirty-index scatter ingest (enable_delta_state, the r7
+    tentpole) against the full-upload path: two encoders fed the
+    IDENTICAL object-level op stream — including node ADD/REMOVE
+    churn, which exercises row recycling and the full-group sentinel —
+    must produce bit-identical snapshots after every batch.  This is
+    the object-semantics companion to tests/test_static_delta.py
+    (which fuzzes the already-encoded mutation ops)."""
+    import dataclasses
+
+    import jax
+
+    from kubernetesnetawarescheduler_tpu.core.encode import Encoder
+
+    cfg_d = SchedulerConfig(max_nodes=16, max_pods=8, max_peers=2,
+                            enable_delta_state=True)
+    cfg_f = dataclasses.replace(cfg_d, enable_delta_state=False)
+    encs = (Encoder(cfg_d), Encoder(cfg_f))
+    rngs = tuple(np.random.default_rng(seed) for _ in encs)
+    live: list[str] = []
+    next_id = 0
+
+    def step(enc, rng, names):
+        nonlocal next_id
+        op = int(rng.integers(0, 5))
+        if op == 0 or len(names) < 4:
+            name = f"c{next_id}"
+            enc.upsert_node(Node(
+                name=name, capacity={"cpu": 16.0, "mem": 32.0},
+                labels=frozenset({f"disk={rng.choice(DISKS)}"}),
+                zone=str(rng.choice(ZONES))))
+            return name
+        if op == 1 and len(names) > 4:
+            enc.remove_node(names[int(rng.integers(len(names)))])
+        elif op == 2:
+            a, b = rng.choice(len(names), size=2, replace=False)
+            enc.update_link(names[int(a)], names[int(b)],
+                            lat_ms=float(rng.uniform(0.05, 2.0)),
+                            bw_bps=float(rng.uniform(1e8, 1e10)))
+        elif op == 3:
+            enc.update_metrics(names[int(rng.integers(len(names)))], {
+                "cpu_freq": float(rng.uniform(1e9, 3e9)),
+                "mem_pct": float(rng.uniform(5, 90))})
+        else:
+            name = names[int(rng.integers(len(names)))]
+            if rng.random() < 0.5:
+                enc.mark_unready(name)
+            else:
+                enc.mark_ready(name)
+        return None
+
+    for batch in range(20):
+        for _ in range(3):
+            added = None
+            for enc, rng in zip(encs, rngs):
+                added = step(enc, rng, live)
+            if added is not None:
+                live.append(added)
+                next_id += 1
+            live = [n for n in live
+                    if encs[0]._node_index.get(n) is not None]
+        snaps = [enc.snapshot() for enc in encs]
+        for i, (g, w) in enumerate(zip(
+                jax.tree_util.tree_leaves(snaps[0]),
+                jax.tree_util.tree_leaves(snaps[1]))):
+            np.testing.assert_array_equal(
+                np.asarray(g), np.asarray(w),
+                err_msg=f"seed {seed} batch {batch} leaf {i}")
+    assert encs[0].snapshot_delta_bytes_total > 0
+    assert encs[1].snapshot_delta_bytes_total == 0

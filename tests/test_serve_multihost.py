@@ -20,6 +20,9 @@ _WORKER = r"""
 import sys
 import jax
 jax.config.update("jax_platforms", "cpu")
+# CPU-backend collectives need gloo (the default CPU client has no
+# multi-process implementation); must precede initialize().
+jax.config.update("jax_cpu_collectives_implementation", "gloo")
 pid = int(sys.argv[1])
 port = sys.argv[2]
 jax.distributed.initialize(coordinator_address=f"127.0.0.1:{port}",

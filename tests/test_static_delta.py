@@ -1,0 +1,329 @@
+"""Incremental device-resident state (r7 tentpole): property tests.
+
+Three layers, each with a bit-identity oracle:
+
+1. Delta INGEST — the encoder's dirty-index scatter snapshot must be
+   bit-identical, on every ``ClusterState`` leaf, to a from-scratch
+   encoder replaying the same object-level ops with
+   ``enable_delta_state=False`` (the pre-r7 full-upload path).
+2. Delta STATIC — ``compute_assign_static_incremental`` walked across
+   a fuzzed churn sequence (link probes, metric samples, readiness
+   flips, extrema retreats) must equal the full
+   ``compute_assign_static`` rebuild at every step, for BOTH score
+   backends (the dense XLA ``(base, C.T)`` pair and the Pallas replay
+   pack).
+3. Async REFRESH — ``SchedulerLoop._static_for``'s staleness contract:
+   serve-stale within the bound, synchronous fallback past it, version
+   monotonicity, and end-to-end binding parity with delta state off.
+
+Bit-identity (not allclose) is the acceptance bar: the delta paths
+recompute each patched element with the same elementwise IEEE ops the
+full rebuild uses, so any tolerance would only hide a real divergence.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+
+import numpy as np
+import pytest
+
+import jax
+
+from kubernetesnetawarescheduler_tpu.config import SchedulerConfig
+from kubernetesnetawarescheduler_tpu.core.encode import Encoder
+from kubernetesnetawarescheduler_tpu.core.pallas_score import (
+    compute_assign_static,
+    compute_assign_static_incremental,
+)
+from kubernetesnetawarescheduler_tpu.k8s.types import Node
+
+ZONES = ("z0", "z1", "z2")
+
+
+def _fill_encoder(enc: Encoder, n: int, seed: int) -> list[str]:
+    rng = np.random.default_rng(seed)
+    names = []
+    for i in range(n):
+        name = f"n{i}"
+        enc.upsert_node(Node(
+            name=name, capacity={"cpu": 16.0, "mem": 32.0},
+            zone=ZONES[i % len(ZONES)],
+            labels=frozenset({f"disk={'ssd' if i % 2 else 'hdd'}"})))
+        names.append(name)
+    lat = rng.uniform(0.05, 2.0, (n, n)).astype(np.float32)
+    bw = rng.uniform(1e8, 1e10, (n, n)).astype(np.float32)
+    lat = (lat + lat.T) / 2
+    bw = (bw + bw.T) / 2
+    np.fill_diagonal(lat, 0.0)  # self-links: keep the extrema holder
+    np.fill_diagonal(bw, 0.0)   # off the diagonal (a real pair)
+    enc.set_network(lat, bw)
+    for name in names:
+        enc.update_metrics(name, {
+            "cpu_freq": float(rng.uniform(1e9, 3e9)),
+            "mem_pct": float(rng.uniform(5, 90)),
+            "net_tx": float(rng.uniform(0, 1e5)),
+            "net_rx": float(rng.uniform(0, 1e5)),
+        })
+    return names
+
+
+def _mutate(enc: Encoder, names: list[str],
+            rng: np.random.Generator) -> None:
+    """One fuzzed churn step: a random mix of the ops that dirty each
+    snapshot group (net pairs, metrics rows, topo rows)."""
+    k = int(rng.integers(0, 4))
+    if k == 0:
+        for _ in range(int(rng.integers(1, 4))):
+            a, b = rng.choice(len(names), size=2, replace=False)
+            enc.update_link(names[int(a)], names[int(b)],
+                            lat_ms=float(rng.uniform(0.05, 3.0)),
+                            bw_bps=float(rng.uniform(1e7, 1e10)))
+    elif k == 1:
+        enc.update_metrics(names[int(rng.integers(len(names)))], {
+            "cpu_freq": float(rng.uniform(1e9, 3e9)),
+            "mem_pct": float(rng.uniform(5, 90))})
+    elif k == 2:
+        name = names[int(rng.integers(len(names)))]
+        if rng.random() < 0.5:
+            enc.mark_unready(name)
+        else:
+            enc.mark_ready(name)
+    else:
+        # Extrema retreat candidate: hammer one pair downward — when
+        # it happens to hold the running bw/lat max, the incremental
+        # path must rescan instead of keeping a stale normalizer.
+        a, b = rng.choice(len(names), size=2, replace=False)
+        enc.update_link(names[int(a)], names[int(b)],
+                        lat_ms=float(rng.uniform(0.05, 0.1)),
+                        bw_bps=float(rng.uniform(1e7, 2e7)))
+
+
+def _assert_tree_equal(got, want, ctx: str = "") -> None:
+    gl = jax.tree_util.tree_leaves(got)
+    wl = jax.tree_util.tree_leaves(want)
+    assert len(gl) == len(wl), ctx
+    for i, (g, w) in enumerate(zip(gl, wl)):
+        np.testing.assert_array_equal(
+            np.asarray(g), np.asarray(w),
+            err_msg=f"{ctx} leaf {i}")
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_delta_snapshot_bit_identical_to_full_path(seed):
+    """Layer 1: dirty-index scatter ingest vs the delta-off encoder
+    replaying the identical op stream — every leaf, every step."""
+    cfg_d = SchedulerConfig(max_nodes=32, max_pods=8, max_peers=2,
+                            enable_delta_state=True)
+    cfg_f = dataclasses.replace(cfg_d, enable_delta_state=False)
+    enc_d, enc_f = Encoder(cfg_d), Encoder(cfg_f)
+    names = _fill_encoder(enc_d, 24, seed)
+    _fill_encoder(enc_f, 24, seed)
+    # Prime both caches (first snapshot is a full upload either way).
+    _assert_tree_equal(enc_d.snapshot(), enc_f.snapshot(), "prime")
+    rng_d = np.random.default_rng(seed + 50)
+    rng_f = np.random.default_rng(seed + 50)
+    for step in range(15):
+        _mutate(enc_d, names, rng_d)
+        _mutate(enc_f, names, rng_f)
+        _assert_tree_equal(enc_d.snapshot(), enc_f.snapshot(),
+                           f"step {step}")
+    assert enc_d.snapshot_delta_bytes_total > 0, \
+        "delta path never engaged — the test lost its subject"
+
+
+@pytest.mark.parametrize("score_backend", ["xla", "pallas"])
+@pytest.mark.parametrize("seed", [0, 1])
+def test_incremental_static_bit_identical_under_churn(seed,
+                                                      score_backend):
+    """Layer 2: the delta static walked across fuzzed churn equals the
+    full rebuild at every step (both backends)."""
+    cfg = SchedulerConfig(max_nodes=32, max_pods=8, max_peers=2,
+                          score_backend=score_backend)
+    enc = Encoder(cfg)
+    names = _fill_encoder(enc, 24, seed)
+    state, ver = enc.snapshot_versioned()
+    static, ex = compute_assign_static_incremental(
+        state, cfg, None, None, None)
+    _assert_tree_equal(static, compute_assign_static(state, cfg),
+                       "initial")
+    rng = np.random.default_rng(seed + 200)
+    delta_steps = 0
+    for step in range(12):
+        _mutate(enc, names, rng)
+        state, ver2 = enc.snapshot_versioned()
+        dirty = enc.static_delta_since(ver)
+        if dirty is not None and dirty.get("net_pairs"):
+            delta_steps += 1
+        static, ex = compute_assign_static_incremental(
+            state, cfg, static, ex, dirty)
+        _assert_tree_equal(static, compute_assign_static(state, cfg),
+                           f"step {step} ({score_backend})")
+        ver = ver2
+    assert delta_steps > 0, \
+        "no step took the pair-delta path — churn mix is broken"
+
+
+@pytest.mark.parametrize("score_backend", ["xla", "pallas"])
+def test_extrema_retreat_rescans(score_backend):
+    """Dirtying the pair that HOLDS the bandwidth max (downward) must
+    trigger the lazy rescan — the patched static still equals the full
+    rebuild, with the new, smaller normalizer."""
+    from kubernetesnetawarescheduler_tpu.core.score import (
+        net_extrema_scan,
+    )
+
+    cfg = SchedulerConfig(max_nodes=32, max_pods=8, max_peers=2,
+                          score_backend=score_backend)
+    enc = Encoder(cfg)
+    names = _fill_encoder(enc, 16, 3)
+    state, ver = enc.snapshot_versioned()
+    static, ex = compute_assign_static_incremental(
+        state, cfg, None, None, None)
+    n = cfg.max_nodes
+    i, j = int(ex.bw_arg) // n, int(ex.bw_arg) % n
+    assert i < 16 and j < 16 and i != j, "degenerate extrema holder"
+    # Retreat: the max-bandwidth link degrades to near the floor.
+    enc.update_link(names[i], names[j], bw_bps=1e7)
+    state2, _ = enc.snapshot_versioned()
+    dirty = enc.static_delta_since(ver)
+    static2, ex2 = compute_assign_static_incremental(
+        state2, cfg, static, ex, dirty)
+    _assert_tree_equal(static2, compute_assign_static(state2, cfg),
+                       "post-retreat")
+    # The running extrema itself must match a from-scratch scan.
+    fresh = net_extrema_scan(state2)
+    assert float(ex2.bw_m) == float(fresh.bw_m)
+    assert float(ex2.bw_m) < float(ex.bw_m)
+
+
+def test_static_delta_since_gap_returns_none():
+    """A version older than the delta window (deque maxlen) must
+    return None — the caller then takes the full rebuild, never a
+    partial patch."""
+    cfg = SchedulerConfig(max_nodes=16, max_pods=4, max_peers=2)
+    enc = Encoder(cfg)
+    names = _fill_encoder(enc, 8, 4)
+    _, v0 = enc.snapshot_versioned()
+    for k in range(140):  # > the 128-entry descriptor window
+        enc.update_link(names[k % 8], names[(k + 1) % 8],
+                        lat_ms=0.5 + k * 1e-3)
+        enc.snapshot_versioned()
+    assert enc.static_delta_since(v0) is None
+    # A recent version still merges.
+    _, v1 = enc.snapshot_versioned()
+    enc.update_link(names[0], names[1], bw_bps=5e8)
+    _, v2 = enc.snapshot_versioned()
+    d = enc.static_delta_since(v1)
+    assert d is not None and d["net_pairs"]
+    assert enc.static_delta_since(v2) is not None  # empty merge ok
+
+
+def _loop_fixture(cfg):
+    from kubernetesnetawarescheduler_tpu.bench.fakecluster import (
+        ClusterSpec,
+        build_fake_cluster,
+        feed_metrics,
+    )
+    from kubernetesnetawarescheduler_tpu.core.loop import SchedulerLoop
+
+    cluster, lat, bw = build_fake_cluster(ClusterSpec(num_nodes=24,
+                                                      seed=9))
+    loop = SchedulerLoop(cluster, cfg, method="parallel")
+    loop.encoder.set_network(lat, bw)
+    feed_metrics(cluster, loop.encoder, np.random.default_rng(10))
+    return cluster, loop
+
+
+def test_async_static_serves_stale_within_bound():
+    """Layer 3: with a roomy staleness budget, a version bump hands
+    the rebuild to the worker and the caller keeps the previous static
+    (no blocking); the worker's publish catches the version up."""
+    cfg = SchedulerConfig(max_nodes=32, max_pods=8, max_peers=2,
+                          enable_async_static=True,
+                          static_max_staleness_s=30.0,
+                          static_max_versions_behind=1000)
+    _, loop = _loop_fixture(cfg)
+    state, ver = loop.encoder.snapshot_versioned()
+    s1 = loop._static_for(state, ver)
+    assert loop.static_sync_builds == 1  # cold start must not serve None
+    loop.encoder.update_link("node-0001", "node-0002", bw_bps=2e9)
+    state2, ver2 = loop.encoder.snapshot_versioned()
+    assert ver2 > ver
+    s2 = loop._static_for(state2, ver2)
+    # Served stale: same object as the previous static, not a rebuild.
+    assert s2 is s1
+    deadline = time.monotonic() + 20.0
+    while loop._static_version < ver2:
+        assert time.monotonic() < deadline, "worker never published"
+        time.sleep(0.01)
+    s3 = loop._static_for(state2, ver2)
+    assert s3 is not None
+    _assert_tree_equal(s3, compute_assign_static(state2, cfg), "async")
+    loop.stop_static_refresher()
+
+
+def test_async_static_sync_fallback_on_breach():
+    """Falling more than static_max_versions_behind versions behind
+    breaches the staleness contract: the call must rebuild
+    synchronously (bounded staleness even with a dead worker) and
+    return the fresh static.  Two version bumps per cycle against the
+    floor bound of 1 guarantees the breach every time."""
+    cfg = SchedulerConfig(max_nodes=32, max_pods=8, max_peers=2,
+                          enable_async_static=True,
+                          static_max_staleness_s=30.0,
+                          static_max_versions_behind=1)
+    _, loop = _loop_fixture(cfg)
+    state, ver = loop.encoder.snapshot_versioned()
+    loop._static_for(state, ver)
+    before = loop.static_sync_builds
+    for k in range(3):
+        loop.encoder.update_link("node-0003", "node-0004",
+                                 bw_bps=1e9 + k * 1e8)
+        loop.encoder.snapshot_versioned()
+        loop.encoder.update_link("node-0005", "node-0006",
+                                 lat_ms=0.2 + k * 0.01)
+        state, ver = loop.encoder.snapshot_versioned()
+        got = loop._static_for(state, ver)
+        assert loop._static_version == ver
+        _assert_tree_equal(got, compute_assign_static(state, cfg),
+                           f"sync fallback {k}")
+    assert loop.static_sync_builds == before + 3
+    loop.stop_static_refresher()
+
+
+def test_delta_disabled_reproduces_bindings_bit_identically():
+    """``enable_delta_state=False`` must reproduce the delta run's
+    behavior exactly: same bindings and a bit-identical final
+    snapshot under interleaved churn (the r7 acceptance criterion)."""
+    from kubernetesnetawarescheduler_tpu.bench.fakecluster import (
+        WorkloadSpec,
+        generate_workload,
+    )
+
+    outs = {}
+    for flag in (True, False):
+        cfg = SchedulerConfig(max_nodes=32, max_pods=8, max_peers=2,
+                              queue_capacity=128,
+                              enable_delta_state=flag)
+        cluster, loop = _loop_fixture(cfg)
+        pods = generate_workload(WorkloadSpec(num_pods=40, seed=11),
+                                 scheduler_name=cfg.scheduler_name)
+        cluster.add_pods(pods)
+        rng = np.random.default_rng(12)
+        for _ in range(40):
+            a, b = rng.choice(24, size=2, replace=False)
+            loop.encoder.update_link(f"node-{a:04d}", f"node-{b:04d}",
+                                     lat_ms=float(rng.uniform(0.1, 2)),
+                                     bw_bps=float(rng.uniform(1e8,
+                                                              1e10)))
+            if loop.run_once(timeout=0.0) == 0 and not len(loop.queue):
+                break
+        loop.run_until_drained()
+        outs[flag] = (
+            {b.pod_name: b.node_name for b in cluster.bindings},
+            loop.encoder.snapshot())
+    assert outs[True][0] == outs[False][0]
+    assert outs[True][0], "nothing bound — vacuous parity"
+    _assert_tree_equal(outs[True][1], outs[False][1], "final snapshot")

@@ -240,6 +240,53 @@ def check_doc(path: str, doc: dict) -> list[str]:
             fails.append(f"{name}: cpu_density.pods_per_sec is a "
                          "single sample; round-6 canary requires "
                          "{mean,min,max,runs}")
+
+    # Rule 7 — incremental-state provenance (round 7+): a density
+    # headline that claims the 5 ms p99 bar must show HOW — the
+    # static_refresh block with at least one refresh and a staleness
+    # p99 inside the configured bound.  A bar met with zero refreshes
+    # under churn, or with scores built from state staler than the
+    # contract allows, is the r5 methodology bug in a new costume
+    # (fast Score() numbers bought by silently serving stale prep).
+    if not grandfathered:
+        sr = detail.get("static_refresh")
+        ns = detail.get("north_star")
+        p99_met = isinstance(ns, dict) and bool(ns.get("p99_met"))
+        if sr is None:
+            if p99_met:
+                fails.append(
+                    f"{name}: north_star.p99_met without a "
+                    "static_refresh block (cannot tell whether the "
+                    "Score() p99 was bought with stale static prep)")
+        elif not isinstance(sr, dict):
+            fails.append(f"{name}: static_refresh is not an object")
+        else:
+            required = {"count", "p99_ms", "delta_bytes", "full_bytes",
+                        "staleness_at_score_p99_ms", "staleness_bound_s"}
+            missing = required - set(sr)
+            if missing:
+                fails.append(f"{name}: static_refresh missing "
+                             f"{sorted(missing)}")
+            else:
+                try:
+                    count = int(sr["count"])
+                    stale_p99 = float(sr["staleness_at_score_p99_ms"])
+                    bound_s = float(sr["staleness_bound_s"])
+                except (TypeError, ValueError):
+                    fails.append(f"{name}: static_refresh not numeric")
+                else:
+                    if bound_s > 0 and stale_p99 > bound_s * 1e3:
+                        fails.append(
+                            f"{name}: staleness_at_score_p99_ms "
+                            f"{stale_p99} exceeds the declared bound "
+                            f"{bound_s}s — the staleness contract the "
+                            "doc claims was not actually held")
+                    if p99_met and count < 1:
+                        fails.append(
+                            f"{name}: north_star.p99_met with "
+                            "static_refresh.count=0 — the refresh "
+                            "path never ran, so the p99 measures an "
+                            "unrefreshed (frozen-state) serve")
     return fails
 
 
