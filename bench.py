@@ -394,6 +394,13 @@ def _assemble_doc(res, *, num_nodes: int, batch: int, method: str,
                                 3),
             "retry_count": int(getattr(res, "bind_retry_count", 0)),
         }
+    if getattr(res, "trace_provenance", None):
+        # Decision-level trace provenance (r8, bench_check Rule 8):
+        # ring-buffer accounting + the worst retained cycle span, so
+        # any claimed p99 is attributable to a concrete cycle.  The
+        # full Perfetto-loadable trace lands at trace_out when
+        # --trace-out / BENCH_TRACE_OUT is set.
+        detail["trace_provenance"] = res.trace_provenance
     if device_lat is not None:
         detail.update({
             "score_p50_ms": device_lat["p50_ms"],
@@ -624,6 +631,16 @@ def main() -> None:
             sys.exit(2)
         _run_suite_bench(argv[idx + 1])
         return
+    if "--trace-out" in argv:
+        # Flight-recorder trace artifact leg: the density run dumps
+        # its recorder (Chrome trace-event JSON, Perfetto-loadable,
+        # lint with tools/trace_check.py) to this path.  Stored in the
+        # env so comparison-mode child legs inherit it.
+        idx = argv.index("--trace-out")
+        if idx + 1 >= len(argv):
+            print("ERROR: --trace-out needs a path", file=sys.stderr)
+            sys.exit(2)
+        os.environ["BENCH_TRACE_OUT"] = argv[idx + 1]
     tpu_ok = True
     force_cpu = os.environ.get("BENCH_FORCE_CPU", "") == "1"
     if "BENCH_CHILD" not in os.environ and not force_cpu:
@@ -817,6 +834,11 @@ def main() -> None:
         else:
             trace_cm = contextlib.nullcontext()
         backend = backends[0]
+        trace_out = os.environ.get("BENCH_TRACE_OUT", "")
+        if trace_out and "BENCH_CHILD" in os.environ:
+            # Comparison-mode legs share the parent env: suffix per
+            # backend so the two children don't clobber one dump.
+            trace_out = f"{trace_out}.{backend}"
         try:
             with trace_cm:
                 res = run_density(
@@ -824,6 +846,7 @@ def main() -> None:
                     batch_size=batch, method=method, mode=mode,
                     chunk_batches=chunk_batches, score_backend=backend,
                     mesh=mesh, churn_links=churn_links,
+                    trace_out=trace_out or None,
                     # Host mode defaults to the three-stage pipelined
                     # datapath (encode-ahead ∥ device step ∥ async
                     # bind); BENCH_HOST_PIPELINED=0 reverts to the

@@ -556,6 +556,36 @@ class ExtenderHandlers:
                 render_metrics,
             )
             return render_metrics(self._loop).encode()
+        if path == "/debug/trace":
+            # Flight-recorder dump as Chrome trace-event JSON: save
+            # the body to a file and open it in Perfetto/chrome://
+            # tracing (docs/OPERATIONS.md "Debugging a slow cycle").
+            flight = getattr(self._loop, "flight", None)
+            if flight is None:
+                return self._json({
+                    "error": "flight recorder disabled "
+                             "(flight_recorder_size=0)"})
+            return self._json(flight.to_chrome_trace())
+        if path.startswith("/explain/"):
+            # Placement explainability: why pod <uid> landed where it
+            # did — top-k candidates with the score decomposition and
+            # the gates that filtered the rest.  Requires
+            # cfg.enable_explain (records are captured at decision
+            # time, not re-derived here — state has moved on since).
+            flight = getattr(self._loop, "flight", None)
+            uid = path[len("/explain/"):]
+            rec = (flight.get_explain(uid)
+                   if flight is not None and uid else None)
+            if rec is None:
+                return self._json({
+                    "error": f"no explain record for pod uid {uid!r}",
+                    "enable_explain": bool(
+                        getattr(self._loop.cfg, "enable_explain",
+                                False)),
+                    "retained": (flight.explains_len()
+                                 if flight is not None else 0),
+                })
+            return self._json(rec)
         raise ValueError(f"unknown op {path!r}")
 
     def readyz(self) -> dict:

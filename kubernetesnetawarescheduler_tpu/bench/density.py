@@ -13,6 +13,7 @@ BASELINE.json north-star metrics (>=10k pods/sec, p99 Score() < 5 ms at
 from __future__ import annotations
 
 import dataclasses
+import json
 import os
 import time
 
@@ -82,6 +83,11 @@ class DensityResult:
     # full_bytes — one link probe re-uploaded the N×N matrices).
     delta_bytes: int = 0
     full_bytes: int = 0
+    # Flight-recorder provenance (r8): worst retained cycle span +
+    # ring accounting.  bench_check Rule 8 requires this block on any
+    # r8+ artifact claiming the p99 bar — a tail-latency claim must be
+    # attributable to a concrete cycle, not just a window percentile.
+    trace_provenance: dict = dataclasses.field(default_factory=dict)
     # Bind-tail split (r7 satellite): r5 reported a 905.74 ms
     # "bind_p99_ms" that was actually drain serialization.  Split the
     # bind cost by cause: queue wait (assignment fetched, binder
@@ -129,6 +135,40 @@ def _static_stats(loop: "SchedulerLoop") -> dict:
             getattr(enc, "snapshot_delta_bytes_total", 0)),
         "full_bytes": int(
             getattr(enc, "snapshot_full_bytes_total", 0)),
+    }
+
+
+def _flight_stats(loop: "SchedulerLoop",
+                  trace_out: str | None = None) -> dict:
+    """Flight-recorder provenance for the artifact: ring accounting
+    plus the worst retained cycle span (bench_check Rule 8), and —
+    when ``trace_out`` is set — the whole recorder dumped as a
+    Perfetto-loadable trace leg (lint: tools/trace_check.py)."""
+    flight = getattr(loop, "flight", None)
+    if flight is None:
+        return {}
+    if trace_out:
+        with open(trace_out, "w", encoding="utf-8") as fh:
+            json.dump(flight.to_chrome_trace(), fh, indent=1,
+                      sort_keys=True)
+    worst = flight.worst_cycle()
+    worst_doc: dict = {}
+    if worst is not None:
+        worst_doc = {
+            "cycle_id": int(worst.cycle_id),
+            "dur_ms": round(worst.dur_s * 1e3, 3),
+            "path": worst.path,
+            "phases": [[name, round(rel * 1e3, 3), round(dur * 1e3, 3)]
+                       for name, rel, dur in worst.phases],
+        }
+    return {
+        "trace_provenance": {
+            "spans": len(flight),
+            "capacity": int(flight.capacity),
+            "dropped": int(flight.dropped),
+            "worst_cycle": worst_doc,
+            "trace_out": trace_out or "",
+        },
     }
 
 
@@ -261,7 +301,8 @@ def run_density(num_nodes: int = 100, num_pods: int = 300,
                 score_backend: str = "xla",
                 sampler=None, mesh=None,
                 pipelined: bool = False,
-                churn_links: int = 0) -> DensityResult:
+                churn_links: int = 0,
+                trace_out: str | None = None) -> DensityResult:
     """Schedule ``num_pods`` generated pods onto a ``num_nodes`` fake
     cluster; returns throughput/latency stats (compile excluded via a
     warmup cycle).
@@ -323,7 +364,8 @@ def run_density(num_nodes: int = 100, num_pods: int = 300,
                                    num_nodes, seed, warmup, sampler,
                                    chunk_batches=chunk_batches,
                                    pipeline=(mode == "pipeline"),
-                                   mesh=mesh, churn_links=churn_links)
+                                   mesh=mesh, churn_links=churn_links,
+                                   trace_out=trace_out)
 
     if warmup:
         wloop = _throwaway_loop(num_nodes, seed, cfg, method)
@@ -395,6 +437,7 @@ def run_density(num_nodes: int = 100, num_pods: int = 300,
         bind_retry_count=int(loop.bind_failures),
         staleness_bound_s=float(cfg.static_max_staleness_s),
         **_static_stats(loop),
+        **_flight_stats(loop, trace_out),
     )
 
 
@@ -404,7 +447,8 @@ def _run_density_device(cluster, loop: SchedulerLoop, pods, cfg,
                         chunk_batches: int = 2,
                         pipeline: bool = False,
                         mesh=None,
-                        churn_links: int = 0) -> DensityResult:
+                        churn_links: int = 0,
+                        trace_out: str | None = None) -> DensityResult:
     """Device-resident drain, two strategies sharing one harness.
 
     ``pipeline=False`` — whole-workload replay: ONE dispatch, one
@@ -669,8 +713,21 @@ def _run_density_device(cluster, loop: SchedulerLoop, pods, cfg,
         else:
             chunks = replay_stream_pipelined(state, stream, cfg, method,
                                              chunk_batches)
+        chunk_iter = iter(chunks)
         prev = time.perf_counter()
-        for pod_start, assignment, rounds in chunks:
+        while True:
+            # One flight-recorder span per chunk arrival: the bench
+            # drain leaves the same decision-level trace a serving
+            # deployment would, so --trace-out and the artifact's
+            # trace_provenance block work in the headline pipeline
+            # mode too (path "bench_chunk", device_wait = the blocking
+            # fetch this mode's score percentiles are built from).
+            sb = loop._span_begin("bench_chunk")
+            try:
+                with sb.phase("device_wait"):
+                    pod_start, assignment, rounds = next(chunk_iter)
+            except StopIteration:
+                break
             round_samples.extend(int(r) for r in rounds)
             now = time.perf_counter()
             # Host-observed latency of this chunk (blocking fetch),
@@ -680,14 +737,17 @@ def _run_density_device(cluster, loop: SchedulerLoop, pods, cfg,
             chunk_times.append((now - prev) / batches_in_chunk)
             prev = now
             end = min(pod_start + len(assignment), len(queued))
+            chunk_pods = queued[pod_start:end]
             if pod_start < end:
-                work.put((time.perf_counter(), queued[pod_start:end],
+                work.put((time.perf_counter(), chunk_pods,
                           assignment[:end - pod_start]))
             if churn_tick is not None:
                 # Host-side ingest + refresh handoff between fetches —
                 # lands in the next chunk sample, exactly where a
                 # serving cycle pays it.
-                _churn_refresh()
+                with sb.phase("ingest"):
+                    _churn_refresh()
+            loop._span_commit(sb, chunk_pods)
         device_span = time.perf_counter() - start - encode_wall
         work.put(None)
         t.join()
@@ -697,6 +757,11 @@ def _run_density_device(cluster, loop: SchedulerLoop, pods, cfg,
             raise binder_error[0]
         bound = bound_total[0]
     else:
+        # Monolithic replay = one serving "cycle" in the recorder: one
+        # device_wait phase (the whole-workload dispatch+fetch) and one
+        # bind phase covering the per-batch bind pass.
+        sb = loop._span_begin("bench_device")
+        t_dev = time.perf_counter()
         if mesh is not None:
             assignment_dev, _final = _mesh_run(stream)
         else:
@@ -704,10 +769,13 @@ def _run_density_device(cluster, loop: SchedulerLoop, pods, cfg,
                 state, stream, cfg, method, with_stats=True)
             round_samples.extend(int(r) for r in np.asarray(rounds_dev))
         assignment = np.asarray(assignment_dev)[:len(queued)]
+        sb.add_phase("device_wait", t_dev,
+                     time.perf_counter() - t_dev)
         device_span = time.perf_counter() - start - encode_wall
         # Per-batch bind pass, sampled per batch — same fanout, real
         # percentiles instead of one monolithic wall.
         bound = 0
+        t_bind = time.perf_counter()
         for a in range(0, len(queued), cfg.max_pods):
             tb = time.perf_counter()
             bound += loop._bind_all(queued[a:a + cfg.max_pods],
@@ -717,6 +785,8 @@ def _run_density_device(cluster, loop: SchedulerLoop, pods, cfg,
             rtt_times.append(rtt)
             if churn_tick is not None:
                 _churn_refresh()
+        sb.add_phase("bind", t_bind, time.perf_counter() - t_bind)
+        loop._span_commit(sb, queued)
     wall = time.perf_counter() - start
     # Quiesce the background refresher off the timed window so the
     # refresh counters below are final.
@@ -753,6 +823,7 @@ def _run_density_device(cluster, loop: SchedulerLoop, pods, cfg,
         bind_retry_count=int(loop.bind_failures),
         staleness_bound_s=float(cfg.static_max_staleness_s),
         **_static_stats(loop),
+        **_flight_stats(loop, trace_out),
     )
 
 

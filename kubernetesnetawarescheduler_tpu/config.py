@@ -375,6 +375,17 @@ class SchedulerConfig:
     static_max_staleness_s: float = 0.25
     static_max_versions_behind: int = 8
 
+    # Decision-level tracing (utils/flight.py): ring-buffer capacity of
+    # the cycle-span flight recorder (0 disables recording entirely),
+    # and the per-pod placement-explain capture.  Explain re-derives the
+    # score decomposition host-side AFTER the jitted score/assign ran,
+    # so the scoring path stays bit-identical whether it is on or off —
+    # it costs extra host work per cycle, hence off by default.
+    flight_recorder_size: int = 512
+    enable_explain: bool = False
+    explain_top_k: int = 5
+    explain_retain: int = 512
+
     def __post_init__(self) -> None:
         if self.max_nodes <= 0 or self.max_pods <= 0 or self.max_peers <= 0:
             raise ValueError("shape limits must be positive")
@@ -432,6 +443,12 @@ class SchedulerConfig:
             raise ValueError("static_max_staleness_s must be > 0")
         if self.static_max_versions_behind < 1:
             raise ValueError("static_max_versions_behind must be >= 1")
+        if self.flight_recorder_size < 0:
+            raise ValueError("flight_recorder_size must be >= 0")
+        if self.explain_top_k < 1:
+            raise ValueError("explain_top_k must be >= 1")
+        if self.explain_retain < 1:
+            raise ValueError("explain_retain must be >= 1")
 
 
 # ---------------------------------------------------------------------------

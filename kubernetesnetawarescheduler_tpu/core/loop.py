@@ -12,6 +12,7 @@ cycle.
 
 from __future__ import annotations
 
+import contextlib
 import threading
 import time
 from typing import Sequence
@@ -37,6 +38,10 @@ from kubernetesnetawarescheduler_tpu.k8s.types import (
     Pod,
     failed_event,
     scheduled_event,
+)
+from kubernetesnetawarescheduler_tpu.utils.flight import (
+    NULL_SPAN,
+    FlightRecorder,
 )
 from kubernetesnetawarescheduler_tpu.utils.tracing import PhaseTimer
 
@@ -98,6 +103,22 @@ class SchedulerLoop:
         self.encoder = encoder if encoder is not None else Encoder(cfg)
         self.queue = PodQueue(cfg.queue_capacity)
         self.timer = PhaseTimer()
+        # Decision-level tracing (utils/flight.py): every serving cycle
+        # commits one CycleSpan into this bounded ring buffer, and
+        # (with cfg.enable_explain) serial/gang cycles retain a per-pod
+        # score-decomposition record.  Observation only — nothing here
+        # feeds back into scoring.  cfg.flight_recorder_size=0
+        # disables the recorder entirely (NULL_SPAN no-ops).
+        self.flight: FlightRecorder | None = (
+            FlightRecorder(cfg.flight_recorder_size, cfg.explain_retain)
+            if cfg.flight_recorder_size > 0 else None)
+        # Last-seen cumulative snapshot-upload byte counters, so spans
+        # carry per-cycle delta-vs-full increments.
+        self._flight_bytes = (0, 0)
+        # serve.py --jax-profile-dir flips this on: the device step is
+        # then wrapped in jax.profiler.StepTraceAnnotation so device
+        # traces correlate with flight-recorder cycle ids.
+        self.jax_profile = False
         self.scheduled = 0
         self.unschedulable = 0
         self.burst_cycles = 0  # backlog bursts served (observability)
@@ -257,6 +278,10 @@ class SchedulerLoop:
         # (run_once / flush_binds callers); retired before any state
         # read that must see its placements.
         self._pipe_inflight: tuple | None = None
+        # The in-flight burst's span builder + static version, committed
+        # at retire alongside the usage commit (crash-safety parity:
+        # a span only exists for cycles whose placements landed).
+        self._pipe_span: tuple | None = None
         self._encode_pool = None
         if self.pipelined:
             import concurrent.futures
@@ -406,6 +431,159 @@ class SchedulerLoop:
             self.queue.push(pp)
 
     # ------------------------------------------------------------------
+    # Decision-level tracing (utils/flight.py)
+
+    def _span_begin(self, path: str):
+        """Start a cycle span, or the shared no-op when the recorder
+        is disabled — call sites keep one code shape either way."""
+        if self.flight is None:
+            return NULL_SPAN
+        return self.flight.begin(path)
+
+    def _profile_step(self, step_num: int):
+        """Opt-in jax.profiler step annotation around the device step
+        (serve.py --jax-profile-dir): device trace steps then carry the
+        flight recorder's cycle id, so a Perfetto device timeline and
+        /debug/trace line up by number."""
+        if not self.jax_profile:
+            return contextlib.nullcontext()
+        import jax
+
+        return jax.profiler.StepTraceAnnotation(
+            "netaware_cycle", step_num=step_num)
+
+    def _span_commit(self, sb, pods: Sequence[Pod],
+                     static_version: int | None = None) -> None:
+        """Freeze and commit a cycle span.  Called where the cycle's
+        effects commit: end of the serial/burst/gang cycle, or at
+        RETIRE for the pipelined path — so a crash never leaves a span
+        claiming a cycle whose placements were lost."""
+        if self.flight is None or sb is NULL_SPAN:
+            return
+        enc = self.encoder
+        db = int(getattr(enc, "snapshot_delta_bytes_total", 0))
+        fb = int(getattr(enc, "snapshot_full_bytes_total", 0))
+        last_db, last_fb = self._flight_bytes
+        self._flight_bytes = (db, fb)
+        built = getattr(self, "_static_version", None)
+        behind = 0
+        if static_version is not None and built is not None:
+            behind = max(0, int(static_version) - int(built))
+        stale = 0.0
+        if self.cfg.enable_async_static and self._staleness_samples:
+            try:
+                stale = float(self._staleness_samples[-1])
+            except IndexError:
+                stale = 0.0
+        breaker = self.breaker
+        bstate = (str(getattr(breaker, "state", "closed"))
+                  if breaker is not None else "closed")
+        degraded = self.degraded
+        fault = ("apiserver_brownout" if degraded
+                 else "watch_gap" if self._relist_needed else None)
+        # Cap the per-span uid list: a whole-workload bench drain can
+        # retire tens of thousands of pods in one span, and the ring
+        # holds `capacity` spans — n_pods still carries the true count.
+        span = sb.finish(
+            n_pods=len(pods),
+            pod_uids=tuple(p.uid for p in pods[:64]),
+            queue_depth=len(self.queue),
+            static_staleness_s=stale,
+            static_versions_behind=behind,
+            breaker_state=bstate,
+            degraded=degraded,
+            fault_class=fault,
+            delta_bytes=max(db - last_db, 0),
+            full_bytes=max(fb - last_fb, 0),
+        )
+        self.flight.commit(span)
+
+    def _capture_explains(self, pods: Sequence[Pod], batch,
+                          assignment: np.ndarray, state, static,
+                          node_table, cycle_id: int, path: str,
+                          extra: dict | None = None) -> None:
+        """Retain a per-pod placement-explain record (top-k candidates
+        with the score decomposition and the gates that filtered the
+        rest).  Host-side, AFTER the jitted score/assign already ran —
+        gated by cfg.enable_explain, so when off the serving path is
+        untouched and placements are bit-identical.  Serial and gang
+        cycles only: burst/pipelined streams resolve in-stream peers
+        against mid-burst placements the snapshot no longer matches,
+        and an approximate decomposition would violate the
+        "reproduces the winner's score" contract."""
+        if (self.flight is None or not self.cfg.enable_explain
+                or not pods):
+            return
+        from kubernetesnetawarescheduler_tpu.core.score import (
+            explain_scores,
+        )
+
+        try:
+            comps = explain_scores(state, batch, self.cfg, static)
+        except Exception:  # noqa: BLE001 — observation never breaks serving
+            return
+        table_names, _gens = node_table
+        valid = np.asarray(state.node_valid, dtype=bool)
+        gate_keys = ("static_ok", "fits", "affinity", "anti",
+                     "sym_anti", "zone_ok", "spread_ok")
+        netmodel = getattr(self.encoder, "netmodel", None)
+        if netmodel is not None:
+            prov = {"network": "netmodel_blend",
+                    "pair_coverage": float(netmodel.coverage_fraction(
+                        self.encoder.num_nodes))}
+        else:
+            prov = {"network": "direct_probe"}
+        k = min(self.cfg.explain_top_k, len(table_names))
+        total = comps["total"]
+        now = time.time()
+        for i, pod in enumerate(pods):
+            row = total[i]
+            idx = int(assignment[i])
+            order = np.argsort(row, kind="stable")[::-1][:k]
+            candidates = []
+            for j in order:
+                j = int(j)
+                name = (table_names[j]
+                        if j < len(table_names) and table_names[j]
+                        else f"slot-{j}")
+                candidates.append({
+                    "node": name,
+                    "node_index": j,
+                    "total": float(row[j]),
+                    "feasible": bool(comps["ok"][i, j]),
+                    "components": {
+                        "base": float(comps["base"][i, j]),
+                        "net": float(comps["net"][i, j]),
+                        "soft": float(comps["soft"][i, j]),
+                        "balance": -float(comps["balance"][i, j]),
+                        "spread": -float(comps["spread"][i, j]),
+                    },
+                    "gates": {g: bool(comps[g][i, j])
+                              for g in gate_keys},
+                })
+            record = {
+                "pod_uid": pod.uid,
+                "pod": f"{pod.namespace}/{pod.name}",
+                "cycle_id": cycle_id,
+                "path": path,
+                "t_wall": now,
+                "decision": "bound" if idx >= 0 else "unschedulable",
+                "node": (table_names[idx]
+                         if 0 <= idx < len(table_names) else None),
+                "node_index": idx,
+                "score": float(row[idx]) if idx >= 0 else None,
+                "candidates": candidates,
+                "feasible_nodes": int(np.sum(valid & comps["ok"][i])),
+                "gates_filtered": {
+                    g: int(np.sum(valid & ~comps[g][i]))
+                    for g in gate_keys},
+                "provenance": prov,
+            }
+            if extra:
+                record.update(extra)
+            self.flight.put_explain(record)
+
+    # ------------------------------------------------------------------
 
     def run_once(self, timeout: float | None = 0.0) -> int:
         """One cycle: pop up to ``max_pods`` pods, schedule, bind.
@@ -513,6 +691,7 @@ class SchedulerLoop:
         # ``burst_wall`` — the latency the last batch in the burst
         # actually observed end-to-end.
         n_real = -(-len(pods) // self.cfg.max_pods)
+        sb = self._span_begin("burst")
         cycle_t0 = time.perf_counter()
         t0 = cycle_t0
         stream = self.encoder.encode_stream(
@@ -526,26 +705,29 @@ class SchedulerLoop:
                             self.burst_batches * self.cfg.max_pods)
         state, version = self.encoder.snapshot_versioned()
         node_table = self.encoder.node_table()
+        sb.add_phase("encode", t0, time.perf_counter() - t0)
         self.timer.record("encode",
                           (time.perf_counter() - t0) / n_real,
                           count=n_real)
         self._emit_degraded_events()
         t0 = time.perf_counter()
-        if self._sharded_burst is not None:
-            # Mesh path: the shared-placer sharded scan (node axis on
-            # tp, batch axis on dp); static prep runs inside the
-            # dispatch like the mesh per-batch path, amortized over
-            # the burst.
-            out, with_stats = self._sharded_burst(state, stream)
-        else:
-            with_stats = self.method == "parallel"
-            # Same version-keyed static cache as the per-batch cycle —
-            # recomputing the O(N²) prep inside every burst dispatch
-            # halved serving throughput on the CPU fallback.
-            static = self._static_for(state, version)
-            out = replay_stream_static(state, stream, static, self.cfg,
-                                       self.method,
-                                       with_stats=with_stats)
+        with self._profile_step(sb.cycle_id):
+            if self._sharded_burst is not None:
+                # Mesh path: the shared-placer sharded scan (node axis
+                # on tp, batch axis on dp); static prep runs inside
+                # the dispatch like the mesh per-batch path, amortized
+                # over the burst.
+                out, with_stats = self._sharded_burst(state, stream)
+            else:
+                with_stats = self.method == "parallel"
+                # Same version-keyed static cache as the per-batch
+                # cycle — recomputing the O(N²) prep inside every
+                # burst dispatch halved serving throughput on the CPU
+                # fallback.
+                static = self._static_for(state, version)
+                out = replay_stream_static(state, stream, static,
+                                           self.cfg, self.method,
+                                           with_stats=with_stats)
         if with_stats:
             assignment_dev, _final_state, rounds_dev = out
             assignment = np.asarray(jax_block(assignment_dev))
@@ -556,6 +738,7 @@ class SchedulerLoop:
         else:
             assignment_dev, _final_state = out
             assignment = np.asarray(jax_block(assignment_dev))
+        sb.add_phase("score_assign", t0, time.perf_counter() - t0)
         self.timer.record("score_assign",
                           (time.perf_counter() - t0) / n_real,
                           count=n_real)
@@ -566,12 +749,14 @@ class SchedulerLoop:
                                              node_table)
         else:
             bound = self._bind_all(pods, assignment, node_table)
+        sb.add_phase("bind", t0, time.perf_counter() - t0)
         self.timer.record("bind",
                           (time.perf_counter() - t0) / n_real,
                           count=n_real)
         self.timer.record("burst_wall",
                           time.perf_counter() - cycle_t0)
         self.burst_cycles += 1
+        self._span_commit(sb, pods, static_version=version)
         return bound
 
     def _pipeline_cycle(self, pods: Sequence[Pod]) -> int:
@@ -593,6 +778,7 @@ class SchedulerLoop:
         )
 
         n_real = -(-len(pods) // self.cfg.max_pods)
+        sb = self._span_begin("pipelined")
 
         def _timed_prepare():
             t = time.perf_counter()
@@ -605,6 +791,8 @@ class SchedulerLoop:
         # worker prepares this burst's arrays.
         bound = self._retire_inflight()
         prepared, encode_s = fut.result()
+        sb.add_phase("encode", time.perf_counter() - encode_s,
+                     encode_s)
         self.timer.record("encode", encode_s / n_real, count=n_real)
         t0 = time.perf_counter()
         stream = self.encoder.finalize_stream(prepared,
@@ -616,23 +804,26 @@ class SchedulerLoop:
         state, version = self.encoder.snapshot_versioned()
         node_table = self.encoder.node_table()
         self._emit_degraded_events()
-        if self._sharded_burst is not None:
-            out, with_stats = self._sharded_burst(state, stream)
-        else:
-            with_stats = self.method == "parallel"
-            static = self._static_for(state, version)
-            out = replay_stream_static(state, stream, static,
-                                       self.cfg, self.method,
-                                       with_stats=with_stats)
+        with self._profile_step(sb.cycle_id):
+            if self._sharded_burst is not None:
+                out, with_stats = self._sharded_burst(state, stream)
+            else:
+                with_stats = self.method == "parallel"
+                static = self._static_for(state, version)
+                out = replay_stream_static(state, stream, static,
+                                           self.cfg, self.method,
+                                           with_stats=with_stats)
         # JAX async dispatch: the device step runs from here until
         # the fetch in _retire_inflight; "dispatch" records only the
         # host-side cost of getting it launched (finalize + snapshot
         # + trace/launch), the pipeline's exposed serial share.
+        sb.add_phase("dispatch", t0, time.perf_counter() - t0)
         self.timer.record("dispatch",
                           (time.perf_counter() - t0) / n_real,
                           count=n_real)
         self._pipe_inflight = (pods, out, with_stats, node_table,
                                n_real, time.perf_counter())
+        self._pipe_span = (sb, version)
         self.burst_cycles += 1
         return bound
 
@@ -648,6 +839,10 @@ class SchedulerLoop:
         self._pipe_inflight = None
         pods, out, with_stats, node_table, n_real, t_dispatch = \
             inflight
+        sb, span_version = (self._pipe_span
+                            if self._pipe_span is not None
+                            else (NULL_SPAN, None))
+        self._pipe_span = None
         t0 = time.perf_counter()
         if with_stats:
             assignment_dev, _final_state, rounds_dev = out
@@ -662,21 +857,25 @@ class SchedulerLoop:
         # The exposed device wait: whatever of the step did NOT
         # overlap host work since dispatch.  Feeds the same
         # score_assign percentile stream as the serial cycle.
+        sb.add_phase("score_assign", t0, time.perf_counter() - t0)
         self.timer.record("score_assign",
                           (time.perf_counter() - t0) / n_real,
                           count=n_real)
         assignment = assignment[:len(pods)]
         t0 = time.perf_counter()
         bound = self._assume_and_enqueue(pods, assignment, node_table)
+        sb.add_phase("bind", t0, time.perf_counter() - t0)
         self.timer.record("bind",
                           (time.perf_counter() - t0) / n_real,
                           count=n_real)
         self.timer.record("burst_wall",
                           time.perf_counter() - t_dispatch)
+        self._span_commit(sb, pods, static_version=span_version)
         return bound
 
     def schedule_pods(self, pods: Sequence[Pod]) -> int:
-        with self.timer.phase("encode"):
+        sb = self._span_begin("serial")
+        with sb.phase("encode"), self.timer.phase("encode"):
             # Lenient: pods arrive from the watch (untrusted
             # manifests), and one pod with un-internable constraints
             # must degrade ITSELF (conservative bit directions +
@@ -698,29 +897,35 @@ class SchedulerLoop:
             # slot's new tenant.
             node_table = self.encoder.node_table()
         self._emit_degraded_events()
-        with self.timer.phase("score_assign"):
+        static = None
+        with sb.phase("score_assign"), self.timer.phase("score_assign"):
             stats = self.method == "parallel"
             # assign_greedy has no with_stats parameter — pass the kw
             # only when asking for it (stats implies parallel).
             kw = {"with_stats": True} if stats else {}
-            if self._assign_takes_static:
-                static = self._static_for(state, static_version)
-                out = self._assign(state, batch, self.cfg, static, **kw)
-            else:
-                out = self._assign(state, batch, self.cfg, **kw)
-            if stats:
-                assignment_dev, rounds = out
-                assignment = np.asarray(jax_block(assignment_dev))
-                with self._round_lock:
-                    self.round_samples.append(int(rounds))
-            else:
-                assignment = np.asarray(jax_block(out))
-        with self.timer.phase("bind"):
+            with self._profile_step(sb.cycle_id):
+                if self._assign_takes_static:
+                    static = self._static_for(state, static_version)
+                    out = self._assign(state, batch, self.cfg, static,
+                                       **kw)
+                else:
+                    out = self._assign(state, batch, self.cfg, **kw)
+                if stats:
+                    assignment_dev, rounds = out
+                    assignment = np.asarray(jax_block(assignment_dev))
+                    with self._round_lock:
+                        self.round_samples.append(int(rounds))
+                else:
+                    assignment = np.asarray(jax_block(out))
+        with sb.phase("bind"), self.timer.phase("bind"):
             if self.async_bind:
                 bound = self._assume_and_enqueue(pods, assignment,
                                                  node_table)
             else:
                 bound = self._bind_all(pods, assignment, node_table)
+        self._capture_explains(pods, batch, assignment, state, static,
+                               node_table, sb.cycle_id, "serial")
+        self._span_commit(sb, pods, static_version=static_version)
         return bound
 
     def _static_for(self, state, version: int):
@@ -871,13 +1076,14 @@ class SchedulerLoop:
                 total += self.schedule_pods(
                     members[i:i + self.cfg.max_pods])
             return total
-        with self.timer.phase("encode"):
+        sb = self._span_begin("gang")
+        with sb.phase("encode"), self.timer.phase("encode"):
             batch = self.encoder.encode_pods(
                 members, node_of=self._peer_node, lenient=True)
             state, static_version = self.encoder.snapshot_versioned()
             node_table = self.encoder.node_table()
         self._emit_degraded_events()
-        with self.timer.phase("score_assign"):
+        with sb.phase("score_assign"), self.timer.phase("score_assign"):
             if self._assign_takes_static:
                 static = self._static_for(state, static_version)
                 assign_fn = self._assign
@@ -889,11 +1095,24 @@ class SchedulerLoop:
                 static = None
                 assign_fn = {"greedy": assign_greedy,
                              "parallel": assign_parallel}[self.method]
-            assignment = place_gang(state, batch, self.cfg, static,
-                                    assign_fn, len(members))
-        with self.timer.phase("bind"):
-            return self._commit_gang(key, members, assignment,
-                                     node_table)
+            with self._profile_step(sb.cycle_id):
+                assignment = place_gang(state, batch, self.cfg, static,
+                                        assign_fn, len(members))
+        with sb.phase("bind"), self.timer.phase("bind"):
+            bound = self._commit_gang(key, members, assignment,
+                                      node_table)
+        # Explain records note the joint C-matrix pass: the per-node
+        # decomposition is the INDEPENDENT score surface; the gang's
+        # co-placement bias may have moved the winner off the
+        # independent argmax, which is exactly what the marker flags.
+        self._capture_explains(
+            members, batch, assignment, state, static, node_table,
+            sb.cycle_id, "gang",
+            extra={"gang": {"key": key, "members": len(members),
+                            "joint_placement": True,
+                            "bound": bool(bound)}})
+        self._span_commit(sb, members, static_version=static_version)
+        return bound
 
     def _commit_gang(self, key: str, members: list[Pod],
                      assignment: np.ndarray, node_table) -> int:

@@ -755,3 +755,81 @@ def score_pods(state: ClusterState, pods: PodBatch,
     raw = base[None, :] + net + soft - bal - spread_pen
     ok = feasibility_mask(state, pods, static_ok=sok) & spread_ok
     return jnp.where(ok, raw, NEG_INF)
+
+
+def _explain_terms(state: ClusterState, pods: PodBatch,
+                   cfg: SchedulerConfig, static=None) -> dict:
+    """Pure-JAX body of :func:`explain_scores`: every additive term and
+    every individual feasibility gate, as ``[P, N]`` (or broadcastable)
+    arrays.  Kept separate so tests can jit it once for the 64-instance
+    property sweep while production calls stay eager via the wrapper."""
+    if static is None:
+        static = static_node_scores(state, cfg)
+    base, ct = static
+    net = network_scores(state, pods, cfg, ct=ct)
+    soft = soft_affinity_scores(state, pods, cfg)
+    bal = cfg.weights.balance * balance_penalty(state, pods)
+    sok = static_feasibility(state, pods)
+    spread_pen, spread_ok = spread_terms(state, pods, cfg,
+                                         static_ok=sok)
+    free = state.cap - state.used
+    fits = jnp.all(pods.req[:, None, :] <= free[None, :, :] + _EPS,
+                   axis=-1)
+    aff_req = pods.affinity_bits[:, None, :]
+    affinity = jnp.all(
+        (state.group_bits[None, :, :] & aff_req) == aff_req, axis=-1)
+    anti = jnp.all(
+        (state.group_bits[None, :, :] & pods.anti_bits[:, None, :]) == 0,
+        axis=-1)
+    sym = jnp.all(
+        (state.resident_anti[None, :, :] & pods.group_bit[:, None, :])
+        == 0, axis=-1)
+    zone = zone_affinity_ok(state, pods)
+    ok = sok & fits & affinity & anti & sym & zone & spread_ok
+    raw = base[None, :] + net + soft - bal - spread_pen
+    total = jnp.where(ok, raw, NEG_INF)
+    return {
+        "base": base[None, :], "net": net, "soft": soft,
+        "balance": bal, "spread": spread_pen, "total": total,
+        "ok": ok, "static_ok": sok, "fits": fits,
+        "affinity": affinity, "anti": anti, "sym_anti": sym,
+        "zone_ok": zone, "spread_ok": spread_ok,
+    }
+
+
+def explain_scores(state: ClusterState, pods: PodBatch,
+                   cfg: SchedulerConfig, static=None
+                   ) -> dict[str, np.ndarray]:
+    """Host-side score decomposition for placement explainability.
+
+    Re-derives :func:`score_pods`'s additive terms AND the individual
+    feasibility gates as host numpy arrays, all ``[P, N]``.  This is
+    deliberately a separate, never-jitted call used only when
+    ``cfg.enable_explain`` is on: the serving score path is untouched,
+    so placements stay bit-identical whether explain runs or not
+    (tests/test_flight.py pins this).  ``total`` is computed with the
+    exact expression score_pods uses, so the winner's score is
+    reproducible from the components (tests/test_score.py property
+    test: base + net + soft - balance - spread == total where
+    feasible, within fp32 tolerance).
+
+    Gate keys mirror :func:`feasibility_mask`'s terms (the three
+    bit-field tests are restated here because the fused mask never
+    materializes them separately).
+    """
+    terms = _explain_terms(state, pods, cfg, static=static)
+    shape = np.asarray(terms["net"]).shape
+
+    def _f32(x):
+        return np.broadcast_to(
+            np.asarray(x, dtype=np.float32), shape).copy()
+
+    def _b(x):
+        return np.broadcast_to(np.asarray(x, dtype=bool), shape).copy()
+
+    out = {}
+    for key, val in terms.items():
+        is_gate = key in ("ok", "static_ok", "fits", "affinity",
+                          "anti", "sym_anti", "zone_ok", "spread_ok")
+        out[key] = _b(val) if is_gate else _f32(val)
+    return out

@@ -127,6 +127,20 @@ def main(argv=None) -> int:
                          "deploy/scorer.yaml; empty disables")
     ap.add_argument("--decision-log", default="",
                     help="JSONL decision log path")
+    ap.add_argument("--jax-profile-dir", default="",
+                    help="opt-in jax.profiler trace directory: the "
+                         "serving run is wrapped in start/stop_trace "
+                         "and every device step carries a "
+                         "StepTraceAnnotation with the flight "
+                         "recorder's cycle id, so the Perfetto device "
+                         "timeline lines up with /debug/trace; empty "
+                         "disables")
+    ap.add_argument("--crash-dump", default="",
+                    help="path for the flight-recorder post-mortem "
+                         "dump (cycle spans + last explain records) "
+                         "written on SIGTERM/fault; defaults to "
+                         "<checkpoint-dir>/flight_dump.json when "
+                         "--checkpoint-dir is set, else disabled")
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--once", action="store_true",
                     help="serve one readiness cycle then exit "
@@ -322,6 +336,14 @@ def main(argv=None) -> int:
                   f"vs {len(loop.encoder._node_names)} live nodes)",
                   file=sys.stderr)
 
+    # The flight recorder does not persist across restarts (spans
+    # describe THIS process's cycles) — but a post-restore trace dump
+    # must say WHY it is empty: stamp the checkpoint disposition so
+    # /debug/trace metadata reads restored/ignored/fresh
+    # (empty-but-versioned, never silently blank).
+    if loop.flight is not None:
+        loop.flight.meta["checkpoint_state"] = loop.checkpoint_state
+
     if args.decision_log:
         from kubernetesnetawarescheduler_tpu.core.checkpoint import (
             DecisionLog,
@@ -511,6 +533,19 @@ def main(argv=None) -> int:
     # reconcile the usage ledger against the live pod listing (pods
     # deleted while we were down emit no watch event).
     last_maint = time.monotonic()
+    profiling = False
+    if args.jax_profile_dir:
+        import jax
+
+        jax.profiler.start_trace(args.jax_profile_dir)
+        loop.jax_profile = True
+        profiling = True
+        print(f"jax profiler tracing to {args.jax_profile_dir}",
+              file=sys.stderr)
+    crash_dump_path = args.crash_dump or (
+        os.path.join(args.checkpoint_dir, "flight_dump.json")
+        if args.checkpoint_dir else "")
+    dump_reason = "exit"
     try:
         loop.maintain()
         while not stop.is_set():
@@ -520,7 +555,32 @@ def main(argv=None) -> int:
                 last_maint = time.monotonic()
             if args.once:
                 break
+        if stop.is_set():
+            dump_reason = "sigterm"
+    except BaseException:
+        dump_reason = "fault"
+        raise
     finally:
+        if profiling:
+            import jax
+
+            loop.jax_profile = False
+            try:
+                jax.profiler.stop_trace()
+            except Exception as exc:  # noqa: BLE001 — best-effort
+                print(f"WARNING: jax profiler stop failed: {exc}",
+                      file=sys.stderr)
+        # Post-mortem first: the recorder's last spans + explain
+        # records survive even if the checkpoint path below fails.
+        if crash_dump_path and loop.flight is not None:
+            try:
+                loop.flight.crash_dump(crash_dump_path,
+                                       reason=dump_reason)
+                print(f"flight recorder dumped to {crash_dump_path} "
+                      f"({dump_reason})", file=sys.stderr)
+            except Exception as exc:  # noqa: BLE001 — best-effort
+                print(f"WARNING: flight dump failed: {exc}",
+                      file=sys.stderr)
         ledger_settled = True
         try:
             # Settle the ledger before it is checkpointed: queued bind

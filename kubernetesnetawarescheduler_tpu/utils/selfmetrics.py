@@ -189,6 +189,24 @@ def render_metrics(loop) -> str:
                 "Per-pair probe bookkeeping entries pruned past the "
                 "forget horizon")
 
+    # Decision-level tracing (utils/flight.py): the cycle sequence and
+    # drop counter make recorder overflow VISIBLE — if dropped grows
+    # between scrapes, /debug/trace no longer covers the full window
+    # and flight_recorder_size needs raising before the next incident.
+    flight = getattr(loop, "flight", None)
+    if flight is not None:
+        gauge("netaware_cycle_seq", float(flight.cycle_seq),
+              "Monotonic serving-cycle sequence number (flight "
+              "recorder span ids)")
+        counter("netaware_flight_dropped_total", float(flight.dropped),
+                "Cycle spans evicted from the flight recorder's ring "
+                "buffer (overflow)")
+        gauge("netaware_flight_spans", float(len(flight)),
+              "Cycle spans currently retained by the flight recorder")
+        gauge("netaware_explain_records", float(flight.explains_len()),
+              "Placement explain records currently retained "
+              "(enable_explain)")
+
     # Extender webhook micro-batcher (api/extender._ScoreBatcher):
     # dispatch count exposes the coalescing rate (requests served /
     # dispatches = mean batch).

@@ -24,6 +24,25 @@ from typing import Deque, Iterator, Mapping
 MAX_SAMPLES_PER_PHASE = 8192
 
 
+def _weighted_percentile(samples: list[tuple[float, int]],
+                         q: float) -> float:
+    """Nearest-rank percentile over weight-expanded ``(seconds, count)``
+    pairs — identical to materializing each pair ``count`` times.  Pure
+    (no lock): callers pass an already-snapshotted list; the sort
+    happens here, outside any lock."""
+    if not samples:
+        return 0.0
+    samples = sorted(samples)
+    n = sum(c for _, c in samples)
+    rank = min(n - 1, max(0, int(round(q / 100.0 * (n - 1)))))
+    cum = 0
+    for value, c in samples:
+        cum += c
+        if rank < cum:
+            return value
+    return samples[-1][0]
+
+
 class PhaseTimer:
     """Accumulates wall-clock samples per named phase.
 
@@ -92,30 +111,37 @@ class PhaseTimer:
         """q in [0, 100]; nearest-rank on the weight-expanded sorted
         samples (identical to materializing each pair ``count``
         times).  Computed over the retained window — the most recent
-        ``max_samples`` weighted pairs."""
+        ``max_samples`` weighted pairs.  The lock only covers the
+        snapshot copy; the O(n log n) sort runs outside it so a scrape
+        never stalls the serving thread's ``record()``."""
         with self._lock:
-            samples = sorted(self._samples.get(name, ()))
-        if not samples:
-            return 0.0
-        n = sum(c for _, c in samples)
-        rank = min(n - 1, max(0, int(round(q / 100.0 * (n - 1)))))
-        cum = 0
-        for value, c in samples:
-            cum += c
-            if rank < cum:
-                return value
-        return samples[-1][0]
+            samples = list(self._samples.get(name, ()))
+        return _weighted_percentile(samples, q)
+
+    def _snapshot(self) -> tuple[dict[str, list[tuple[float, int]]],
+                                 dict[str, int], dict[str, float]]:
+        """One consistent copy of (samples, counts, totals) under a
+        single lock acquisition — the scrape path's entire critical
+        section."""
+        with self._lock:
+            samples = {name: list(buf)
+                       for name, buf in self._samples.items()}
+            counts = dict(self._counts)
+            totals = dict(self._totals)
+        return samples, counts, totals
 
     def summary(self) -> Mapping[str, Mapping[str, float]]:
-        with self._lock:
-            names = list(self._samples)
+        """One lock acquisition total (via :meth:`_snapshot`), then all
+        sorting and percentile math on the copies — previously this
+        re-took the lock 3×+ per phase and sorted inside it."""
+        samples, counts, totals = self._snapshot()
         out: dict[str, dict[str, float]] = {}
-        for name in names:
+        for name, buf in samples.items():
             out[name] = {
-                "count": float(self.count(name)),
-                "total_s": self.total(name),
-                "p50_ms": self.percentile(name, 50) * 1e3,
-                "p99_ms": self.percentile(name, 99) * 1e3,
+                "count": float(counts.get(name, 0)),
+                "total_s": totals.get(name, 0.0),
+                "p50_ms": _weighted_percentile(buf, 50) * 1e3,
+                "p99_ms": _weighted_percentile(buf, 99) * 1e3,
             }
         return out
 
@@ -135,17 +161,19 @@ class PhaseTimer:
             phases = {"encode": "encode", "dispatch": "dispatch",
                       "device_wait": "score_assign",
                       "bind": "bind_net"}
+        samples, counts, totals = self._snapshot()
         out: dict[str, dict[str, float]] = {}
         for stage, name in phases.items():
-            c = self.count(name)
+            c = counts.get(name, 0)
             if not c:
                 continue
-            tot = self.total(name)
+            tot = totals.get(name, 0.0)
+            buf = samples.get(name, [])
             out[stage] = {
                 "count": float(c),
                 "mean_ms": round(tot / c * 1e3, 3),
-                "p50_ms": round(self.percentile(name, 50) * 1e3, 3),
-                "p99_ms": round(self.percentile(name, 99) * 1e3, 3),
+                "p50_ms": round(_weighted_percentile(buf, 50) * 1e3, 3),
+                "p99_ms": round(_weighted_percentile(buf, 99) * 1e3, 3),
                 "total_s": round(tot, 3),
             }
         return out

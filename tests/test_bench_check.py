@@ -256,3 +256,68 @@ def test_topology_rules_fire():
             "blended_bw_gbps": 17.0, "gain_ratio": 2.0 / 11.0,
             "gain_target_met": True}))
     assert any("gain_target_met" in f for f in fails), fails
+
+
+def _trace_prov(**overrides):
+    """A valid r8 trace_provenance block (bench/density._flight_stats
+    shape)."""
+    block = {
+        "spans": 33,
+        "capacity": 512,
+        "dropped": 0,
+        "worst_cycle": {
+            "cycle_id": 17,
+            "dur_ms": 4.8,
+            "path": "bench_chunk",
+            "phases": [["device_wait", 0.01, 3.9],
+                       ["ingest", 4.0, 0.7]],
+        },
+        "trace_out": "",
+    }
+    block.update(overrides)
+    return block
+
+
+def test_trace_provenance_required_from_round8():
+    # r8+ headline claiming the p99 bar without the block: fails.
+    doc = _headline()
+    fails = bench_check.check_doc("BENCH_r08.json", doc)
+    assert any("trace_provenance" in f for f in fails), fails
+    # Same doc with the block: clean.
+    ok = _headline(detail={"trace_provenance": _trace_prov()})
+    assert bench_check.check_doc("BENCH_r08.json", ok) == []
+    # Committed r6/r7 history predates the recorder: exempt.
+    assert bench_check.check_doc("BENCH_r06.json", doc) == []
+    assert bench_check.check_doc("BENCH_r07.json", doc) == []
+    # A doc not claiming the bar may omit the block even at r8+.
+    quiet = _headline()
+    quiet["detail"]["score_p99_ms"] = 87.44
+    quiet["detail"]["north_star"]["p99_met"] = False
+    assert bench_check.check_doc("BENCH_r08.json", quiet) == []
+
+
+def test_trace_provenance_shape_validated_when_present():
+    # Zero spans cannot back a claimed p99.
+    fails = bench_check.check_doc("BENCH_r08.json", _headline(
+        detail={"trace_provenance": _trace_prov(spans=0)}))
+    assert any("spans=0" in f for f in fails), fails
+    # More spans than capacity: the ring is not actually bounded.
+    fails = bench_check.check_doc("BENCH_r08.json", _headline(
+        detail={"trace_provenance": _trace_prov(spans=600)}))
+    assert any("over capacity" in f for f in fails), fails
+    # Missing accounting keys.
+    bad = _trace_prov()
+    del bad["dropped"]
+    fails = bench_check.check_doc("BENCH_r08.json", _headline(
+        detail={"trace_provenance": bad}))
+    assert any("trace_provenance missing" in f for f in fails), fails
+    # worst_cycle must name its cycle, duration, path, and phases.
+    bad2 = _trace_prov()
+    del bad2["worst_cycle"]["phases"]
+    fails = bench_check.check_doc("BENCH_r08.json", _headline(
+        detail={"trace_provenance": bad2}))
+    assert any("worst_cycle" in f for f in fails), fails
+    # Validated even on a pre-r8 filename: carrying the block opts in.
+    fails = bench_check.check_doc("BENCH_r06.json", _headline(
+        detail={"trace_provenance": _trace_prov(spans=600)}))
+    assert any("over capacity" in f for f in fails), fails

@@ -80,6 +80,73 @@ def test_scores_match_oracle(instance):
                                rtol=1e-4, atol=1e-3)
 
 
+def test_explain_components_reconstruct_score():
+    """Property test over 64 fuzzed instances: the explain
+    decomposition's additive terms (base + net + soft - balance -
+    spread) must reconstruct score_pods' winning totals within fp32
+    tolerance, and its fused gate must match score_pods' feasibility
+    exactly — otherwise /explain/<uid> would publish a story the
+    scheduler didn't act on."""
+    import jax
+
+    # Jit the reference scorer AND the explain decomposition once each:
+    # on the single-core CI runner 64 eager explain sweeps blow the
+    # tier-1 wall-clock budget, and jit preserves the computation graph
+    # the property quantifies over.  Eager-wrapper parity (the exact
+    # production path) is pinned separately on the first few seeds.
+    score_fn = jax.jit(
+        lambda s, p: score_lib.score_pods(s, p, CFG))
+    explain_fn = jax.jit(
+        lambda s, p: score_lib._explain_terms(s, p, CFG))
+    for seed in range(64):
+        rng = np.random.default_rng(1000 + seed)
+        state_np, pods_np = gen.random_instance(rng, CFG,
+                                                n_nodes=12, n_pods=6)
+        state, pods = gen.to_pytrees(CFG, state_np, pods_np)
+        want = np.asarray(score_fn(state, pods))
+        exp = {k: np.asarray(v)
+               for k, v in explain_fn(state, pods).items()}
+        if seed < 2:
+            # The production entry point is the eager wrapper; pin it
+            # to the jitted terms (within fp32 noise) on a sample of
+            # seeds.
+            eager = score_lib.explain_scores(state, pods, CFG)
+            for key, val in eager.items():
+                np.testing.assert_allclose(
+                    val, np.broadcast_to(exp[key], val.shape),
+                    rtol=1e-6, atol=1e-6,
+                    err_msg=f"seed {seed} key {key}")
+        feasible = want > oracle.NEG_INF / 2
+        np.testing.assert_array_equal(exp["ok"], feasible,
+                                      err_msg=f"seed {seed}")
+        recon = (exp["base"] + exp["net"] + exp["soft"]
+                 - exp["balance"] - exp["spread"])
+        np.testing.assert_allclose(recon[feasible], want[feasible],
+                                   rtol=1e-4, atol=1e-3,
+                                   err_msg=f"seed {seed}")
+        np.testing.assert_allclose(exp["total"][feasible],
+                                   want[feasible],
+                                   rtol=1e-4, atol=1e-3,
+                                   err_msg=f"seed {seed}")
+        # Gated-out cells sit at the same sentinel score_pods uses.
+        assert np.all(exp["total"][~feasible] <= oracle.NEG_INF / 2), \
+            f"seed {seed}"
+
+
+def test_explain_gate_conjunction_matches_ok():
+    """The individual gates explain_scores reports must AND together
+    into its own fused ok — no hidden gate, no double counting."""
+    rng = np.random.default_rng(7)
+    state_np, pods_np = gen.random_instance(rng, CFG,
+                                            n_nodes=12, n_pods=6)
+    state, pods = gen.to_pytrees(CFG, state_np, pods_np)
+    exp = score_lib.explain_scores(state, pods, CFG)
+    fused = (exp["static_ok"] & exp["fits"] & exp["affinity"]
+             & exp["anti"] & exp["sym_anti"] & exp["zone_ok"]
+             & exp["spread_ok"])
+    np.testing.assert_array_equal(fused, exp["ok"])
+
+
 def test_reference_vote_parity():
     """A 5-node scenario shaped like the reference's weighted vote
     (scheduler.go:334-365): each node is the extreme winner of specific

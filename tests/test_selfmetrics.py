@@ -113,3 +113,34 @@ def test_batcher_and_degradation_metrics_exposed(tmp_path):
     assert next(iter(
         parsed["netaware_extender_dispatches_total"].values())) >= 1
     assert "netaware_constraint_degraded_pods_total" in parsed
+
+def test_flight_recorder_metrics_exposed():
+    """r8: the flight recorder's cycle sequence and ring-drop counter
+    are scrapeable, and agree with the recorder itself."""
+    loop = _run_loop(num_pods=24, seed=7)
+    parsed = parse_prometheus_text(render_metrics(loop))
+    flat = {name: next(iter(series.values()))
+            for name, series in parsed.items() if len(series) == 1}
+    assert loop.flight is not None
+    assert flat["netaware_cycle_seq"] == loop.flight.cycle_seq
+    assert flat["netaware_cycle_seq"] > 0
+    assert flat["netaware_flight_dropped_total"] == loop.flight.dropped
+    assert flat["netaware_flight_spans"] == len(loop.flight)
+
+
+def test_flight_metrics_absent_when_recorder_disabled():
+    cluster, lat, bw = build_fake_cluster(ClusterSpec(num_nodes=20,
+                                                      seed=9))
+    cfg = SchedulerConfig(max_nodes=32, max_pods=8, max_peers=2,
+                          queue_capacity=200, flight_recorder_size=0)
+    loop = SchedulerLoop(cluster, cfg)
+    loop.encoder.set_network(lat, bw)
+    feed_metrics(cluster, loop.encoder, np.random.default_rng(10))
+    cluster.add_pods(generate_workload(
+        WorkloadSpec(num_pods=8, seed=9),
+        scheduler_name=cfg.scheduler_name))
+    loop.run_until_drained()
+    parsed = parse_prometheus_text(render_metrics(loop))
+    assert loop.flight is None
+    assert "netaware_cycle_seq" not in parsed
+    assert "netaware_flight_dropped_total" not in parsed

@@ -1,0 +1,134 @@
+"""Trace linter (tools/trace_check.py).
+
+A recorder-built trace must lint clean, and each check must fire on
+the failure shape that motivated it: a trace that LOOKS Perfetto-
+loadable but carries negative durations, orphan phases, or
+out-of-order cycle ids silently lies in the viewer.
+"""
+
+from __future__ import annotations
+
+import copy
+import importlib.util
+import json
+import os
+
+from kubernetesnetawarescheduler_tpu.utils.flight import FlightRecorder
+
+_TOOL = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "tools", "trace_check.py")
+_spec = importlib.util.spec_from_file_location("trace_check", _TOOL)
+trace_check = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(trace_check)
+
+
+def _recorded_trace(cycles: int = 5, capacity: int = 512) -> dict:
+    rec = FlightRecorder(capacity=capacity)
+    for _ in range(cycles):
+        sb = rec.begin("serial")
+        with sb.phase("encode"):
+            pass
+        with sb.phase("score_assign"):
+            pass
+        rec.commit(sb.finish(n_pods=1, pod_uids=("p",), queue_depth=0))
+    return rec.to_chrome_trace()
+
+
+def test_recorder_trace_lints_clean():
+    doc = _recorded_trace()
+    assert trace_check.check_trace(doc) == []
+    # The crash-dump envelope (trace nested under "trace") is
+    # unwrapped transparently.
+    assert trace_check.check_trace({"reason": "sigterm",
+                                    "trace": doc}) == []
+
+
+def test_structural_failures():
+    assert trace_check.check_trace([1, 2]) != []
+    assert trace_check.check_trace({"foo": 1}) != []
+    doc = _recorded_trace()
+    doc["traceEvents"][2].pop("ts")
+    fails = trace_check.check_trace(doc)
+    assert any("missing" in f for f in fails), fails
+
+
+def test_negative_duration_fires_monotonic_check():
+    doc = _recorded_trace()
+    # First non-metadata event is the first cycle span.
+    doc["traceEvents"][2]["dur"] = -1.0
+    fails = trace_check.check_trace(doc)
+    assert any("not monotonic" in f for f in fails), fails
+
+
+def test_orphan_phase_detected():
+    doc = _recorded_trace()
+    for ev in doc["traceEvents"]:
+        if ev.get("cat") == "phase":
+            ev["args"]["cycle_id"] = 9999  # no such cycle
+            break
+    fails = trace_check.check_trace(doc)
+    assert any("orphan" in f for f in fails), fails
+
+
+def test_phase_escaping_its_cycle_detected():
+    doc = _recorded_trace()
+    for ev in doc["traceEvents"]:
+        if ev.get("cat") == "phase":
+            ev["dur"] = ev["dur"] + 60_000_000.0  # way past the cycle
+            break
+    fails = trace_check.check_trace(doc)
+    assert any("escapes" in f for f in fails), fails
+
+
+def test_cycle_ids_must_strictly_increase():
+    doc = _recorded_trace()
+    cycles = [ev for ev in doc["traceEvents"]
+              if ev.get("cat") == "cycle"]
+    cycles[1]["args"]["cycle_id"] = cycles[0]["args"]["cycle_id"]
+    # Keep the recorder consistent; reattach the phases to survive the
+    # orphan check — the duplicate-id failure is what we want to see.
+    fails = trace_check.check_trace(doc)
+    assert any("strictly increasing" in f for f in fails), fails
+
+
+def test_recorder_block_proves_bounded_memory():
+    doc = _recorded_trace()
+    clean = copy.deepcopy(doc)
+    doc["recorder"]["spans"] = doc["recorder"]["capacity"] + 1
+    fails = trace_check.check_trace(doc)
+    assert any("over its declared capacity" in f for f in fails), fails
+    # spans must agree with the cycle events actually present.
+    doc2 = copy.deepcopy(clean)
+    doc2["recorder"]["spans"] += 1
+    # Avoid also tripping spans>capacity: capacity is 512 here.
+    fails2 = trace_check.check_trace(doc2)
+    assert any("cycle events" in f for f in fails2), fails2
+    doc3 = copy.deepcopy(clean)
+    del doc3["recorder"]
+    fails3 = trace_check.check_trace(doc3)
+    assert any("recorder block missing" in f for f in fails3), fails3
+    doc4 = copy.deepcopy(clean)
+    doc4["recorder"]["dropped"] = -2
+    fails4 = trace_check.check_trace(doc4)
+    assert any("dropped" in f for f in fails4), fails4
+
+
+def test_unknown_event_phase_rejected():
+    doc = _recorded_trace()
+    doc["traceEvents"].append({"name": "b", "ph": "B", "pid": 1,
+                               "tid": 1, "ts": 1.0})
+    fails = trace_check.check_trace(doc)
+    assert any("only emits complete" in f for f in fails), fails
+
+
+def test_cli_run_roundtrip(tmp_path):
+    good = tmp_path / "good.json"
+    good.write_text(json.dumps(_recorded_trace()))
+    assert trace_check.run([str(good)]) == []
+    bad = tmp_path / "bad.json"
+    bad.write_text("{not json")
+    fails = trace_check.run([str(bad)])
+    assert any("unreadable" in f for f in fails), fails
+    assert trace_check.main([str(good)]) == 0
+    assert trace_check.main([str(bad)]) == 1
+    assert trace_check.main([]) == 2

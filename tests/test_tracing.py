@@ -124,3 +124,46 @@ def test_weighted_record_edge_cases():
     assert t.percentile("y", 0) == 0.25
     assert t.percentile("y", 100) == 0.25
     assert abs(t.total("y") - 0.75) < 1e-12
+
+
+class _CountingLock:
+    """Wraps a Lock, counting context-manager acquisitions."""
+
+    def __init__(self, inner):
+        self._inner = inner
+        self.acquisitions = 0
+
+    def __enter__(self):
+        self.acquisitions += 1
+        return self._inner.__enter__()
+
+    def __exit__(self, *exc):
+        return self._inner.__exit__(*exc)
+
+
+def test_summary_takes_one_lock_acquisition():
+    """Scrape-path regression (r8): summary() must snapshot every
+    phase under ONE lock acquisition — the old shape re-took the lock
+    per phase per stat (count/total/percentile x phases), stalling the
+    serving thread's timer.record() during a /metrics scrape."""
+    t = PhaseTimer()
+    for _ in range(50):
+        t.record("encode", 0.001)
+        t.record("score_assign", 0.002)
+        t.record("bind", 0.001)
+    lock = _CountingLock(t._lock)
+    t._lock = lock
+    summary = t.summary()
+    assert set(summary) >= {"encode", "score_assign", "bind"}
+    assert lock.acquisitions == 1
+
+    # percentile(): one acquisition to snapshot; the O(n log n) sort
+    # runs outside the lock.
+    lock.acquisitions = 0
+    t.percentile("encode", 99)
+    assert lock.acquisitions == 1
+
+    # pipeline_budgets() rides the same single-snapshot path.
+    lock.acquisitions = 0
+    t.pipeline_budgets()
+    assert lock.acquisitions == 1

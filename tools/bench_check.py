@@ -26,6 +26,7 @@ from __future__ import annotations
 import glob
 import json
 import os
+import re
 import sys
 
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -51,6 +52,17 @@ SCAN_SOURCES = {"device_scan_amortized", "device_scan_amortized_artifact"}
 # honest r5 relabel, "host_observed" the no-microbench fallback).
 LEGACY_SOURCES = {"device_boundary", "device_boundary_artifact",
                   "device_boundary_host_inputs", "host_observed"}
+
+
+_ROUND_RE = re.compile(r"BENCH_r(\d+)")
+
+
+def _round_of(name: str) -> int | None:
+    """Round number from a BENCH_rNN-style filename, None otherwise.
+    Gates round-scoped rules (Rule 8) so committed earlier-round
+    history keeps linting clean without per-file grandfather lists."""
+    m = _ROUND_RE.search(name)
+    return int(m.group(1)) if m else None
 
 
 def _load(path: str) -> dict | None:
@@ -287,6 +299,68 @@ def check_doc(path: str, doc: dict) -> list[str]:
                             "static_refresh.count=0 — the refresh "
                             "path never ran, so the p99 measures an "
                             "unrefreshed (frozen-state) serve")
+
+    # Rule 8 — decision-trace provenance (round 8+): a headline that
+    # claims a p99 number must ship its flight-recorder evidence — the
+    # trace_provenance block with the worst retained cycle span — so a
+    # claimed regression/improvement can be attributed to a phase in
+    # minutes instead of a doc spelunk (the 87-vs-3.4 ms class of root
+    # cause, docs/ROUND_NOTES.md r6).  Round-gated by filename:
+    # committed r6/r7 history predates the recorder and stays clean;
+    # any artifact CARRYING the block gets its shape validated.
+    if not grandfathered:
+        ns = detail.get("north_star")
+        p99_met = isinstance(ns, dict) and bool(ns.get("p99_met"))
+        tp = detail.get("trace_provenance")
+        rnd = _round_of(name)
+        if tp is None:
+            if p99_met and rnd is not None and rnd >= 8:
+                fails.append(
+                    f"{name}: north_star.p99_met without a "
+                    "trace_provenance block (round 8+ requires the "
+                    "worst-cycle span behind any claimed p99)")
+        elif not isinstance(tp, dict):
+            fails.append(f"{name}: trace_provenance is not an object")
+        else:
+            required = {"spans", "capacity", "dropped", "worst_cycle"}
+            missing = required - set(tp)
+            if missing:
+                fails.append(f"{name}: trace_provenance missing "
+                             f"{sorted(missing)}")
+            else:
+                try:
+                    spans = int(tp["spans"])
+                    cap = int(tp["capacity"])
+                    dropped = int(tp["dropped"])
+                except (TypeError, ValueError):
+                    fails.append(
+                        f"{name}: trace_provenance not numeric")
+                else:
+                    if p99_met and spans < 1:
+                        fails.append(
+                            f"{name}: north_star.p99_met with "
+                            "trace_provenance.spans=0 — no cycle "
+                            "span backs the claimed p99")
+                    if spans > cap:
+                        fails.append(
+                            f"{name}: trace_provenance.spans={spans} "
+                            f"over capacity={cap} (unbounded ring?)")
+                    if dropped < 0:
+                        fails.append(f"{name}: trace_provenance."
+                                     f"dropped={dropped} negative")
+                wc = tp.get("worst_cycle")
+                if spans := tp.get("spans"):
+                    if not isinstance(wc, dict):
+                        fails.append(f"{name}: trace_provenance."
+                                     "worst_cycle is not an object")
+                    else:
+                        wc_missing = ({"cycle_id", "dur_ms", "path",
+                                       "phases"} - set(wc))
+                        if wc_missing:
+                            fails.append(
+                                f"{name}: trace_provenance."
+                                f"worst_cycle missing "
+                                f"{sorted(wc_missing)}")
     return fails
 
 

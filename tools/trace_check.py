@@ -1,0 +1,187 @@
+#!/usr/bin/env python3
+"""Lint a dumped flight-recorder trace (Chrome trace-event JSON).
+
+The /debug/trace endpoint and serve.py's crash dump both emit the
+``{"traceEvents": [...]}`` object form that Perfetto loads.  A trace
+that LOOKS loadable but carries negative durations, phases outside
+their cycle, or out-of-order cycle ids silently lies in the viewer —
+this linter makes those failure shapes loud, the same contract
+tools/bench_check.py enforces for bench artifacts.
+
+Checks:
+  * structural validity — object form, traceEvents list, every event
+    carries name/ph/pid/tid/ts (and a numeric dur for ``ph:"X"``)
+  * monotonic spans — no negative ts or dur
+  * no orphan children — every phase event nests inside a cycle event
+    on the same pid/tid (time containment, the nesting Perfetto infers)
+  * cycle ids strictly increasing in event order
+  * bounded memory — the ``recorder`` block proves ring-buffer
+    eviction: spans <= capacity, non-negative drop counters
+
+Usage: trace_check.py [trace.json ...]; exits nonzero on any failure.
+check_trace(doc) is importable for tests (tests/test_flight.py).
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from typing import Any
+
+# Matches utils/flight.py's crash_dump envelope: the trace object may
+# be nested under "trace" (post-mortem dumps) or be the document
+# itself (/debug/trace).
+_EV_REQUIRED = ("name", "ph", "pid", "tid", "ts")
+
+
+def _events(doc: Any) -> Any:
+    if isinstance(doc, dict) and isinstance(doc.get("trace"), dict):
+        doc = doc["trace"]
+    return doc
+
+
+def check_trace(doc: Any) -> list[str]:
+    """Return a list of human-readable failures (empty = clean)."""
+    fails: list[str] = []
+    doc = _events(doc)
+    if not isinstance(doc, dict):
+        return ["trace is not a JSON object (Perfetto needs the "
+                "object form with a traceEvents key)"]
+    events = doc.get("traceEvents")
+    if not isinstance(events, list):
+        return ["traceEvents missing or not a list"]
+
+    cycles: list[tuple[float, float, int, Any]] = []  # ts, end, idx, id
+    phases: list[tuple[float, float, int, Any]] = []
+    for i, ev in enumerate(events):
+        if not isinstance(ev, dict):
+            fails.append(f"event[{i}] is not an object")
+            continue
+        missing = [k for k in _EV_REQUIRED if k not in ev]
+        if missing:
+            fails.append(f"event[{i}] missing {missing}")
+            continue
+        ph = ev.get("ph")
+        ts = ev.get("ts")
+        if not isinstance(ts, (int, float)):
+            fails.append(f"event[{i}] ({ev.get('name')}) has "
+                         f"non-numeric ts {ts!r}")
+            continue
+        if ph == "M":  # metadata events carry no duration
+            continue
+        if ph != "X":
+            fails.append(f"event[{i}] ({ev.get('name')}) has phase "
+                         f"{ph!r}; the recorder only emits complete "
+                         "(X) and metadata (M) events")
+            continue
+        dur = ev.get("dur")
+        if not isinstance(dur, (int, float)):
+            fails.append(f"event[{i}] ({ev.get('name')}) has "
+                         f"non-numeric dur {dur!r}")
+            continue
+        if ts < 0 or dur < 0:
+            fails.append(f"event[{i}] ({ev.get('name')}) is not "
+                         f"monotonic: ts={ts} dur={dur}")
+            continue
+        key = (ev.get("pid"), ev.get("tid"))
+        cat = ev.get("cat")
+        args = ev.get("args") or {}
+        if cat == "cycle":
+            cycles.append((ts, ts + dur, i,
+                           (key, args.get("cycle_id"))))
+        elif cat == "phase":
+            phases.append((ts, ts + dur, i,
+                           (key, args.get("cycle_id"))))
+        else:
+            fails.append(f"event[{i}] ({ev.get('name')}) has "
+                         f"unknown cat {cat!r}")
+
+    # Cycle ids strictly increasing in event order.
+    last_id = None
+    for _ts, _end, i, (_key, cid) in cycles:
+        if not isinstance(cid, int):
+            fails.append(f"event[{i}] cycle span lacks an integer "
+                         f"args.cycle_id (got {cid!r})")
+            continue
+        if last_id is not None and cid <= last_id:
+            fails.append(f"event[{i}] cycle id {cid} not strictly "
+                         f"increasing (previous {last_id})")
+        last_id = cid
+
+    # No orphan children: each phase nests inside ITS cycle (matched
+    # by cycle_id + pid/tid), with time containment — the property
+    # Perfetto's nesting relies on.  A phase pointing at a cycle the
+    # ring buffer already evicted is an orphan too.
+    by_id = {cid: (ts, end, key)
+             for ts, end, _i, (key, cid) in cycles}
+    _SLOP = 1.0  # µs of float rounding tolerance
+    for ts, end, i, (key, cid) in phases:
+        parent = by_id.get(cid)
+        if parent is None:
+            fails.append(f"event[{i}] phase span is an orphan: no "
+                         f"cycle with id {cid!r} in this trace")
+            continue
+        pts, pend, pkey = parent
+        if key != pkey:
+            fails.append(f"event[{i}] phase span is on pid/tid {key} "
+                         f"but its cycle {cid} is on {pkey}")
+        elif ts < pts - _SLOP or end > pend + _SLOP:
+            fails.append(
+                f"event[{i}] phase span [{ts}, {end}] escapes its "
+                f"cycle {cid}'s interval [{pts}, {pend}]")
+
+    # Bounded memory: the recorder block must prove eviction works.
+    rec = doc.get("recorder")
+    if not isinstance(rec, dict):
+        fails.append("recorder block missing (capacity/dropped "
+                     "accounting is the bounded-memory proof)")
+    else:
+        cap = rec.get("capacity")
+        spans = rec.get("spans")
+        if not isinstance(cap, int) or cap < 1:
+            fails.append(f"recorder.capacity invalid: {cap!r}")
+        if not isinstance(spans, int) or spans < 0:
+            fails.append(f"recorder.spans invalid: {spans!r}")
+        if (isinstance(cap, int) and isinstance(spans, int)
+                and spans > cap):
+            fails.append(f"recorder holds {spans} spans over its "
+                         f"declared capacity {cap} (unbounded ring?)")
+        if isinstance(spans, int) and spans != len(cycles):
+            fails.append(f"recorder.spans={spans} but the trace "
+                         f"carries {len(cycles)} cycle events")
+        for k in ("dropped", "cycle_seq"):
+            v = rec.get(k)
+            if not isinstance(v, int) or v < 0:
+                fails.append(f"recorder.{k} invalid: {v!r}")
+
+    return fails
+
+
+def run(paths: list[str]) -> list[str]:
+    fails: list[str] = []
+    for path in paths:
+        try:
+            with open(path, encoding="utf-8") as fh:
+                doc = json.load(fh)
+        except (OSError, ValueError) as exc:
+            fails.append(f"{path}: unreadable trace JSON ({exc})")
+            continue
+        fails.extend(f"{path}: {f}" for f in check_trace(doc))
+    return fails
+
+
+def main(argv: list[str]) -> int:
+    if not argv:
+        print("usage: trace_check.py trace.json [trace.json ...]",
+              file=sys.stderr)
+        return 2
+    fails = run(argv)
+    for f in fails:
+        print(f"FAIL {f}")
+    if not fails:
+        print(f"OK {len(argv)} trace(s) lint clean")
+    return 1 if fails else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv[1:]))
