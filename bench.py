@@ -426,6 +426,13 @@ def _assemble_doc(res, *, num_nodes: int, batch: int, method: str,
             "host_transport_p50_ms": round(max(
                 0.0, res.score_p50_ms - device_lat["p50_ms"]), 2),
         })
+        if device_lat.get("winner_fusion") is not None:
+            # Fused-winner provenance (r9, bench_check Rule 9): the
+            # per-dispatch fused-vs-unfused A/B, donation accounting
+            # (verified buffer-deleted, not assumed), and the fused
+            # leg's conflict-round histogram — any r9+ artifact
+            # claiming the p99 bar must carry this block.
+            detail["winner_fusion"] = device_lat["winner_fusion"]
     else:
         detail.update({
             "score_p50_ms": round(res.score_p50_ms, 2),

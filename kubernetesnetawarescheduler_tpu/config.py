@@ -375,6 +375,20 @@ class SchedulerConfig:
     static_max_staleness_s: float = 0.25
     static_max_versions_behind: int = 8
 
+    # Fused winner selection + single-dispatch scheduling step
+    # (core/pallas_score.score_winner_tiled, core/score.score_winner,
+    # core/assign.fused_schedule_step): the per-batch winner argmax is
+    # fused into the score kernel (each pod tile carries a running
+    # (best_score, best_node) pair across node tiles instead of
+    # writing the P×N score plane to HBM) and the assign+commit pair
+    # runs as ONE jitted dispatch with the ClusterState carry donated.
+    # Placements are bit-identical to the two-stage path (the fused
+    # winner preserves the documented lowest-index tie-break and falls
+    # back to score→argmax whenever an out-of-kernel constraint join
+    # is active); on by default because it only changes WHERE the
+    # reduction runs, never what it computes.
+    enable_winner_fusion: bool = True
+
     # Decision-level tracing (utils/flight.py): ring-buffer capacity of
     # the cycle-span flight recorder (0 disables recording entirely),
     # and the per-pod placement-explain capture.  Explain re-derives the

@@ -755,3 +755,42 @@ def schedule_batch(state: ClusterState, pods: PodBatch, cfg: SchedulerConfig,
     else:
         raise ValueError(f"unknown method {method!r}")
     return assignment, commit_assignments(state, pods, assignment)
+
+
+@partial(jax.jit, static_argnames=("cfg", "method"), donate_argnums=(0,))
+def fused_schedule_step(state: ClusterState, pods: PodBatch,
+                        cfg: SchedulerConfig, static=None,
+                        method: str = "parallel"):
+    """The whole per-batch scheduling decision as ONE donated device
+    dispatch: score + conflict resolution (the device-resident
+    ``lax.while_loop`` inside :func:`assign_parallel` — the host never
+    re-enters per round) + usage commit.  Returns
+    ``(new_state, assignment i32[P], rounds i32)``.
+
+    ``donate_argnums=(0,)``: the caller's ``ClusterState`` buffers are
+    DONATED — XLA writes the committed usage/group/zone planes in
+    place and forwards the untouched N×N lat/bw planes, so
+    batch-to-batch state threading stops allocating fresh copies of
+    the large planes each step.  The contract is strict: the caller
+    must OWN the state it passes (a scan carry, a replay fold, the
+    bench chain) and must not read it afterwards.  The serving loop's
+    encoder-cached snapshot leaves are NOT owned — the r7 delta-ingest
+    cache patches them in place across cycles — so SchedulerLoop never
+    routes its cached snapshot through here (it counts the skip in
+    ``donation_skipped_total`` instead; see core/loop.py).
+
+    Bit-identity: results equal ``schedule_batch`` exactly (the same
+    assigner and commit run inside; property-tested in
+    tests/test_winner_fusion.py).  ``static`` is the backend prep from
+    :func:`~.pallas_score.compute_assign_static`, like
+    :func:`assign_parallel`'s.
+    """
+    if method == "greedy":
+        assignment = assign_greedy(state, pods, cfg, static)
+        rounds = jnp.int32(1)
+    elif method == "parallel":
+        assignment, rounds = assign_parallel(state, pods, cfg, static,
+                                             with_stats=True)
+    else:
+        raise ValueError(f"unknown method {method!r}")
+    return commit_assignments(state, pods, assignment), assignment, rounds

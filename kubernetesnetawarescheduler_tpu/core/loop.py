@@ -46,6 +46,20 @@ from kubernetesnetawarescheduler_tpu.utils.flight import (
 from kubernetesnetawarescheduler_tpu.utils.tracing import PhaseTimer
 
 
+def _tracked_jit_fns():
+    """The serving-path jitted entry points whose executable-cache
+    growth feeds ``jit_cache_miss_total``.  Lazy import of the fused
+    step so a loop constructed before core.assign finishes importing
+    (test doubles) still works; ``_cache_size`` is jax's public
+    per-function compile-cache counter and every tracked fn is a
+    ``jax.jit`` product that has it."""
+    from kubernetesnetawarescheduler_tpu.core.assign import (
+        fused_schedule_step,
+    )
+
+    return (assign_greedy, assign_parallel, fused_schedule_step)
+
+
 class SchedulerLoop:
     """Owns the informer, encoder and queue; drives scheduling cycles."""
 
@@ -310,6 +324,24 @@ class SchedulerLoop:
         # from the UDS/gRPC threads; iterating a deque mid-append
         # raises RuntimeError, so both sides take this lock.
         self._round_lock = threading.Lock()
+        # Fused-step accounting (ISSUE 9).  The serving loop never
+        # donates: its snapshot leaves belong to the encoder's
+        # delta-ingest cache (patched in place across cycles, r7), so
+        # routing them through fused_schedule_step's donate_argnums
+        # would hand XLA buffers the encoder still owns — every device
+        # dispatch here counts a donation SKIP instead, and
+        # donated_total moves only on paths that own their state (the
+        # bench chain, replay folds).  jit_cache_miss_total is the
+        # executable-cache growth across the tracked serving-path
+        # entry points (``_cache_size`` deltas): after warmup, any
+        # motion is a recompile the bucketed batch-size ladder was
+        # supposed to prevent (scraped as
+        # ``netaware_jit_cache_miss_total``; regression-tested in
+        # tests/test_winner_fusion.py).
+        self.donated_total = 0
+        self.donation_skipped_total = 0
+        self.jit_cache_miss_total = 0
+        self._jit_cache_last = 0
         # is_parked keeps resync/watch re-deliveries of a preemptor
         # that is waiting for victim confirmation out of the queue —
         # scoring it early would drop its reservation and burn its
@@ -452,8 +484,36 @@ class SchedulerLoop:
         return jax.profiler.StepTraceAnnotation(
             "netaware_cycle", step_num=step_num)
 
+    def _poll_jit_misses(self) -> None:
+        """Fold executable-cache growth across the tracked jitted
+        entry points into ``jit_cache_miss_total``.  Called once per
+        device dispatch (cheap: three int reads); after warmup the
+        delta must be zero — the bucketed batch-size ladder exists so
+        every steady-state shape hits a warm cache."""
+        total = 0
+        for fn in _tracked_jit_fns():
+            size = getattr(fn, "_cache_size", None)
+            if size is None:
+                continue
+            try:
+                total += int(size())
+            except Exception:  # noqa: BLE001 — accounting only
+                continue
+        if total > self._jit_cache_last:
+            self.jit_cache_miss_total += total - self._jit_cache_last
+        self._jit_cache_last = total
+
+    def _note_dispatch(self) -> None:
+        """Per-device-dispatch fused-step accounting: the serving
+        loop's snapshot is encoder-owned (delta-ingest patches it in
+        place), so its dispatches never donate — count the skip, and
+        poll the jit caches for recompiles while we're here."""
+        self.donation_skipped_total += 1
+        self._poll_jit_misses()
+
     def _span_commit(self, sb, pods: Sequence[Pod],
-                     static_version: int | None = None) -> None:
+                     static_version: int | None = None,
+                     rounds: int = 0) -> None:
         """Freeze and commit a cycle span.  Called where the cycle's
         effects commit: end of the serial/burst/gang cycle, or at
         RETIRE for the pipelined path — so a crash never leaves a span
@@ -495,6 +555,14 @@ class SchedulerLoop:
             fault_class=fault,
             delta_bytes=max(db - last_db, 0),
             full_bytes=max(fb - last_fb, 0),
+            rounds=int(rounds),
+            # Cycle-level donation disposition mirrors the loop-wide
+            # counters: serving dispatches never donate (snapshot is
+            # encoder-owned), so spans carry donated=0 and one skip —
+            # a trace reader sees WHY the single-dispatch step still
+            # copies state, per cycle, not just in aggregate.
+            donated=0,
+            donation_skipped=1,
         )
         self.flight.commit(span)
 
@@ -728,16 +796,19 @@ class SchedulerLoop:
                 out = replay_stream_static(state, stream, static,
                                            self.cfg, self.method,
                                            with_stats=with_stats)
+        cycle_rounds = 0
         if with_stats:
             assignment_dev, _final_state, rounds_dev = out
             assignment = np.asarray(jax_block(assignment_dev))
             rounds = np.asarray(rounds_dev)
+            cycle_rounds = int(rounds[:n_real].max()) if n_real else 0
             with self._round_lock:
                 self.round_samples.extend(
                     int(r) for r in rounds[:n_real])
         else:
             assignment_dev, _final_state = out
             assignment = np.asarray(jax_block(assignment_dev))
+        self._note_dispatch()
         sb.add_phase("score_assign", t0, time.perf_counter() - t0)
         self.timer.record("score_assign",
                           (time.perf_counter() - t0) / n_real,
@@ -756,7 +827,8 @@ class SchedulerLoop:
         self.timer.record("burst_wall",
                           time.perf_counter() - cycle_t0)
         self.burst_cycles += 1
-        self._span_commit(sb, pods, static_version=version)
+        self._span_commit(sb, pods, static_version=version,
+                          rounds=cycle_rounds)
         return bound
 
     def _pipeline_cycle(self, pods: Sequence[Pod]) -> int:
@@ -821,6 +893,7 @@ class SchedulerLoop:
         self.timer.record("dispatch",
                           (time.perf_counter() - t0) / n_real,
                           count=n_real)
+        self._note_dispatch()
         self._pipe_inflight = (pods, out, with_stats, node_table,
                                n_real, time.perf_counter())
         self._pipe_span = (sb, version)
@@ -844,10 +917,12 @@ class SchedulerLoop:
                             else (NULL_SPAN, None))
         self._pipe_span = None
         t0 = time.perf_counter()
+        cycle_rounds = 0
         if with_stats:
             assignment_dev, _final_state, rounds_dev = out
             assignment = np.asarray(jax_block(assignment_dev))
             rounds = np.asarray(rounds_dev)
+            cycle_rounds = int(rounds[:n_real].max()) if n_real else 0
             with self._round_lock:
                 self.round_samples.extend(
                     int(r) for r in rounds[:n_real])
@@ -870,7 +945,8 @@ class SchedulerLoop:
                           count=n_real)
         self.timer.record("burst_wall",
                           time.perf_counter() - t_dispatch)
-        self._span_commit(sb, pods, static_version=span_version)
+        self._span_commit(sb, pods, static_version=span_version,
+                          rounds=cycle_rounds)
         return bound
 
     def schedule_pods(self, pods: Sequence[Pod]) -> int:
@@ -910,13 +986,16 @@ class SchedulerLoop:
                                        **kw)
                 else:
                     out = self._assign(state, batch, self.cfg, **kw)
+                cycle_rounds = 0
                 if stats:
                     assignment_dev, rounds = out
                     assignment = np.asarray(jax_block(assignment_dev))
+                    cycle_rounds = int(rounds)
                     with self._round_lock:
-                        self.round_samples.append(int(rounds))
+                        self.round_samples.append(cycle_rounds)
                 else:
                     assignment = np.asarray(jax_block(out))
+                self._note_dispatch()
         with sb.phase("bind"), self.timer.phase("bind"):
             if self.async_bind:
                 bound = self._assume_and_enqueue(pods, assignment,
@@ -925,7 +1004,8 @@ class SchedulerLoop:
                 bound = self._bind_all(pods, assignment, node_table)
         self._capture_explains(pods, batch, assignment, state, static,
                                node_table, sb.cycle_id, "serial")
-        self._span_commit(sb, pods, static_version=static_version)
+        self._span_commit(sb, pods, static_version=static_version,
+                          rounds=cycle_rounds)
         return bound
 
     def _static_for(self, state, version: int):
@@ -1098,6 +1178,7 @@ class SchedulerLoop:
             with self._profile_step(sb.cycle_id):
                 assignment = place_gang(state, batch, self.cfg, static,
                                         assign_fn, len(members))
+            self._note_dispatch()
         with sb.phase("bind"), self.timer.phase("bind"):
             bound = self._commit_gang(key, members, assignment,
                                       node_table)

@@ -130,6 +130,66 @@ def _soft_bonus(label_at, group_at, podf_ref, podi_ref, like, *,
     return soft
 
 
+def _tile_scores(params_ref, nodef_ref, nodei_ref, podf_ref, podi_ref,
+                 acc, *, num_resources: int, mask_words: int,
+                 soft_terms: int):
+    """Final-tile masked score computation shared by :func:`_kernel`
+    (which writes the (bp, bn) tile to HBM) and :func:`_winner_kernel`
+    (which reduces it into the running per-pod winner pair WITHOUT the
+    HBM write).  One implementation guarantees the fused winner is
+    numerically identical to the unfused tile, not merely close."""
+    r_res = num_resources
+    eps = params_ref[5]
+    wbal = params_ref[4]
+    base = nodef_ref[2 * r_res:2 * r_res + 1, :]            # (1, bn)
+    nvalid = nodef_ref[2 * r_res + 1:2 * r_res + 2, :] > 0.5
+    pvalid = podf_ref[:, r_res:r_res + 1] > 0.5             # (bp, 1)
+
+    fits = nvalid & pvalid
+    bal = jnp.zeros_like(acc)
+    for r in range(r_res):
+        used_r = nodef_ref[r:r + 1, :]                      # (1, bn)
+        cap_r = nodef_ref[r_res + r:r_res + r + 1, :]
+        req_r = podf_ref[:, r:r + 1]                        # (bp, 1)
+        fits = fits & (req_r <= cap_r - used_r + eps)
+        bal = jnp.maximum(
+            bal, (used_r + req_r) / jnp.maximum(cap_r, eps))
+
+    # W-word bit fields: subset/overlap tests accumulate over the
+    # static word loop (unrolled at trace time).  Required affinity
+    # is a subset test (terms AND, kube semantics) like the node
+    # selector.
+    mw = mask_words
+    ok = fits
+    for w in range(mw):
+        taint = nodei_ref[w:w + 1, :]                    # (1, bn)
+        label = nodei_ref[mw + w:mw + w + 1, :]
+        group = nodei_ref[2 * mw + w:2 * mw + w + 1, :]
+        ranti = nodei_ref[3 * mw + w:3 * mw + w + 1, :]
+        tol = podi_ref[:, w:w + 1]                       # (bp, 1)
+        sel = podi_ref[:, mw + w:mw + w + 1]
+        aff = podi_ref[:, 2 * mw + w:2 * mw + w + 1]
+        anti = podi_ref[:, 3 * mw + w:3 * mw + w + 1]
+        gbit = podi_ref[:, 4 * mw + w:4 * mw + w + 1]
+        ok = ok & ((taint & ~tol) == 0)
+        ok = ok & ((label & sel) == sel)
+        ok = ok & ((group & anti) == 0)
+        ok = ok & ((ranti & gbit) == 0)
+        ok = ok & ((group & aff) == aff)
+
+    # Soft (preferred) affinity: weighted bonuses, fused into the
+    # same tile write.
+    soft = _soft_bonus(
+        lambda w: nodei_ref[mw + w:mw + w + 1, :],
+        lambda w: nodei_ref[2 * mw + w:2 * mw + w + 1, :],
+        podf_ref, podi_ref, acc,
+        r_res=r_res, mw=mw, soft_terms=soft_terms)
+
+    return jnp.where(
+        ok, acc + base + params_ref[6] * soft - wbal * bal,
+        jnp.float32(float(NEG_INF)))
+
+
 def _kernel(params_ref, t_ref, bw_ref, lat_ref, validk_ref, nodef_ref,
             nodei_ref, podf_ref, podi_ref, out_ref, acc_ref, *,
             block_n: int, block_k: int, num_resources: int,
@@ -142,56 +202,77 @@ def _kernel(params_ref, t_ref, bw_ref, lat_ref, validk_ref, nodef_ref,
 
     @pl.when(k == nk - 1)
     def _epilogue():
-        r_res = num_resources
-        eps = params_ref[5]
-        wbal = params_ref[4]
-        base = nodef_ref[2 * r_res:2 * r_res + 1, :]            # (1, bn)
-        nvalid = nodef_ref[2 * r_res + 1:2 * r_res + 2, :] > 0.5
-        pvalid = podf_ref[:, r_res:r_res + 1] > 0.5             # (bp, 1)
+        out_ref[:] = _tile_scores(
+            params_ref, nodef_ref, nodei_ref, podf_ref, podi_ref,
+            acc_ref[:], num_resources=num_resources,
+            mask_words=mask_words, soft_terms=soft_terms)
 
-        fits = nvalid & pvalid
-        bal = jnp.zeros_like(acc_ref)
-        for r in range(r_res):
-            used_r = nodef_ref[r:r + 1, :]                      # (1, bn)
-            cap_r = nodef_ref[r_res + r:r_res + r + 1, :]
-            req_r = podf_ref[:, r:r + 1]                        # (bp, 1)
-            fits = fits & (req_r <= cap_r - used_r + eps)
-            bal = jnp.maximum(
-                bal, (used_r + req_r) / jnp.maximum(cap_r, eps))
 
-        # W-word bit fields: subset/overlap tests accumulate over the
-        # static word loop (unrolled at trace time).  Required affinity
-        # is a subset test (terms AND, kube semantics) like the node
-        # selector.
-        mw = mask_words
-        ok = fits
-        for w in range(mw):
-            taint = nodei_ref[w:w + 1, :]                    # (1, bn)
-            label = nodei_ref[mw + w:mw + w + 1, :]
-            group = nodei_ref[2 * mw + w:2 * mw + w + 1, :]
-            ranti = nodei_ref[3 * mw + w:3 * mw + w + 1, :]
-            tol = podi_ref[:, w:w + 1]                       # (bp, 1)
-            sel = podi_ref[:, mw + w:mw + w + 1]
-            aff = podi_ref[:, 2 * mw + w:2 * mw + w + 1]
-            anti = podi_ref[:, 3 * mw + w:3 * mw + w + 1]
-            gbit = podi_ref[:, 4 * mw + w:4 * mw + w + 1]
-            ok = ok & ((taint & ~tol) == 0)
-            ok = ok & ((label & sel) == sel)
-            ok = ok & ((group & anti) == 0)
-            ok = ok & ((ranti & gbit) == 0)
-            ok = ok & ((group & aff) == aff)
+# Sentinel node index for the fused winner's min-index-of-max: larger
+# than any global node index (row_offset included), so an all-masked
+# tile can never contribute a real-looking index.
+_WINNER_SENTINEL = 2 ** 30
 
-        # Soft (preferred) affinity: weighted bonuses, fused into the
-        # same tile write.
-        soft = _soft_bonus(
-            lambda w: nodei_ref[mw + w:mw + w + 1, :],
-            lambda w: nodei_ref[2 * mw + w:2 * mw + w + 1, :],
-            podf_ref, podi_ref, acc_ref[:],
-            r_res=r_res, mw=mw, soft_terms=soft_terms)
 
-        out_ref[:] = jnp.where(
-            ok, acc_ref[:] + base + params_ref[6] * soft - wbal * bal,
-            jnp.float32(float(NEG_INF)))
+def _winner_kernel(params_ref, t_ref, bw_ref, lat_ref, validk_ref,
+                   nodef_ref, nodei_ref, podf_ref, podi_ref,
+                   best_ref, node_ref, acc_ref, *,
+                   block_n: int, block_k: int, num_resources: int,
+                   mask_words: int, soft_terms: int,
+                   use_bfloat16: bool):
+    """:func:`_kernel` with the winner reduction fused in: instead of
+    writing each (bp, bn) score tile to HBM, every pod row carries a
+    running ``(best_score, best_node)`` pair across the node-tile axis
+    ``j`` — the output BlockSpecs map every ``(j, k)`` step to block
+    ``(i, 0)``, so the pair stays VMEM-resident for the whole row
+    sweep (the revisited-output-block reduction pattern) and the P×N
+    score plane never exists in HBM.
+
+    Tie-break contract (score.winner_from_scores): lowest node index
+    among equal-best candidates.  Within a tile that is the
+    min-index-of-max; across tiles the update takes a later tile only
+    on STRICTLY greater score — earlier ``j`` means lower global node
+    indices, so ties keep the earlier tile's winner.  Global indices
+    (``row_offset`` from params[7]) make the same kernel correct under
+    the shard_map'd tp path, where each instance owns a row shard."""
+    j = pl.program_id(1)
+    k = pl.program_id(2)
+    nk = pl.num_programs(2)
+    _net_accum(params_ref, t_ref, bw_ref, lat_ref, validk_ref, acc_ref,
+               block_n=block_n, block_k=block_k,
+               use_bfloat16=use_bfloat16)
+
+    @pl.when(k == nk - 1)
+    def _reduce():
+        s = _tile_scores(
+            params_ref, nodef_ref, nodei_ref, podf_ref, podi_ref,
+            acc_ref[:], num_resources=num_resources,
+            mask_words=mask_words, soft_terms=soft_terms)
+        row_offset = params_ref[7].astype(jnp.int32)
+        cols = (row_offset + j * block_n
+                + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1))
+        tile_best = jnp.max(s, axis=1, keepdims=True)       # (bp, 1)
+        tile_node = jnp.min(
+            jnp.where(s == tile_best, cols,
+                      jnp.int32(_WINNER_SENTINEL)),
+            axis=1, keepdims=True)
+        # Lane-broadcast to the (bp, 128) output blocks: a (bp, 1)
+        # store would fight the lane tiling; every lane carries the
+        # same pair and the caller reads lane 0.
+        tb = jnp.broadcast_to(tile_best, best_ref.shape)
+        tn = jnp.broadcast_to(tile_node, node_ref.shape)
+
+        @pl.when(j == 0)
+        def _init():
+            best_ref[:] = tb
+            node_ref[:] = tn
+
+        @pl.when(j > 0)
+        def _update():
+            prev = best_ref[:]
+            better = tb > prev
+            best_ref[:] = jnp.where(better, tb, prev)
+            node_ref[:] = jnp.where(better, tn, node_ref[:])
 
 
 def _static_kernel(params_ref, t_ref, bw_ref, lat_ref, validk_ref,
@@ -512,6 +593,116 @@ def score_pods_tiled(state: ClusterState, pods: PodBatch,
     return jax.lax.cond(jnp.any(active), with_spread, lambda s: s, out)
 
 
+def winner_joins_active(state: ClusterState, pods: PodBatch) -> jax.Array:
+    """Scalar bool: is any constraint that :func:`score_pods_tiled`
+    joins OUTSIDE the tile kernel live for this (state, batch)?  The
+    in-kernel winner reduction is exact only when every out-of-kernel
+    join is a no-op (soft zone adds zeros, the ns/zone masks are
+    all-true, spread is inactive) — when any is live the winner must
+    be taken AFTER the joins, so :func:`score_winner_tiled` falls back
+    to the two-stage score→argmax path.  Each predicate mirrors the
+    corresponding join's own ``lax.cond`` gate in core/score.py; the
+    two must agree or the fused path would silently skip a constraint
+    the unfused path honors."""
+    return (jnp.any(pods.soft_zone_bits != 0)
+            | jnp.any(pods.ns_term_used)
+            | jnp.any(pods.zaff_bits != 0)
+            | jnp.any(pods.zanti_bits != 0)
+            | jnp.any(state.az_anti != 0)
+            | jnp.any(score_lib.spread_active(pods)))
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("cfg", "block_p", "block_n", "block_k", "interpret"))
+def score_winner_tiled(state: ClusterState, pods: PodBatch,
+                       cfg: SchedulerConfig, static=None, *,
+                       block_p: int = 128,
+                       block_n: int = 128, block_k: int = 128,
+                       interpret: bool = False
+                       ) -> tuple[jax.Array, jax.Array]:
+    """Fused winner selection, tiled-Pallas implementation: returns
+    ``(best f32[P], node i32[P])`` with ``node == -1`` for infeasible
+    rows — bit-identical to
+    ``score.winner_from_scores(score_pods_tiled(...))`` (the parity
+    property suite pins this, tie-breaks included).
+
+    Grid and packing are exactly :func:`score_pods_tiled`'s; the
+    difference is the output: two ``(P_pad, 128)`` lane-broadcast
+    planes instead of the ``(P_pad, N_pad)`` score matrix, so HBM
+    write traffic per batch drops from O(P·N) to O(P).  Batches with a
+    live out-of-kernel constraint join (``winner_joins_active``) take
+    the two-stage path under a ``lax.cond`` — correctness never
+    depends on the workload being constraint-free."""
+    import math
+
+    p_real, n_real = pods.num_pods, state.num_nodes
+    r_res = state.num_resources
+    bp = min(block_p, _round_up(p_real, 8))
+    p_pad = _round_up(p_real, bp)
+    nb, kb = block_n, block_k
+    n_pad = _round_up(n_real, math.lcm(nb, kb))
+    mw = cfg.mask_words
+    t_soft = cfg.max_soft_terms
+    nf_rows = _round_up(2 * r_res + 2, 8)
+    pf_cols = _round_up(r_res + 1 + 2 * t_soft, 8)
+    ni_rows = _round_up(4 * mw, 8)
+    pi_cols = _round_up((5 + 2 * t_soft) * mw, 8)
+
+    if static is None:
+        static = static_tile_inputs(state, cfg)
+
+    def fused(_):
+        args = _pack_inputs(state, pods, cfg, static, p_real, n_real,
+                            p_pad, n_pad, r_res, mw, t_soft, nf_rows,
+                            pf_cols, ni_rows, pi_cols)
+        grid = (p_pad // bp, n_pad // nb, n_pad // kb)
+        kernel = functools.partial(
+            _winner_kernel, block_n=nb, block_k=kb,
+            num_resources=r_res, mask_words=mw, soft_terms=t_soft,
+            use_bfloat16=cfg.use_bfloat16)
+        best2, node2 = pl.pallas_call(
+            kernel,
+            out_shape=(
+                jax.ShapeDtypeStruct((p_pad, 128), jnp.float32),
+                jax.ShapeDtypeStruct((p_pad, 128), jnp.int32)),
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec(memory_space=pltpu.SMEM),              # params
+                pl.BlockSpec((bp, kb), lambda i, j, k: (i, k)),     # T
+                pl.BlockSpec((nb, kb), lambda i, j, k: (j, k)),     # bw
+                pl.BlockSpec((nb, kb), lambda i, j, k: (j, k)),     # lat
+                pl.BlockSpec((1, kb), lambda i, j, k: (0, k)),      # validk
+                pl.BlockSpec((nf_rows, nb), lambda i, j, k: (0, j)),  # nodef
+                pl.BlockSpec((ni_rows, nb), lambda i, j, k: (0, j)),  # nodei
+                pl.BlockSpec((bp, pf_cols), lambda i, j, k: (i, 0)),  # podf
+                pl.BlockSpec((bp, pi_cols), lambda i, j, k: (i, 0)),  # podi
+            ],
+            # The revisited-output-block reduction: both outputs map
+            # every (j, k) to block (i, 0), staying VMEM-resident
+            # across the row sweep (see _winner_kernel).
+            out_specs=(
+                pl.BlockSpec((bp, 128), lambda i, j, k: (i, 0)),
+                pl.BlockSpec((bp, 128), lambda i, j, k: (i, 0))),
+            scratch_shapes=[pltpu.VMEM((bp, nb), jnp.float32)],
+            interpret=interpret,
+        )(*args)
+        best = best2[:p_real, 0]
+        node = node2[:p_real, 0]
+        feasible = best > jnp.float32(float(NEG_INF)) * 0.5
+        node = jnp.where(feasible, node, jnp.int32(-1))
+        return best, node
+
+    def two_stage(_):
+        scores = score_pods_tiled(state, pods, cfg, static,
+                                  block_p=block_p, block_n=block_n,
+                                  block_k=block_k, interpret=interpret)
+        return score_lib.winner_from_scores(scores)
+
+    return jax.lax.cond(winner_joins_active(state, pods),
+                        two_stage, fused, None)
+
+
 def _pack_inputs(state: ClusterState, pods: PodBatch,
                  cfg: SchedulerConfig, static, p_real: int, n_real: int,
                  p_pad: int, n_pad: int, r_res: int, mw: int,
@@ -678,6 +869,31 @@ def compute_assign_static_incremental(
 # call score_pods inside their own jit.
 _score_pods_jit = functools.partial(
     jax.jit, static_argnames=("cfg",))(score_lib.score_pods)
+
+# Dense fused winner: one jit around score→argmax, so XLA fuses the
+# row reduction with the score producer (the segment-max epilogue)
+# instead of round-tripping the P×N plane between two dispatches.
+_score_winner_jit = functools.partial(
+    jax.jit, static_argnames=("cfg",))(score_lib.score_winner)
+
+
+def score_winner_auto(state: ClusterState, pods: PodBatch,
+                      cfg: SchedulerConfig, static=None
+                      ) -> tuple[jax.Array, jax.Array]:
+    """Backend dispatch for the fused winner (:func:`score_pods_auto`'s
+    twin): ``(best f32[P], node i32[P])``, ``node == -1`` infeasible.
+    ``static`` is an optional precomputed :func:`compute_static`.
+    With ``cfg.enable_winner_fusion`` off, the two-stage score→argmax
+    path runs instead — same results (property-tested), kept as the
+    bisection escape hatch (OPERATIONS.md)."""
+    if not cfg.enable_winner_fusion:
+        scores = score_pods_auto(state, pods, cfg, static)
+        return score_lib.winner_from_scores(scores)
+    if cfg.score_backend == "pallas":
+        interpret = jax.default_backend() != "tpu"
+        return score_winner_tiled(state, pods, cfg, static,
+                                  interpret=interpret)
+    return _score_winner_jit(state, pods, cfg, static)
 
 
 def score_pods_auto(state: ClusterState, pods: PodBatch,

@@ -757,6 +757,43 @@ def score_pods(state: ClusterState, pods: PodBatch,
     return jnp.where(ok, raw, NEG_INF)
 
 
+def winner_from_scores(scores: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """Per-pod winner reduction over a masked score matrix:
+    ``(best f32[P], node i32[P])``, ``node == -1`` where the row is
+    all-infeasible.
+
+    THE tie-break contract of the repo (assign.argmax2, the greedy
+    scan, the gang re-score all follow it): equal-best candidates
+    resolve to the LOWEST node index, deterministically — implemented
+    as min-index-of-max rather than ``jnp.argmax`` so the semantics
+    are explicit in the expression the fused kernels must reproduce.
+    The Pallas winner kernel (pallas_score.score_winner_tiled) and the
+    cross-shard combine (parallel.sharding) are property-tested
+    bit-identical against this function.
+    """
+    n = scores.shape[1]
+    best = jnp.max(scores, axis=1)
+    cols = jax.lax.broadcasted_iota(jnp.int32, scores.shape, 1)
+    choice = jnp.min(
+        jnp.where(scores == best[:, None], cols, jnp.int32(n)), axis=1)
+    feasible = best > NEG_INF * 0.5
+    node = jnp.where(feasible, choice, np.int32(-1)).astype(jnp.int32)
+    return best, node
+
+
+def score_winner(state: ClusterState, pods: PodBatch,
+                 cfg: SchedulerConfig, static=None
+                 ) -> tuple[jax.Array, jax.Array]:
+    """Fused score→winner: ``(best f32[P], node i32[P])`` in ONE
+    compiled program — the masked-argmax epilogue runs inside the same
+    XLA computation as :func:`score_pods`, so when jitted the P×N
+    score plane never round-trips through HBM as a kernel boundary
+    (XLA fuses the row reduction with its producer; the Pallas twin in
+    core/pallas_score.py makes the same fusion explicit per tile).
+    Same tie-break contract as :func:`winner_from_scores`."""
+    return winner_from_scores(score_pods(state, pods, cfg, static))
+
+
 def _explain_terms(state: ClusterState, pods: PodBatch,
                    cfg: SchedulerConfig, static=None) -> dict:
     """Pure-JAX body of :func:`explain_scores`: every additive term and

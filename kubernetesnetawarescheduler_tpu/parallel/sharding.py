@@ -343,6 +343,90 @@ def sharded_score_fn(cfg: SchedulerConfig, mesh: Mesh,
     return fn
 
 
+def sharded_winner_fn(cfg: SchedulerConfig, mesh: Mesh,
+                      state_placer=None):
+    """Mesh-sharded FUSED winner: ``fn(state, pods, static) ->
+    (best f32[P], node i32[P])`` without a replicated ``P x N`` score
+    matrix ever leaving the shards.
+
+    The score runs under the same GSPMD layout as
+    :func:`sharded_score_fn` (node axis on ``tp``, pods replicated);
+    the winner reduction is then a ``shard_map`` over the tp-sharded
+    score columns — each shard reduces its own node slice to a local
+    ``(best, node)`` pair with GLOBAL node indices
+    (``axis_index("tp") * n_shard`` offset), and the cross-shard
+    combine is ``pmax`` on the values plus ``pmin`` over the local
+    winners that match the global max.  Node indices are global and
+    ``pmin`` picks the smallest, so the repo's lowest-index-of-max
+    tie-break (core/score.winner_from_scores) survives sharding
+    exactly: results are bit-identical to the single-device fused
+    winner (pinned on the 8-virtual-device CPU mesh in
+    tests/test_winner_fusion.py).  Infeasible rows come back as -1,
+    same sentinel contract as the unsharded path.
+    """
+    cfg = _force_dense(cfg)
+    from kubernetesnetawarescheduler_tpu.core import score as score_lib
+    from kubernetesnetawarescheduler_tpu.core.pallas_score import (
+        _WINNER_SENTINEL,
+    )
+    from kubernetesnetawarescheduler_tpu.core.score import NEG_INF
+
+    try:
+        from jax import shard_map  # jax >= 0.8
+        sm_kwargs = {"check_vma": False}
+    except ImportError:  # older jax
+        from jax.experimental.shard_map import shard_map
+        sm_kwargs = {"check_rep": False}
+
+    rep = NamedSharding(mesh, P())
+    st_shard = state_sharding(mesh)
+    pods_rep = jax.tree_util.tree_map(
+        lambda _: rep, pods_sharding(mesh),
+        is_leaf=lambda x: isinstance(x, NamedSharding))
+    static_shard = (NamedSharding(mesh, P("tp")),
+                    NamedSharding(mesh, P(None, "tp")))
+
+    def _combine(s_local):
+        n_shard = s_local.shape[1]
+        offset = jax.lax.axis_index("tp") * n_shard
+        best_l = jnp.max(s_local, axis=1)
+        cols = offset + jax.lax.broadcasted_iota(
+            jnp.int32, s_local.shape, 1)
+        node_l = jnp.min(
+            jnp.where(s_local == best_l[:, None], cols,
+                      jnp.int32(_WINNER_SENTINEL)), axis=1)
+        best = jax.lax.pmax(best_l, "tp")
+        node = jax.lax.pmin(
+            jnp.where(best_l == best, node_l,
+                      jnp.int32(_WINNER_SENTINEL)), "tp")
+        feasible = best > NEG_INF * 0.5
+        return best, jnp.where(feasible, node,
+                               jnp.int32(-1)).astype(jnp.int32)
+
+    combine = shard_map(
+        _combine, mesh=mesh, in_specs=P(None, "tp"),
+        out_specs=(P(), P()), **sm_kwargs)
+
+    def _winner(state, pods, static):
+        scores = score_lib.score_pods(state, pods, cfg, static)
+        scores = jax.lax.with_sharding_constraint(
+            scores, NamedSharding(mesh, P(None, "tp")))
+        return combine(scores)
+
+    jitted = jax.jit(
+        _winner,
+        in_shardings=(st_shard, pods_rep, static_shard),
+        out_shardings=(rep, rep),
+    )
+    place_state = state_placer or _leaf_placer(st_shard)
+    place_static = _leaf_placer(static_shard)
+
+    def fn(state, pods, static):
+        return jitted(place_state(state), pods, place_static(static))
+
+    return fn
+
+
 def replicated(mesh: Mesh):
     return NamedSharding(mesh, P())
 
@@ -543,4 +627,5 @@ def sharded_replay_fn(cfg: SchedulerConfig, mesh: Mesh, method: str,
 
 __all__ = ["make_mesh", "state_sharding", "pods_sharding", "place",
            "sharded_schedule_step", "sharded_replay_stream",
-           "sharded_replay_fn", "sharded_assign_fn", "replicated"]
+           "sharded_replay_fn", "sharded_assign_fn",
+           "sharded_winner_fn", "replicated"]

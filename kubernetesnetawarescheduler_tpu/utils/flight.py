@@ -72,6 +72,17 @@ class CycleSpan:
     fault_class: str | None = None
     delta_bytes: int = 0
     full_bytes: int = 0
+    # Fused-step accounting (ISSUE 9): conflict rounds the device
+    # executed for this cycle's batches (max across a burst's batches
+    # — the round-bound share of the cycle's device time), and the
+    # donation disposition of the dispatch (buffers donated vs skips
+    # counted because the caller did not own the state; see
+    # core/assign.fused_schedule_step's contract).  Default-valued so
+    # spans recorded by older code paths (and pre-r9 crash dumps)
+    # deserialize unchanged.
+    rounds: int = 0
+    donated: int = 0
+    donation_skipped: int = 0
 
     def to_dict(self) -> dict[str, Any]:
         return {
@@ -91,6 +102,9 @@ class CycleSpan:
             "fault_class": self.fault_class,
             "delta_bytes": self.delta_bytes,
             "full_bytes": self.full_bytes,
+            "rounds": self.rounds,
+            "donated": self.donated,
+            "donation_skipped": self.donation_skipped,
         }
 
 

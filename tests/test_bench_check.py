@@ -321,3 +321,88 @@ def test_trace_provenance_shape_validated_when_present():
     fails = bench_check.check_doc("BENCH_r06.json", _headline(
         detail={"trace_provenance": _trace_prov(spans=600)}))
     assert any("over capacity" in f for f in fails), fails
+
+
+def _winner_fusion(**overrides):
+    """A healthy r9 winner_fusion block (bench/density._fusion_ab_leg
+    shape)."""
+    block = {
+        "enabled": True,
+        "donated": 34,
+        "donation_failures": 0,
+        "rounds": {"p50": 3.0, "p99": 4.0, "max": 4},
+        "fused_step_p50_ms": 0.9,
+        "fused_step_p99_ms": 1.3,
+        "unfused_step_p50_ms": 1.3,
+        "unfused_step_p99_ms": 1.6,
+        "steps_per_leg": 32,
+        "ab_source": "per_dispatch_chain",
+    }
+    block.update(overrides)
+    return block
+
+
+def _r9_doc(**detail_overrides):
+    detail = {"trace_provenance": _trace_prov(),
+              "winner_fusion": _winner_fusion(),
+              "rounds_max": 4}
+    detail.update(detail_overrides)
+    return _headline(detail=detail)
+
+
+def test_winner_fusion_required_from_round9():
+    # r9+ headline claiming the p99 bar without the block: fails.
+    doc = _headline(detail={"trace_provenance": _trace_prov()})
+    fails = bench_check.check_doc("BENCH_r09.json", doc)
+    assert any("winner_fusion" in f for f in fails), fails
+    # Same doc with the block: clean.
+    assert bench_check.check_doc("BENCH_r09.json", _r9_doc()) == []
+    # Committed r8 history predates the fused step: exempt.
+    assert bench_check.check_doc("BENCH_r08.json", doc) == []
+    # A doc not claiming the bar may omit the block even at r9+.
+    quiet = _headline(detail={"trace_provenance": _trace_prov()})
+    quiet["detail"]["score_p99_ms"] = 87.44
+    quiet["detail"]["north_star"]["p99_met"] = False
+    assert bench_check.check_doc("BENCH_r09.json", quiet) == []
+
+
+def test_winner_fusion_shape_validated_when_present():
+    # Donation failures mean the A/B measured a non-donating program.
+    fails = bench_check.check_doc("BENCH_r09.json", _r9_doc(
+        winner_fusion=_winner_fusion(donation_failures=3)))
+    assert any("donation_failures=3" in f for f in fails), fails
+    # A claimed p99 with zero donations lacks its fused-step evidence.
+    fails = bench_check.check_doc("BENCH_r09.json", _r9_doc(
+        winner_fusion=_winner_fusion(donated=0)))
+    assert any("donated=0" in f for f in fails), fails
+    # Missing accounting keys.
+    bad = _winner_fusion()
+    del bad["rounds"]
+    fails = bench_check.check_doc("BENCH_r09.json", _r9_doc(
+        winner_fusion=bad))
+    assert any("winner_fusion missing" in f for f in fails), fails
+    # The rounds histogram must carry its percentiles.
+    fails = bench_check.check_doc("BENCH_r09.json", _r9_doc(
+        winner_fusion=_winner_fusion(rounds={"p50": 3.0})))
+    assert any("winner_fusion.rounds" in f for f in fails), fails
+    # Validated even on a pre-r9 filename: carrying the block opts in.
+    fails = bench_check.check_doc("BENCH_r08.json", _headline(
+        detail={"trace_provenance": _trace_prov(),
+                "winner_fusion": _winner_fusion(donation_failures=1)}))
+    assert any("donation_failures" in f for f in fails), fails
+
+
+def test_round_bound_p99_flagged_from_round9():
+    # A claimed sub-5ms p99 carried by >8 conflict rounds: fails.
+    fails = bench_check.check_doc("BENCH_r09.json",
+                                  _r9_doc(rounds_max=19))
+    assert any("round-bound" in f for f in fails), fails
+    # Not claiming the bar: deep-round drains are honest history.
+    deep = _r9_doc(rounds_max=19)
+    deep["detail"]["score_p99_ms"] = 87.44
+    deep["detail"]["north_star"]["p99_met"] = False
+    assert bench_check.check_doc("BENCH_r09.json", deep) == []
+    # Pre-r9 filenames keep their committed rounds_max history clean.
+    old = _headline(detail={"trace_provenance": _trace_prov(),
+                            "rounds_max": 19})
+    assert bench_check.check_doc("BENCH_r08.json", old) == []

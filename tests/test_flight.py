@@ -294,3 +294,66 @@ def test_spans_tag_degraded_fault_class():
     span2 = loop.flight.spans()[-1]
     assert span2.degraded is False
     assert span2.fault_class == "watch_gap"
+
+
+def test_collapsed_phase_shape_accepted():
+    """The r9 fused single-dispatch cycle collapses score+assign+
+    commit into one phase (or, replayed, none at all) — the linter
+    enforces containment and ordering, never a phase-name schema, so
+    both shapes lint clean with the fused-step args attached.
+    Referenced by name from tools/trace_check.py's docstring."""
+    rec = FlightRecorder(capacity=16)
+    sb = rec.begin("serial")
+    with sb.phase("score_assign"):
+        pass
+    rec.commit(sb.finish(n_pods=2, pod_uids=("a", "b"), queue_depth=0,
+                         rounds=3, donated=0, donation_skipped=1))
+    sb2 = rec.begin("burst")  # zero-phase cycle
+    rec.commit(sb2.finish(n_pods=0, pod_uids=(), queue_depth=0))
+    doc = rec.to_chrome_trace()
+    assert trace_check.check_trace(doc) == []
+    # The committed spans really carry the accounting the linter and
+    # bench_check read back.
+    spans = rec.spans()
+    assert spans[0].rounds == 3
+    assert spans[0].donation_skipped == 1
+    assert spans[0].to_dict()["rounds"] == 3
+
+
+def test_fused_step_args_validated_in_trace():
+    rec = FlightRecorder(capacity=16)
+    sb = rec.begin("serial")
+    rec.commit(sb.finish(n_pods=1, pod_uids=("a",), queue_depth=0,
+                         rounds=2))
+    doc = rec.to_chrome_trace()
+    for ev in doc["traceEvents"]:
+        if ev.get("cat") == "cycle":
+            ev["args"]["rounds"] = -2
+            break
+    fails = trace_check.check_trace(doc)
+    assert any("args.rounds" in f for f in fails), fails
+    doc2 = rec.to_chrome_trace()
+    for ev in doc2["traceEvents"]:
+        if ev.get("cat") == "cycle":
+            ev["args"]["donated"] = 1.5
+            break
+    fails2 = trace_check.check_trace(doc2)
+    assert any("args.donated" in f for f in fails2), fails2
+
+
+def test_cycle_spans_carry_round_and_donation_accounting():
+    """Serving cycles record the device while_loop's round count and
+    the donation disposition: the serving snapshot is encoder-owned,
+    so every dispatch is a donation SKIP (donated stays 0) — the
+    counters /metrics scrapes must agree with the spans."""
+    cfg = _cfg()  # method defaults to parallel, which carries stats
+    cluster, loop = _make_loop(cfg, seed=5)
+    _drain(cluster, loop, num_pods=10, seed=5)
+    spans = [s for s in loop.flight.spans() if s.n_pods > 0]
+    assert spans
+    assert all(s.donated == 0 for s in spans)
+    assert all(s.donation_skipped == 1 for s in spans)
+    assert any(s.rounds >= 1 for s in spans)
+    assert loop.donation_skipped_total >= len(spans)
+    assert loop.donated_total == 0
+    assert trace_check.check_trace(loop.flight.to_chrome_trace()) == []

@@ -144,3 +144,22 @@ def test_flight_metrics_absent_when_recorder_disabled():
     assert loop.flight is None
     assert "netaware_cycle_seq" not in parsed
     assert "netaware_flight_dropped_total" not in parsed
+
+
+def test_fused_step_counters_exposed():
+    """r9: recompile and donation accounting is scrapeable and agrees
+    with the loop.  A drained serving loop has warm caches and an
+    encoder-owned snapshot, so: misses == the warmup compiles (flat
+    afterwards, pinned in test_winner_fusion), every dispatch a
+    donation skip, zero donations."""
+    loop = _run_loop(num_pods=24, seed=11)
+    parsed = parse_prometheus_text(render_metrics(loop))
+    flat = {name: next(iter(series.values()))
+            for name, series in parsed.items() if len(series) == 1}
+    assert flat["netaware_jit_cache_miss_total"] == \
+        loop.jit_cache_miss_total
+    assert flat["netaware_donated_dispatches_total"] == \
+        loop.donated_total == 0
+    assert flat["netaware_donation_skipped_total"] == \
+        loop.donation_skipped_total
+    assert loop.donation_skipped_total > 0

@@ -361,6 +361,81 @@ def check_doc(path: str, doc: dict) -> list[str]:
                                 f"{name}: trace_provenance."
                                 f"worst_cycle missing "
                                 f"{sorted(wc_missing)}")
+
+    # Rule 9 — fused-winner provenance (round 9+): a headline that
+    # claims the p99 bar must say whether the single-dispatch fused
+    # step produced it — winner_fusion with fusion on/off, VERIFIED
+    # donation accounting (donated/donation_failures from the
+    # buffer-deleted check, not an assumption), and the fused leg's
+    # conflict-round histogram.  A p99 claimed with the fusion state
+    # unrecorded is the r5 two-labels bug again (which program was
+    # measured?); donation failures mean the A/B silently re-copied
+    # N×N planes every step; and rounds_max > 8 means the number is
+    # round-bound, not kernel-bound — flagged wherever the block
+    # appears, p99 bar or not.
+    if not grandfathered:
+        ns = detail.get("north_star")
+        p99_met = isinstance(ns, dict) and bool(ns.get("p99_met"))
+        wf = detail.get("winner_fusion")
+        rnd = _round_of(name)
+        if wf is None:
+            if p99_met and rnd is not None and rnd >= 9:
+                fails.append(
+                    f"{name}: north_star.p99_met without a "
+                    "winner_fusion block (round 9+ requires fused-step "
+                    "provenance behind any claimed p99)")
+        elif not isinstance(wf, dict):
+            fails.append(f"{name}: winner_fusion is not an object")
+        else:
+            required = {"enabled", "donated", "donation_failures",
+                        "rounds"}
+            missing = required - set(wf)
+            if missing:
+                fails.append(f"{name}: winner_fusion missing "
+                             f"{sorted(missing)}")
+            else:
+                try:
+                    donated = int(wf["donated"])
+                    failures = int(wf["donation_failures"])
+                except (TypeError, ValueError):
+                    fails.append(f"{name}: winner_fusion not numeric")
+                else:
+                    if failures > 0:
+                        fails.append(
+                            f"{name}: winner_fusion.donation_failures="
+                            f"{failures} — the donated step re-copied "
+                            "state buffers; the A/B did not measure "
+                            "the donating program")
+                    if p99_met and donated < 1:
+                        fails.append(
+                            f"{name}: north_star.p99_met with "
+                            "winner_fusion.donated=0 — no dispatch "
+                            "actually donated, so the fused-step "
+                            "evidence is missing")
+                rounds = wf.get("rounds")
+                if not isinstance(rounds, dict):
+                    fails.append(f"{name}: winner_fusion.rounds is "
+                                 "not an object")
+                else:
+                    r_missing = {"p50", "p99", "max"} - set(rounds)
+                    if r_missing:
+                        fails.append(f"{name}: winner_fusion.rounds "
+                                     f"missing {sorted(r_missing)}")
+        # Round-bound flag, same p99-bar scope as the rest of the
+        # rule: a CLAIMED sub-5 ms p99 carried by >8 conflict rounds
+        # is a convergence problem no kernel fusion can fix — the
+        # number would be round-bound, not kernel-bound, and must
+        # fail loudly rather than ride in.  (Artifacts not claiming
+        # the bar may honestly record deep-round drains.)
+        rounds_max = detail.get("rounds_max")
+        if (p99_met and rnd is not None and rnd >= 9
+                and isinstance(rounds_max, (int, float))
+                and rounds_max > 8):
+            fails.append(
+                f"{name}: north_star.p99_met with rounds_max="
+                f"{int(rounds_max)} > 8 — the claimed p99 is "
+                "round-bound; investigate the second-chance pass "
+                "before publishing this artifact")
     return fails
 
 

@@ -17,6 +17,16 @@ Checks:
   * cycle ids strictly increasing in event order
   * bounded memory — the ``recorder`` block proves ring-buffer
     eviction: spans <= capacity, non-negative drop counters
+  * fused-step accounting — cycle spans carrying the r9 args
+    (``rounds``/``donated``/``donation_skipped``) must be
+    non-negative integers
+
+A cycle's phase set is NOT prescribed: the r9 fused single-dispatch
+step collapses score+assign+commit into one ``score_assign`` phase
+(or, for a replayed burst, a lone ``dispatch``), and a cycle with one
+— or zero — phase children lints clean.  Only containment and
+ordering are enforced, never a phase-name schema (pinned by
+tests/test_flight.py::test_collapsed_phase_shape_accepted).
 
 Usage: trace_check.py [trace.json ...]; exits nonzero on any failure.
 check_trace(doc) is importable for tests (tests/test_flight.py).
@@ -89,6 +99,14 @@ def check_trace(doc: Any) -> list[str]:
         if cat == "cycle":
             cycles.append((ts, ts + dur, i,
                            (key, args.get("cycle_id"))))
+            # r9 fused-step accounting, validated only when present
+            # (pre-r9 dumps carry none of these and stay clean).
+            for k in ("rounds", "donated", "donation_skipped"):
+                v = args.get(k)
+                if v is not None and (not isinstance(v, int)
+                                      or v < 0):
+                    fails.append(f"event[{i}] ({ev.get('name')}) "
+                                 f"args.{k} invalid: {v!r}")
         elif cat == "phase":
             phases.append((ts, ts + dur, i,
                            (key, args.get("cycle_id"))))
