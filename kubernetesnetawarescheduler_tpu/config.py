@@ -400,6 +400,22 @@ class SchedulerConfig:
     explain_top_k: int = 5
     explain_retain: int = 512
 
+    # State integrity & self-healing (core/integrity.py): anti-entropy
+    # audit period in seconds (0 disables the background auditor; the
+    # digest kernel itself costs nothing extra on the hot path — it
+    # rides the fused step's donated chain).  The watchdog fires a
+    # flight-recorder crash dump after this many CONSECUTIVE audits
+    # that detected drift the repair ladder could not clear.
+    audit_interval_s: float = 0.0
+    audit_watchdog_failures: int = 3
+
+    # Ingest quarantine (ingest/probe.py): a probe result with a
+    # non-finite value, negative latency, or non-positive bandwidth is
+    # quarantined instead of written into staging; after this many
+    # CONSECUTIVE quarantines on one link, a LinkQuarantined Event is
+    # raised so operators see the sick path, not just a counter.
+    quarantine_streak_events: int = 3
+
     def __post_init__(self) -> None:
         if self.max_nodes <= 0 or self.max_pods <= 0 or self.max_peers <= 0:
             raise ValueError("shape limits must be positive")
@@ -463,6 +479,12 @@ class SchedulerConfig:
             raise ValueError("explain_top_k must be >= 1")
         if self.explain_retain < 1:
             raise ValueError("explain_retain must be >= 1")
+        if self.audit_interval_s < 0:
+            raise ValueError("audit_interval_s must be >= 0")
+        if self.audit_watchdog_failures < 1:
+            raise ValueError("audit_watchdog_failures must be >= 1")
+        if self.quarantine_streak_events < 1:
+            raise ValueError("quarantine_streak_events must be >= 1")
 
 
 # ---------------------------------------------------------------------------

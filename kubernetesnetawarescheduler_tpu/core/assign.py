@@ -757,10 +757,12 @@ def schedule_batch(state: ClusterState, pods: PodBatch, cfg: SchedulerConfig,
     return assignment, commit_assignments(state, pods, assignment)
 
 
-@partial(jax.jit, static_argnames=("cfg", "method"), donate_argnums=(0,))
+@partial(jax.jit, static_argnames=("cfg", "method", "with_digest"),
+         donate_argnums=(0,))
 def fused_schedule_step(state: ClusterState, pods: PodBatch,
                         cfg: SchedulerConfig, static=None,
-                        method: str = "parallel"):
+                        method: str = "parallel",
+                        with_digest: bool = False):
     """The whole per-batch scheduling decision as ONE donated device
     dispatch: score + conflict resolution (the device-resident
     ``lax.while_loop`` inside :func:`assign_parallel` — the host never
@@ -784,6 +786,14 @@ def fused_schedule_step(state: ClusterState, pods: PodBatch,
     tests/test_winner_fusion.py).  ``static`` is the backend prep from
     :func:`~.pallas_score.compute_assign_static`, like
     :func:`assign_parallel`'s.
+
+    ``with_digest=True`` additionally returns the committed state's
+    per-plane integrity digest (``u32[len(integrity.PLANES)]``,
+    :func:`~.integrity.plane_digest_vector`) as a fourth output —
+    folded into the SAME donated dispatch, so a running state
+    fingerprint on the hot path costs zero extra dispatches (the r10
+    anti-entropy contract; the digest reads the post-commit planes XLA
+    is already holding in registers/HBM for the state output).
     """
     if method == "greedy":
         assignment = assign_greedy(state, pods, cfg, static)
@@ -793,4 +803,12 @@ def fused_schedule_step(state: ClusterState, pods: PodBatch,
                                              with_stats=True)
     else:
         raise ValueError(f"unknown method {method!r}")
-    return commit_assignments(state, pods, assignment), assignment, rounds
+    new_state = commit_assignments(state, pods, assignment)
+    if with_digest:
+        from kubernetesnetawarescheduler_tpu.core.integrity import (
+            plane_digest_vector,
+        )
+
+        return new_state, assignment, rounds, plane_digest_vector(
+            new_state)
+    return new_state, assignment, rounds

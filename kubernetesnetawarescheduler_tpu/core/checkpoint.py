@@ -17,6 +17,15 @@ A checkpoint is a directory:
   and constraint bitmasks).
 - ``meta.json``  — config echo, node name table, interner tables
   (string -> bit position), and counters.
+- ``MANIFEST.json`` — per-file SHA-256 digests; its rename is the
+  SINGLE commit point of a save (r10).  Payload files are written to
+  ``.staging/`` and renamed into place first, the previous good file
+  set is preserved under ``previous/``, and restore verifies every
+  digest — a crash anywhere in the sequence leaves either the old
+  committed set or a digest mismatch that falls back to
+  ``previous/``, never a silently-torn mixed-version checkpoint (the
+  pre-r10 bug: ``state.npz`` and ``meta.json`` were ``os.replace``d
+  independently).
 
 ``decisions.jsonl`` (one JSON object per scheduling decision) is written
 by :class:`DecisionLog`, which the loop appends to; replaying the same
@@ -27,8 +36,10 @@ pod stream against a restored checkpoint must reproduce it bit-for-bit
 from __future__ import annotations
 
 import dataclasses
+import hashlib
 import json
 import os
+import shutil
 from typing import Iterator, Sequence
 
 import numpy as np
@@ -129,6 +140,112 @@ class DecisionLog:
 
 
 # ---------------------------------------------------------------------------
+# Manifest protocol (r10): per-file SHA-256 digests, one commit point.
+# ---------------------------------------------------------------------------
+
+MANIFEST = "MANIFEST.json"
+PREVIOUS_DIR = "previous"
+_STAGING_DIR = ".staging"
+
+
+def _sha256_file(path: str) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as fh:
+        for chunk in iter(lambda: fh.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def verify_manifest(path: str) -> "list[str] | None":
+    """Digest-check a checkpoint directory against its manifest.
+
+    Returns ``None`` when no manifest exists (a pre-r10 checkpoint —
+    the caller decides whether to trust it), ``[]`` when every listed
+    file is present with a matching SHA-256, and a list of
+    human-readable mismatch descriptions otherwise."""
+    mpath = os.path.join(path, MANIFEST)
+    if not os.path.exists(mpath):
+        return None
+    try:
+        with open(mpath, encoding="utf-8") as fh:
+            manifest = json.load(fh)
+        files = dict(manifest["files"])
+    except Exception as exc:  # noqa: BLE001 — unreadable manifest IS
+        # a verification failure, not a missing one
+        return [f"manifest unreadable: {exc}"]
+    errors: list[str] = []
+    for name, digest in files.items():
+        fpath = os.path.join(path, name)
+        if not os.path.exists(fpath):
+            errors.append(f"{name}: listed in manifest but missing")
+        elif _sha256_file(fpath) != digest:
+            errors.append(f"{name}: SHA-256 mismatch")
+    return errors
+
+
+def update_manifest(path: str) -> None:
+    """Recompute the manifest digests for the files currently in
+    ``path`` (keeping the existing file list).  For tooling and tests
+    that legitimately edit a checkpoint in place — production writers
+    go through :func:`save_checkpoint`'s staged commit."""
+    mpath = os.path.join(path, MANIFEST)
+    with open(mpath, encoding="utf-8") as fh:
+        manifest = json.load(fh)
+    manifest["files"] = {
+        name: _sha256_file(os.path.join(path, name))
+        for name in manifest["files"]
+        if os.path.exists(os.path.join(path, name))}
+    tmp = mpath + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as fh:
+        json.dump(manifest, fh, indent=2)
+    os.replace(tmp, mpath)
+
+
+def resolve_checkpoint_dir(path: str) -> str:
+    """The directory restore should actually read: ``path`` when its
+    manifest verifies (or predates manifests), else the preserved
+    ``previous/`` good set, else a :class:`ValueError` — garbage is
+    REFUSED, never loaded."""
+    errors = verify_manifest(path)
+    if errors is None:
+        # Pre-r10 checkpoint: no digests to check.  Loaded as before
+        # (np.load/json still fail loudly on gross truncation).
+        return path
+    if not errors:
+        return path
+    prev = os.path.join(path, PREVIOUS_DIR)
+    prev_errors = verify_manifest(prev)
+    if prev_errors == []:
+        import sys
+
+        print(f"WARNING: checkpoint {path} failed verification "
+              f"({'; '.join(errors)}); falling back to the previous "
+              "good checkpoint", file=sys.stderr)
+        return prev
+    raise ValueError(
+        f"checkpoint {path} is corrupt ({'; '.join(errors)}) and no "
+        "verified previous checkpoint is available — refusing to "
+        "restore (start fresh; state rebuilds from the API server)")
+
+
+def read_state_arrays(path: str) -> "dict[str, np.ndarray]":
+    """Load (and digest-verify) just the ``state.npz`` plane arrays
+    from a checkpoint — the integrity repair ladder's
+    checkpoint-restore rung reads staging planes without rebuilding a
+    whole Encoder."""
+    base = resolve_checkpoint_dir(path)
+    out: dict[str, np.ndarray] = {}
+    with np.load(os.path.join(base, "state.npz")) as data:
+        for name in _STATE_ARRAYS:
+            key = name.lstrip("_")
+            if key not in data:
+                raise ValueError(
+                    f"checkpoint state.npz is missing array {name!r}")
+            out[key] = np.array(data[key])
+    return out
+
+
+# ---------------------------------------------------------------------------
 # Encoder snapshot <-> directory.
 # ---------------------------------------------------------------------------
 
@@ -197,35 +314,81 @@ def save_checkpoint(path: str, encoder: Encoder) -> None:
                 for key, (ml, exprs)
                 in encoder._selector_defs.items()},
         }
-    # Atomic like meta.json below: a crash mid-savez must not leave a
-    # truncated state.npz beside a valid meta (np.load raises
-    # BadZipFile on the next start — a crash-looping daemon until an
-    # operator deletes the file).
-    tmp_npz = os.path.join(path, "state.npz.tmp")
-    with open(tmp_npz, "wb") as fh:
+    # Staged commit (r10): every payload file is written to .staging/
+    # first, the CURRENT good set is preserved under previous/, the
+    # payload files rename into place, and the MANIFEST rename is the
+    # single commit point.  A crash anywhere leaves either the old
+    # committed set intact or a digest mismatch restore detects and
+    # falls back from — never the pre-r10 torn mixed-version window
+    # (state.npz and meta.json os.replace'd independently).
+    staging = os.path.join(path, _STAGING_DIR)
+    shutil.rmtree(staging, ignore_errors=True)
+    os.makedirs(staging)
+    with open(os.path.join(staging, "state.npz"), "wb") as fh:
         np.savez_compressed(fh, **arrays)
-    os.replace(tmp_npz, os.path.join(path, "state.npz"))
-    tmp = os.path.join(path, "meta.json.tmp")
-    with open(tmp, "w", encoding="utf-8") as fh:
+    with open(os.path.join(staging, "meta.json"), "w",
+              encoding="utf-8") as fh:
         json.dump(meta, fh, indent=2)
-    os.replace(tmp, os.path.join(path, "meta.json"))
-    # Learned topology model (netmodel/): its own atomic .npz beside
-    # the encoder state, so restarts resume learning instead of
-    # re-learning 54 hours of probes from scratch.  Written only when
-    # attached; a stale file from a since-detached model is removed so
-    # restore cannot resurrect it.
-    npz = os.path.join(path, "netmodel.npz")
+    payload = ["state.npz", "meta.json"]
+    # Learned topology model (netmodel/): beside the encoder state, so
+    # restarts resume learning instead of re-learning 54 hours of
+    # probes from scratch.  Written only when attached; a stale file
+    # from a since-detached model is dropped from the manifest and
+    # removed post-commit so restore cannot resurrect it.
     if encoder.netmodel is not None:
-        encoder.netmodel.save(npz)
-    elif os.path.exists(npz):
+        encoder.netmodel.save(os.path.join(staging, "netmodel.npz"))
+        payload.append("netmodel.npz")
+    manifest = {
+        "format_version": FORMAT_VERSION,
+        "files": {name: _sha256_file(os.path.join(staging, name))
+                  for name in payload},
+    }
+    with open(os.path.join(staging, MANIFEST), "w",
+              encoding="utf-8") as fh:
+        json.dump(manifest, fh, indent=2)
+    # Preserve the current committed set — only if it verifies (a torn
+    # current set must not overwrite an older good previous/).  Copies,
+    # not renames: a crash mid-rotation must leave the committed set in
+    # place, and a torn previous/ is detected by ITS manifest (copied
+    # last).
+    if verify_manifest(path) == []:
+        prev = os.path.join(path, PREVIOUS_DIR)
+        os.makedirs(prev, exist_ok=True)
+        with open(os.path.join(path, MANIFEST),
+                  encoding="utf-8") as fh:
+            cur_files = list(json.load(fh)["files"])
+        for name in cur_files:
+            tmp = os.path.join(prev, name + ".tmp")
+            shutil.copy2(os.path.join(path, name), tmp)
+            os.replace(tmp, os.path.join(prev, name))
+        tmp = os.path.join(prev, MANIFEST + ".tmp")
+        shutil.copy2(os.path.join(path, MANIFEST), tmp)
+        os.replace(tmp, os.path.join(prev, MANIFEST))
+    # Commit: payload first, manifest LAST.
+    for name in payload:
+        os.replace(os.path.join(staging, name),
+                   os.path.join(path, name))
+    os.replace(os.path.join(staging, MANIFEST),
+               os.path.join(path, MANIFEST))
+    npz = os.path.join(path, "netmodel.npz")
+    if encoder.netmodel is None and os.path.exists(npz):
         os.remove(npz)
+    shutil.rmtree(staging, ignore_errors=True)
 
 
 def load_checkpoint(path: str,
                     cfg: SchedulerConfig | None = None) -> Encoder:
     """Reconstruct an :class:`Encoder` from :func:`save_checkpoint`
     output.  ``cfg`` overrides the checkpointed config (shapes must
-    match the stored arrays)."""
+    match the stored arrays).
+
+    Restore resolves through the r10 MANIFEST: a committed set whose
+    digests verify loads as-is; a torn/corrupted set falls back to the
+    ``previous/`` good set; if neither verifies the load REFUSES
+    (:class:`ValueError`) rather than deserialize garbage into hard
+    allocation constraints.  Legacy checkpoints (no manifest) load
+    exactly as before."""
+    path = resolve_checkpoint_dir(path)
     with open(os.path.join(path, "meta.json"), encoding="utf-8") as fh:
         meta = json.load(fh)
     if meta.get("format_version") not in _ACCEPTED_VERSIONS:

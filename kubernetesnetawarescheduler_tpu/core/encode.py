@@ -1319,8 +1319,15 @@ class Encoder:
         return np.array(sorted(rows), np.int32)
 
     def _full_up(self, key: str, host) -> None:
-        """Full-group transfer of one cached array (+accounting)."""
-        arr = jnp.asarray(host)
+        """Full-group transfer of one cached array (+accounting).
+
+        ``copy=True`` is load-bearing: on the CPU backend a bare
+        ``jnp.asarray(host)`` zero-copies a well-aligned numpy buffer,
+        so the cached "device" plane would ALIAS the staging array and
+        every later in-place staging write would leak into snapshots
+        already handed out — breaking the immutable-pytree contract
+        and making device-vs-staging drift undetectable."""
+        arr = jnp.array(host, copy=True)
         self._cache[key] = arr
         self.snapshot_full_bytes_total += int(arr.nbytes)
 
@@ -1515,6 +1522,43 @@ class Encoder:
                                 "topo": set()}
             self._dirty_pairs = set()
             return ClusterState(**self._cache), self._static_version
+
+    def expected_device_arrays(self) -> "dict[str, np.ndarray]":
+        """Host-side truth of what the device cache must hold after a
+        flush: the staging arrays routed through the SAME transforms
+        the snapshot transfer path applies (netmodel blend on the net
+        group, nomination reservations folded into ``used``).  The
+        anti-entropy auditor (core/integrity.py) digests this against
+        the live device planes — bit-exact agreement is the invariant
+        the delta-ingest design promises.  Returns copies (safe to
+        digest outside the lock)."""
+        with self._lock:
+            model = self.netmodel
+            if model is not None and model.enabled:
+                lat, bw = model.blend(self._lat, self._bw)
+                lat = np.asarray(lat, np.float32)
+                bw = np.asarray(bw, np.float32)
+            else:
+                lat, bw = self._lat.copy(), self._bw.copy()
+            used = (self._used + self._reserved if self._nominations
+                    else self._used.copy())
+            return {
+                "metrics": self._metrics.copy(),
+                "metrics_age": self._metrics_age.copy(),
+                "lat": lat,
+                "bw": bw,
+                "cap": self._cap.copy(),
+                "used": used,
+                "node_valid": self._node_valid.copy(),
+                "label_bits": self._label_bits.copy(),
+                "taint_bits": self._taint_bits.copy(),
+                "group_bits": self._group_bits.copy(),
+                "resident_anti": self._resident_anti.copy(),
+                "node_zone": self._node_zone.copy(),
+                "gz_counts": self._gz_counts.copy(),
+                "az_anti": self._az_anti.copy(),
+                "node_numeric": self._node_numeric.copy(),
+            }
 
     # -- pods ---------------------------------------------------------
 

@@ -1,0 +1,494 @@
+"""State integrity: plane digests, the anti-entropy auditor, and the
+automatic repair ladder.
+
+Since r7 the scheduler's truth is *device-resident incremental state*:
+the encoder keeps a host staging mirror and patches the HBM planes with
+row/pair scatters (core/encode.py snapshot_versioned).  That design has
+a failure class the reference scheduler could not even express: a
+dropped or re-ordered delta patch, a NaN-poisoned probe row, or a
+flipped bit in a device plane silently drifts the device view away from
+staging truth, and every subsequent placement is wrong with no detector
+anywhere.  This module closes that gap with three legs:
+
+- **Detect** — :func:`device_row_digests` / :func:`host_row_digests`: a
+  cheap per-plane rolling checksum (positionally weighted uint32
+  wraparound sums over the raw bit patterns), computed identically by a
+  jitted kernel over :class:`~.state.ClusterState` and by a numpy
+  mirror over the encoder's staging arrays.  Bit-exact agreement is the
+  invariant; disagreement localizes drift to (plane, row).  The fused
+  scheduling step can fold the digest into its single donated dispatch
+  (:func:`~.assign.fused_schedule_step` ``with_digest=True``) so the
+  hot path pays zero extra dispatches for a running fingerprint.
+- **Audit** — :class:`IntegrityAuditor`: a background anti-entropy
+  thread that periodically flushes pending deltas, shadow-re-derives
+  the expected device view from staging
+  (:meth:`~.encode.Encoder.expected_device_arrays`) and compares
+  digests.  Observation-only on clean runs: placements are bit-identical
+  with the auditor on or off (tests/test_integrity.py pins this).
+- **Repair** — an escalation ladder, cheapest rung first:
+  row-level re-patch from staging -> full re-encode -> checkpoint
+  restore -> apiserver relist.  Each rung is re-audited before the next
+  is tried; per-rung counters feed ``/metrics``
+  (``netaware_integrity_repairs_total{rung=...}``), escalations emit
+  k8s Events, and a stuck-audit watchdog (drift surviving the whole
+  ladder for ``watchdog_failures`` consecutive audits) triggers the r8
+  flight-recorder ``crash_dump``.
+
+Fault injection for all of this lives in core/state_chaos.py; the
+offline twin (checkpoint vs decision-replay digests) in
+tools/state_audit.py.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Mapping, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from kubernetesnetawarescheduler_tpu.core.state import ClusterState
+
+#: Every ClusterState plane, in checkpoint (_STATE_ARRAYS) order, with
+#: the encoder dirty group whose transfer path owns it.  The digest
+#: machinery iterates this — adding a plane to ClusterState without
+#: registering it here fails test_integrity's coverage check.
+PLANES: tuple[tuple[str, str], ...] = (
+    ("metrics", "metrics"),
+    ("metrics_age", "metrics"),
+    ("lat", "net"),
+    ("bw", "net"),
+    ("cap", "alloc"),
+    ("used", "alloc"),
+    ("node_valid", "topo"),
+    ("label_bits", "topo"),
+    ("taint_bits", "topo"),
+    ("group_bits", "alloc"),
+    ("resident_anti", "alloc"),
+    ("node_zone", "topo"),
+    ("gz_counts", "alloc"),
+    ("az_anti", "alloc"),
+    ("node_numeric", "topo"),
+)
+
+PLANE_NAMES: tuple[str, ...] = tuple(name for name, _ in PLANES)
+GROUP_OF: dict[str, str] = dict(PLANES)
+
+#: Float planes where a non-finite STAGING value is itself corruption
+#: (the ingest paths all validate; NaN here means something bypassed
+#: them).  node_numeric is excluded on purpose — NaN is its legitimate
+#: "label absent" sentinel.
+_FINITE_PLANES = ("metrics", "metrics_age", "lat", "bw", "cap", "used")
+
+#: The repair ladder, cheapest first.  Rung names are the
+#: ``netaware_integrity_repairs_total{rung=...}`` label values.
+REPAIR_RUNGS = ("repatch_rows", "full_reencode", "checkpoint_restore",
+                "relist")
+
+
+# ---------------------------------------------------------------------------
+# Digest kernels — device (jitted) and host (numpy) mirrors.
+#
+# Per row: digest = sum_k u32(row[k]) * (2k + 1)  (mod 2^32).
+# The raw BIT PATTERN is digested (float32 bitcast to uint32), so the
+# comparison is bit-exact, not tolerance-based — the delta-ingest
+# contract is bit-identity with a full re-upload, so any mismatch at
+# all is drift.  Odd positional weights make the map value -> digest a
+# bijection per element (multiplication by an odd number is invertible
+# mod 2^32): a single flipped bit or swapped pair always moves the
+# digest.
+# ---------------------------------------------------------------------------
+
+
+def _row_weights(width: int) -> np.ndarray:
+    return (2 * np.arange(width, dtype=np.uint32) + np.uint32(1))
+
+
+def _host_u32_rows(arr: np.ndarray) -> np.ndarray:
+    a = np.ascontiguousarray(arr)
+    if a.dtype == np.bool_:
+        a = a.astype(np.uint32)
+    elif a.dtype in (np.dtype(np.float32), np.dtype(np.int32)):
+        a = a.view(np.uint32)
+    elif a.dtype != np.dtype(np.uint32):
+        a = a.astype(np.float32).view(np.uint32)
+    return a.reshape(a.shape[0], -1)
+
+
+def host_row_digest(arr: np.ndarray) -> np.ndarray:
+    """``u32[rows]`` rolling digest of one host array."""
+    u = _host_u32_rows(arr)
+    w = _row_weights(u.shape[1])
+    return np.sum(u * w[None, :], axis=1, dtype=np.uint32)
+
+
+def host_row_digests(arrays: Mapping[str, np.ndarray]
+                     ) -> dict[str, np.ndarray]:
+    """Per-plane row digests of a host array set (the expected device
+    view from :meth:`Encoder.expected_device_arrays`, or raw staging
+    arrays for offline audits)."""
+    return {name: host_row_digest(arrays[name]) for name in PLANE_NAMES
+            if name in arrays}
+
+
+def _dev_u32_rows(x: jax.Array) -> jax.Array:
+    if x.dtype == jnp.bool_:
+        u = x.astype(jnp.uint32)
+    elif x.dtype in (jnp.dtype(jnp.float32), jnp.dtype(jnp.int32)):
+        u = jax.lax.bitcast_convert_type(x, jnp.uint32)
+    elif x.dtype == jnp.dtype(jnp.uint32):
+        u = x
+    else:
+        # Narrow accelerator dtypes (bf16 planes): digest the f32
+        # widening, matching the host mirror's fallback bit-for-bit.
+        u = jax.lax.bitcast_convert_type(
+            x.astype(jnp.float32), jnp.uint32)
+    return u.reshape(x.shape[0], -1)
+
+
+def _dev_row_digest(x: jax.Array) -> jax.Array:
+    u = _dev_u32_rows(x)
+    w = jnp.asarray(_row_weights(u.shape[1]))
+    return jnp.sum(u * w[None, :], axis=1, dtype=jnp.uint32)
+
+
+@jax.jit
+def device_row_digests(state: ClusterState) -> dict[str, jax.Array]:
+    """Per-plane ``u32[rows]`` digests of the device-resident state —
+    ONE fused dispatch over every plane (the per-plane reductions fuse;
+    the transfer back is ~sum(rows) u32, a few KB at N=5120)."""
+    return {name: _dev_row_digest(getattr(state, name))
+            for name in PLANE_NAMES}
+
+
+def _fold_rows(rowd) -> np.ndarray:
+    w = _row_weights(int(rowd.shape[0]))
+    if isinstance(rowd, np.ndarray):
+        return np.sum(rowd * w, dtype=np.uint32)
+    return jnp.sum(rowd * jnp.asarray(w), dtype=jnp.uint32)
+
+
+@jax.jit
+def plane_digest_vector(state: ClusterState) -> jax.Array:
+    """``u32[len(PLANES)]`` — one scalar digest per plane, the compact
+    fingerprint the fused scheduling step folds into its donated chain
+    (:func:`~.assign.fused_schedule_step` ``with_digest=True``)."""
+    return jnp.stack([_fold_rows(_dev_row_digest(getattr(state, name)))
+                      for name in PLANE_NAMES])
+
+
+def host_plane_digest_vector(arrays: Mapping[str, np.ndarray]
+                             ) -> np.ndarray:
+    """Numpy mirror of :func:`plane_digest_vector`."""
+    return np.stack([
+        np.sum(host_row_digest(arrays[name])
+               * _row_weights(arrays[name].shape[0]), dtype=np.uint32)
+        for name in PLANE_NAMES])
+
+
+def compare_row_digests(dev: Mapping[str, np.ndarray],
+                        host: Mapping[str, np.ndarray]
+                        ) -> dict[str, list[int]]:
+    """Drift localization: plane -> sorted row indices whose digests
+    disagree.  Empty dict == bit-identical state."""
+    drift: dict[str, list[int]] = {}
+    for name in PLANE_NAMES:
+        if name not in dev or name not in host:
+            continue
+        d = np.asarray(dev[name])
+        h = np.asarray(host[name])
+        rows = np.flatnonzero(d != h)
+        if rows.size:
+            drift[name] = [int(r) for r in rows]
+    return drift
+
+
+def staging_sanity(arrays: Mapping[str, np.ndarray]
+                   ) -> dict[str, list[int]]:
+    """Rows of the HOST truth itself holding non-finite values in
+    planes where that is corruption (every ingest path validates;
+    see _FINITE_PLANES).  Device-vs-staging digests cannot see this
+    case — both sides agree on the poison — so the auditor checks it
+    separately and repairs from the checkpoint rung."""
+    bad: dict[str, list[int]] = {}
+    for name in _FINITE_PLANES:
+        if name not in arrays:
+            continue
+        a = np.asarray(arrays[name])
+        flat = a.reshape(a.shape[0], -1)
+        rows = np.flatnonzero(~np.all(np.isfinite(flat), axis=1))
+        if rows.size:
+            bad[name] = [int(r) for r in rows]
+    return bad
+
+
+# ---------------------------------------------------------------------------
+# The anti-entropy auditor + repair ladder.
+# ---------------------------------------------------------------------------
+
+
+class IntegrityAuditor:
+    """Periodic device-vs-staging integrity audit with self-healing.
+
+    ``audit_once`` is the whole cycle: flush pending deltas, compare
+    digests, and if anything drifted walk the repair ladder, re-auditing
+    after each rung.  ``start``/``stop`` run it on a daemon thread
+    every ``interval_s`` (the serve.py ``--audit-interval`` flag).
+
+    Clean-run bit-identity: a passing audit only ever calls
+    ``snapshot_versioned()`` — the same flush the next scheduling cycle
+    would perform, producing the same arrays by the delta-ingest
+    bit-identity contract — so placements are unchanged by auditing.
+    """
+
+    def __init__(self, encoder, loop=None, *,
+                 interval_s: float = 5.0,
+                 checkpoint_dir: str | None = None,
+                 watchdog_failures: int = 3,
+                 crash_dump_path: str | None = None) -> None:
+        self.encoder = encoder
+        self.loop = loop
+        self.interval_s = float(interval_s)
+        self.checkpoint_dir = checkpoint_dir
+        self.watchdog_failures = max(1, int(watchdog_failures))
+        self.crash_dump_path = crash_dump_path
+        # Counters (selfmetrics reads these; names mirror /metrics).
+        self.audits_total = 0
+        self.drift_detected_total = 0
+        self.drift_rows_total = 0
+        self.repairs = {rung: 0 for rung in REPAIR_RUNGS}
+        self.unrepaired_total = 0
+        self.watchdog_dumps = 0
+        self.last_audit_ms = 0.0
+        self.last_drift: dict[str, list[int]] = {}
+        from collections import deque
+        self.audit_ms: "deque[float]" = deque(maxlen=2048)
+        self._unrepaired_streak = 0
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    # -- detect -------------------------------------------------------
+
+    def check(self) -> tuple[dict[str, list[int]], dict[str, list[int]]]:
+        """One detection pass: ``(device_drift, staging_corruption)``,
+        both plane -> row lists (empty == clean).  Flushes pending
+        deltas first so legitimate not-yet-shipped dirt is never
+        reported as drift — "detected within one audit period" starts
+        from a flushed baseline."""
+        enc = self.encoder
+        with enc._lock:
+            state, _ = enc.snapshot_versioned()
+            expected = enc.expected_device_arrays()
+        dev = {k: np.asarray(v)
+               for k, v in device_row_digests(state).items()}
+        host = host_row_digests(expected)
+        return compare_row_digests(dev, host), staging_sanity(expected)
+
+    # -- repair rungs -------------------------------------------------
+
+    def _rung_repatch_rows(self, drift: Mapping[str, Sequence[int]]
+                           ) -> None:
+        """Rung 1: re-scatter exactly the drifted rows from staging.
+        Net drift re-ships the whole group — its delta protocol is
+        (i, j) pairs, and a drifted ROW of an N x N matrix is already
+        past the pair-scatter's break-even."""
+        enc = self.encoder
+        with enc._lock:
+            for plane, rows in drift.items():
+                group = GROUP_OF[plane]
+                if group == "net":
+                    enc._mark_full("net")
+                else:
+                    enc._mark_rows(group, *[int(r) for r in rows])
+            enc.snapshot()
+
+    def _rung_full_reencode(self) -> None:
+        """Rung 2: drop the device cache and re-upload every plane
+        from staging (the pre-delta full-transfer path)."""
+        enc = self.encoder
+        with enc._lock:
+            enc._cache.clear()
+            for group in enc._dirty:
+                enc._mark_full(group)
+            enc.snapshot()
+
+    def _rung_checkpoint_restore(self) -> None:
+        """Rung 3: overwrite the STAGING planes from the last good
+        (manifest-verified) checkpoint, then full re-encode.  Repairs
+        staging-side corruption rungs 1-2 cannot touch; the ledger and
+        interners are left alone (rung 4's relist reconciles them
+        against the apiserver if they too have drifted)."""
+        if not self.checkpoint_dir:
+            raise RuntimeError("no checkpoint directory configured")
+        from kubernetesnetawarescheduler_tpu.core.checkpoint import (
+            _STATE_ARRAYS,
+            read_state_arrays,
+        )
+
+        arrays = read_state_arrays(self.checkpoint_dir)
+        enc = self.encoder
+        with enc._lock:
+            for name in _STATE_ARRAYS:
+                target = getattr(enc, name)
+                stored = arrays[name.lstrip("_")]
+                if stored.shape != target.shape:
+                    raise ValueError(
+                        f"checkpoint array {name} has shape "
+                        f"{stored.shape}, expected {target.shape}")
+                target[...] = stored
+            enc._cache.clear()
+            for group in enc._dirty:
+                enc._mark_full(group)
+            enc.snapshot()
+
+    def _rung_relist(self) -> None:
+        """Rung 4: apiserver relist (the r9 watch-gap audit) to repair
+        ledger/node drift at the source of truth, then re-encode."""
+        if self.loop is not None:
+            self.loop.relist_audit()
+        self._rung_full_reencode()
+
+    def _apply_rung(self, rung: str,
+                    drift: Mapping[str, Sequence[int]]) -> None:
+        if rung == "repatch_rows":
+            self._rung_repatch_rows(drift)
+        elif rung == "full_reencode":
+            self._rung_full_reencode()
+        elif rung == "checkpoint_restore":
+            self._rung_checkpoint_restore()
+        elif rung == "relist":
+            self._rung_relist()
+        else:  # pragma: no cover - registry and ladder stay in sync
+            raise ValueError(f"unknown repair rung {rung!r}")
+
+    def _emit_event(self, message: str) -> None:
+        loop = self.loop
+        if loop is None or getattr(loop, "client", None) is None:
+            return
+        try:
+            from kubernetesnetawarescheduler_tpu.k8s.types import Event
+
+            loop.client.create_event(Event(
+                message=message,
+                reason="StateIntegrity",
+                involved_pod=loop.cfg.scheduler_name,
+                namespace="default",
+                component=loop.cfg.scheduler_name,
+                type="Warning"))
+        except Exception:  # noqa: BLE001 — events are best-effort
+            pass
+
+    # -- the audit cycle ----------------------------------------------
+
+    def audit_once(self) -> dict:
+        """Detect + repair.  Returns a summary dict:
+        ``{"clean", "drift", "staging", "rung", "repaired"}``."""
+        t0 = time.perf_counter()
+        self.audits_total += 1
+        drift, staging_bad = self.check()
+        out = {"clean": not drift and not staging_bad,
+               "drift": drift, "staging": staging_bad,
+               "rung": None, "repaired": True}
+        if not out["clean"]:
+            self.drift_detected_total += 1
+            self.drift_rows_total += sum(
+                len(r) for r in drift.values()) + sum(
+                len(r) for r in staging_bad.values())
+            self.last_drift = {**drift,
+                               **{f"staging:{k}": v
+                                  for k, v in staging_bad.items()}}
+            out.update(self._repair(drift, staging_bad))
+        if out["repaired"]:
+            self._unrepaired_streak = 0
+        else:
+            self._unrepaired_streak += 1
+            if self._unrepaired_streak >= self.watchdog_failures:
+                self._watchdog_fire()
+        dt_ms = (time.perf_counter() - t0) * 1e3
+        self.last_audit_ms = dt_ms
+        self.audit_ms.append(dt_ms)
+        return out
+
+    def _repair(self, drift: dict, staging_bad: dict) -> dict:
+        for i, rung in enumerate(REPAIR_RUNGS):
+            if (rung == "checkpoint_restore"
+                    and not self.checkpoint_dir):
+                continue
+            if rung == "relist" and self.loop is None:
+                # A bare-encoder auditor has no apiserver to relist
+                # against; full_reencode is then its top rung.
+                continue
+            try:
+                self._apply_rung(rung, drift)
+            except Exception:  # noqa: BLE001 — a failing rung (e.g. a
+                # corrupt checkpoint refused by its manifest) escalates
+                # to the next one instead of killing the audit thread.
+                continue
+            drift, staging_bad = self.check()
+            if not drift and not staging_bad:
+                self.repairs[rung] += 1
+                if i > 0:
+                    self._emit_event(
+                        f"state drift repaired at rung '{rung}' "
+                        f"(escalated past {i} cheaper rung(s))")
+                return {"rung": rung, "repaired": True,
+                        "drift": {}, "staging": {}}
+        self.unrepaired_total += 1
+        self._emit_event(
+            "state drift UNREPAIRED after full ladder: "
+            + ", ".join(sorted(set(drift) | {f"staging:{k}"
+                                             for k in staging_bad})))
+        return {"rung": None, "repaired": False,
+                "drift": drift, "staging": staging_bad}
+
+    def _watchdog_fire(self) -> None:
+        """Stuck-audit watchdog: drift has survived the whole ladder
+        for ``watchdog_failures`` consecutive audits — dump the flight
+        recorder for the post-mortem (once per streak)."""
+        if self._unrepaired_streak != self.watchdog_failures:
+            return  # fire once per streak, not every audit after
+        self.watchdog_dumps += 1
+        loop = self.loop
+        flight = getattr(loop, "flight", None) if loop else None
+        if flight is not None and self.crash_dump_path:
+            try:
+                flight.crash_dump(
+                    self.crash_dump_path, reason="stuck_audit",
+                    extra={"drift": {k: list(v) for k, v
+                                     in self.last_drift.items()},
+                           "unrepaired_streak":
+                               self._unrepaired_streak,
+                           "repairs": dict(self.repairs)})
+            except Exception:  # noqa: BLE001 — the dump is best-effort
+                pass
+
+    # -- background thread --------------------------------------------
+
+    def start(self) -> None:
+        """Run :meth:`audit_once` every ``interval_s`` on a daemon
+        thread (idempotent)."""
+        if self._thread is not None and self._thread.is_alive():
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._run, name="integrity-audit", daemon=True)
+        self._thread.start()
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.audit_once()
+            except Exception:  # noqa: BLE001 — a wedged audit must not
+                # kill the daemon; the next tick retries and the
+                # watchdog counters surface persistent failure.
+                pass
+
+    def stop(self, timeout: float | None = 10.0) -> None:
+        t = self._thread
+        if t is None:
+            return
+        self._stop.set()
+        t.join(timeout)
+        self._thread = None

@@ -155,6 +155,17 @@ class SchedulerLoop:
         self.binds_adopted = 0     # bound-elsewhere conflicts adopted
         self.binds_redirected = 0  # re-routed to the ledger's node
         self._relist_needed = False
+        # State integrity & self-healing (core/integrity.py): serve.py
+        # attaches the anti-entropy auditor under --audit-interval and
+        # the seeded fault injector under --state-chaos; /metrics and
+        # the chaos soak read the counters through these handles.
+        self.integrity = None
+        self.state_chaos = None
+        # One-shot span tag set by StateChaosInjector._record: the
+        # next committed cycle span carries the injected fault class,
+        # so a trace reader sees WHICH cycle first ran on corrupted
+        # state.
+        self._state_fault_pending: str | None = None
         # "fresh" | "restored" | "ignored": serve.py records its
         # checkpoint-restore decision here; /readyz reports it.
         self.checkpoint_state = "fresh"
@@ -539,7 +550,13 @@ class SchedulerLoop:
         bstate = (str(getattr(breaker, "state", "closed"))
                   if breaker is not None else "closed")
         degraded = self.degraded
-        fault = ("apiserver_brownout" if degraded
+        # Injected state faults outrank transport faults on the span:
+        # corrupted state is the rarer, more actionable signal, and the
+        # tag is one-shot (consumed by the first committed span).
+        state_fault = self._state_fault_pending
+        self._state_fault_pending = None
+        fault = (f"state_{state_fault}" if state_fault
+                 else "apiserver_brownout" if degraded
                  else "watch_gap" if self._relist_needed else None)
         # Cap the per-span uid list: a whole-workload bench drain can
         # retire tens of thousands of pods in one span, and the ring
@@ -1531,6 +1548,14 @@ class SchedulerLoop:
         for i, pod in enumerate(pods):
             idx = int(assignment[i])
             if idx < 0:
+                if self.encoder.committed_node(pod.uid) is not None:
+                    # Re-delivered pod whose usage is already in the
+                    # ledger (watch replay, resync, relist audit): it
+                    # is bound, not unschedulable — its OWN usage is
+                    # what the re-score tripped over.  Logging "" /
+                    # emitting FailedScheduling / parking it here
+                    # would contradict the ledger and the apiserver.
+                    continue
                 if self.decision_log is not None:
                     self.decision_log.append(pod.name, "")
                 if self.cfg.enable_preemption and \
@@ -1553,13 +1578,17 @@ class SchedulerLoop:
                             "(capacity 1024 exceeded); recovered by "
                             "the next resync"))
                 continue
-            name = table_names[idx]
-            if self.decision_log is not None:
-                self.decision_log.append(pod.name, name)
             bindable.append(pod)
             node_idxs.append(idx)
-            names.append(name)
+            names.append(table_names[idx])
         self._redirect_committed(bindable, node_idxs, names)
+        # Decision-log AFTER the redirect: for an already-committed pod
+        # the ledger's node is the decision that actually binds — the
+        # re-scored target would record a placement that never happens
+        # (tools/state_audit.py cross-checks exactly this agreement).
+        if self.decision_log is not None:
+            for pod, name in zip(bindable, names):
+                self.decision_log.append(pod.name, name)
         return bindable, node_idxs, names
 
     def _redirect_committed(self, bindable: list, node_idxs: list,

@@ -212,7 +212,8 @@ class ProbeOrchestrator:
 
     def __init__(self, encoder: Encoder, prober: Prober,
                  names: Sequence[str], planner=None, model=None,
-                 forget_s: float = 0.0) -> None:
+                 forget_s: float = 0.0,
+                 quarantine_streak: int = 3) -> None:
         self._encoder = encoder
         self._prober = prober
         self._names = list(names)
@@ -224,6 +225,22 @@ class ProbeOrchestrator:
         self.failures = 0
         self.successes = 0
         self.pruned_total = 0
+        # Ingest quarantine: a probe that RETURNS (no exception) but
+        # carries a value no sane link produces — NaN/Inf, negative
+        # latency, non-positive bandwidth — must not reach staging;
+        # update_link would either drop it silently or, worse, a NaN
+        # would poison the lat/bw planes and every score using them.
+        # Quarantined samples are counted per reason (/metrics:
+        # netaware_ingest_quarantined_total{reason=...}), the pair
+        # stays stale (same degradation as a probe failure), and a
+        # per-link CONSECUTIVE-quarantine streak past the threshold
+        # queues a LinkQuarantined event (drain_quarantine_events) so
+        # operators see the sick path, not just a counter.
+        self.quarantined = {"non_finite": 0, "negative_latency": 0,
+                            "non_positive_bandwidth": 0}
+        self._quarantine_streak = max(int(quarantine_streak), 1)
+        self._streaks: dict[tuple[int, int], int] = {}
+        self._quarantine_events: list[dict] = []
 
     def advance_clock(self, dt_s: float) -> None:
         self._clock += dt_s
@@ -275,6 +292,11 @@ class ProbeOrchestrator:
                           f"{exc!r} (further failures counted "
                           "silently)", file=sys.stderr)
                 continue
+            reason = self._validate(lat_ms, bw_bps)
+            if reason is not None:
+                self._quarantine(i, j, a, b, reason, lat_ms, bw_bps)
+                continue
+            self._streaks.pop((i, j), None)
             self._encoder.update_link(a, b, lat_ms=lat_ms, bw_bps=bw_bps)
             if self._model is not None:
                 ia = self._encoder.node_slot(a)
@@ -290,6 +312,45 @@ class ProbeOrchestrator:
                 # with no new direct probe on a given pair.
                 self._encoder.touch_net()
         return done
+
+    @staticmethod
+    def _validate(lat_ms: float | None,
+                  bw_bps: float | None) -> str | None:
+        """Range-check one probe result; returns the quarantine reason
+        or ``None`` when the sample is admissible.  A ``None`` quantity
+        is the Prober protocol's "no figure from this prober" (e.g.
+        iperf3 has no latency) — not a bad sample, so only the
+        quantities actually measured are validated."""
+        if lat_ms is not None:
+            if not np.isfinite(lat_ms):
+                return "non_finite"
+            if lat_ms < 0:
+                return "negative_latency"
+        if bw_bps is not None:
+            if not np.isfinite(bw_bps):
+                return "non_finite"
+            if bw_bps <= 0:
+                return "non_positive_bandwidth"
+        return None
+
+    def _quarantine(self, i: int, j: int, a: str, b: str,
+                    reason: str, lat_ms: float, bw_bps: float) -> None:
+        self.quarantined[reason] += 1
+        streak = self._streaks.get((i, j), 0) + 1
+        self._streaks[(i, j)] = streak
+        if streak == self._quarantine_streak:
+            # Exactly-at-threshold, not >=: one event per sick episode,
+            # re-armed when a good sample clears the streak.
+            self._quarantine_events.append({
+                "link": (a, b), "reason": reason, "streak": streak,
+                "lat_ms": None if lat_ms is None else float(lat_ms),
+                "bw_bps": None if bw_bps is None else float(bw_bps)})
+
+    def drain_quarantine_events(self) -> list[dict]:
+        """Pop the pending over-threshold quarantine streaks — serve.py
+        turns each into a ``LinkQuarantined`` k8s Event."""
+        out, self._quarantine_events = self._quarantine_events, []
+        return out
 
     def staleness(self) -> dict[str, float]:
         """Aggregate staleness stats — O(tracked pairs) time, O(1)

@@ -522,7 +522,8 @@ def run_chaos_soak(seed: int = 0, num_nodes: int = 32,
                    recovery_limit_s: float = 120.0,
                    pipelined: bool = True,
                    spacing_s: float = 6.0,
-                   base_duration_s: float = 2.0) -> dict:
+                   base_duration_s: float = 2.0,
+                   state_faults: bool = False) -> dict:
     """Drive a full SchedulerLoop through a seeded fault schedule on
     virtual time and return the ``chaos_soak`` benchmark document.
 
@@ -531,6 +532,15 @@ def run_chaos_soak(seed: int = 0, num_nodes: int = 32,
     cycling until the backlog drains and the breaker closes (or
     ``recovery_limit_s`` of virtual time elapses — reported, not
     raised, so the artifact shows the failure).
+
+    ``state_faults=True`` layers the r10 state-layer chaos on top of
+    the control-plane schedule: a seeded
+    :class:`~..core.state_chaos.StateChaosInjector` corrupts the
+    device planes mid-soak and an
+    :class:`~..core.integrity.IntegrityAuditor` (driven inline every
+    maintain interval, not on its own thread — virtual time) must
+    detect and repair each one; the counters land in
+    ``detail["integrity"]``.
     """
     from kubernetesnetawarescheduler_tpu.bench.fakecluster import (
         ClusterSpec,
@@ -561,6 +571,21 @@ def run_chaos_soak(seed: int = 0, num_nodes: int = 32,
                      peer_fraction=0.4, affinity_fraction=0.1,
                      anti_fraction=0.1),
         scheduler_name=cfg.scheduler_name)
+
+    auditor = injector = None
+    if state_faults:
+        from kubernetesnetawarescheduler_tpu.core.integrity import (
+            IntegrityAuditor,
+        )
+        from kubernetesnetawarescheduler_tpu.core.state_chaos import (
+            StateChaosInjector,
+        )
+
+        auditor = IntegrityAuditor(loop.encoder, loop)
+        injector = StateChaosInjector(loop.encoder, seed=seed + 4,
+                                      loop=loop)
+        loop.integrity = auditor
+        loop.state_chaos = injector
 
     horizon = schedule.end_s + 1.0
     # Wave arrivals: evenly spread over the horizon so each window
@@ -594,6 +619,12 @@ def run_chaos_soak(seed: int = 0, num_nodes: int = 32,
                 healthy_assumed += assumed
         if cycle % 16 == 15:
             loop.maintain()
+            if injector is not None and now < horizon:
+                # One state fault per maintain interval, audited
+                # inline right after — the soak proves repair keeps
+                # pace with injection under live traffic.
+                injector.inject_random()
+                auditor.audit_once()
         proxy.advance(cycle_s)
         cycle += 1
         now = proxy.clock()
@@ -661,5 +692,12 @@ def run_chaos_soak(seed: int = 0, num_nodes: int = 32,
             "dropped_watch_events": proxy.dropped_watch_events,
             "dropped_event_posts": proxy.dropped_event_posts,
             "blackholed_binds": proxy.blackholed_binds,
+            **({"integrity": {
+                "state_faults_injected": dict(injector.injected),
+                "audits": auditor.audits_total,
+                "drift_detected": auditor.drift_detected_total,
+                "repairs": dict(auditor.repairs),
+                "unrepaired": auditor.unrepaired_total,
+            }} if auditor is not None else {}),
         },
     }

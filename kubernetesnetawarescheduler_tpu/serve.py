@@ -141,6 +141,22 @@ def main(argv=None) -> int:
                          "written on SIGTERM/fault; defaults to "
                          "<checkpoint-dir>/flight_dump.json when "
                          "--checkpoint-dir is set, else disabled")
+    ap.add_argument("--audit-interval", type=float, default=None,
+                    help="anti-entropy audit period in seconds "
+                         "(core/integrity.py): a background thread "
+                         "digests the device planes against a shadow "
+                         "re-encode of the staging truth and walks "
+                         "the repair ladder on drift; overrides "
+                         "cfg.audit_interval_s; 0 disables")
+    ap.add_argument("--state-chaos", type=float, default=0.0,
+                    help="state-fault injection period in seconds "
+                         "(core/state_chaos.py): every period one "
+                         "seeded fault (dropped/duplicated/reordered "
+                         "delta, NaN poison, bit flip) is injected "
+                         "into the state layer — pair with "
+                         "--audit-interval to exercise the repair "
+                         "ladder; 0 disables (NEVER enable in "
+                         "production)")
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--once", action="store_true",
                     help="serve one readiness cycle then exit "
@@ -463,14 +479,33 @@ def main(argv=None) -> int:
         )
         from kubernetesnetawarescheduler_tpu.k8s.types import Event
 
-        orch = ProbeOrchestrator(loop.encoder, prober, names,
-                                 planner=planner, model=netmodel,
-                                 forget_s=cfg.probe_forget_s)
+        orch = ProbeOrchestrator(
+            loop.encoder, prober, names, planner=planner,
+            model=netmodel, forget_s=cfg.probe_forget_s,
+            quarantine_streak=cfg.quarantine_streak_events)
         loop.probe_orchestrator = orch
 
         def probe_forever() -> None:
             while not stop.is_set():
                 orch.run_cycle(budget=64)
+                for ev in orch.drain_quarantine_events():
+                    a, b = ev["link"]
+                    try:
+                        loop.client.create_event(Event(
+                            message=(
+                                f"link {a}<->{b} probe samples "
+                                f"quarantined {ev['streak']}x in a row "
+                                f"({ev['reason']}: lat={ev['lat_ms']} "
+                                f"ms, bw={ev['bw_bps']} bps)"),
+                            reason="LinkQuarantined",
+                            involved_pod="",
+                            namespace="default",
+                            component=cfg.scheduler_name,
+                            type="Warning"))
+                    except Exception:
+                        # Best-effort, like LinkDegraded below — the
+                        # refusals are already counted in /metrics.
+                        pass
                 if netmodel is not None:
                     for i, j, pred, meas, _t in \
                             netmodel.drain_degradations():
@@ -498,6 +533,56 @@ def main(argv=None) -> int:
         threads.append(threading.Thread(target=probe_forever, daemon=True,
                                         name="probe-orchestrator"))
 
+    # State integrity & self-healing (ISSUE 10): the anti-entropy
+    # auditor shadow-re-encodes truth from the staging arrays on its
+    # own thread and walks the repair ladder on digest drift; the
+    # chaos injector (opt-in, test/soak only) feeds it faults.
+    audit_interval = (args.audit_interval
+                      if args.audit_interval is not None
+                      else cfg.audit_interval_s)
+    auditor = None
+    if audit_interval > 0:
+        from kubernetesnetawarescheduler_tpu.core.integrity import (
+            IntegrityAuditor,
+        )
+
+        auditor = IntegrityAuditor(
+            loop.encoder, loop,
+            interval_s=audit_interval,
+            checkpoint_dir=args.checkpoint_dir or None,
+            watchdog_failures=cfg.audit_watchdog_failures,
+            crash_dump_path=(
+                os.path.join(args.checkpoint_dir,
+                             "integrity_dump.json")
+                if args.checkpoint_dir else "integrity_dump.json"))
+        loop.integrity = auditor
+        print(f"integrity auditor enabled: period {audit_interval}s",
+              file=sys.stderr)
+    if args.state_chaos > 0:
+        from kubernetesnetawarescheduler_tpu.core.state_chaos import (
+            StateChaosInjector,
+        )
+
+        injector = StateChaosInjector(
+            loop.encoder, seed=args.seed, loop=loop,
+            checkpoint_dir=args.checkpoint_dir or None)
+        loop.state_chaos = injector
+
+        def chaos_forever() -> None:
+            while not stop.wait(args.state_chaos):
+                try:
+                    injector.inject_random()
+                except Exception as exc:  # noqa: BLE001
+                    print(f"WARNING: state-chaos injection failed: "
+                          f"{exc}", file=sys.stderr)
+
+        threads.append(threading.Thread(target=chaos_forever,
+                                        daemon=True,
+                                        name="state-chaos"))
+        print(f"STATE CHAOS enabled: one fault per "
+              f"{args.state_chaos}s (seed {args.seed})",
+              file=sys.stderr)
+
     def shutdown(signum, frame):
         stop.set()
 
@@ -509,6 +594,8 @@ def main(argv=None) -> int:
 
     for t in threads:
         t.start()
+    if auditor is not None:
+        auditor.start()
 
     # Multi-process mesh: process 0 is the single controller; wrap its
     # assign dispatch with the broadcast protocol that keeps follower
@@ -561,6 +648,12 @@ def main(argv=None) -> int:
         dump_reason = "fault"
         raise
     finally:
+        stop.set()
+        if auditor is not None:
+            # Before the checkpoint save below: a mid-audit repair
+            # mutating staging while save_checkpoint deep-copies it
+            # would persist a half-repaired mirror.
+            auditor.stop()
         if profiling:
             import jax
 

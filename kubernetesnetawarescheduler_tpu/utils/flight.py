@@ -323,10 +323,14 @@ class FlightRecorder:
             return None
         return max(spans, key=lambda s: s.dur_s)
 
-    def crash_dump(self, path: str, reason: str = "shutdown") -> str:
+    def crash_dump(self, path: str, reason: str = "shutdown",
+                   extra: dict | None = None) -> str:
         """Write the recorder + retained explain records to ``path``
-        for post-mortem (SIGTERM / fault path in serve.py).  Returns
-        the path written.  Best-effort caller-side: exceptions
+        for post-mortem (SIGTERM / fault path in serve.py; the
+        integrity watchdog's stuck-audit dump).  ``extra`` rides along
+        verbatim — the watchdog attaches the drift localization so the
+        post-mortem names the corrupt rows, not just the cycle.
+        Returns the path written.  Best-effort caller-side: exceptions
         propagate so the caller can log-and-continue."""
         doc = {
             "reason": reason,
@@ -334,9 +338,18 @@ class FlightRecorder:
             "trace": self.to_chrome_trace(),
             "explains": self.explains(),
         }
+        if extra:
+            doc["extra"] = extra
+        import os
+
+        # serve.py defaults the dump into --checkpoint-dir, which on a
+        # first-run shutdown does not exist yet (save_checkpoint only
+        # creates it AFTER this post-mortem is written).
+        parent = os.path.dirname(path)
+        if parent:
+            os.makedirs(parent, exist_ok=True)
         tmp = path + ".tmp"
         with open(tmp, "w", encoding="utf-8") as fh:
             json.dump(doc, fh, indent=1, sort_keys=True)
-        import os
         os.replace(tmp, path)
         return path

@@ -188,6 +188,20 @@ def render_metrics(loop) -> str:
                 float(getattr(orch, "pruned_total", 0)),
                 "Per-pair probe bookkeeping entries pruned past the "
                 "forget horizon")
+        # Ingest quarantine: samples refused at the staging boundary,
+        # per reason — growth here means a sick probe agent is
+        # emitting garbage, not that links are bad.
+        quarantined = getattr(orch, "quarantined", None)
+        if quarantined:
+            lines.append("# HELP netaware_ingest_quarantined_total "
+                         "Probe samples refused at the staging "
+                         "boundary (range validation)")
+            lines.append("# TYPE netaware_ingest_quarantined_total "
+                         "counter")
+            for reason, n in sorted(quarantined.items()):
+                lines.append(
+                    "netaware_ingest_quarantined_total"
+                    f'{{reason="{reason}"}} {_fmt(float(n))}')
 
     # Decision-level tracing (utils/flight.py): the cycle sequence and
     # drop counter make recorder overflow VISIBLE — if dropped grows
@@ -206,6 +220,50 @@ def render_metrics(loop) -> str:
         gauge("netaware_explain_records", float(flight.explains_len()),
               "Placement explain records currently retained "
               "(enable_explain)")
+
+    # State integrity & self-healing (core/integrity.py): audit cadence
+    # and the repair ladder's per-rung spend.  unrepaired_total > 0 is
+    # a page — the ladder exhausted itself and placements may be
+    # computed from corrupt state (see docs/OPERATIONS.md "State drift
+    # & corruption").
+    auditor = getattr(loop, "integrity", None)
+    if auditor is not None:
+        counter("netaware_integrity_audits_total",
+                float(auditor.audits_total),
+                "Anti-entropy audit passes (digest compare of device "
+                "planes vs shadow re-encode)")
+        counter("netaware_integrity_drift_total",
+                float(auditor.drift_detected_total),
+                "Audits that detected device/staging digest drift")
+        counter("netaware_integrity_drift_rows_total",
+                float(auditor.drift_rows_total),
+                "Total drifted rows localized across all audits")
+        counter("netaware_integrity_unrepaired_total",
+                float(auditor.unrepaired_total),
+                "Audits whose drift survived the FULL repair ladder")
+        counter("netaware_integrity_watchdog_dumps_total",
+                float(auditor.watchdog_dumps),
+                "Flight-recorder crash dumps fired by the stuck-audit "
+                "watchdog")
+        gauge("netaware_integrity_last_audit_ms",
+              float(auditor.last_audit_ms),
+              "Wall time of the most recent audit pass")
+        lines.append("# HELP netaware_integrity_repairs_total Repairs "
+                     "applied, by escalation-ladder rung")
+        lines.append("# TYPE netaware_integrity_repairs_total counter")
+        for rung, n in sorted(auditor.repairs.items()):
+            lines.append("netaware_integrity_repairs_total"
+                         f'{{rung="{rung}"}} {_fmt(float(n))}')
+    chaos = getattr(loop, "state_chaos", None)
+    if chaos is not None:
+        lines.append("# HELP netaware_state_faults_injected_total "
+                     "State-layer faults injected by the chaos "
+                     "injector, by class")
+        lines.append("# TYPE netaware_state_faults_injected_total "
+                     "counter")
+        for kind, n in sorted(chaos.injected.items()):
+            lines.append("netaware_state_faults_injected_total"
+                         f'{{fault="{kind}"}} {_fmt(float(n))}')
 
     # Extender webhook micro-batcher (api/extender._ScoreBatcher):
     # dispatch count exposes the coalescing rate (requests served /

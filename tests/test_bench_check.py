@@ -406,3 +406,75 @@ def test_round_bound_p99_flagged_from_round9():
     old = _headline(detail={"trace_provenance": _trace_prov(),
                             "rounds_max": 19})
     assert bench_check.check_doc("BENCH_r08.json", old) == []
+
+
+def _integrity(**overrides):
+    """A healthy r10 integrity block (bench.py _persisted_integrity
+    shape)."""
+    block = {
+        "audit_enabled": True,
+        "overhead_fraction": 0.0007,
+        "audit_per_cycle_fraction": 0.66,
+        "audit_ms_p50": 3.3,
+        "audits": 22,
+        "clean_run_bit_identical": True,
+        "all_faults_detected": True,
+        "unrepaired_drift": 0,
+        "source": "suite_integrity",
+    }
+    block.update(overrides)
+    return block
+
+
+def _r10_doc(**detail_overrides):
+    detail = {"trace_provenance": _trace_prov(),
+              "winner_fusion": _winner_fusion(),
+              "rounds_max": 4,
+              "integrity": _integrity()}
+    detail.update(detail_overrides)
+    return _headline(detail=detail)
+
+
+def test_integrity_block_required_from_round10():
+    # r10+ headline claiming the p99 bar without the block: fails.
+    doc = _r9_doc()
+    fails = bench_check.check_doc("BENCH_r10.json", doc)
+    assert any("integrity" in f for f in fails), fails
+    # Same doc with the block: clean.
+    assert bench_check.check_doc("BENCH_r10.json", _r10_doc()) == []
+    # Committed r9 history predates the auditor: exempt.
+    assert bench_check.check_doc("BENCH_r09.json", doc) == []
+    # A doc not claiming the bar may omit the block even at r10+.
+    quiet = _r9_doc()
+    quiet["detail"]["score_p99_ms"] = 87.44
+    quiet["detail"]["north_star"]["p99_met"] = False
+    assert bench_check.check_doc("BENCH_r10.json", quiet) == []
+
+
+def test_integrity_shape_validated_when_present():
+    # A leg that ran without the auditor is no evidence at all.
+    fails = bench_check.check_doc("BENCH_r10.json", _r10_doc(
+        integrity=_integrity(audit_enabled=False)))
+    assert any("audit_enabled" in f for f in fails), fails
+    # Faults that survived the repair ladder taint the measured state.
+    fails = bench_check.check_doc("BENCH_r10.json", _r10_doc(
+        integrity=_integrity(unrepaired_drift=2)))
+    assert any("unrepaired_drift=2" in f for f in fails), fails
+    # A p99 claim whose audit costs more than the 5% budget.
+    fails = bench_check.check_doc("BENCH_r10.json", _r10_doc(
+        integrity=_integrity(overhead_fraction=0.09)))
+    assert any("0.09" in f for f in fails), fails
+    # An undetected fault class passed the audit unseen.
+    fails = bench_check.check_doc("BENCH_r10.json", _r10_doc(
+        integrity=_integrity(all_faults_detected=False)))
+    assert any("all_faults_detected" in f for f in fails), fails
+    # Missing accounting keys.
+    bad = _integrity()
+    del bad["overhead_fraction"]
+    fails = bench_check.check_doc("BENCH_r10.json", _r10_doc(
+        integrity=bad))
+    assert any("integrity missing" in f for f in fails), fails
+    # Validated even on a pre-r10 filename: carrying the block opts in.
+    fails = bench_check.check_doc("BENCH_r09.json", _r9_doc(
+        integrity=_integrity(unrepaired_drift=1)))
+    assert any("unrepaired_drift=1" in f for f in fails), fails

@@ -153,6 +153,13 @@ def test_restore_rebuilds_group_refcounts(tmp_path):
     meta["committed"] = {uid: entry[:5]
                          for uid, entry in meta["committed"].items()}
     json.dump(meta, open(meta_path, "w"))
+    # The hand-edit invalidates the r10 manifest digest; re-stamp it
+    # (the tooling path for legitimate in-place edits) so the restore
+    # does not refuse the directory as corrupt.
+    from kubernetesnetawarescheduler_tpu.core.checkpoint import (
+        update_manifest,
+    )
+    update_manifest(path)
     enc3 = load_checkpoint(path, cfg)
     assert (words_to_int(enc3._group_bits[0]) & gbit)
     enc3.release(p1)
@@ -263,3 +270,50 @@ def test_restored_commit_binds_at_recorded_node(tmp_path):
     # the restored commit instead of double-committing.
     assert set(enc2._committed) == {pod.uid}
     assert loop2.scheduled == 1
+
+
+def test_decision_log_agrees_with_ledger_on_redirect(tmp_path):
+    """tools/state_audit.py cross-checks decisions.jsonl against the
+    usage ledger; two planner behaviors keep them in agreement: a
+    redirected bind must log the LEDGER node (the placement that
+    actually binds, not the re-scored target), and a re-delivered
+    already-committed pod that re-scores infeasible is bound, not
+    unschedulable — no "" decision line, no FailedScheduling event,
+    no parking."""
+    pod = generate_workload(
+        WorkloadSpec(num_pods=4, seed=11, services=2),
+        scheduler_name=CFG.scheduler_name)[0]
+    probe_cluster, probe_loop = _warm_encoder(seed=5)
+    probe_cluster.add_pod(pod)
+    probe_loop.run_once()
+    scored = probe_cluster.bindings[-1].node_name
+
+    pod = generate_workload(
+        WorkloadSpec(num_pods=4, seed=11, services=2),
+        scheduler_name=CFG.scheduler_name)[0]
+    cluster, loop = _warm_encoder(seed=5)
+    other = next(n for n in loop.encoder.known_node_names()
+                 if n and n != scored)
+    loop.encoder.commit_many([pod], [loop.encoder.node_index(other)])
+    save_checkpoint(str(tmp_path / "ckpt"), loop.encoder)
+
+    enc2 = load_checkpoint(str(tmp_path / "ckpt"))
+    dec = str(tmp_path / "decisions.jsonl")
+    log = DecisionLog(dec)
+    loop2 = SchedulerLoop(cluster, CFG, encoder=enc2,
+                          decision_log=log)
+    cluster.add_pod(pod)
+    loop2.run_once()
+    assert loop2.binds_redirected == 1
+
+    # Infeasible re-score of the SAME committed pod: quiet no-op.
+    events: list = []
+    bindable, _, _ = loop2._plan_bind(
+        [pod], np.array([-1]), loop2.encoder.node_table()[0],
+        events, CFG.scheduler_name)
+    assert bindable == [] and events == []
+    assert loop2.unschedulable == 0
+
+    log.close()
+    entries = DecisionLog.load(dec)
+    assert [d.node for d in entries if d.pod == pod.name] == [other]

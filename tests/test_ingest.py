@@ -157,6 +157,90 @@ def test_probe_failures_counted_not_fatal():
     assert orch.failures == 3
 
 
+class ScriptedProber:
+    """Replays a fixed list of (lat_ms, bw_bps) samples — including the
+    invalid ones FakeProber can't produce — then repeats the last."""
+
+    def __init__(self, samples):
+        self._samples = list(samples)
+        self.calls = 0
+
+    def probe(self, a, b):
+        sample = self._samples[min(self.calls, len(self._samples) - 1)]
+        self.calls += 1
+        return sample
+
+
+def test_probe_quarantine_rejects_bad_samples_counts_by_reason():
+    """A probe that RETURNS garbage (NaN, negative latency, zero
+    bandwidth) must be quarantined: counted per reason, never written
+    into staging, and not counted as a success."""
+    names = ["a", "b"]
+    enc = make_encoder(names)
+    before = enc._lat.copy(), enc._bw.copy()
+    bad = [(float("nan"), 1e9), (-3.0, 1e9), (1.0, 0.0),
+           (1.0, float("inf"))]
+    for sample, reason in zip(bad, ("non_finite", "negative_latency",
+                                    "non_positive_bandwidth",
+                                    "non_finite")):
+        orch = ProbeOrchestrator(enc, ScriptedProber([sample]), names)
+        assert orch.run_cycle(budget=10) == 0
+        assert orch.quarantined[reason] >= 1
+        assert orch.successes == 0 and orch.failures == 0
+    np.testing.assert_array_equal(enc._lat, before[0])
+    np.testing.assert_array_equal(enc._bw, before[1])
+
+
+def test_probe_quarantine_streak_event_exactly_at_threshold():
+    """One LinkQuarantined event per sick episode: emitted exactly when
+    the consecutive streak hits the threshold, re-armed only after a
+    good sample clears it."""
+    names = ["a", "b"]
+    enc = make_encoder(names)
+    orch = ProbeOrchestrator(enc, ScriptedProber([(-1.0, 1e9)]), names,
+                             quarantine_streak=3)
+    orch.run_cycle(budget=1)
+    orch.run_cycle(budget=1)
+    assert orch.drain_quarantine_events() == []  # streak 2 < threshold
+    orch.run_cycle(budget=1)
+    events = orch.drain_quarantine_events()
+    assert len(events) == 1
+    assert events[0]["link"] == ("a", "b")
+    assert events[0]["reason"] == "negative_latency"
+    assert events[0]["streak"] == 3
+    orch.run_cycle(budget=1)  # streak 4: past threshold, no re-fire
+    assert orch.drain_quarantine_events() == []
+    assert orch.quarantined["negative_latency"] == 4
+
+    # A good sample clears the streak; the next sick episode re-fires.
+    good_then_bad = ScriptedProber([(1.0, 1e9)] + [(-1.0, 1e9)] * 3)
+    orch2 = ProbeOrchestrator(enc, good_then_bad, names,
+                              quarantine_streak=3)
+    orch2.run_cycle(budget=1)  # bad streak would have been reset here
+    for _ in range(3):
+        orch2.run_cycle(budget=1)
+    assert len(orch2.drain_quarantine_events()) == 1
+
+
+def test_probe_validate_allows_protocol_none():
+    """The Prober protocol's ``None`` means "no figure from this
+    prober" (iperf3 has no latency) — it must pass validation, not be
+    quarantined as non-finite."""
+    names = ["a", "b"]
+    enc = make_encoder(names)
+    orch = ProbeOrchestrator(enc, ScriptedProber([(None, 5e9)]), names)
+    assert orch.run_cycle(budget=1) == 1
+    assert orch.quarantined == {"non_finite": 0, "negative_latency": 0,
+                                "non_positive_bandwidth": 0}
+    # But a None alongside a measured-and-bad quantity still trips.
+    orch2 = ProbeOrchestrator(enc, ScriptedProber([(None, 0.0)]), names,
+                              quarantine_streak=1)
+    assert orch2.run_cycle(budget=1) == 0
+    assert orch2.quarantined["non_positive_bandwidth"] == 1
+    (event,) = orch2.drain_quarantine_events()
+    assert event["lat_ms"] is None and event["bw_bps"] == 0.0
+
+
 def test_unescape_backslash_then_n():
     """Sequential replaces would turn an escaped backslash + literal n
     into a newline; the single-pass unescape must not."""

@@ -1,0 +1,340 @@
+"""State integrity (core/integrity.py + core/state_chaos.py).
+
+The r10 invariants, each pinned here:
+
+* the jitted device digest kernel and its numpy mirror agree bit-
+  exactly on every ClusterState plane (and every plane IS registered);
+* every runtime state-fault class is detected within one audit and
+  repaired bit-identical to a clean re-encode;
+* the ladder escalates: staging-side poison is invisible to the
+  device-vs-staging compare, caught by the sanity check, and only the
+  checkpoint rung can repair it;
+* a clean run is bit-identical with the auditor on or off;
+* unrepairable drift fires the stuck-audit watchdog crash dump;
+* a torn/corrupted checkpoint is never loaded as garbage — restore
+  falls back to the previous good set or refuses.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from kubernetesnetawarescheduler_tpu.config import SchedulerConfig
+from kubernetesnetawarescheduler_tpu.core.checkpoint import (
+    load_checkpoint,
+    save_checkpoint,
+)
+from kubernetesnetawarescheduler_tpu.core.encode import Encoder
+from kubernetesnetawarescheduler_tpu.core.integrity import (
+    PLANE_NAMES,
+    PLANES,
+    IntegrityAuditor,
+    compare_row_digests,
+    device_row_digests,
+    host_plane_digest_vector,
+    host_row_digests,
+    plane_digest_vector,
+    staging_sanity,
+)
+from kubernetesnetawarescheduler_tpu.core.state import ClusterState
+from kubernetesnetawarescheduler_tpu.core.state_chaos import (
+    STATE_FAULT_CLASSES,
+    StateChaosInjector,
+    run_state_fault_matrix,
+)
+from kubernetesnetawarescheduler_tpu.bench.fakecluster import (
+    ClusterSpec,
+    WorkloadSpec,
+    build_fake_cluster,
+    feed_metrics,
+    generate_workload,
+)
+from kubernetesnetawarescheduler_tpu.core.loop import SchedulerLoop
+from kubernetesnetawarescheduler_tpu.k8s.types import Node
+
+
+def make_encoder(n: int = 12, seed: int = 0) -> Encoder:
+    enc = Encoder(SchedulerConfig(max_nodes=16, max_pods=8,
+                                  max_peers=2))
+    rng = np.random.default_rng(seed)
+    for i in range(n):
+        enc.upsert_node(Node(name=f"n{i}",
+                             capacity={"cpu": 8.0, "memory": 32.0},
+                             labels={"zone": f"z{i % 3}"}))
+        enc.update_metrics(f"n{i}", {
+            "cpu_util": float(rng.uniform(0, 1)),
+            "net_bw_bps": float(rng.uniform(1e9, 1e11))})
+    for i in range(n):
+        for j in range(i + 1, n):
+            enc.update_link(f"n{i}", f"n{j}",
+                            lat_ms=float(rng.uniform(0.1, 5.0)),
+                            bw_bps=float(rng.uniform(1e9, 1e10)))
+    return enc
+
+
+def make_loop(num_nodes=24, seed=3):
+    cfg = SchedulerConfig(max_nodes=32, max_pods=16, max_peers=4)
+    cluster, lat, bw = build_fake_cluster(
+        ClusterSpec(num_nodes=num_nodes, seed=seed))
+    loop = SchedulerLoop(cluster, cfg)
+    loop.encoder.set_network(lat, bw)
+    feed_metrics(cluster, loop.encoder, np.random.default_rng(0))
+    return cluster, loop
+
+
+# ---------------------------------------------------------------------------
+# Digest kernels.
+# ---------------------------------------------------------------------------
+
+
+def test_every_state_plane_is_registered():
+    """Adding a plane to ClusterState without registering it in
+    integrity.PLANES would silently exempt it from auditing."""
+    fields = set(ClusterState.__dataclass_fields__)
+    assert set(PLANE_NAMES) == fields
+
+
+def test_device_and_host_digests_agree_bit_exactly():
+    enc = make_encoder()
+    with enc._lock:
+        state, _ = enc.snapshot_versioned()
+        expected = enc.expected_device_arrays()
+    dev = {k: np.asarray(v)
+           for k, v in device_row_digests(state).items()}
+    host = host_row_digests(expected)
+    assert compare_row_digests(dev, host) == {}
+    # The scalar plane vector agrees too (the fused-step fingerprint).
+    assert np.array_equal(np.asarray(plane_digest_vector(state)),
+                          host_plane_digest_vector(expected))
+
+
+def test_digest_moves_on_any_single_bit():
+    """Odd positional weights make value->digest a bijection per
+    element: one flipped bit in any plane must move that row's
+    digest."""
+    enc = make_encoder()
+    with enc._lock:
+        state, _ = enc.snapshot_versioned()
+    base = {k: np.asarray(v)
+            for k, v in device_row_digests(state).items()}
+    rng = np.random.default_rng(7)
+    for plane, _group in PLANES[:6]:
+        arr = np.array(getattr(state, plane))
+        flat = arr.reshape(arr.shape[0], -1)
+        r = int(rng.integers(0, flat.shape[0]))
+        c = int(rng.integers(0, flat.shape[1]))
+        u = flat if flat.dtype == np.uint32 else flat.view(np.uint32)
+        u[r, c] ^= np.uint32(1 << int(rng.integers(0, 32)))
+        mutated = state.replace(**{plane: arr})
+        moved = np.asarray(device_row_digests(mutated)[plane])
+        assert moved[r] != base[plane][r], plane
+
+
+def test_staging_sanity_catches_nan_and_inf():
+    enc = make_encoder()
+    assert staging_sanity(enc.expected_device_arrays()) == {}
+    enc._metrics[3, 0] = np.nan
+    enc._lat[1, 2] = np.inf
+    bad = staging_sanity(enc.expected_device_arrays())
+    assert bad["metrics"] == [3]
+    assert bad["lat"] == [1]
+
+
+# ---------------------------------------------------------------------------
+# Fault matrix: detect within one audit, repair bit-identically.
+# ---------------------------------------------------------------------------
+
+
+def test_every_runtime_fault_detected_and_repaired():
+    enc = make_encoder()
+    auditor = IntegrityAuditor(enc)
+    assert auditor.audit_once()["clean"]
+    matrix = run_state_fault_matrix(enc, auditor, seed=11)
+    runtime = [k for k in STATE_FAULT_CLASSES
+               if k != "checkpoint_corrupt"]
+    assert sorted(matrix) == sorted(runtime)
+    for kind, result in matrix.items():
+        assert result["detected"] == 1, kind
+        assert result["repaired"] == 1, kind
+    # Device-side faults are row-localized: the cheapest rung heals.
+    assert auditor.repairs["repatch_rows"] >= 1
+    assert auditor.unrepaired_total == 0
+
+
+def test_delta_drop_survives_legitimate_flush():
+    """The dropped-delta model: staging moves with NO dirty marking,
+    so an ordinary snapshot between injection and audit must NOT heal
+    it (this is exactly what the cache-aliasing bug in _full_up used
+    to break on CPU)."""
+    enc = make_encoder()
+    auditor = IntegrityAuditor(enc)
+    auditor.audit_once()
+    injector = StateChaosInjector(enc, seed=5)
+    desc = injector.inject("delta_drop")
+    enc.snapshot()  # a legitimate flush with no pending dirt
+    out = auditor.audit_once()
+    assert not out["clean"]
+    # A successful repair clears the returned drift; the detection
+    # footprint is retained in last_drift.
+    assert desc["rows"][0] in auditor.last_drift.get("metrics", [])
+    assert out["repaired"]
+
+
+def test_injection_is_seed_deterministic():
+    d1 = StateChaosInjector(make_encoder(), seed=9).inject_random()
+    d2 = StateChaosInjector(make_encoder(), seed=9).inject_random()
+    assert d1 == d2
+
+
+# ---------------------------------------------------------------------------
+# Ladder escalation + watchdog.
+# ---------------------------------------------------------------------------
+
+
+def test_staging_poison_escalates_to_checkpoint_rung(tmp_path):
+    """NaN in STAGING is invisible to the device-vs-staging digest
+    compare (both sides agree on the poison) and un-repairable from
+    staging itself — only the checkpoint-restore rung heals it."""
+    enc = make_encoder()
+    ck = str(tmp_path / "ck")
+    save_checkpoint(ck, enc)
+    auditor = IntegrityAuditor(enc, checkpoint_dir=ck)
+    enc._metrics[2, 0] = np.nan
+    enc._mark_rows("metrics", 2)
+    out = auditor.audit_once()
+    assert not out["clean"]
+    assert auditor.last_drift["staging:metrics"] == [2]
+    assert out["repaired"]
+    assert out["rung"] == "checkpoint_restore"
+    assert np.isfinite(enc._metrics).all()
+    assert auditor.audit_once()["clean"]
+
+
+def test_unrepairable_drift_fires_watchdog_dump(tmp_path):
+    """No checkpoint to restore from and staging itself is poisoned:
+    the whole ladder fails, and after ``watchdog_failures`` audits the
+    flight recorder dumps for the post-mortem."""
+    cluster, loop = make_loop()
+    dump = str(tmp_path / "integrity_dump.json")
+    auditor = IntegrityAuditor(loop.encoder, loop,
+                               watchdog_failures=2,
+                               crash_dump_path=dump)
+    loop.encoder._metrics[1, 0] = np.nan
+    for _ in range(2):
+        out = auditor.audit_once()
+        assert not out["repaired"]
+    assert auditor.watchdog_dumps == 1
+    assert os.path.exists(dump)
+    doc = json.load(open(dump))
+    assert doc["reason"] == "stuck_audit"
+    assert "staging:metrics" in doc["extra"]["drift"]
+    # Escalation emitted k8s Events an operator can see.
+    assert any(e.reason == "StateIntegrity" for e in cluster.events)
+
+
+def test_audit_counters_accumulate():
+    enc = make_encoder()
+    auditor = IntegrityAuditor(enc)
+    auditor.audit_once()
+    StateChaosInjector(enc, seed=2).inject("bit_flip")
+    auditor.audit_once()
+    assert auditor.audits_total == 2
+    assert auditor.drift_detected_total == 1
+    assert auditor.drift_rows_total >= 1
+    assert sum(auditor.repairs.values()) == 1
+    assert auditor.last_audit_ms > 0.0
+
+
+# ---------------------------------------------------------------------------
+# Clean-run bit-identity: auditing must not change placements.
+# ---------------------------------------------------------------------------
+
+
+def test_clean_run_placements_bit_identical_with_auditor():
+    def drain(audited: bool):
+        cluster, loop = make_loop()
+        auditor = (IntegrityAuditor(loop.encoder, loop)
+                   if audited else None)
+        pods = generate_workload(WorkloadSpec(num_pods=48, seed=21))
+        for start in range(0, len(pods), 16):
+            cluster.add_pods(pods[start:start + 16])
+            loop.run_once()
+            if auditor is not None:
+                out = auditor.audit_once()
+                assert out["clean"]
+        loop.run_until_drained()
+        loop.flush_binds()
+        loop.stop_bind_worker()
+        return sorted((b.namespace, b.pod_name, b.node_name)
+                      for b in cluster.bindings)
+
+    assert drain(audited=False) == drain(audited=True)
+
+
+# ---------------------------------------------------------------------------
+# Checkpoint torture: corruption never loads as garbage.
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("frac", [0.0, 0.2, 0.6, 0.95])
+def test_truncated_checkpoint_refused_or_fell_back(tmp_path, frac):
+    enc = make_encoder()
+    ck = str(tmp_path / "ck")
+    save_checkpoint(ck, enc)
+    target = os.path.join(ck, "state.npz")
+    size = os.path.getsize(target)
+    with open(target, "r+b") as fh:
+        fh.truncate(int(size * frac))
+    with pytest.raises(ValueError):
+        load_checkpoint(ck)
+
+
+def test_corrupted_checkpoint_falls_back_to_previous(tmp_path, capsys):
+    enc = make_encoder()
+    ck = str(tmp_path / "ck")
+    save_checkpoint(ck, enc)
+    baseline = host_row_digests(
+        {"metrics": enc._metrics, "lat": enc._lat})
+    # A second save preserves the first as previous/; then tear main.
+    save_checkpoint(ck, enc)
+    with open(os.path.join(ck, "state.npz"), "r+b") as fh:
+        fh.seek(8)
+        fh.write(b"\x00" * 16)
+    enc2 = load_checkpoint(ck)
+    restored = host_row_digests(
+        {"metrics": enc2._metrics, "lat": enc2._lat})
+    assert compare_row_digests(restored, baseline) == {}
+    assert "falling back" in capsys.readouterr().err
+
+
+def test_deleted_meta_refused_without_previous(tmp_path):
+    enc = make_encoder()
+    ck = str(tmp_path / "ck")
+    save_checkpoint(ck, enc)
+    os.remove(os.path.join(ck, "meta.json"))
+    with pytest.raises(ValueError):
+        load_checkpoint(ck)
+
+
+def test_checkpoint_corrupt_injector_is_detected_at_restore(tmp_path):
+    """The checkpoint_corrupt fault class end-to-end: whatever the
+    seeded injector does to the files, restore never loads garbage —
+    it either refuses or restores a verified set."""
+    for seed in range(4):
+        enc = make_encoder()
+        ck = str(tmp_path / f"ck{seed}")
+        save_checkpoint(ck, enc)
+        injector = StateChaosInjector(enc, seed=seed,
+                                      checkpoint_dir=ck)
+        injector.inject("checkpoint_corrupt")
+        try:
+            enc2 = load_checkpoint(ck)
+        except ValueError:
+            continue  # refused: acceptable
+        restored = host_row_digests(
+            {"metrics": enc2._metrics, "lat": enc2._lat})
+        baseline = host_row_digests(
+            {"metrics": enc._metrics, "lat": enc._lat})
+        assert compare_row_digests(restored, baseline) == {}

@@ -1,0 +1,161 @@
+"""Offline state auditor (tools/state_audit.py).
+
+A freshly saved checkpoint must pass every check; each check must
+actually fire on the corruption it exists for — a flipped payload
+byte (manifest), persisted NaN metrics (staging sanity), and a
+decision log that contradicts the usage ledger (cross-check).  The
+refusal path (corrupt main, no previous/) must fail the audit rather
+than read garbage.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import json
+import os
+
+from kubernetesnetawarescheduler_tpu.config import SchedulerConfig
+from kubernetesnetawarescheduler_tpu.core.checkpoint import (
+    DecisionLog,
+    save_checkpoint,
+    update_manifest,
+)
+from kubernetesnetawarescheduler_tpu.core.encode import Encoder
+from kubernetesnetawarescheduler_tpu.k8s.types import Node, Pod
+
+_TOOL = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "tools", "state_audit.py")
+_spec = importlib.util.spec_from_file_location("state_audit", _TOOL)
+state_audit = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(state_audit)
+
+
+def _encoder(n: int = 4) -> Encoder:
+    enc = Encoder(SchedulerConfig(max_nodes=128, max_pods=8))
+    for i in range(n):
+        enc.upsert_node(Node(name=f"n{i}", capacity={"cpu": 8.0}))
+    return enc
+
+
+def _checkpoint(tmp_path, enc: Encoder | None = None) -> str:
+    path = str(tmp_path / "ck")
+    save_checkpoint(path, enc if enc is not None else _encoder())
+    return path
+
+
+def test_clean_checkpoint_passes_everything(tmp_path):
+    path = _checkpoint(tmp_path)
+    report = state_audit.run_audit(path)
+    assert report["ok"]
+    assert report["manifest"]["manifest"] == "ok"
+    assert report["manifest"]["resolved"] == "main"
+    assert report["staging"]["ok"]
+    assert report["roundtrip"]["ok"]
+    assert report["roundtrip"]["drift"] == {}
+
+
+def test_flipped_payload_byte_fails_manifest(tmp_path):
+    path = _checkpoint(tmp_path)
+    with open(os.path.join(path, "state.npz"), "r+b") as fh:
+        fh.seek(12)
+        b = fh.read(1)
+        fh.seek(12)
+        fh.write(bytes([b[0] ^ 0xFF]))
+    report = state_audit.run_audit(path)
+    assert not report["ok"]
+    assert report["manifest"]["manifest"] == "corrupt"
+    # First save: no previous/ good set, so restore refuses entirely
+    # and the remaining checks never read the corrupt payload.
+    assert report["manifest"]["resolved"] is None
+    assert "staging" not in report
+
+
+def test_corrupt_main_falls_back_to_previous(tmp_path):
+    enc = _encoder()
+    path = _checkpoint(tmp_path, enc)
+    save_checkpoint(path, enc)  # second save rotates previous/
+    with open(os.path.join(path, "meta.json"), "a",
+              encoding="utf-8") as fh:
+        fh.write(" ")
+    report = state_audit.run_audit(path)
+    assert report["manifest"]["manifest"] == "corrupt"
+    assert report["manifest"]["resolved"] == "previous"
+    # The checks downstream read the good previous/ set and pass.
+    assert report["staging"]["ok"]
+    assert report["roundtrip"]["ok"]
+
+
+def test_persisted_nan_fails_staging_sanity(tmp_path):
+    enc = _encoder()
+    enc._metrics[1, 0] = float("nan")
+    path = _checkpoint(tmp_path, enc)
+    report = state_audit.run_audit(path)
+    assert not report["ok"]
+    assert report["staging"]["non_finite_rows"] == {"metrics": [1]}
+    # The manifest is fine — the corruption predates the save.
+    assert report["manifest"]["ok"]
+
+
+def test_decision_log_agreement_and_mismatch(tmp_path):
+    enc = _encoder()
+    enc.commit(Pod(name="p0", requests={"cpu": 1.0}), "n0")
+    enc.commit(Pod(name="p1", requests={"cpu": 1.0}), "n1")
+    path = _checkpoint(tmp_path, enc)
+
+    dec = str(tmp_path / "decisions.jsonl")
+    log = DecisionLog(dec)
+    log.append("p0", "n2")  # stale first decision...
+    log.append("p0", "n0")  # ...superseded: last one wins
+    log.append("p1", "n1")
+    log.append("p9", "n3")  # logged but later deleted: not a failure
+    log.close()
+    report = state_audit.run_audit(path, decisions=dec)
+    assert report["ok"]
+    assert report["decisions"]["mismatches"] == []
+
+    log = DecisionLog(dec)
+    log.append("p1", "n0")  # contradicts the ledger's n1
+    log.close()
+    report = state_audit.run_audit(path, decisions=dec)
+    assert not report["ok"]
+    assert report["decisions"]["mismatches"] == [
+        {"pod": "p1", "ledger_node": "n1", "decision_node": "n0"}]
+
+
+def test_ledger_without_decision_reported_not_failed(tmp_path):
+    enc = _encoder()
+    enc.commit(Pod(name="p0", requests={"cpu": 1.0}), "n0")
+    path = _checkpoint(tmp_path, enc)
+    dec = str(tmp_path / "decisions.jsonl")
+    DecisionLog(dec).close()
+    report = state_audit.run_audit(path, decisions=dec)
+    assert report["ok"]
+    assert report["decisions"]["ledger_without_decision"] == ["p0"]
+
+
+def test_main_entrypoint_exit_codes(tmp_path, capsys):
+    path = _checkpoint(tmp_path)
+    assert state_audit.main([path]) == 0
+    out = capsys.readouterr().out
+    assert "audit: OK" in out
+
+    assert state_audit.main([path, "--json"]) == 0
+    report = json.loads(capsys.readouterr().out)
+    assert report["ok"]
+
+    with open(os.path.join(path, "state.npz"), "r+b") as fh:
+        fh.truncate(16)
+    assert state_audit.main([path]) == 1
+
+
+def test_update_manifest_restamps_legitimate_edit(tmp_path):
+    """The tooling path for in-place edits: after update_manifest the
+    audit passes again (this is what tests that hand-edit meta.json
+    rely on)."""
+    path = _checkpoint(tmp_path)
+    mpath = os.path.join(path, "meta.json")
+    meta = json.load(open(mpath))
+    json.dump(meta, open(mpath, "w"))  # re-serialize: bytes change
+    assert state_audit.run_audit(path)["ok"] is False
+    update_manifest(path)
+    assert state_audit.run_audit(path)["ok"] is True

@@ -436,6 +436,70 @@ def check_doc(path: str, doc: dict) -> list[str]:
                 f"{int(rounds_max)} > 8 — the claimed p99 is "
                 "round-bound; investigate the second-chance pass "
                 "before publishing this artifact")
+
+    # Rule 10 — state-integrity provenance (round 10+): a headline
+    # claiming the p99 bar must prove the number was measured with the
+    # anti-entropy auditor accounted for — an ``integrity`` block from
+    # the ``bench.py --suite integrity`` leg with the audit enabled,
+    # its overhead under 5% of serving capacity at the default audit
+    # cadence, and ZERO unrepaired drift
+    # across the injected fault matrix.  A p99 published from a run
+    # that skipped auditing (or whose repair ladder failed) is a
+    # number measured on state nobody verified; round-gated by
+    # filename like Rules 8/9 so committed earlier-round artifacts
+    # stay clean, but the block's shape is validated wherever it
+    # appears.
+    if not grandfathered:
+        ns = detail.get("north_star")
+        p99_met = isinstance(ns, dict) and bool(ns.get("p99_met"))
+        integ = detail.get("integrity")
+        rnd = _round_of(name)
+        if integ is None:
+            if p99_met and rnd is not None and rnd >= 10:
+                fails.append(
+                    f"{name}: north_star.p99_met without an integrity "
+                    "block (round 10+ requires the --suite integrity "
+                    "leg's audit-overhead + fault-matrix evidence "
+                    "behind any claimed p99)")
+        elif not isinstance(integ, dict):
+            fails.append(f"{name}: integrity is not an object")
+        else:
+            required = {"audit_enabled", "overhead_fraction",
+                        "unrepaired_drift"}
+            missing = required - set(integ)
+            if missing:
+                fails.append(f"{name}: integrity missing "
+                             f"{sorted(missing)}")
+            else:
+                try:
+                    overhead = float(integ["overhead_fraction"])
+                    unrepaired = int(integ["unrepaired_drift"])
+                except (TypeError, ValueError):
+                    fails.append(f"{name}: integrity not numeric")
+                else:
+                    if not integ.get("audit_enabled"):
+                        fails.append(
+                            f"{name}: integrity.audit_enabled is "
+                            "false — the leg ran without the auditor, "
+                            "which is no evidence at all")
+                    if unrepaired != 0:
+                        fails.append(
+                            f"{name}: integrity.unrepaired_drift="
+                            f"{unrepaired} — injected faults survived "
+                            "the repair ladder; the measured state "
+                            "cannot be trusted")
+                    if p99_met and overhead >= 0.05:
+                        fails.append(
+                            f"{name}: north_star.p99_met with "
+                            f"integrity.overhead_fraction={overhead} "
+                            ">= 0.05 — the audit costs more than the "
+                            "5% budget, so the claimed p99 excludes a "
+                            "real production overhead")
+                if integ.get("all_faults_detected") is False:
+                    fails.append(
+                        f"{name}: integrity.all_faults_detected is "
+                        "false — at least one injected fault class "
+                        "passed the audit unseen")
     return fails
 
 

@@ -1,0 +1,216 @@
+"""Offline state auditor: is this checkpoint trustworthy, before a
+daemon bets its restore on it?
+
+The runtime anti-entropy auditor (core/integrity.py) compares the
+LIVE device planes against staging; this tool is its offline twin for
+the at-rest artifacts — runnable from cron, a debug shell, or CI
+against any checkpoint directory, with no accelerator and no running
+scheduler.  Four independent checks:
+
+* **manifest** — the r10 per-file SHA-256 digests verify (or the
+  directory predates manifests), and where the main set fails, whether
+  the preserved ``previous/`` good set would be restored instead
+  (exactly :func:`~core.checkpoint.resolve_checkpoint_dir`'s logic,
+  reported instead of silently applied).
+* **staging sanity** — no non-finite values in the persisted plane
+  arrays where that is corruption (``integrity.staging_sanity``): a
+  checkpoint carrying NaN metrics restores NaN metrics.
+* **digest round-trip** — :func:`~core.checkpoint.load_checkpoint`
+  rebuilds an Encoder and its staging planes must digest bit-identical
+  to the raw ``state.npz`` arrays (``host_plane_digest_vector``): the
+  restore path is lossless, not just non-crashing.
+* **decision cross-check** (``--decisions``) — the append-only
+  ``decisions.jsonl`` log agrees with the checkpoint's usage ledger:
+  every committed pod's node matches its LAST logged decision.  A
+  mismatch means the ledger and the decision record diverged — the
+  state-drift analog at the commit layer.
+
+Exit 0 when every requested check passes, 1 otherwise; ``--json``
+emits the full report for machines.  Exercised by tier-1 via
+tests/test_state_audit.py.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:  # runnable as `python tools/state_audit.py`
+    sys.path.insert(0, _REPO)
+
+
+def audit_manifest(path: str) -> dict:
+    """Manifest status of ``path`` plus the restore resolution:
+    which directory a restore would actually read, if any."""
+    from kubernetesnetawarescheduler_tpu.core.checkpoint import (
+        PREVIOUS_DIR,
+        resolve_checkpoint_dir,
+        verify_manifest,
+    )
+
+    errors = verify_manifest(path)
+    out = {
+        "manifest": ("absent_pre_r10" if errors is None
+                     else "ok" if not errors else "corrupt"),
+        "errors": errors or [],
+        "previous_errors": None,
+        "resolved": None,
+        "ok": errors is None or errors == [],
+    }
+    prev = os.path.join(path, PREVIOUS_DIR)
+    if os.path.isdir(prev):
+        out["previous_errors"] = verify_manifest(prev)
+    try:
+        resolved = resolve_checkpoint_dir(path)
+        out["resolved"] = ("main" if os.path.samefile(resolved, path)
+                           else "previous")
+    except ValueError as exc:
+        out["resolved"] = None
+        out["errors"] = out["errors"] or [str(exc)]
+    return out
+
+
+def audit_staging(path: str) -> dict:
+    """Non-finite corruption scan of the persisted plane arrays (reads
+    the resolved good set — same fallback a restore would take)."""
+    from kubernetesnetawarescheduler_tpu.core.checkpoint import (
+        read_state_arrays,
+    )
+    from kubernetesnetawarescheduler_tpu.core.integrity import (
+        host_plane_digest_vector,
+        staging_sanity,
+    )
+
+    arrays = read_state_arrays(path)
+    bad = staging_sanity(arrays)
+    return {
+        "ok": not bad,
+        "non_finite_rows": {k: v for k, v in bad.items()},
+        "digest_vector": [int(d)
+                          for d in host_plane_digest_vector(arrays)],
+    }
+
+
+def audit_roundtrip(path: str) -> dict:
+    """Restore-path losslessness: load_checkpoint's rebuilt staging
+    planes digest bit-identical to the raw state.npz arrays."""
+    from kubernetesnetawarescheduler_tpu.core.checkpoint import (
+        _STATE_ARRAYS,
+        load_checkpoint,
+        read_state_arrays,
+    )
+    from kubernetesnetawarescheduler_tpu.core.integrity import (
+        PLANE_NAMES,
+        compare_row_digests,
+        host_row_digests,
+    )
+
+    stored = read_state_arrays(path)
+    enc = load_checkpoint(path)
+    restored = {name.lstrip("_"): getattr(enc, name)
+                for name in _STATE_ARRAYS}
+    drift = compare_row_digests(host_row_digests(restored),
+                                host_row_digests(stored))
+    return {"ok": not drift,
+            "planes": len(PLANE_NAMES),
+            "drift": drift}
+
+
+def audit_decisions(path: str, decisions_path: str) -> dict:
+    """Ledger-vs-log agreement: each committed pod's node must equal
+    its LAST decision (re-decisions after preemption make earlier
+    lines stale by design).  Committed pods with no logged decision
+    are reported but not failed — a ledger restored from an apiserver
+    listing legitimately predates the local log."""
+    from kubernetesnetawarescheduler_tpu.core.checkpoint import (
+        DecisionLog,
+        resolve_checkpoint_dir,
+    )
+
+    base = resolve_checkpoint_dir(path)
+    with open(os.path.join(base, "meta.json"), encoding="utf-8") as fh:
+        meta = json.load(fh)
+    # committed: uid -> [node_idx, req, priority, namespace, name, ...]
+    # — the ledger stores the encoder ROW; decisions log node NAMES.
+    names = meta["node_names"]
+    ledger = {rec[4]: names[rec[0]]
+              for rec in meta["committed"].values()}
+    log = DecisionLog.load(decisions_path)
+    last: dict[str, str] = {}
+    for d in log:
+        last[d.pod] = d.node
+    mismatches = [
+        {"pod": pod, "ledger_node": node,
+         "decision_node": last[pod]}
+        for pod, node in sorted(ledger.items())
+        if pod in last and last[pod] != node]
+    return {
+        "ok": not mismatches,
+        "committed": len(ledger),
+        "decisions": len(log),
+        "mismatches": mismatches,
+        "ledger_without_decision": sorted(
+            pod for pod in ledger if pod not in last),
+    }
+
+
+def run_audit(path: str, decisions: str | None = None) -> dict:
+    """Every check that applies to ``path``; ``report["ok"]`` is the
+    conjunction."""
+    report: dict = {"checkpoint": path,
+                    "manifest": audit_manifest(path)}
+    # Past a refused checkpoint there is nothing safe to read — the
+    # remaining checks would just re-raise resolve's ValueError.
+    if report["manifest"]["resolved"] is not None:
+        report["staging"] = audit_staging(path)
+        report["roundtrip"] = audit_roundtrip(path)
+        if decisions is not None:
+            report["decisions"] = audit_decisions(path, decisions)
+    report["ok"] = all(
+        section.get("ok", False)
+        for key, section in report.items()
+        if isinstance(section, dict)) and (
+            report["manifest"]["resolved"] is not None)
+    return report
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("checkpoint", help="checkpoint directory to audit")
+    ap.add_argument("--decisions", default=None,
+                    help="decisions.jsonl to cross-check against the "
+                         "checkpoint's usage ledger")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the full report as JSON")
+    args = ap.parse_args(argv)
+
+    report = run_audit(args.checkpoint, args.decisions)
+    if args.json:
+        print(json.dumps(report, indent=2))
+    else:
+        for key in ("manifest", "staging", "roundtrip", "decisions"):
+            section = report.get(key)
+            if section is None:
+                continue
+            status = "OK" if section.get("ok") else "FAIL"
+            print(f"{key:10s} {status}")
+            if key == "manifest" and section["resolved"] is not None:
+                print(f"{'':10s} restore reads: {section['resolved']}")
+            for err in section.get("errors", []):
+                print(f"{'':10s} - {err}")
+            for plane, rows in section.get(
+                    "non_finite_rows", {}).items():
+                print(f"{'':10s} - non-finite {plane} rows {rows}")
+            for m in section.get("mismatches", []):
+                print(f"{'':10s} - {m['pod']}: ledger says "
+                      f"{m['ledger_node']!r}, last decision "
+                      f"{m['decision_node']!r}")
+        print("audit:", "OK" if report["ok"] else "FAIL")
+    return 0 if report["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
