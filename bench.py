@@ -208,6 +208,33 @@ def _persisted_integrity() -> dict | None:
         return None
 
 
+def _persisted_quality() -> dict | None:
+    """The ``--suite quality`` leg's artifact
+    (bench_artifacts/quality.json), compressed to the block r11+
+    density artifacts must carry when claiming the p99 bar
+    (tools/bench_check Rule 11): observation enabled, measured
+    serving overhead with the quality observer riding every commit,
+    and a live calibration sample count.  None when the leg has not
+    run in this tree."""
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "bench_artifacts", "quality.json")
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+        d = doc["detail"]
+        return {
+            "observation_enabled": bool(d["observation_enabled"]),
+            "overhead_fraction": float(d["overhead_fraction"]),
+            "calibration_samples": int(d["calibration_samples"]),
+            "bit_identical": bool(d.get("bit_identical", False)),
+            "regret_p99": float(d.get("regret_p99", 0.0)),
+            "harvest_ms_p50": float(d.get("harvest_ms_p50", 0.0)),
+            "source": "suite_quality",
+        }
+    except (OSError, ValueError, KeyError, TypeError):
+        return None
+
+
 def _mark_driver_active():
     """Touch driver.intent and take chip.lock so the round-long
     watcher yields the single-owner chip to this run (it re-checks the
@@ -439,6 +466,14 @@ def _assemble_doc(res, *, num_nodes: int, batch: int, method: str,
         # anti-entropy auditor's overhead accounted for and the fault
         # matrix fully repaired (--suite integrity leg).
         detail["integrity"] = integ
+    qual = _persisted_quality()
+    if qual is not None:
+        # Outcome-observability provenance (r11, bench_check Rule 11):
+        # the p99 claim only counts if it was measured with the
+        # quality observer's commit-seam cost accounted for and the
+        # join actually producing calibration samples (--suite
+        # quality leg).
+        detail["quality"] = qual
     if device_lat is not None:
         detail.update({
             "score_p50_ms": device_lat["p50_ms"],
@@ -681,6 +716,28 @@ def _run_suite_bench(name: str) -> None:
                        "of serving at the default audit cadence")
         if bad:
             print("WARNING: integrity bars unmet: " + "; ".join(bad),
+                  file=sys.stderr)
+            sys.exit(1)
+    if name == "quality":
+        detail = res.metrics.get("detail", {})
+        # Every bar holds at every shape: bit-identity and nonzero
+        # calibration are structural; the overhead fraction is a p50
+        # ratio, which smoke-run cycle sizes do not bias.
+        bad = []
+        if not detail.get("bit_identical"):
+            bad.append("observation CHANGED placements")
+        if not detail.get("overhead_under_2pct"):
+            bad.append("observation overhead "
+                       f"{detail.get('overhead_fraction')} >= 2% "
+                       "of serving cycle p50")
+        if detail.get("calibration_samples", 0) <= 0:
+            bad.append("zero calibration samples (the join ran "
+                       "blind)")
+        if not detail.get("drift_detected"):
+            bad.append("injected network drift did not move the "
+                       "calibration residuals")
+        if bad:
+            print("WARNING: quality bars unmet: " + "; ".join(bad),
                   file=sys.stderr)
             sys.exit(1)
 

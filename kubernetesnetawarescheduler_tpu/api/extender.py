@@ -586,6 +586,19 @@ class ExtenderHandlers:
                                  if flight is not None else 0),
                 })
             return self._json(rec)
+        if path == "/debug/slo":
+            # The SLO engine's full burn-rate state plus the quality
+            # observer's outcome stats — the first stop of the
+            # "Responding to an SLO burn" runbook (docs/OPERATIONS.md)
+            # and the live counterpart of tools/slo_report.py.
+            slo = getattr(self._loop, "slo", None)
+            quality = getattr(self._loop, "quality", None)
+            return self._json({
+                "slo": (slo.snapshot() if slo is not None
+                        else {"enabled": False}),
+                "quality": (quality.summary() if quality is not None
+                            else {"enabled": False}),
+            })
         raise ValueError(f"unknown op {path!r}")
 
     def readyz(self) -> dict:
@@ -599,10 +612,23 @@ class ExtenderHandlers:
         loop = self._loop
         breaker = getattr(loop, "breaker", None)
         state = breaker.state if breaker is not None else "closed"
+        # A burning SLO degrades readiness the same way an open
+        # breaker does: ``ready`` stays true (the scorer still
+        # serves), ``degraded`` flips so probes ALERT instead of
+        # evicting the warm ledger, and the burning objectives are
+        # named so the on-call lands on /debug/slo next.
+        slo = getattr(loop, "slo", None)
+        burning: tuple = ()
+        if slo is not None:
+            try:
+                burning = slo.burning()
+            except Exception:  # noqa: BLE001 — readiness never 500s
+                burning = ()
         return {
             "ready": True,
-            "degraded": state == "open",
+            "degraded": state == "open" or bool(burning),
             "breaker": state,
+            "slo_burning": list(burning),
             "checkpoint": getattr(loop, "checkpoint_state", "fresh"),
             "parked_binds": len(getattr(loop, "_parked_binds", ())),
             "watch_gaps": int(getattr(loop, "watch_gaps", 0)),

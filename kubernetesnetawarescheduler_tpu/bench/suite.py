@@ -1746,6 +1746,198 @@ def run_integrity_config(out_dir: str | None = None,
     return SuiteResult("integrity", doc, artifacts)
 
 
+def run_quality_config(out_dir: str | None = None,
+                       num_nodes: int = 512,
+                       num_pods: int = 512, batch: int = 32,
+                       seed: int = 0,
+                       drift_sigma: float = 0.3) -> SuiteResult:
+    """Outcome-observability leg (ISSUE 11): what does watching
+    placement quality cost, and what does it measure?
+
+    Three proofs in one artifact:
+
+    - **overhead** — the same workload drains twice from identical
+      seeds, observation off then on (``note_commit`` riding every
+      commit, one ``harvest`` per wave); ``overhead_fraction`` is the
+      serving-cycle p50 inflation, bar < 2%.  Harvest cost (a
+      maintain-cadence job, not a serving stage) is reported
+      separately, like the integrity leg's audit_ms.
+    - **bit-identity** — both drains must produce byte-for-byte the
+      same pod->node bindings: ``note_commit`` only READS state and
+      ``harvest`` runs off the hot path, so observation must not move
+      a single placement.
+    - **calibration under drift** — a third drained wave commits
+      against the pre-drift matrices, then the staging network is
+      perturbed (symmetric lognormal noise, ``drift_sigma``) before
+      its harvest: the regret and bw-residual distributions must WAKE
+      UP (nonzero), proving the join measures prediction error rather
+      than echoing the inputs.
+    """
+    from kubernetesnetawarescheduler_tpu.bench.envinfo import bench_env
+    from kubernetesnetawarescheduler_tpu.obs.quality import (
+        QualityObserver,
+        _Pending,
+        _round_pow2,
+    )
+
+    def _drain_timed(loop, pods, observer=None, harvest_ms=None):
+        # Batch-sized arrival waves (same shape as the integrity leg);
+        # one harvest per wave keeps the pending set wave-sized and
+        # samples the maintain-cadence cost densely.
+        cycle_ms = []
+
+        def _tick():
+            t0 = time.perf_counter()
+            loop.run_once()
+            cycle_ms.append((time.perf_counter() - t0) * 1e3)
+            if observer is not None:
+                t1 = time.perf_counter()
+                observer.harvest(loop.encoder)
+                harvest_ms.append((time.perf_counter() - t1) * 1e3)
+
+        for start in range(0, len(pods), batch):
+            loop.client.add_pods(pods[start:start + batch])
+            _tick()
+        while len(loop.queue) or loop._pipe_inflight is not None:
+            _tick()
+        loop.flush_binds()
+        loop.stop_bind_worker()
+        return cycle_ms
+
+    _warm_like(num_nodes, seed, BW_LAT, batch=batch, queue=num_pods)
+
+    def _workload(cfg):
+        return generate_workload(
+            WorkloadSpec(num_pods=num_pods, seed=seed + 5,
+                         services=8, peer_fraction=0.3),
+            scheduler_name=cfg.scheduler_name)
+
+    def _placements(loop):
+        return sorted((b.namespace, b.pod_name, b.node_name)
+                      for b in loop.client.bindings)
+
+    # Leg A: observation off.
+    loop_a, cfg_a = _make_loop(num_nodes, seed, BW_LAT, batch=batch,
+                               queue=num_pods)
+    cycles_a = _drain_timed(loop_a, _workload(cfg_a))
+    bindings_a = _placements(loop_a)
+
+    # Leg B: identical seeds, observer attached DIRECTLY (same cfg
+    # object as leg A's shape — flipping enable_quality_obs in cfg
+    # would change the jit static arg and bill a recompile as
+    # observation overhead).
+    loop_b, cfg_b = _make_loop(num_nodes, seed, BW_LAT, batch=batch,
+                               queue=num_pods)
+    observer = QualityObserver(cfg_b)
+    loop_b.quality = observer
+    # Warm the evaluator for every pow2 pad the waves can produce —
+    # synthetic pendings through the module-level jit cache, outside
+    # the measured window.
+    warm = QualityObserver(cfg_b)
+    size = 8
+    while True:
+        for i in range(size):
+            uid = f"warm-{size}-{i}"
+            warm._pending[uid] = _Pending(
+                uid=uid, node="warm", node_idx=0, cycle_id=0,
+                t_commit=0.0, peer_idx=(0,), peer_traffic=(1.0,),
+                pred_lat_ms=(0.1,), pred_bw_bps=(1e9,),
+                score_pred=None)
+        warm.harvest(loop_b.encoder)
+        if size >= _round_pow2(batch):
+            break
+        size *= 2
+    harvest_ms: list[float] = []
+    cycles_b = _drain_timed(loop_b, _workload(cfg_b),
+                            observer=observer, harvest_ms=harvest_ms)
+    bindings_b = _placements(loop_b)
+    bit_identical = bindings_a == bindings_b
+    clean = observer.summary()
+
+    p50_a = float(np.percentile(cycles_a, 50)) if cycles_a else 0.0
+    p50_b = float(np.percentile(cycles_b, 50)) if cycles_b else 0.0
+    overhead = max(0.0, p50_b / p50_a - 1.0) if p50_a else 0.0
+    p50_harvest = float(np.median(harvest_ms)) if harvest_ms else 0.0
+
+    # Leg C (drift): commit a fresh wave against today's matrices,
+    # then perturb the staging network BEFORE its harvest — the
+    # prediction/observation gap the join exists to measure.
+    drift_pods = generate_workload(
+        WorkloadSpec(num_pods=min(num_pods, 256), seed=seed + 11,
+                     services=8, peer_fraction=0.6),
+        scheduler_name=cfg_b.scheduler_name)
+    loop_b.client.add_pods(drift_pods)
+    loop_b.run_until_drained()
+    enc = loop_b.encoder
+    with enc._lock:
+        lat0 = np.array(enc._lat, dtype=np.float64)
+        bw0 = np.array(enc._bw, dtype=np.float64)
+    rng = np.random.default_rng(seed + 12)
+    noise = rng.lognormal(mean=0.0, sigma=drift_sigma,
+                          size=lat0.shape)
+    noise = (noise + noise.T) / 2.0     # links drift symmetrically
+    enc.set_network(lat0 * noise, bw0 / noise)
+    observer.harvest(enc)
+    drifted = observer.summary()
+
+    regret_p99 = float(drifted["regret_p99"])
+    cal_samples = int(drifted["calibration_samples"])
+    doc = {
+        "metric": "placement_quality",
+        "value": round(float(overhead), 6),
+        "unit": "observation_overhead_fraction_of_cycle_p50",
+        "seed": seed,
+        "detail": {
+            "num_nodes": num_nodes,
+            "num_pods": num_pods,
+            "batch": batch,
+            "observation_enabled": True,
+            "cycle_ms_p50_off": p50_a,
+            "cycle_ms_p50_on": p50_b,
+            "overhead_fraction": float(overhead),
+            "overhead_under_2pct": bool(overhead < 0.02),
+            "bit_identical": bool(bit_identical),
+            "bindings": len(bindings_b),
+            "harvest_ms_p50": p50_harvest,
+            "harvest_ms_p99": (float(np.percentile(harvest_ms, 99))
+                               if harvest_ms else 0.0),
+            "harvests": len(harvest_ms),
+            "commits_noted": int(drifted["noted_total"]),
+            "no_peer_skipped": int(drifted["no_peer_total"]),
+            "outcomes": int(drifted["harvested_total"]),
+            "calibration_samples": cal_samples,
+            # Clean-leg distributions: commits harvested against the
+            # SAME matrices they were scored on — regret here is the
+            # placement's real suboptimality (conflict fallbacks,
+            # capacity), not prediction error.
+            "regret_p50_clean": float(clean["regret_p50"]),
+            "regret_p99_clean": float(clean["regret_p99"]),
+            "bw_residual_p99_clean":
+                float(clean["bw_residual_log1p_p99"]),
+            # Post-drift distributions: the join must WAKE UP.
+            "drift_sigma": float(drift_sigma),
+            "regret_p50": float(drifted["regret_p50"]),
+            "regret_p99": regret_p99,
+            "bw_residual_p50":
+                float(drifted["bw_residual_log1p_p50"]),
+            "bw_residual_p99":
+                float(drifted["bw_residual_log1p_p99"]),
+            "drift_detected": bool(
+                drifted["bw_residual_log1p_p99"]
+                > clean["bw_residual_log1p_p99"]),
+            "ring_depth": int(drifted["ring_depth"]),
+            "bench_env": bench_env(),
+        },
+    }
+    artifacts = []
+    if out_dir:
+        path = os.path.join(out_dir, "quality.json")
+        with open(path, "w", encoding="utf-8") as fh:
+            json.dump(doc, fh, indent=2)
+        artifacts.append(path)
+    return SuiteResult("quality", doc, artifacts)
+
+
 CONFIGS: dict[str, Callable[..., SuiteResult]] = {
     "density": run_density_config,
     "custom_network": run_custom_network_config,
@@ -1758,6 +1950,7 @@ CONFIGS: dict[str, Callable[..., SuiteResult]] = {
     "gang": run_gang_config,
     "topology": run_topology_config,
     "integrity": run_integrity_config,
+    "quality": run_quality_config,
 }
 
 # Reduced shapes for smoke runs / CPU CI.
@@ -1776,6 +1969,7 @@ SMALL = {
     "topology": dict(num_nodes=128, cycles=40, probe_budget=32,
                      num_gangs=4),
     "integrity": dict(num_nodes=64, num_pods=96, batch=32),
+    "quality": dict(num_nodes=64, num_pods=96, batch=32),
 }
 
 

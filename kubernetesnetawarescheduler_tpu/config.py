@@ -416,6 +416,32 @@ class SchedulerConfig:
     # raised so operators see the sick path, not just a counter.
     quarantine_streak_events: int = 3
 
+    # Outcome observability (obs/quality.py): join each bound pod's
+    # score-time network prediction against subsequently observed
+    # probe truth at the maintain cadence — realized bw/lat, regret
+    # vs best alternative, calibration residuals.  Observation-only:
+    # placements are bit-identical on or off (tests/test_quality.py).
+    enable_quality_obs: bool = False
+    quality_ring_size: int = 2048
+    quality_harvest_interval_s: float = 5.0
+
+    # SLO burn-rate engine (obs/slo.py): declarative objectives
+    # evaluated over multi-window burn rates; <= 0 disables an
+    # objective.  Targets default to the north-star bars (score p99
+    # 5 ms; bind tail from BENCH_r05's measured envelope).  The error
+    # budget is the tolerated breach fraction per window; an
+    # objective burns when BOTH windows spend budget faster than
+    # slo_burn_threshold.
+    enable_slo: bool = False
+    slo_score_p99_ms: float = 5.0
+    slo_bind_p99_ms: float = 1000.0
+    slo_regret_ceiling: float = 0.5
+    slo_error_budget: float = 0.01
+    slo_fast_window_s: float = 300.0
+    slo_slow_window_s: float = 3600.0
+    slo_burn_threshold: float = 1.0
+    slo_eval_interval_s: float = 5.0
+
     def __post_init__(self) -> None:
         if self.max_nodes <= 0 or self.max_pods <= 0 or self.max_peers <= 0:
             raise ValueError("shape limits must be positive")
@@ -485,6 +511,21 @@ class SchedulerConfig:
             raise ValueError("audit_watchdog_failures must be >= 1")
         if self.quarantine_streak_events < 1:
             raise ValueError("quarantine_streak_events must be >= 1")
+        if self.quality_ring_size < 1:
+            raise ValueError("quality_ring_size must be >= 1")
+        if self.quality_harvest_interval_s <= 0:
+            raise ValueError("quality_harvest_interval_s must be > 0")
+        if self.slo_error_budget < 0:
+            raise ValueError("slo_error_budget must be >= 0")
+        if self.slo_fast_window_s <= 0 or self.slo_slow_window_s <= 0:
+            raise ValueError("slo windows must be > 0")
+        if self.slo_fast_window_s > self.slo_slow_window_s:
+            raise ValueError("slo_fast_window_s must be <= "
+                             "slo_slow_window_s")
+        if self.slo_burn_threshold <= 0:
+            raise ValueError("slo_burn_threshold must be > 0")
+        if self.slo_eval_interval_s <= 0:
+            raise ValueError("slo_eval_interval_s must be > 0")
 
 
 # ---------------------------------------------------------------------------

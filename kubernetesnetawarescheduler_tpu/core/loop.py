@@ -43,7 +43,10 @@ from kubernetesnetawarescheduler_tpu.utils.flight import (
     NULL_SPAN,
     FlightRecorder,
 )
-from kubernetesnetawarescheduler_tpu.utils.tracing import PhaseTimer
+from kubernetesnetawarescheduler_tpu.utils.timeseries import (
+    HistogramPhaseTimer,
+    LogHistogram,
+)
 
 
 def _tracked_jit_fns():
@@ -116,7 +119,10 @@ class SchedulerLoop:
         # injected to resume from a snapshot instead of re-ingesting.
         self.encoder = encoder if encoder is not None else Encoder(cfg)
         self.queue = PodQueue(cfg.queue_capacity)
-        self.timer = PhaseTimer()
+        # HistogramPhaseTimer = PhaseTimer + per-phase log-bucketed
+        # histograms (utils/timeseries.py): the summary families keep
+        # their series while /metrics gains native _hist buckets.
+        self.timer = HistogramPhaseTimer()
         # Decision-level tracing (utils/flight.py): every serving cycle
         # commits one CycleSpan into this bounded ring buffer, and
         # (with cfg.enable_explain) serial/gang cycles retain a per-pod
@@ -161,6 +167,31 @@ class SchedulerLoop:
         # the chaos soak read the counters through these handles.
         self.integrity = None
         self.state_chaos = None
+        # Outcome observability (obs/, ISSUE 11): the placement-
+        # quality observer joins score-time predictions against later
+        # probe truth at the commit seam; the SLO engine evaluates the
+        # declarative objectives over multi-window burn rates.  Both
+        # are observation-only (placements bit-identical on or off,
+        # tests/test_quality.py) and cfg-gated off by default.
+        if cfg.enable_quality_obs:
+            from kubernetesnetawarescheduler_tpu.obs.quality import (
+                QualityObserver,
+            )
+
+            self.quality: "QualityObserver | None" = (
+                QualityObserver(cfg))
+        else:
+            self.quality = None
+        if cfg.enable_slo:
+            from kubernetesnetawarescheduler_tpu.obs.slo import (
+                SLOEngine,
+            )
+
+            self.slo: "SLOEngine | None" = SLOEngine(cfg)
+        else:
+            self.slo = None
+        self._slo_last_eval = 0.0
+        self._quality_last_harvest = 0.0
         # One-shot span tag set by StateChaosInjector._record: the
         # next committed cycle span carries the injected fault class,
         # so a trace reader sees WHICH cycle first ran on corrupted
@@ -226,9 +257,16 @@ class SchedulerLoop:
         self._static_stale_since: float | None = None
         self.static_refresh_total = 0
         self.static_sync_builds = 0
-        from collections import deque as _deque
-        self._static_refresh_ms: "_deque[float]" = _deque(maxlen=2048)
-        self._staleness_samples: "_deque[float]" = _deque(maxlen=8192)
+        # Log-bucketed histograms (utils/timeseries.py) replacing the
+        # r7 ad-hoc deques: same drop-in window surface (append /
+        # list / clear / len / [-1]) for existing consumers, plus
+        # exact never-evicting bucket counts exported as native
+        # Prometheus histograms.  Bounds in the RECORDED unit
+        # (milliseconds / seconds respectively).
+        self._static_refresh_ms = LogHistogram(
+            lo=1e-2, hi=1e6, window=2048)
+        self._staleness_samples = LogHistogram(
+            lo=1e-3, hi=1e5, window=8192)
         # The mesh serving fns keep their own leaf-placer transfer
         # cache; only the plain path threads an explicit static pair.
         self._assign_takes_static = mesh is None
@@ -330,7 +368,11 @@ class SchedulerLoop:
         self.gangs_bound = 0
         self.gangs_rolled_back = 0
 
-        self.round_samples: deque = deque(maxlen=256)
+        # Conflict-round window as a LogHistogram (rounds are small
+        # ints; doubling buckets from 1 keep them exact): drop-in for
+        # the old deque, with one-lock internal snapshots.
+        self.round_samples = LogHistogram(
+            lo=1.0, hi=1024.0, growth=2.0, window=256)
         # Appends happen on the serving thread while /metrics scrapes
         # from the UDS/gRPC threads; iterating a deque mid-append
         # raises RuntimeError, so both sides take this lock.
@@ -528,7 +570,31 @@ class SchedulerLoop:
         """Freeze and commit a cycle span.  Called where the cycle's
         effects commit: end of the serial/burst/gang cycle, or at
         RETIRE for the pipelined path — so a crash never leaves a span
-        claiming a cycle whose placements were lost."""
+        claiming a cycle whose placements were lost.
+
+        Also THE outcome-observability seam (obs/, ISSUE 11): quality
+        capture and the time-gated SLO evaluation ride here — before
+        the recorder guard, so they run on all four paths even with
+        the flight recorder off.  Both are exception-guarded:
+        observation never breaks serving."""
+        if self.quality is not None:
+            try:
+                self.quality.note_commit(self, pods,
+                                         cycle_id=sb.cycle_id)
+            except Exception:  # noqa: BLE001 — observation only
+                pass
+        slo_burning = None
+        if self.slo is not None:
+            try:
+                now = time.monotonic()
+                if (now - self._slo_last_eval
+                        >= self.cfg.slo_eval_interval_s):
+                    self._slo_last_eval = now
+                    self.slo.evaluate(self)
+                b = self.slo.burning()
+                slo_burning = b[0] if b else None
+            except Exception:  # noqa: BLE001 — observation only
+                slo_burning = None
         if self.flight is None or sb is NULL_SPAN:
             return
         enc = self.encoder
@@ -580,6 +646,9 @@ class SchedulerLoop:
             # copies state, per cycle, not just in aggregate.
             donated=0,
             donation_skipped=1,
+            slo_burning=slo_burning,
+            outcome_ring_depth=(self.quality.ring_depth()
+                                if self.quality is not None else 0),
         )
         self.flight.commit(span)
 
@@ -2100,6 +2169,25 @@ class SchedulerLoop:
         self._flush_preemption_waits()
         self._flush_gang_timeouts()
         self.encoder.expire_nominations(self.cfg.preemption_wait_s)
+        # Outcome observability: harvest pending quality joins against
+        # the probes that arrived since the commits, and keep the SLO
+        # engine sampling even when no cycles are committing (an idle
+        # burning objective must still clear / keep burning).
+        if self.quality is not None:
+            try:
+                now = time.monotonic()
+                if (now - self._quality_last_harvest
+                        >= self.cfg.quality_harvest_interval_s):
+                    self._quality_last_harvest = now
+                    self.quality.harvest(self.encoder)
+            except Exception:  # noqa: BLE001 — observation only
+                pass
+        if self.slo is not None:
+            try:
+                self._slo_last_eval = time.monotonic()
+                self.slo.evaluate(self)
+            except Exception:  # noqa: BLE001 — observation only
+                pass
 
     def _flush_preemption_waits(self) -> None:
         """Requeue preemptors whose confirmation deadline passed (a

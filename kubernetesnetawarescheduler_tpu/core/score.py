@@ -112,6 +112,26 @@ def peer_traffic_matrix(pods: PodBatch, num_nodes: int) -> jax.Array:
     return t.at[jnp.arange(p)[:, None], safe].add(traffic, mode="drop")
 
 
+def net_desirability(lat: jax.Array, bw: jax.Array,
+                     node_valid: jax.Array, w_bw: jax.Array,
+                     w_lat: jax.Array) -> jax.Array:
+    """``C[N, N] = w_bw * bw_norm - w_lat * lat_norm`` from raw
+    lat/bw planes — the pure core of :func:`net_cost_matrix`, split
+    out so the outcome-quality evaluator (obs/quality.py) scores
+    REALIZED placements with the exact same desirability semantics
+    the scheduler optimized at decision time (same normalization,
+    same loopback-diagonal pin): regret-vs-best-alternative is then
+    measured in genuine score units, not a lookalike metric."""
+    pair_valid = node_valid[:, None] & node_valid[None, :]
+    bw_max = jnp.maximum(jnp.max(jnp.where(pair_valid, bw, 0.0)), _EPS)
+    lat_max = jnp.maximum(jnp.max(jnp.where(pair_valid, lat, 0.0)),
+                          _EPS)
+    c = w_bw * bw / bw_max - w_lat * lat / lat_max
+    eye = jnp.eye(lat.shape[0], dtype=bool)
+    c = jnp.where(eye, w_bw, c)
+    return jnp.where(pair_valid, c, 0.0)
+
+
 def net_cost_matrix(state: ClusterState, cfg: SchedulerConfig) -> jax.Array:
     """``C[N, N] = w_bw * bw_norm - w_lat * lat_norm``, the desirability
     of placing one end of a flow on row-node given the other end on
@@ -123,15 +143,10 @@ def net_cost_matrix(state: ClusterState, cfg: SchedulerConfig) -> jax.Array:
     beats — regardless of what the probe pipeline wrote into
     ``bw[i, i]`` (iperf never measures a node against itself;
     run.sh:12 probes client->server pairs only)."""
-    pair_valid = state.node_valid[:, None] & state.node_valid[None, :]
-    bw_max = jnp.maximum(jnp.max(jnp.where(pair_valid, state.bw, 0.0)), _EPS)
-    lat_max = jnp.maximum(jnp.max(jnp.where(pair_valid, state.lat, 0.0)), _EPS)
-    c = (cfg.weights.peer_bw * state.bw / bw_max
-         - cfg.weights.peer_lat * state.lat / lat_max)
-    n = state.num_nodes
-    eye = jnp.eye(n, dtype=bool)
-    c = jnp.where(eye, cfg.weights.peer_bw, c)
-    return jnp.where(pair_valid, c, 0.0)
+    return net_desirability(
+        state.lat, state.bw, state.node_valid,
+        jnp.float32(cfg.weights.peer_bw),
+        jnp.float32(cfg.weights.peer_lat))
 
 
 def _use_bf16(cfg: SchedulerConfig) -> bool:

@@ -1,0 +1,349 @@
+"""Placement-quality evaluator: prediction vs realized probe truth.
+
+The scheduler picks nodes from a *prediction* of the network (staging
+lat/bw, possibly netmodel-blended).  Probes keep flowing after the
+bind, so some time later the repo knows what the link quality around a
+placement actually *was* — and nothing before r11 ever joined the two.
+This module closes that loop:
+
+- :meth:`QualityObserver.note_commit` rides the retire/commit seam of
+  all four loop paths (``SchedulerLoop._span_commit`` calls it before
+  the flight-recorder guard, so it runs even with the recorder off):
+  for every pod whose bind just committed it captures the score-time
+  prediction — chosen node, resolved peer nodes with traffic weights,
+  the staging lat/bw the scorer saw for those pairs, and the explain
+  store's predicted winner score when available — into a bounded
+  pending map keyed by pod uid.  Host-side, O(pods x peers) dict/array
+  reads; no device work, no state mutation.
+- :meth:`QualityObserver.harvest` (periodic: ``SchedulerLoop.
+  maintain``; explicit: bench/tests) batches every pending entry
+  through ONE jitted, vmapped device evaluator against the *current*
+  staging matrices: per-pod realized bandwidth/latency (traffic-
+  weighted over peers), realized net score vs the best alternative
+  node under the SAME desirability semantics the scheduler optimized
+  (:func:`core.score.net_desirability` — regret is in genuine score
+  units), and calibration residuals (|log1p pred_bw - log1p obs_bw|,
+  |pred_lat - obs_lat|) that tell the netmodel how wrong its blend
+  was.  Outcomes land in a bounded uid-keyed ring.
+
+Batch sizes are padded to power-of-two buckets (floor 8) so the
+evaluator's jit cache stays bounded; harvest runs off the hot path
+(maintain cadence), and ``note_commit`` never dispatches to device —
+the serving cycle's placements are bit-identical with observation on
+or off (tests/test_quality.py pins this).
+
+This is the realized-outcome label stream "Learning to Score"
+(PAPERS.md) needs for off-policy evaluation, and the per-pod
+current-placement-cost signal the future rebalancer (ROADMAP) will
+consume.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from dataclasses import dataclass
+from typing import Any, Mapping
+
+import numpy as np
+
+from kubernetesnetawarescheduler_tpu.config import SchedulerConfig
+from kubernetesnetawarescheduler_tpu.utils.timeseries import LogHistogram
+
+__all__ = ["QualityObserver"]
+
+_EPS = 1e-9
+
+
+@dataclass(frozen=True)
+class _Pending:
+    """One bound pod's score-time prediction, waiting for probe truth."""
+
+    uid: str
+    node: str
+    node_idx: int
+    cycle_id: int
+    t_commit: float
+    peer_idx: tuple[int, ...]
+    peer_traffic: tuple[float, ...]
+    pred_lat_ms: tuple[float, ...]
+    pred_bw_bps: tuple[float, ...]
+    score_pred: float | None        # explain store's winner score
+
+
+def _round_pow2(n: int, floor: int = 8) -> int:
+    out = floor
+    while out < n:
+        out *= 2
+    return out
+
+
+def _evaluate(lat, bw, valid, chosen, peers, traffic,
+              pred_lat, pred_bw, w_bw, w_lat):
+    """Device-side realized-quality kernel: vmapped over the pod batch.
+
+    Inputs: staging planes ``lat/bw f32[N, N]``, ``valid bool[N]``;
+    per-pod ``chosen i32[B]``, ``peers i32[B, K]`` (-1 = empty slot),
+    ``traffic f32[B, K]``, score-time predictions ``pred_lat/pred_bw
+    f32[B, K]``; scalar score weights (traced, so weight changes don't
+    recompile).  Returns per-pod realized lat/bw, net score of the
+    chosen node, best-alternative net score, regret, bw/lat
+    calibration residuals and the live peer-sample count."""
+    import jax
+    import jax.numpy as jnp
+
+    from kubernetesnetawarescheduler_tpu.core.score import (
+        net_desirability,
+    )
+
+    c = net_desirability(lat, bw, valid, w_bw, w_lat)
+
+    def one(ch, pk, tk, pl, pb):
+        m = pk >= 0
+        safe = jnp.where(m, pk, 0)
+        w = jnp.where(m, tk, 0.0)
+        wsum = jnp.maximum(jnp.sum(w), _EPS)
+        obs_l = lat[ch, safe]
+        obs_b = bw[ch, safe]
+        realized_lat = jnp.sum(w * obs_l) / wsum
+        realized_bw = jnp.sum(w * obs_b) / wsum
+        # Realized net score of EVERY node against this pod's peers —
+        # the same reduction network_scores does per candidate, under
+        # today's observed desirability matrix.
+        cost = jnp.sum(c[:, safe] * w[None, :], axis=1)        # [N]
+        mine = cost[ch]
+        best = jnp.max(jnp.where(valid, cost, -jnp.inf))
+        regret = jnp.maximum(best - mine, 0.0)
+        bw_res = jnp.sum(
+            w * jnp.abs(jnp.log1p(pb) - jnp.log1p(obs_b))) / wsum
+        lat_res = jnp.sum(w * jnp.abs(pl - obs_l)) / wsum
+        return (realized_lat, realized_bw, mine, best, regret,
+                bw_res, lat_res, jnp.sum(m))
+
+    return jax.vmap(one)(chosen, peers, traffic, pred_lat, pred_bw)
+
+
+# Module-level jit cache, shared by every observer: a bench/test
+# warmup harvest on a throwaway observer warms the executable the
+# measured observer will hit (per-instance caches would recompile).
+_EVAL_JIT = None
+
+
+class QualityObserver:
+    """Bounded two-stage join of placement predictions and probe truth.
+
+    Thread-safe: the serving thread calls :meth:`note_commit`, the
+    maintain tick / bench calls :meth:`harvest`, scrape threads read
+    :meth:`summary` — one lock, snapshot-then-math."""
+
+    def __init__(self, cfg: SchedulerConfig) -> None:
+        self.cfg = cfg
+        self._ring_size = max(1, int(cfg.quality_ring_size))
+        self._pending: collections.OrderedDict[str, _Pending] = (
+            collections.OrderedDict())
+        self._ring: collections.OrderedDict[str, dict[str, Any]] = (
+            collections.OrderedDict())
+        self._lock = threading.Lock()
+        # Counters (exact, never evict).
+        self.noted_total = 0
+        self.no_peer_total = 0
+        self.pending_dropped = 0
+        self.ring_evicted = 0
+        self.harvested_total = 0
+        self.calibration_samples = 0
+        # Distributions: regret in score units, calibration residual
+        # in log1p-bw units — both small positives near 0.
+        self.regret_hist = LogHistogram(lo=1e-6, hi=1e3, window=4096)
+        self.bw_residual_hist = LogHistogram(lo=1e-6, hi=1e3,
+                                             window=4096)
+
+    # -- stage 1: capture at the commit seam -------------------------
+
+    def note_commit(self, loop, pods, cycle_id: int = 0) -> None:
+        """Capture score-time predictions for pods whose binds just
+        committed.  Called from ``SchedulerLoop._span_commit`` on all
+        four paths, exception-guarded by the caller (observation must
+        never break serving).  Pods that did not commit (unschedulable
+        / rolled back) and pods with no resolvable peers are counted
+        and skipped — a peerless pod's net term is identical on every
+        node, so its regret is zero by construction."""
+        enc = loop.encoder
+        k_max = self.cfg.max_peers
+        for pod in pods:
+            node = enc.committed_node(pod.uid)
+            if not node:
+                continue
+            idx = enc.node_slot(node)
+            if idx is None:
+                continue
+            self.noted_total += 1
+            peer_idx: list[int] = []
+            peer_w: list[float] = []
+            pred_lat: list[float] = []
+            pred_bw: list[float] = []
+            for peer_name, weight in pod.peers.items():
+                if len(peer_idx) >= k_max:
+                    break
+                peer_node = loop._peer_node(peer_name)
+                if not peer_node:
+                    continue
+                pidx = enc.node_slot(peer_node)
+                if pidx is None:
+                    continue
+                peer_idx.append(int(pidx))
+                peer_w.append(float(weight))
+                # The staging planes ARE what the scorer consumed
+                # this cycle (netmodel blend included): scalar reads,
+                # no lock needed for single-element numpy access.
+                pred_lat.append(float(enc._lat[idx, pidx]))
+                pred_bw.append(float(enc._bw[idx, pidx]))
+            if not peer_idx:
+                self.no_peer_total += 1
+                continue
+            score_pred = None
+            flight = getattr(loop, "flight", None)
+            if flight is not None:
+                rec = flight.get_explain(pod.uid)
+                if rec is not None:
+                    score_pred = rec.get("score")
+            entry = _Pending(
+                uid=pod.uid, node=node, node_idx=int(idx),
+                cycle_id=int(cycle_id), t_commit=time.time(),
+                peer_idx=tuple(peer_idx),
+                peer_traffic=tuple(peer_w),
+                pred_lat_ms=tuple(pred_lat),
+                pred_bw_bps=tuple(pred_bw),
+                score_pred=score_pred)
+            with self._lock:
+                self._pending.pop(pod.uid, None)
+                self._pending[pod.uid] = entry
+                while len(self._pending) > self._ring_size:
+                    self._pending.popitem(last=False)
+                    self.pending_dropped += 1
+
+    # -- stage 2: harvest against current probe truth ----------------
+
+    def harvest(self, enc) -> int:
+        """Evaluate every pending prediction against the CURRENT
+        staging lat/bw (probes have kept flowing since the commits)
+        in one vmapped device dispatch; append outcomes to the ring.
+        Returns the number of outcomes produced.  Off the hot path:
+        called from ``maintain()`` and explicitly by bench/tests."""
+        with self._lock:
+            batch = list(self._pending.values())
+            self._pending.clear()
+        if not batch:
+            return 0
+        import jax.numpy as jnp
+
+        lock = getattr(enc, "_lock", None)
+        if lock is not None:
+            with lock:
+                lat = np.array(enc._lat, dtype=np.float32)
+                bw = np.array(enc._bw, dtype=np.float32)
+                valid = np.array(enc._node_valid, dtype=bool)
+        else:
+            lat = np.array(enc._lat, dtype=np.float32)
+            bw = np.array(enc._bw, dtype=np.float32)
+            valid = np.array(enc._node_valid, dtype=bool)
+        b = len(batch)
+        bpad = _round_pow2(b)
+        k = self.cfg.max_peers
+        chosen = np.zeros((bpad,), np.int32)
+        peers = np.full((bpad, k), -1, np.int32)
+        traffic = np.zeros((bpad, k), np.float32)
+        pred_lat = np.zeros((bpad, k), np.float32)
+        pred_bw = np.zeros((bpad, k), np.float32)
+        for i, e in enumerate(batch):
+            kk = len(e.peer_idx)
+            chosen[i] = e.node_idx
+            peers[i, :kk] = e.peer_idx
+            traffic[i, :kk] = e.peer_traffic
+            pred_lat[i, :kk] = e.pred_lat_ms
+            pred_bw[i, :kk] = e.pred_bw_bps
+        global _EVAL_JIT
+        if _EVAL_JIT is None:
+            import jax
+
+            _EVAL_JIT = jax.jit(_evaluate)
+        out = _EVAL_JIT(
+            jnp.asarray(lat), jnp.asarray(bw), jnp.asarray(valid),
+            jnp.asarray(chosen), jnp.asarray(peers),
+            jnp.asarray(traffic), jnp.asarray(pred_lat),
+            jnp.asarray(pred_bw),
+            jnp.float32(self.cfg.weights.peer_bw),
+            jnp.float32(self.cfg.weights.peer_lat))
+        (r_lat, r_bw, mine, best, regret, bw_res, lat_res,
+         n_samp) = (np.asarray(x) for x in out)
+        now = time.time()
+        with self._lock:
+            for i, e in enumerate(batch):
+                outcome = {
+                    "pod_uid": e.uid,
+                    "node": e.node,
+                    "cycle_id": e.cycle_id,
+                    "t_commit": e.t_commit,
+                    "t_harvest": now,
+                    "peer_samples": int(n_samp[i]),
+                    "realized_lat_ms": float(r_lat[i]),
+                    "realized_bw_bps": float(r_bw[i]),
+                    "net_score": float(mine[i]),
+                    "best_net_score": float(best[i]),
+                    "regret": float(regret[i]),
+                    "bw_residual_log1p": float(bw_res[i]),
+                    "lat_residual_ms": float(lat_res[i]),
+                    "score_pred": e.score_pred,
+                }
+                self._ring.pop(e.uid, None)
+                self._ring[e.uid] = outcome
+                while len(self._ring) > self._ring_size:
+                    self._ring.popitem(last=False)
+                    self.ring_evicted += 1
+                self.harvested_total += 1
+                self.calibration_samples += int(n_samp[i])
+                self.regret_hist.record(float(regret[i]))
+                self.bw_residual_hist.record(float(bw_res[i]))
+        return b
+
+    # -- reads -------------------------------------------------------
+
+    def ring_depth(self) -> int:
+        with self._lock:
+            return len(self._ring)
+
+    def pending_depth(self) -> int:
+        with self._lock:
+            return len(self._pending)
+
+    def outcomes(self) -> list[dict[str, Any]]:
+        with self._lock:
+            return [dict(o) for o in self._ring.values()]
+
+    def outcome(self, uid: str) -> dict[str, Any] | None:
+        with self._lock:
+            rec = self._ring.get(uid)
+            return dict(rec) if rec is not None else None
+
+    def summary(self) -> Mapping[str, Any]:
+        """One-shot stats block for /debug/slo, /metrics and bench."""
+        with self._lock:
+            pending = len(self._pending)
+            ring = len(self._ring)
+        return {
+            "pending": pending,
+            "ring_depth": ring,
+            "ring_size": self._ring_size,
+            "noted_total": self.noted_total,
+            "no_peer_total": self.no_peer_total,
+            "pending_dropped": self.pending_dropped,
+            "ring_evicted": self.ring_evicted,
+            "harvested_total": self.harvested_total,
+            "calibration_samples": self.calibration_samples,
+            "regret_p50": self.regret_hist.percentile(50),
+            "regret_p99": self.regret_hist.percentile(99),
+            "bw_residual_log1p_p50":
+                self.bw_residual_hist.percentile(50),
+            "bw_residual_log1p_p99":
+                self.bw_residual_hist.percentile(99),
+        }

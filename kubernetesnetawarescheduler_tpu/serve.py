@@ -179,6 +179,23 @@ def main(argv=None) -> int:
                          "binds of burst k-1 (implies --async-bind); "
                          "assignments are identical to the serial "
                          "cycle on the same feed")
+    ap.add_argument("--quality-obs", action="store_true",
+                    help="outcome observability (obs/quality.py): "
+                         "join each bound pod's score-time network "
+                         "prediction against later probe truth — "
+                         "realized bw/lat, regret vs best "
+                         "alternative, calibration residuals — in a "
+                         "bounded outcome ring (/debug/slo, "
+                         "/metrics); equivalent to "
+                         "enable_quality_obs=true in --config")
+    ap.add_argument("--slo", action="store_true",
+                    help="SLO burn-rate engine (obs/slo.py): "
+                         "evaluate the declarative objectives "
+                         "(score p99, bind tail, quality regret, "
+                         "unrepaired drift) over multi-window burn "
+                         "rates, emit SLOBurn Events and degrade "
+                         "/readyz while burning; equivalent to "
+                         "enable_slo=true in --config")
     ap.add_argument("--async-static", action="store_true",
                     help="rebuild the batch-invariant static score "
                          "prep on a background thread while batches "
@@ -263,6 +280,22 @@ def main(argv=None) -> int:
         import dataclasses
 
         cfg = dataclasses.replace(cfg, enable_async_static=True)
+    if args.quality_obs and not cfg.enable_quality_obs:
+        import dataclasses
+
+        cfg = dataclasses.replace(cfg, enable_quality_obs=True)
+    if args.slo and not cfg.enable_slo:
+        import dataclasses
+
+        cfg = dataclasses.replace(cfg, enable_slo=True)
+    if cfg.enable_quality_obs:
+        print(f"quality observer enabled: outcome ring "
+              f"{cfg.quality_ring_size}, harvest every "
+              f"{cfg.quality_harvest_interval_s}s", file=sys.stderr)
+    if cfg.enable_slo:
+        print(f"slo engine enabled: score p99 {cfg.slo_score_p99_ms}ms, "
+              f"burn windows {cfg.slo_fast_window_s}s/"
+              f"{cfg.slo_slow_window_s}s", file=sys.stderr)
 
     if args.compilation_cache_dir:
         # Persistent XLA compilation cache: must be configured BEFORE

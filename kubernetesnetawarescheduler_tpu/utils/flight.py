@@ -83,6 +83,14 @@ class CycleSpan:
     rounds: int = 0
     donated: int = 0
     donation_skipped: int = 0
+    # Outcome observability (ISSUE 11): the SLO objective burning when
+    # this cycle committed (None = all objectives healthy or engine
+    # off) and the quality observer's outcome-ring depth — so a trace
+    # export shows WHICH cycles ran under a burning SLO and how much
+    # realized-outcome evidence existed at the time.  Default-valued:
+    # pre-r11 spans and crash dumps deserialize unchanged.
+    slo_burning: str | None = None
+    outcome_ring_depth: int = 0
 
     def to_dict(self) -> dict[str, Any]:
         return {
@@ -105,6 +113,8 @@ class CycleSpan:
             "rounds": self.rounds,
             "donated": self.donated,
             "donation_skipped": self.donation_skipped,
+            "slo_burning": self.slo_burning,
+            "outcome_ring_depth": self.outcome_ring_depth,
         }
 
 

@@ -15,8 +15,30 @@ from __future__ import annotations
 
 import numpy as np
 
+from kubernetesnetawarescheduler_tpu.utils.timeseries import (
+    prom_histogram_lines,
+)
+
 
 _QUANTILES = (0.5, 0.9, 0.99)
+
+
+class FamilyRegistry:
+    """Duplicate-family guard for one exposition render: Prometheus
+    silently keeps the FIRST HELP/TYPE it sees and some scrapers drop
+    the whole body, so a name collision (two subsystems exporting the
+    same family, or a summary vs histogram TYPE clash) must fail
+    loudly at render time, not page someone with half-missing
+    series."""
+
+    def __init__(self) -> None:
+        self._names: set[str] = set()
+
+    def register(self, name: str) -> None:
+        if name in self._names:
+            raise ValueError(
+                f"duplicate metric family {name!r} in /metrics render")
+        self._names.add(name)
 
 
 def _fmt(value: float) -> str:
@@ -30,16 +52,29 @@ def render_metrics(loop) -> str:
     :class:`~kubernetesnetawarescheduler_tpu.core.loop.SchedulerLoop`."""
     enc = loop.encoder
     lines: list[str] = []
+    _register = FamilyRegistry().register
 
     def counter(name: str, value: float, help_: str) -> None:
+        _register(name)
         lines.append(f"# HELP {name} {help_}")
         lines.append(f"# TYPE {name} counter")
         lines.append(f"{name} {_fmt(value)}")
 
     def gauge(name: str, value: float, help_: str) -> None:
+        _register(name)
         lines.append(f"# HELP {name} {help_}")
         lines.append(f"# TYPE {name} gauge")
         lines.append(f"{name} {_fmt(value)}")
+
+    def hist(name: str, help_: str, snaps) -> None:
+        """Native-histogram family from (labels, snapshot) pairs —
+        HELP/TYPE once, then every label set's buckets."""
+        _register(name)
+        first = True
+        for labels, snap in snaps:
+            lines.extend(prom_histogram_lines(
+                name, help_, snap, labels=labels, header=first))
+            first = False
 
     counter("netaware_pods_scheduled_total", loop.scheduled,
             "Pods successfully bound")
@@ -193,6 +228,7 @@ def render_metrics(loop) -> str:
         # emitting garbage, not that links are bad.
         quarantined = getattr(orch, "quarantined", None)
         if quarantined:
+            _register("netaware_ingest_quarantined_total")
             lines.append("# HELP netaware_ingest_quarantined_total "
                          "Probe samples refused at the staging "
                          "boundary (range validation)")
@@ -248,6 +284,7 @@ def render_metrics(loop) -> str:
         gauge("netaware_integrity_last_audit_ms",
               float(auditor.last_audit_ms),
               "Wall time of the most recent audit pass")
+        _register("netaware_integrity_repairs_total")
         lines.append("# HELP netaware_integrity_repairs_total Repairs "
                      "applied, by escalation-ladder rung")
         lines.append("# TYPE netaware_integrity_repairs_total counter")
@@ -256,6 +293,7 @@ def render_metrics(loop) -> str:
                          f'{{rung="{rung}"}} {_fmt(float(n))}')
     chaos = getattr(loop, "state_chaos", None)
     if chaos is not None:
+        _register("netaware_state_faults_injected_total")
         lines.append("# HELP netaware_state_faults_injected_total "
                      "State-layer faults injected by the chaos "
                      "injector, by class")
@@ -337,6 +375,7 @@ def render_metrics(loop) -> str:
     refresh_ms = _snap_deque("_static_refresh_ms")
     stale_s = _snap_deque("_staleness_samples")
     if refresh_ms.size:
+        _register("netaware_static_refresh_ms")
         lines.append("# HELP netaware_static_refresh_ms Wall time per "
                      "assign-static rebuild (delta or full)")
         lines.append("# TYPE netaware_static_refresh_ms summary")
@@ -349,6 +388,7 @@ def render_metrics(loop) -> str:
         lines.append(
             f"netaware_static_refresh_ms_count {refresh_ms.size}")
     if stale_s.size:
+        _register("netaware_static_staleness_s")
         lines.append("# HELP netaware_static_staleness_s Age of the "
                      "static each Score() call served (async refresh; "
                      "0 = current)")
@@ -375,6 +415,7 @@ def render_metrics(loop) -> str:
     else:
         rounds = np.zeros((0,))
     if rounds.size:
+        _register("netaware_conflict_rounds")
         lines.append("# HELP netaware_conflict_rounds Conflict-"
                      "resolution rounds per scheduled batch")
         lines.append("# TYPE netaware_conflict_rounds summary")
@@ -388,6 +429,7 @@ def render_metrics(loop) -> str:
 
     # Metric staleness distribution over ready nodes — the quantity the
     # exp(-age/tau) decay consumes.
+    _register("netaware_metric_staleness_seconds")
     lines.append("# HELP netaware_metric_staleness_seconds Age of each "
                  "ready node's last telemetry sample")
     lines.append("# TYPE netaware_metric_staleness_seconds summary")
@@ -402,6 +444,7 @@ def render_metrics(loop) -> str:
 
     # Per-phase latency summaries (encode / score_assign / bind) — p99
     # Score() latency is a north-star metric (BASELINE.json).
+    _register("netaware_phase_latency_seconds")
     lines.append("# HELP netaware_phase_latency_seconds Wall time per "
                  "scheduling phase")
     lines.append("# TYPE netaware_phase_latency_seconds summary")
@@ -418,6 +461,36 @@ def render_metrics(loop) -> str:
             f'netaware_phase_latency_seconds_sum{{phase="{phase}"}} '
             f"{_fmt(stats['total_s'])}")
 
+    # Native-histogram ride-alongs (r11, utils/timeseries.py): the
+    # summary families above keep their series names for existing
+    # dashboards; these ``_hist`` families export the SAME
+    # observations as cumulative le-buckets with exact never-evicting
+    # counts, so "how many cycles ever crossed 5 ms" survives the
+    # percentile window sliding and sums across replicas.
+    hists = getattr(loop.timer, "hists", None)
+    if hists:
+        hist("netaware_phase_latency_seconds_hist",
+             "Wall time per scheduling phase (log-bucketed native "
+             "histogram; exact counts)",
+             [(f'phase="{phase}"', h.snapshot())
+              for phase, h in sorted(hists.items())])
+    for attr, fam, help_ in (
+            ("_static_refresh_ms", "netaware_static_refresh_ms_hist",
+             "Wall time per assign-static rebuild, milliseconds "
+             "(log-bucketed native histogram)"),
+            ("_staleness_samples", "netaware_static_staleness_s_hist",
+             "Age of the static each Score() call served, seconds "
+             "(log-bucketed native histogram)"),
+            ("round_samples", "netaware_conflict_rounds_hist",
+             "Conflict-resolution rounds per scheduled batch "
+             "(log-bucketed native histogram)")):
+        h = getattr(loop, attr, None)
+        snap_fn = getattr(h, "snapshot", None)
+        if snap_fn is not None:
+            snap = snap_fn()
+            if snap["count"]:
+                hist(fam, help_, [("", snap)])
+
     # Pipeline stage budgets (pipelined serving datapath): the live
     # counterpart of the bench artifact's pipeline_budgets block —
     # encode / dispatch / device_wait / bind, so overlap health is
@@ -425,6 +498,7 @@ def render_metrics(loop) -> str:
     # has run.
     budgets = loop.timer.pipeline_budgets()
     if budgets:
+        _register("netaware_pipeline_stage_ms")
         lines.append("# HELP netaware_pipeline_stage_ms Per-stage "
                      "serving-pipeline budget in milliseconds")
         lines.append("# TYPE netaware_pipeline_stage_ms gauge")
@@ -433,5 +507,79 @@ def render_metrics(loop) -> str:
                 lines.append(
                     f'netaware_pipeline_stage_ms{{stage="{stage}",'
                     f'stat="{stat[:-3]}"}} {_fmt(b[stat])}')
+
+    # Outcome observability (r11, obs/quality.py): did the placements
+    # the scheduler committed turn out to be GOOD?  Regret is in the
+    # same desirability units the score kernel optimized; calibration
+    # residuals measure how honest the score-time network prediction
+    # was against later probe truth.
+    quality = getattr(loop, "quality", None)
+    if quality is not None:
+        qs = quality.summary()
+        counter("netaware_quality_commits_noted_total",
+                float(qs["noted_total"]),
+                "Bound pods whose score-time prediction was captured "
+                "for outcome joining")
+        counter("netaware_quality_outcomes_total",
+                float(qs["harvested_total"]),
+                "Placement outcomes evaluated against observed probe "
+                "state (regret + calibration)")
+        counter("netaware_quality_no_peer_total",
+                float(qs["no_peer_total"]),
+                "Bound pods skipped by the quality observer (no "
+                "resolvable peers at commit time)")
+        counter("netaware_quality_calibration_samples_total",
+                float(qs["calibration_samples"]),
+                "Pod-peer samples contributing to netmodel "
+                "calibration residuals")
+        counter("netaware_quality_pending_dropped_total",
+                float(qs["pending_dropped"]),
+                "Pending observations evicted before harvest "
+                "(capacity)")
+        gauge("netaware_quality_ring_depth", float(qs["ring_depth"]),
+              "Evaluated outcomes retained in the bounded ring")
+        gauge("netaware_quality_pending_depth", float(qs["pending"]),
+              "Commits awaiting their next harvest join")
+        hist("netaware_quality_regret",
+             "Per-pod placement regret vs the best feasible "
+             "alternative, in net-desirability score units",
+             [("", quality.regret_hist.snapshot())])
+        hist("netaware_quality_bw_residual_log1p",
+             "Per-pod |log1p(predicted bw) - log1p(observed bw)| "
+             "calibration residual",
+             [("", quality.bw_residual_hist.snapshot())])
+
+    # SLO burn-rate engine (r11, obs/slo.py): multi-window burn per
+    # objective, plus a 0/1 burning flag alertmanager can gate on
+    # without re-deriving the window math.
+    slo = getattr(loop, "slo", None)
+    if slo is not None:
+        ss = slo.snapshot()
+        counter("netaware_slo_evaluations_total",
+                float(ss["evaluations_total"]),
+                "SLO engine evaluation passes")
+        counter("netaware_slo_burn_events_total",
+                float(ss["burn_events_total"]),
+                "Not-burning -> burning transitions (each also gets "
+                "an SLOBurn event)")
+        _register("netaware_slo_burn_rate")
+        lines.append("# HELP netaware_slo_burn_rate Error-budget burn "
+                     "rate per objective and window (1.0 = burning "
+                     "exactly at budget)")
+        lines.append("# TYPE netaware_slo_burn_rate gauge")
+        for name, obj in sorted(ss["objectives"].items()):
+            for window in ("fast", "slow"):
+                lines.append(
+                    f'netaware_slo_burn_rate{{objective="{name}",'
+                    f'window="{window}"}} '
+                    f"{_fmt(obj[f'burn_{window}'])}")
+        _register("netaware_slo_burning")
+        lines.append("# HELP netaware_slo_burning Whether the "
+                     "objective is burning on BOTH windows (1 = page)")
+        lines.append("# TYPE netaware_slo_burning gauge")
+        for name, obj in sorted(ss["objectives"].items()):
+            lines.append(
+                f'netaware_slo_burning{{objective="{name}"}} '
+                f"{1 if obj['burning'] else 0}")
 
     return "\n".join(lines) + "\n"

@@ -478,3 +478,79 @@ def test_integrity_shape_validated_when_present():
     fails = bench_check.check_doc("BENCH_r09.json", _r9_doc(
         integrity=_integrity(unrepaired_drift=1)))
     assert any("unrepaired_drift=1" in f for f in fails), fails
+
+def _quality(**overrides):
+    """A healthy r11 quality block (bench.py _persisted_quality
+    shape)."""
+    block = {
+        "observation_enabled": True,
+        "overhead_fraction": 0.004,
+        "calibration_samples": 755,
+        "bit_identical": True,
+        "regret_p99": 64.9,
+        "harvest_ms_p50": 2.8,
+        "source": "suite_quality",
+    }
+    block.update(overrides)
+    return block
+
+
+def _r11_doc(**detail_overrides):
+    detail = {"trace_provenance": _trace_prov(),
+              "winner_fusion": _winner_fusion(),
+              "rounds_max": 4,
+              "integrity": _integrity(),
+              "quality": _quality()}
+    detail.update(detail_overrides)
+    return _headline(detail=detail)
+
+
+def test_quality_block_required_from_round11():
+    # r11+ headline claiming the p99 bar without the block: fails.
+    doc = _r10_doc()
+    fails = bench_check.check_doc("BENCH_r11.json", doc)
+    assert any("quality" in f for f in fails), fails
+    # Same doc with the block: clean.
+    assert bench_check.check_doc("BENCH_r11.json", _r11_doc()) == []
+    # Committed r10 history predates the observer: exempt.
+    assert bench_check.check_doc("BENCH_r10.json", doc) == []
+    # A doc not claiming the bar may omit the block even at r11+.
+    quiet = _r10_doc()
+    quiet["detail"]["score_p99_ms"] = 87.44
+    quiet["detail"]["north_star"]["p99_met"] = False
+    assert bench_check.check_doc("BENCH_r11.json", quiet) == []
+
+
+def test_quality_shape_validated_when_present():
+    # A leg that ran without the observer is no evidence at all.
+    fails = bench_check.check_doc("BENCH_r11.json", _r11_doc(
+        quality=_quality(observation_enabled=False)))
+    assert any("observation_enabled" in f for f in fails), fails
+    # A join that produced no samples measured nothing.
+    fails = bench_check.check_doc("BENCH_r11.json", _r11_doc(
+        quality=_quality(calibration_samples=0)))
+    assert any("calibration_samples=0" in f for f in fails), fails
+    # A p99 claim whose observation costs more than the 2% budget.
+    fails = bench_check.check_doc("BENCH_r11.json", _r11_doc(
+        quality=_quality(overhead_fraction=0.031)))
+    assert any("0.031" in f for f in fails), fails
+    # Observation that changed placements is not a ride-along.
+    fails = bench_check.check_doc("BENCH_r11.json", _r11_doc(
+        quality=_quality(bit_identical=False)))
+    assert any("bit_identical" in f for f in fails), fails
+    # Missing accounting keys.
+    bad = _quality()
+    del bad["overhead_fraction"]
+    fails = bench_check.check_doc("BENCH_r11.json", _r11_doc(
+        quality=bad))
+    assert any("quality missing" in f for f in fails), fails
+    # Validated even on a pre-r11 filename: carrying the block opts in.
+    fails = bench_check.check_doc("BENCH_r10.json", _r10_doc(
+        quality=_quality(bit_identical=False)))
+    assert any("bit_identical" in f for f in fails), fails
+    # Overhead inside budget but not claiming the bar: clean even at
+    # a high fraction (the budget gates the p99 claim, not history).
+    quiet = _r11_doc(quality=_quality(overhead_fraction=0.05))
+    quiet["detail"]["score_p99_ms"] = 87.44
+    quiet["detail"]["north_star"]["p99_met"] = False
+    assert bench_check.check_doc("BENCH_r11.json", quiet) == []

@@ -28,6 +28,7 @@ from kubernetesnetawarescheduler_tpu.config import SchedulerConfig
 from kubernetesnetawarescheduler_tpu.core.loop import SchedulerLoop
 from kubernetesnetawarescheduler_tpu.utils.flight import (
     NULL_SPAN,
+    CycleSpan,
     FlightRecorder,
 )
 
@@ -357,3 +358,41 @@ def test_cycle_spans_carry_round_and_donation_accounting():
     assert loop.donation_skipped_total >= len(spans)
     assert loop.donated_total == 0
     assert trace_check.check_trace(loop.flight.to_chrome_trace()) == []
+
+
+def test_pre_r11_spans_default_load():
+    """Spans recorded by older code (and pre-r11 crash dumps)
+    construct without the outcome-observability fields and serialize
+    with honest defaults — None (engine off) and 0 (no evidence)."""
+    span = CycleSpan(
+        cycle_id=1, path="serial", t_wall=0.0, t_mono=0.0,
+        dur_s=0.001, n_pods=2, pod_uids=("a", "b"), queue_depth=0,
+        phases=())
+    assert span.slo_burning is None
+    assert span.outcome_ring_depth == 0
+    d = span.to_dict()
+    assert d["slo_burning"] is None
+    assert d["outcome_ring_depth"] == 0
+
+
+def test_cycle_spans_carry_outcome_observability():
+    """With the quality observer and SLO engine on, every committed
+    span carries the r11 fields, the chrome-trace args expose them,
+    and trace_check lints the result clean."""
+    cfg = _cfg(enable_quality_obs=True, enable_slo=True,
+               slo_eval_interval_s=1e-6)
+    cluster, loop = _make_loop(cfg, seed=7)
+    _drain(cluster, loop, num_pods=10, seed=7)
+    spans = [s for s in loop.flight.spans() if s.n_pods > 0]
+    assert spans
+    for s in spans:
+        assert s.slo_burning is None or isinstance(s.slo_burning, str)
+        assert isinstance(s.outcome_ring_depth, int)
+        assert s.outcome_ring_depth >= 0
+    assert loop.quality is not None and loop.quality.noted_total > 0
+    assert loop.slo is not None and loop.slo.evaluations_total > 0
+    trace = loop.flight.to_chrome_trace()
+    cycle_args = [e["args"] for e in trace["traceEvents"]
+                  if e.get("cat") == "cycle"]
+    assert any("outcome_ring_depth" in a for a in cycle_args)
+    assert trace_check.check_trace(trace) == []

@@ -163,3 +163,95 @@ def test_fused_step_counters_exposed():
     assert flat["netaware_donation_skipped_total"] == \
         loop.donation_skipped_total
     assert loop.donation_skipped_total > 0
+
+
+def test_family_registry_guard_raises_on_duplicate():
+    """r11: one render must never emit two HELP/TYPE headers for the
+    same family (Prometheus keeps the first silently; some scrapers
+    drop the whole body)."""
+    from kubernetesnetawarescheduler_tpu.utils.selfmetrics import (
+        FamilyRegistry,
+    )
+
+    reg = FamilyRegistry()
+    reg.register("netaware_pods_scheduled_total")
+    reg.register("netaware_queue_depth")
+    with np.testing.assert_raises(ValueError):
+        reg.register("netaware_pods_scheduled_total")
+
+
+def _render_full():
+    """Drain a loop with every r11 subsystem enabled and render."""
+    import dataclasses
+
+    cfg = dataclasses.replace(CFG, enable_quality_obs=True,
+                              enable_slo=True,
+                              slo_eval_interval_s=1e-6)
+    cluster, lat, bw = build_fake_cluster(ClusterSpec(num_nodes=20,
+                                                      seed=4))
+    loop = SchedulerLoop(cluster, cfg)
+    loop.encoder.set_network(lat, bw)
+    feed_metrics(cluster, loop.encoder, np.random.default_rng(5))
+    pods = generate_workload(WorkloadSpec(num_pods=24, seed=4),
+                             scheduler_name=cfg.scheduler_name)
+    cluster.add_pods(pods)
+    loop.run_until_drained()
+    loop.quality.harvest(loop.encoder)
+    return render_metrics(loop), loop
+
+
+def test_render_has_no_duplicate_families():
+    """The full render — every subsystem enabled — passes its own
+    guard and exposes each family's header exactly once."""
+    body, _loop = _render_full()
+    declared = [line.split()[2] for line in body.splitlines()
+                if line.startswith("# TYPE ")]
+    assert len(declared) == len(set(declared))
+
+
+def test_histogram_families_ride_along_unrenamed():
+    """r11 migration satellite: the native _hist families appear WITHOUT
+    renaming the pre-existing summary series — both shapes coexist in
+    one body."""
+    body, _loop = _render_full()
+    # Legacy summary family intact...
+    assert 'netaware_phase_latency_seconds{phase="score_assign"' \
+        in body or 'netaware_phase_latency_seconds{quantile' in body \
+        or "# TYPE netaware_phase_latency_seconds summary" in body
+    # ...and the native histogram rides along with per-phase labels,
+    # one header, cumulative le buckets and the mandatory +Inf.
+    assert "# TYPE netaware_phase_latency_seconds_hist histogram" \
+        in body
+    hist_lines = [l for l in body.splitlines()
+                  if l.startswith("netaware_phase_latency_seconds_hist")]
+    assert any('le="+Inf"' in l for l in hist_lines)
+    assert any("_sum{" in l for l in hist_lines)
+    assert body.count(
+        "# HELP netaware_phase_latency_seconds_hist") == 1
+
+
+def test_quality_and_slo_families_exposed():
+    body, loop = _render_full()
+    parsed = parse_prometheus_text(body)
+    flat = {name: next(iter(series.values()))
+            for name, series in parsed.items() if len(series) == 1}
+    assert flat["netaware_quality_commits_noted_total"] == \
+        loop.quality.noted_total > 0
+    assert flat["netaware_quality_outcomes_total"] == \
+        loop.quality.harvested_total > 0
+    assert flat["netaware_quality_ring_depth"] == \
+        loop.quality.ring_depth()
+    assert flat["netaware_slo_evaluations_total"] == \
+        loop.slo.evaluations_total > 0
+    burn = parsed["netaware_slo_burn_rate"]
+    windows = {dict(labels).get("window") for labels in burn}
+    assert {"fast", "slow"} <= windows
+    burning = parsed["netaware_slo_burning"]
+    assert all(v in (0.0, 1.0) for v in burning.values())
+
+
+def test_quality_slo_families_absent_when_disabled():
+    loop = _run_loop(num_pods=12, seed=13)
+    body = render_metrics(loop)
+    assert "netaware_quality_" not in body
+    assert "netaware_slo_" not in body

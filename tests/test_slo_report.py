@@ -1,0 +1,237 @@
+"""Offline SLO report (tools/slo_report.py) + pure burn-rate math.
+
+The r11 invariants, each pinned here:
+
+* the pure window math (obs/slo.py — the SAME functions the live
+  engine runs) has exact edge semantics: the interval is
+  ``(now - window_s, now]``, empty windows burn at 0.0, a zero error
+  budget makes any breach an infinite burn, and is_burning is a
+  multi-window AND;
+* ``build_report`` replays that math over a trace's own time axis and
+  produces the stable report schema;
+* quality bars (overhead / calibration / bit-identity / regret
+  ceiling) fire from bench ``detail.quality`` blocks;
+* absence of telemetry is reported as absence, never compliance.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import math
+import os
+
+_TOOL = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "tools", "slo_report.py")
+_spec = importlib.util.spec_from_file_location("slo_report", _TOOL)
+slo_report = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(slo_report)
+
+from kubernetesnetawarescheduler_tpu.obs.slo import (  # noqa: E402
+    breach_fraction,
+    burn_rate,
+    is_burning,
+)
+
+
+# ---------------------------------------------------------------------------
+# Pure window math (shared by the live engine and the offline report).
+# ---------------------------------------------------------------------------
+
+
+def test_breach_fraction_window_edges():
+    now = 100.0
+    samples = [
+        (90.0, True),    # inside (90, 100]?  t > now - 10 is FALSE at
+                         # exactly the edge: 90 is excluded
+        (90.1, True),    # inside
+        (100.0, False),  # inclusive at now
+        (100.1, True),   # future (crash-dump clock skew): excluded
+    ]
+    frac, n = breach_fraction(samples, now, 10.0)
+    assert n == 2
+    assert frac == 0.5
+
+
+def test_breach_fraction_empty_window():
+    assert breach_fraction([], 100.0, 10.0) == (0.0, 0)
+    # Samples exist but all outside the window.
+    assert breach_fraction([(1.0, True)], 100.0, 10.0) == (0.0, 0)
+
+
+def test_burn_rate_semantics():
+    now = 100.0
+    samples = [(99.0, True), (98.0, False), (97.0, False),
+               (96.0, False)]
+    # 1/4 breaches against a 5% budget = 5x burn.
+    assert burn_rate(samples, now, 10.0, 0.05) == 5.0
+    # No samples / no breaches -> 0.0, never a division.
+    assert burn_rate([], now, 10.0, 0.05) == 0.0
+    assert burn_rate([(99.0, False)], now, 10.0, 0.0) == 0.0
+    # Zero budget + any breach = infinite burn (invariant objectives).
+    assert math.isinf(burn_rate([(99.0, True)], now, 10.0, 0.0))
+
+
+def test_is_burning_multi_window_and():
+    assert is_burning(2.0, 1.5, 1.0)
+    assert not is_burning(2.0, 0.5, 1.0)   # fast alone is a blip
+    assert not is_burning(0.5, 2.0, 1.0)   # slow alone is stale news
+    assert is_burning(1.0, 1.0, 1.0)       # threshold is inclusive
+
+
+# ---------------------------------------------------------------------------
+# Report fusion over synthetic artifacts.
+# ---------------------------------------------------------------------------
+
+
+def _trace(phase="score_assign", durs_ms=(1.0,), cycle_args=()):
+    """Chrome-trace doc: one phase event per duration, 1s apart, plus
+    optional cycle events carrying r11 span args."""
+    events = []
+    for i, d in enumerate(durs_ms):
+        events.append({"name": phase, "cat": "phase", "ph": "X",
+                       "ts": i * 1e6, "dur": d * 1e3})
+    for i, args in enumerate(cycle_args):
+        events.append({"name": "cycle", "cat": "cycle", "ph": "X",
+                       "ts": i * 1e6, "dur": 2e3, "args": args})
+    return {"traceEvents": events}
+
+
+def _opts(**kw):
+    argv = []
+    for k, v in kw.items():
+        argv += [f"--{k.replace('_', '-')}", str(v)]
+    return slo_report.parse_args(argv)
+
+
+def test_report_schema_and_clean_verdict():
+    report = slo_report.build_report(
+        trace_doc=_trace(durs_ms=[1.0, 2.0, 3.0]),
+        decisions=[{"seq": 1, "pod": "a", "node": "n1"},
+                   {"seq": 2, "pod": "b", "node": ""}],
+        bench_docs={},
+        opts=_opts())
+    assert set(report) == {
+        "generated_from", "windows", "slo", "burning", "decisions",
+        "cycles", "quality", "failures", "ok"}
+    assert report["ok"] and not report["failures"]
+    assert report["decisions"] == {"bound": 1, "unschedulable": 1}
+    slo = report["slo"]["score_p99_ms"]
+    assert slo["target"] == 5.0
+    assert slo["samples"] == 3
+    assert not slo["burning"]
+    # bind_net never appeared in the trace: absence != compliance,
+    # the objective has NO entry rather than a passing one.
+    assert "bind_p99_ms" not in report["slo"]
+
+
+def test_burning_objective_fails_report():
+    # Every score sample breaches a 5ms target inside both windows
+    # (trace spans ~10s; fast/slow windows set to cover it).
+    report = slo_report.build_report(
+        trace_doc=_trace(durs_ms=[8.0] * 10),
+        opts=_opts(fast_window_s=5, slow_window_s=60))
+    obj = report["slo"]["score_p99_ms"]
+    assert obj["burning"]
+    assert math.isinf(obj["burn_fast"]) or obj["burn_fast"] >= 1.0
+    assert report["burning"] == ["score_p99_ms"]
+    assert not report["ok"]
+    assert any("score_p99_ms" in f for f in report["failures"])
+
+
+def test_burn_replayed_on_trace_time_axis():
+    # 10 samples, only the FIRST breaches; now = last end.  A 5s fast
+    # window excludes the early breach -> burn_fast 0; the 60s slow
+    # window sees it -> nonzero slow burn, but no multi-window AND.
+    durs = [8.0] + [1.0] * 9
+    report = slo_report.build_report(
+        trace_doc=_trace(durs_ms=durs),
+        opts=_opts(fast_window_s=5, slow_window_s=60))
+    obj = report["slo"]["score_p99_ms"]
+    assert obj["burn_fast"] == 0.0
+    assert obj["burn_slow"] > 0.0
+    assert not obj["burning"]
+    assert report["ok"]
+
+
+def test_cycles_block_reads_r11_span_args():
+    report = slo_report.build_report(
+        trace_doc=_trace(cycle_args=[
+            {"slo_burning": None, "outcome_ring_depth": 3},
+            {"slo_burning": "score_p99_ms", "outcome_ring_depth": 7},
+            {"slo_burning": "score_p99_ms", "outcome_ring_depth": 5},
+        ]),
+        opts=_opts())
+    cyc = report["cycles"]
+    assert cyc["count"] == 3
+    assert cyc["slo_burning_cycles"] == 2
+    assert cyc["slo_burning_by_objective"] == {"score_p99_ms": 2}
+    assert cyc["outcome_ring_depth_max"] == 7
+
+
+def _bench_doc(**quality):
+    q = {"observation_enabled": True, "overhead_fraction": 0.004,
+         "calibration_samples": 755, "bit_identical": True,
+         "regret_p99": 0.2}
+    q.update(quality)
+    return {"detail": {"quality": q}}
+
+
+def test_quality_bars_fire():
+    cases = {
+        "overhead.json": _bench_doc(overhead_fraction=0.03),
+        "blind.json": _bench_doc(calibration_samples=0),
+        "moved.json": _bench_doc(bit_identical=False),
+        "regret.json": _bench_doc(regret_p99=0.9),
+    }
+    report = slo_report.build_report(
+        bench_docs=cases, opts=_opts(regret_ceiling=0.5))
+    assert not report["ok"]
+    assert len(report["failures"]) == 4
+    assert set(report["quality"]) == set(cases)
+
+
+def test_quality_clean_passes():
+    report = slo_report.build_report(
+        bench_docs={"q.json": _bench_doc()}, opts=_opts())
+    assert report["ok"]
+    # A bench doc with no quality block contributes nothing.
+    report = slo_report.build_report(
+        bench_docs={"other.json": {"detail": {}}}, opts=_opts())
+    assert report["quality"] == {}
+    assert report["ok"]
+
+
+def test_suite_artifact_shape_accepted():
+    # bench --suite quality writes the quality fields directly into
+    # detail (the artifact IS the block); headline docs nest it under
+    # detail.quality.  Both shapes must aggregate, and the regret
+    # ceiling is opt-in (score units are workload-dependent, so the
+    # committed artifact lints clean under the default invocation).
+    doc = {"metric": "placement_quality", "detail": {
+        "observation_enabled": True, "overhead_fraction": 0.0,
+        "calibration_samples": 755, "bit_identical": True,
+        "regret_p99": 64.97}}
+    report = slo_report.build_report(
+        bench_docs={"quality.json": doc}, opts=_opts())
+    assert report["quality"]["quality.json"][
+        "calibration_samples"] == 755
+    assert report["ok"]
+    gated = slo_report.build_report(
+        bench_docs={"quality.json": doc},
+        opts=_opts(regret_ceiling=0.5))
+    assert not gated["ok"]
+
+
+def test_crash_dump_envelope_accepted():
+    doc = {"reason": "watchdog", "trace": _trace(durs_ms=[1.0, 2.0])}
+    report = slo_report.build_report(trace_doc=doc, opts=_opts())
+    assert report["generated_from"]["trace_events"] == 2
+    assert report["slo"]["score_p99_ms"]["samples"] == 2
+
+
+def test_empty_inputs_shrink_report():
+    report = slo_report.build_report(opts=_opts())
+    assert report["slo"] == {}
+    assert report["burning"] == []
+    assert report["cycles"]["count"] == 0
+    assert report["ok"]

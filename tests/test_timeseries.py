@@ -1,0 +1,170 @@
+"""Log-bucketed histograms (utils/timeseries.py).
+
+The r11 invariants, each pinned here:
+
+* bucket assignment follows Prometheus ``le`` semantics (value <=
+  bound), exact count/sum never evict while the percentile window
+  stays bounded;
+* the deque drop-in surface (append/extend/clear/len/iter/[-1])
+  behaves like the ad-hoc deques it replaced, so every pre-r11
+  consumer (bench/density's list(), selfmetrics' iteration, loop's
+  [-1]) keeps working;
+* prom_histogram_lines renders valid sparse cumulative exposition
+  (monotone buckets, mandatory +Inf, _sum/_count, label splicing and
+  one-header-per-family);
+* HistogramPhaseTimer keeps the PhaseTimer contract byte-for-byte
+  (summary/percentile unchanged) while landing the same observations
+  in per-phase histograms.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import pytest
+
+from kubernetesnetawarescheduler_tpu.utils.timeseries import (
+    HistogramPhaseTimer,
+    LogHistogram,
+    _geometric_bounds,
+    prom_histogram_lines,
+)
+from kubernetesnetawarescheduler_tpu.utils.tracing import PhaseTimer
+
+
+def test_geometric_bounds_cover_range():
+    bounds = _geometric_bounds(1e-3, 1e3, 10.0)
+    assert bounds[0] == pytest.approx(1e-3)
+    assert bounds[-1] >= 1e3
+    for a, b in zip(bounds, bounds[1:]):
+        assert b == pytest.approx(a * 10.0)
+
+
+def test_geometric_bounds_reject_bad_params():
+    for lo, hi, g in ((0.0, 1.0, 2.0), (1.0, 1.0, 2.0),
+                      (1.0, 2.0, 1.0), (-1.0, 2.0, 2.0)):
+        with pytest.raises(ValueError):
+            _geometric_bounds(lo, hi, g)
+
+
+def test_le_bucket_semantics():
+    h = LogHistogram(lo=1.0, hi=100.0, growth=10.0)
+    # Bounds are exactly (1, 10, 100).  A value ON a bound belongs to
+    # that bound's bucket (le semantics), just above goes up one.
+    h.record(1.0)
+    h.record(1.0001)
+    h.record(10.0)
+    h.record(100.0)
+    h.record(100.1)     # overflow (+Inf bucket)
+    snap = h.snapshot()
+    cum = dict(snap["buckets"])
+    assert cum[1.0] == 1
+    assert cum[10.0] == 3
+    assert cum[100.0] == 4
+    assert snap["overflow"] == 1
+    assert snap["count"] == 5
+
+
+def test_exact_aggregates_survive_window_eviction():
+    h = LogHistogram(lo=1e-3, hi=10.0, window=4)
+    for i in range(100):
+        h.record(1.0)
+    assert h.count == 100
+    assert h.sum == pytest.approx(100.0)
+    assert len(h) == 4          # window bounded
+    snap = h.snapshot()
+    assert snap["count"] == 100
+    # All 100 observations are still in the bucket counts even though
+    # the window only retains the last 4.
+    assert snap["buckets"][-1][1] + snap["overflow"] == 100
+
+
+def test_deque_drop_in_surface():
+    h = LogHistogram(lo=1e-3, hi=1e3)
+    h.append(2.0)
+    h.extend([3.0, 4.0])
+    assert len(h) == 3
+    assert list(h) == [2.0, 3.0, 4.0]
+    assert h[-1] == 4.0
+    assert h[0] == 2.0
+    h.clear()
+    assert len(h) == 0
+    assert h.count == 0         # clear resets exact aggregates too
+    assert h.sum == 0.0
+
+
+def test_percentile_nearest_rank():
+    h = LogHistogram(lo=1e-3, hi=1e3)
+    for v in range(1, 101):
+        h.record(float(v))
+    # Nearest-rank over 1..100: rank round(q/100*(n-1)) → 51 and 99,
+    # the same contract PhaseTimer.percentile has had since r6.
+    assert h.percentile(50) == pytest.approx(51.0)
+    assert h.percentile(99) == pytest.approx(99.0)
+    assert LogHistogram().percentile(50) == 0.0
+
+
+def test_prom_lines_shape():
+    h = LogHistogram(lo=1.0, hi=100.0, growth=10.0)
+    for v in (0.5, 5.0, 50.0, 500.0):
+        h.record(v)
+    lines = prom_histogram_lines("x_seconds", "help text",
+                                 h.snapshot())
+    assert lines[0] == "# HELP x_seconds help text"
+    assert lines[1] == "# TYPE x_seconds histogram"
+    # Sparse cumulative buckets end with the mandatory +Inf at the
+    # TOTAL count (overflow included), then _sum/_count.
+    assert 'x_seconds_bucket{le="+Inf"} 4' in lines
+    assert any(line.startswith("x_seconds_sum ") for line in lines)
+    assert "x_seconds_count 4" in lines
+    # Cumulative counts are monotone in emission order.
+    cums = [int(line.rsplit(" ", 1)[1]) for line in lines
+            if "_bucket" in line]
+    assert cums == sorted(cums)
+
+
+def test_prom_lines_labels_and_header_suppression():
+    h = LogHistogram(lo=1.0, hi=10.0, growth=10.0)
+    h.record(2.0)
+    first = prom_histogram_lines("f", "h", h.snapshot(),
+                                 labels='phase="encode"')
+    rest = prom_histogram_lines("f", "h", h.snapshot(),
+                                labels='phase="bind"', header=False)
+    assert first[0].startswith("# HELP")
+    assert not any(line.startswith("#") for line in rest)
+    assert 'f_bucket{phase="encode",le=' in first[2]
+    assert 'f_sum{phase="bind"}' in " ".join(rest)
+
+
+def test_histogram_phase_timer_keeps_contract():
+    ht = HistogramPhaseTimer()
+    pt = PhaseTimer()
+    for t in (0.001, 0.002, 0.004, 0.008):
+        ht.record("encode", t)
+        pt.record("encode", t)
+    # Same summary and percentiles as the plain PhaseTimer.
+    assert ht.summary() == pt.summary()
+    assert ht.percentile("encode", 99) == pt.percentile("encode", 99)
+    # ...plus the ride-along histogram with the same observations.
+    assert ht.hists["encode"].count == 4
+    assert ht.hists["encode"].sum == pytest.approx(0.015)
+    ht.reset()
+    assert ht.hists == {}
+    assert ht.count("encode") == 0
+
+
+def test_concurrent_records_stay_consistent():
+    h = LogHistogram(lo=1e-6, hi=1e3, window=64)
+
+    def work():
+        for _ in range(500):
+            h.record(0.01)
+
+    threads = [threading.Thread(target=work) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert h.count == 2000
+    snap = h.snapshot()
+    assert snap["buckets"][-1][1] + snap["overflow"] == 2000

@@ -132,3 +132,44 @@ def test_cli_run_roundtrip(tmp_path):
     assert trace_check.main([str(good)]) == 0
     assert trace_check.main([str(bad)]) == 1
     assert trace_check.main([]) == 2
+
+def test_r11_slo_args_validated_when_present():
+    # The recorder's own spans carry the r11 fields with defaults
+    # (slo_burning null, outcome_ring_depth 0) and lint clean.
+    doc = _recorded_trace()
+    cyc = next(e for e in doc["traceEvents"]
+               if e.get("cat") == "cycle")
+    assert "slo_burning" in cyc["args"]
+    assert cyc["args"]["outcome_ring_depth"] == 0
+    assert trace_check.check_trace(doc) == []
+    # A burning objective name is a string: clean.
+    ok = copy.deepcopy(doc)
+    cyc = next(e for e in ok["traceEvents"]
+               if e.get("cat") == "cycle")
+    cyc["args"]["slo_burning"] = "score_p99_ms"
+    cyc["args"]["outcome_ring_depth"] = 17
+    assert trace_check.check_trace(ok) == []
+    # Wrong types fire.
+    bad = copy.deepcopy(doc)
+    cyc = next(e for e in bad["traceEvents"]
+               if e.get("cat") == "cycle")
+    cyc["args"]["slo_burning"] = 3
+    fails = trace_check.check_trace(bad)
+    assert any("slo_burning" in f for f in fails), fails
+    bad = copy.deepcopy(doc)
+    cyc = next(e for e in bad["traceEvents"]
+               if e.get("cat") == "cycle")
+    cyc["args"]["outcome_ring_depth"] = -1
+    fails = trace_check.check_trace(bad)
+    assert any("outcome_ring_depth" in f for f in fails), fails
+
+
+def test_pre_r11_traces_stay_lint_clean():
+    # A dump from before the r11 span fields (neither key present)
+    # must keep linting clean — old committed traces are history.
+    doc = _recorded_trace()
+    for ev in doc["traceEvents"]:
+        if ev.get("cat") == "cycle":
+            ev["args"].pop("slo_burning", None)
+            ev["args"].pop("outcome_ring_depth", None)
+    assert trace_check.check_trace(doc) == []

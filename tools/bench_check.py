@@ -500,6 +500,66 @@ def check_doc(path: str, doc: dict) -> list[str]:
                         f"{name}: integrity.all_faults_detected is "
                         "false — at least one injected fault class "
                         "passed the audit unseen")
+
+    # Rule 11 — outcome-observability provenance (round 11+): a
+    # headline claiming the p99 bar must prove the number was measured
+    # with the placement-quality observer riding the commit seam — a
+    # ``quality`` block from the ``bench.py --suite quality`` leg with
+    # observation enabled, its serving overhead under the 2% budget,
+    # and a NONZERO calibration sample count (a join that produced no
+    # samples measured nothing).  Round-gated by filename like Rules
+    # 8/9/10; the block's shape is validated wherever it appears.
+    if not grandfathered:
+        ns = detail.get("north_star")
+        p99_met = isinstance(ns, dict) and bool(ns.get("p99_met"))
+        qual = detail.get("quality")
+        rnd = _round_of(name)
+        if qual is None:
+            if p99_met and rnd is not None and rnd >= 11:
+                fails.append(
+                    f"{name}: north_star.p99_met without a quality "
+                    "block (round 11+ requires the --suite quality "
+                    "leg's observation-overhead + calibration "
+                    "evidence behind any claimed p99)")
+        elif not isinstance(qual, dict):
+            fails.append(f"{name}: quality is not an object")
+        else:
+            required = {"observation_enabled", "overhead_fraction",
+                        "calibration_samples"}
+            missing = required - set(qual)
+            if missing:
+                fails.append(f"{name}: quality missing "
+                             f"{sorted(missing)}")
+            else:
+                try:
+                    overhead = float(qual["overhead_fraction"])
+                    cal = int(qual["calibration_samples"])
+                except (TypeError, ValueError):
+                    fails.append(f"{name}: quality not numeric")
+                else:
+                    if not qual.get("observation_enabled"):
+                        fails.append(
+                            f"{name}: quality.observation_enabled is "
+                            "false — the leg ran without the "
+                            "observer, which is no evidence at all")
+                    if cal <= 0:
+                        fails.append(
+                            f"{name}: quality.calibration_samples="
+                            f"{cal} — the prediction/outcome join "
+                            "produced no samples, so the quality "
+                            "claim measured nothing")
+                    if p99_met and overhead >= 0.02:
+                        fails.append(
+                            f"{name}: north_star.p99_met with "
+                            f"quality.overhead_fraction={overhead} "
+                            ">= 0.02 — observation costs more than "
+                            "the 2% budget, so the claimed p99 "
+                            "excludes a real production overhead")
+                if qual.get("bit_identical") is False:
+                    fails.append(
+                        f"{name}: quality.bit_identical is false — "
+                        "observation changed placements; it must be "
+                        "a pure ride-along")
     return fails
 
 

@@ -1,0 +1,331 @@
+#!/usr/bin/env python3
+"""Offline SLO & placement-quality report from serving artifacts.
+
+The live engine (obs/slo.py) answers "are we burning RIGHT NOW" from
+in-process telemetry; after an incident — or in CI, where there is no
+live process — the same questions must be answerable from what the
+scheduler left on disk.  This tool fuses the three artifact families
+the repo already emits into ONE report:
+
+  * the decision log (``--decisions decisions.jsonl``,
+    core/checkpoint.DecisionLog): bound vs unschedulable totals
+  * a flight-recorder trace export (``--trace trace.json``,
+    /debug/trace or a crash dump): per-phase latency samples with
+    timestamps, replayed through obs/slo.py's PURE burn-rate math
+    (breach_fraction / burn_rate / is_burning — the exact functions
+    the live engine runs, so offline and live verdicts cannot drift)
+  * bench artifacts (``--bench bench_artifacts/*.json``): the
+    ``detail.quality`` blocks bench_check Rule 11 pins (observation
+    overhead, calibration sample counts, regret distribution)
+
+Latency objectives are evaluated over the trace's own time axis: the
+report's "now" is the last event's end, so a dumped trace replays the
+same multi-window burn arithmetic the engine would have run at dump
+time.  Missing inputs shrink the report (absence of telemetry is
+reported as absence, never as compliance).
+
+Usage:
+  slo_report.py --trace trace.json --decisions decisions.jsonl \
+      --bench bench_artifacts/*.json [--out report.json]
+
+Exit status: 0 when every evaluable objective is within budget, 1 when
+anything is burning or a quality bar fails, 2 on unusable input.
+``build_report(...)`` is importable for tests
+(tests/test_slo_report.py).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Any, Mapping, Sequence
+
+sys.path.insert(0, ".")  # repo-root invocation, like bench_check
+
+from kubernetesnetawarescheduler_tpu.obs.slo import (  # noqa: E402
+    breach_fraction,
+    burn_rate,
+    is_burning,
+)
+
+#: Phase name -> (objective name, default target ms).  Mirrors the
+#: live engine's value sources: score_assign feeds score_p99_ms,
+#: bind_net feeds bind_p99_ms.
+_PHASE_OBJECTIVES = {
+    "score_assign": ("score_p99_ms", 5.0),
+    "bind_net": ("bind_p99_ms", 1000.0),
+}
+
+
+def _trace_events(doc: Any) -> list[dict]:
+    """Accept both /debug/trace output and the crash-dump envelope."""
+    if isinstance(doc, dict) and isinstance(doc.get("trace"), dict):
+        doc = doc["trace"]
+    if not isinstance(doc, dict):
+        return []
+    events = doc.get("traceEvents")
+    return [e for e in events if isinstance(e, dict)] \
+        if isinstance(events, list) else []
+
+
+def _phase_samples(events: Sequence[Mapping[str, Any]]
+                   ) -> tuple[dict[str, list[tuple[float, float]]],
+                              float]:
+    """Per-phase ``(t_end_s, dur_ms)`` samples plus the trace's "now"
+    (the last event end, in seconds on the trace's own clock)."""
+    samples: dict[str, list[tuple[float, float]]] = {}
+    now = 0.0
+    for ev in events:
+        ts = ev.get("ts")
+        dur = ev.get("dur")
+        if not isinstance(ts, (int, float)) \
+                or not isinstance(dur, (int, float)):
+            continue
+        end_s = (ts + dur) / 1e6
+        now = max(now, end_s)
+        if ev.get("cat") == "phase":
+            samples.setdefault(str(ev.get("name")), []).append(
+                (end_s, dur / 1e3))
+    return samples, now
+
+
+def _latency_slo(samples: dict[str, list[tuple[float, float]]],
+                 now: float, opts: argparse.Namespace
+                 ) -> dict[str, Any]:
+    out: dict[str, Any] = {}
+    for phase, (name, default_target) in _PHASE_OBJECTIVES.items():
+        target = getattr(opts, name, None)
+        if target is None:
+            target = default_target
+        if target <= 0:  # objective disabled
+            continue
+        phase_samples = samples.get(phase)
+        if not phase_samples:
+            continue  # absence != compliance: no entry at all
+        breach = [(t, dur_ms > target) for t, dur_ms in phase_samples]
+        fast = burn_rate(breach, now, opts.fast_window_s,
+                         opts.error_budget)
+        slow = burn_rate(breach, now, opts.slow_window_s,
+                         opts.error_budget)
+        frac_fast, n_fast = breach_fraction(breach, now,
+                                            opts.fast_window_s)
+        frac_slow, n_slow = breach_fraction(breach, now,
+                                            opts.slow_window_s)
+        durs = sorted(d for _t, d in phase_samples)
+        p99 = durs[min(len(durs) - 1,
+                       int(0.99 * (len(durs) - 1) + 0.5))]
+        out[name] = {
+            "target": target,
+            "unit": "ms",
+            "error_budget": opts.error_budget,
+            "observed_p99": p99,
+            "samples": len(phase_samples),
+            "breach_fraction_fast": frac_fast,
+            "breach_fraction_slow": frac_slow,
+            "samples_fast": n_fast,
+            "samples_slow": n_slow,
+            "burn_fast": fast,
+            "burn_slow": slow,
+            "burning": is_burning(fast, slow, opts.burn_threshold),
+        }
+    return out
+
+
+def _cycles_block(events: Sequence[Mapping[str, Any]]
+                  ) -> dict[str, Any]:
+    durs_ms: list[float] = []
+    burning_cycles = 0
+    tagged: dict[str, int] = {}
+    ring_depth_max = 0
+    for ev in events:
+        if ev.get("cat") != "cycle":
+            continue
+        dur = ev.get("dur")
+        if isinstance(dur, (int, float)):
+            durs_ms.append(dur / 1e3)
+        args = ev.get("args") or {}
+        slo = args.get("slo_burning")
+        if isinstance(slo, str) and slo:
+            burning_cycles += 1
+            tagged[slo] = tagged.get(slo, 0) + 1
+        depth = args.get("outcome_ring_depth")
+        if isinstance(depth, int):
+            ring_depth_max = max(ring_depth_max, depth)
+    durs_ms.sort()
+
+    def pct(q: float) -> float | None:
+        if not durs_ms:
+            return None
+        return durs_ms[min(len(durs_ms) - 1,
+                           int(q / 100 * (len(durs_ms) - 1) + 0.5))]
+
+    return {
+        "count": len(durs_ms),
+        "dur_p50_ms": pct(50),
+        "dur_p99_ms": pct(99),
+        "slo_burning_cycles": burning_cycles,
+        "slo_burning_by_objective": tagged,
+        "outcome_ring_depth_max": ring_depth_max,
+    }
+
+
+def _quality_block(bench_docs: Mapping[str, Mapping[str, Any]],
+                   opts: argparse.Namespace
+                   ) -> tuple[dict[str, Any], list[str]]:
+    """Aggregate ``detail.quality`` blocks across bench artifacts and
+    evaluate the quality bars (the offline mirror of bench_check
+    Rule 11 + the regret-ceiling objective)."""
+    per_artifact: dict[str, Any] = {}
+    failures: list[str] = []
+    for name, doc in sorted(bench_docs.items()):
+        detail = doc.get("detail") if isinstance(doc, dict) else None
+        q = detail.get("quality") if isinstance(detail, dict) else None
+        if not isinstance(q, dict) and isinstance(detail, dict) \
+                and "observation_enabled" in detail \
+                and "overhead_fraction" in detail:
+            # The --suite quality artifact IS the quality block
+            # (fields live directly in detail); headline docs nest it
+            # under detail.quality.
+            q = detail
+        if not isinstance(q, dict):
+            continue
+        per_artifact[name] = dict(q)
+        overhead = q.get("overhead_fraction")
+        if isinstance(overhead, (int, float)) \
+                and overhead >= opts.overhead_ceiling:
+            failures.append(
+                f"{name}: observation overhead {overhead:.4f} >= "
+                f"ceiling {opts.overhead_ceiling}")
+        cal = q.get("calibration_samples")
+        if isinstance(cal, (int, float)) and cal <= 0:
+            failures.append(f"{name}: zero calibration samples "
+                            "(observation ran blind)")
+        if q.get("bit_identical") is False:
+            failures.append(f"{name}: observation CHANGED placements "
+                            "(bit_identical false)")
+        regret = q.get("regret_p99")
+        if isinstance(regret, (int, float)) \
+                and opts.regret_ceiling > 0 \
+                and regret > opts.regret_ceiling:
+            failures.append(
+                f"{name}: regret p99 {regret:.4f} > ceiling "
+                f"{opts.regret_ceiling}")
+    return per_artifact, failures
+
+
+def build_report(trace_doc: Any = None,
+                 decisions: Sequence[Mapping[str, Any]] = (),
+                 bench_docs: Mapping[str, Mapping[str, Any]] = {},
+                 opts: argparse.Namespace | None = None
+                 ) -> dict[str, Any]:
+    """Pure fusion: artifacts in, one report dict out."""
+    if opts is None:
+        opts = parse_args([])
+    events = _trace_events(trace_doc) if trace_doc is not None else []
+    samples, now = _phase_samples(events)
+    slo = _latency_slo(samples, now, opts)
+    quality, q_failures = _quality_block(bench_docs, opts)
+
+    bound = sum(1 for d in decisions if d.get("node"))
+    unsched = sum(1 for d in decisions if not d.get("node"))
+
+    burning = sorted(name for name, obj in slo.items()
+                     if obj["burning"])
+    failures = [f"objective {name} burning (fast "
+                f"{slo[name]['burn_fast']:.2f}x / slow "
+                f"{slo[name]['burn_slow']:.2f}x budget)"
+                for name in burning] + q_failures
+    return {
+        "generated_from": {
+            "trace_events": len(events),
+            "decisions": len(decisions),
+            "bench_artifacts": sorted(bench_docs),
+        },
+        "windows": {
+            "fast_s": opts.fast_window_s,
+            "slow_s": opts.slow_window_s,
+            "burn_threshold": opts.burn_threshold,
+            "error_budget": opts.error_budget,
+        },
+        "slo": slo,
+        "burning": burning,
+        "decisions": {"bound": bound, "unschedulable": unsched},
+        "cycles": _cycles_block(events),
+        "quality": quality,
+        "failures": failures,
+        "ok": not failures,
+    }
+
+
+def _load_decisions(path: str) -> list[dict]:
+    out = []
+    with open(path, encoding="utf-8") as fh:
+        for line in fh:
+            if line.strip():
+                out.append(json.loads(line))
+    return out
+
+
+def parse_args(argv: Sequence[str]) -> argparse.Namespace:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--trace", help="flight-recorder trace JSON "
+                    "(/debug/trace or crash dump)")
+    ap.add_argument("--decisions", help="decision log (jsonl)")
+    ap.add_argument("--bench", nargs="*", default=[],
+                    help="bench artifact JSON files")
+    ap.add_argument("--out", help="write the report here instead of "
+                    "stdout")
+    ap.add_argument("--score-p99-ms", dest="score_p99_ms",
+                    type=float, default=5.0)
+    ap.add_argument("--bind-p99-ms", dest="bind_p99_ms",
+                    type=float, default=1000.0)
+    ap.add_argument("--error-budget", type=float, default=0.01)
+    ap.add_argument("--fast-window-s", type=float, default=300.0)
+    ap.add_argument("--slow-window-s", type=float, default=3600.0)
+    ap.add_argument("--burn-threshold", type=float, default=1.0)
+    ap.add_argument("--overhead-ceiling", type=float, default=0.02)
+    # Regret is in score units, whose scale depends on the workload
+    # and the configured weights — there is no universal ceiling, so
+    # the offline check is opt-in (0 disables; the LIVE objective uses
+    # cfg.slo_regret_ceiling, tuned alongside the weights).
+    ap.add_argument("--regret-ceiling", type=float, default=0.0)
+    return ap.parse_args(argv)
+
+
+def main(argv: Sequence[str]) -> int:
+    opts = parse_args(list(argv))
+    if not (opts.trace or opts.decisions or opts.bench):
+        print("need at least one of --trace / --decisions / --bench",
+              file=sys.stderr)
+        return 2
+    trace_doc = None
+    decisions: list[dict] = []
+    bench_docs: dict[str, dict] = {}
+    try:
+        if opts.trace:
+            with open(opts.trace, encoding="utf-8") as fh:
+                trace_doc = json.load(fh)
+        if opts.decisions:
+            decisions = _load_decisions(opts.decisions)
+        for path in opts.bench:
+            with open(path, encoding="utf-8") as fh:
+                bench_docs[path] = json.load(fh)
+    except (OSError, ValueError) as exc:
+        print(f"unusable input: {exc}", file=sys.stderr)
+        return 2
+    report = build_report(trace_doc, decisions, bench_docs, opts)
+    body = json.dumps(report, indent=2, sort_keys=True)
+    if opts.out:
+        with open(opts.out, "w", encoding="utf-8") as fh:
+            fh.write(body + "\n")
+    else:
+        print(body)
+    if not report["ok"]:
+        for f in report["failures"]:
+            print(f"FAIL {f}", file=sys.stderr)
+    return 0 if report["ok"] else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv[1:]))

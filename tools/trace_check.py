@@ -20,6 +20,10 @@ Checks:
   * fused-step accounting — cycle spans carrying the r9 args
     (``rounds``/``donated``/``donation_skipped``) must be
     non-negative integers
+  * outcome observability — cycle spans carrying the r11 args must
+    have a non-negative integer ``outcome_ring_depth`` and a
+    null-or-string ``slo_burning`` (pre-r11 dumps carry neither
+    and stay clean)
 
 A cycle's phase set is NOT prescribed: the r9 fused single-dispatch
 step collapses score+assign+commit into one ``score_assign`` phase
@@ -101,12 +105,20 @@ def check_trace(doc: Any) -> list[str]:
                            (key, args.get("cycle_id"))))
             # r9 fused-step accounting, validated only when present
             # (pre-r9 dumps carry none of these and stay clean).
-            for k in ("rounds", "donated", "donation_skipped"):
+            for k in ("rounds", "donated", "donation_skipped",
+                      "outcome_ring_depth"):
                 v = args.get(k)
                 if v is not None and (not isinstance(v, int)
                                       or v < 0):
                     fails.append(f"event[{i}] ({ev.get('name')}) "
                                  f"args.{k} invalid: {v!r}")
+            # r11 SLO tagging: null (nothing burning, or pre-r11
+            # dump) or the name of a burning objective.
+            if "slo_burning" in args:
+                v = args["slo_burning"]
+                if v is not None and not isinstance(v, str):
+                    fails.append(f"event[{i}] ({ev.get('name')}) "
+                                 f"args.slo_burning invalid: {v!r}")
         elif cat == "phase":
             phases.append((ts, ts + dur, i,
                            (key, args.get("cycle_id"))))
