@@ -235,6 +235,34 @@ def _persisted_quality() -> dict | None:
         return None
 
 
+def _persisted_rebalance() -> dict | None:
+    """The ``--suite rebalance`` leg's artifact
+    (bench_artifacts/rebalance.json), compressed to the block r12+
+    density artifacts must carry when claiming the p99 bar
+    (tools/bench_check Rule 12): rebalancer enabled, zero half-moved
+    gangs, and disruption (evictions/pod/hour) beside the configured
+    budget.  None when the leg has not run in this tree."""
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "bench_artifacts", "rebalance.json")
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+        d = doc["detail"]
+        return {
+            "enabled": bool(d["rebalance_enabled"]),
+            "half_moved_gangs": int(d["half_moved_gangs"]),
+            "evictions_per_pod_hour": float(
+                d["evictions_per_pod_hour"]),
+            "budget_per_pod_hour": float(d["budget_per_pod_hour"]),
+            "recovered_frac": float(d.get("recovered_frac", 0.0)),
+            "no_drift_moves": int(d.get("no_drift_moves", 0)),
+            "moves": int(d.get("moves", 0)),
+            "source": "suite_rebalance",
+        }
+    except (OSError, ValueError, KeyError, TypeError):
+        return None
+
+
 def _mark_driver_active():
     """Touch driver.intent and take chip.lock so the round-long
     watcher yields the single-owner chip to this run (it re-checks the
@@ -474,6 +502,13 @@ def _assemble_doc(res, *, num_nodes: int, batch: int, method: str,
         # join actually producing calibration samples (--suite
         # quality leg).
         detail["quality"] = qual
+    reb = _persisted_rebalance()
+    if reb is not None:
+        # Continuous-rebalancing provenance (r12, bench_check Rule
+        # 12): the p99 claim only counts alongside proof that the
+        # descheduler kept disruption inside its eviction budget and
+        # never stranded a half-moved gang (--suite rebalance leg).
+        detail["rebalance"] = reb
     if device_lat is not None:
         detail.update({
             "score_p50_ms": device_lat["p50_ms"],
@@ -738,6 +773,36 @@ def _run_suite_bench(name: str) -> None:
                        "calibration residuals")
         if bad:
             print("WARNING: quality bars unmet: " + "; ".join(bad),
+                  file=sys.stderr)
+            sys.exit(1)
+    if name == "rebalance":
+        detail = res.metrics.get("detail", {})
+        # Structural bars hold at every shape: hysteresis quiet on a
+        # healthy cluster, disruption inside the budget, zero
+        # half-moved gangs.  The recovery fraction is a full-shape
+        # property (small shapes under-fragment), so only full runs
+        # are held to >= 0.6.
+        bad = []
+        if detail.get("half_moved_gangs", 1) != 0:
+            bad.append("half_moved_gangs="
+                       f"{detail.get('half_moved_gangs')}")
+        if detail.get("no_drift_moves", 1) != 0:
+            bad.append("hysteresis failed to hold: "
+                       f"{detail.get('no_drift_moves')} moves on a "
+                       "healthy cluster")
+        if not detail.get("no_drift_bit_identical"):
+            bad.append("idle rebalancer CHANGED placements")
+        if (detail.get("evictions_per_pod_hour", 1e9)
+                > detail.get("budget_per_pod_hour", 0.0)):
+            bad.append("disruption "
+                       f"{detail.get('evictions_per_pod_hour')} over "
+                       f"budget {detail.get('budget_per_pod_hour')}")
+        if not small and detail.get("recovered_frac", 0.0) < 0.6:
+            bad.append("recovered "
+                       f"{detail.get('recovered_frac')} < 0.6 of "
+                       "oracle bandwidth gain")
+        if bad:
+            print("WARNING: rebalance bars unmet: " + "; ".join(bad),
                   file=sys.stderr)
             sys.exit(1)
 

@@ -599,6 +599,17 @@ class ExtenderHandlers:
                 "quality": (quality.summary() if quality is not None
                             else {"enabled": False}),
             })
+        if path == "/debug/rebalance":
+            # The descheduler's full state: scan/candidate/move
+            # counters, the skip breakdown (which hysteresis gate or
+            # budget held each candidate back), trigger attribution
+            # and the live in-flight ledger depth — the first stop of
+            # the "responding to a rebalance storm" runbook
+            # (docs/OPERATIONS.md).
+            rb = getattr(self._loop, "rebalance", None)
+            return self._json(
+                rb.summary() if rb is not None
+                else {"enabled": False})
         raise ValueError(f"unknown op {path!r}")
 
     def readyz(self) -> dict:

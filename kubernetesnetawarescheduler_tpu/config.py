@@ -442,6 +442,23 @@ class SchedulerConfig:
     slo_burn_threshold: float = 1.0
     slo_eval_interval_s: float = 5.0
 
+    # Continuous rebalancing (core/rebalance.py): a budgeted
+    # descheduler that revisits bound pods at maintain cadence,
+    # scores current placement vs best feasible alternative on
+    # device, and live-migrates the worst offenders through the
+    # crash-safe migration ledger.  Hysteresis (minimum relative
+    # gain, minimum placement age, per-pod move cooldown) keeps a
+    # healthy cluster quiet; the eviction budget and per-group
+    # disruption limits bound the blast radius of a storm.
+    enable_rebalance: bool = False
+    rebalance_interval_s: float = 15.0
+    rebalance_min_gain: float = 0.05
+    rebalance_min_age_s: float = 60.0
+    rebalance_cooldown_s: float = 300.0
+    rebalance_max_moves_per_cycle: int = 4
+    rebalance_evictions_per_hour: float = 60.0
+    rebalance_move_timeout_s: float = 120.0
+
     def __post_init__(self) -> None:
         if self.max_nodes <= 0 or self.max_pods <= 0 or self.max_peers <= 0:
             raise ValueError("shape limits must be positive")
@@ -526,6 +543,22 @@ class SchedulerConfig:
             raise ValueError("slo_burn_threshold must be > 0")
         if self.slo_eval_interval_s <= 0:
             raise ValueError("slo_eval_interval_s must be > 0")
+        if self.rebalance_interval_s <= 0:
+            raise ValueError("rebalance_interval_s must be > 0")
+        if self.rebalance_min_gain < 0:
+            raise ValueError("rebalance_min_gain must be >= 0")
+        if self.rebalance_min_age_s < 0:
+            raise ValueError("rebalance_min_age_s must be >= 0")
+        if self.rebalance_cooldown_s < 0:
+            raise ValueError("rebalance_cooldown_s must be >= 0")
+        if self.rebalance_max_moves_per_cycle < 0:
+            raise ValueError(
+                "rebalance_max_moves_per_cycle must be >= 0")
+        if self.rebalance_evictions_per_hour < 0:
+            raise ValueError(
+                "rebalance_evictions_per_hour must be >= 0")
+        if self.rebalance_move_timeout_s <= 0:
+            raise ValueError("rebalance_move_timeout_s must be > 0")
 
 
 # ---------------------------------------------------------------------------

@@ -301,6 +301,16 @@ def save_checkpoint(path: str, encoder: Encoder) -> None:
             "gangs_inflight": {
                 key: [list(e) for e in entries]
                 for key, entries in encoder._inflight_gangs.items()},
+            # Live migrations inside their evict->rebind window
+            # (core/rebalance.py): restore rolls back the TARGET
+            # commits of every member so a crashed move lands
+            # fully-reverted, never half-evicted.  Optional key, read
+            # via .get: no format bump needed, pre-r12 checkpoints
+            # load unchanged.
+            "migrations_inflight": {
+                key: [list(e) for e in entries]
+                for key, entries in
+                encoder._inflight_migrations.items()},
             # Zone interner (topology-spread domains).
             "zones": dict(encoder._zone_index),
             # Numeric-label columns (v5): Gt/Lt key -> column of
@@ -377,10 +387,14 @@ def save_checkpoint(path: str, encoder: Encoder) -> None:
 
 
 def load_checkpoint(path: str,
-                    cfg: SchedulerConfig | None = None) -> Encoder:
+                    cfg: SchedulerConfig | None = None,
+                    settle_inflight: bool = True) -> Encoder:
     """Reconstruct an :class:`Encoder` from :func:`save_checkpoint`
     output.  ``cfg`` overrides the checkpointed config (shapes must
-    match the stored arrays).
+    match the stored arrays).  ``settle_inflight=False`` skips the
+    gang/migration rollback passes and restores the ledger EXACTLY as
+    written — the offline auditor's pristine read (a restore that will
+    actually serve must keep the default and settle).
 
     Restore resolves through the r10 MANIFEST: a committed set whose
     digests verify loads as-is; a torn/corrupted set falls back to the
@@ -521,8 +535,21 @@ def load_checkpoint(path: str,
     # rebuilt, so _release_record reverses them consistently).  The
     # members' pods are still Pending on the API server and re-arrive
     # through the informer's initial resync to re-gate.
-    for key, entries in meta.get("gangs_inflight", {}).items():
-        enc.rollback_gang_members(e[0] for e in entries)
+    if settle_inflight:
+        for key, entries in meta.get("gangs_inflight", {}).items():
+            enc.rollback_gang_members(e[0] for e in entries)
+    # Live migrations inside their evict->rebind window (optional
+    # key, pre-r12 checkpoints carry none): the move's outcome is
+    # unknown, so revert it whole — pop every member's TARGET commit
+    # (the rebalancer pins the target before eviction completes) and
+    # let the informer resync re-place the gang as a unit.  Either
+    # every member re-binds (the move had already completed and the
+    # members are Bound — rollback then strands nothing because
+    # resync re-commits from the API server's truth) or none do;
+    # never a half-moved gang (tests/test_rebalance.py chaos drill).
+    if settle_inflight:
+        for key, entries in meta.get("migrations_inflight", {}).items():
+            enc.rollback_gang_members(e[0] for e in entries)
     # Learned topology model: restore beside the encoder when the
     # config wants one and the checkpoint carries it.  A shape mismatch
     # (dims/rank/max_nodes changed) starts the model fresh rather than

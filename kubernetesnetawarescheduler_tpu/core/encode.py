@@ -395,6 +395,10 @@ class Encoder:
         # gang back deterministically on restore (no member of a gang
         # may survive in the ledger without the rest).
         self._inflight_gangs: dict[str, list[list]] = {}
+        # Live-migration ledger (core/rebalance.py): moves staged
+        # between evict and re-bind, persisted by checkpoints so a
+        # crash mid-move restores fully-moved-or-fully-reverted.
+        self._inflight_migrations: dict[str, list[list]] = {}
 
         # Nominations (kube's nominatedNodeName analog): a preemptor
         # whose victims are terminating holds a capacity reservation on
@@ -815,6 +819,30 @@ class Encoder:
                     self._mark_rows("alloc", rec.node)
                     n += 1
         return n
+
+    def note_migration_inflight(self, move_key: str,
+                                entries: list[list]) -> None:
+        """Record a live migration entering its evict->rebind window
+        (entries: ``[uid, namespace, name, from_node, to_node]`` per
+        member).  A checkpoint taken inside the window persists this
+        so restore rolls ALL members back — the move becomes
+        fully-reverted rather than half-evicted (the rebalancer's
+        all-or-nothing contract, tests/test_rebalance.py)."""
+        with self._lock:
+            self._inflight_migrations[move_key] = [
+                list(e) for e in entries]
+
+    def clear_migration_inflight(self, move_key: str) -> None:
+        """The move resolved (every member re-bound, or reverted)."""
+        with self._lock:
+            self._inflight_migrations.pop(move_key, None)
+
+    def migrations_inflight(self) -> dict[str, list[list]]:
+        """Snapshot of the live-migration ledger (deep copy; the
+        checkpoint writer and tools/state_audit.py read this)."""
+        with self._lock:
+            return {k: [list(e) for e in v]
+                    for k, v in self._inflight_migrations.items()}
 
     def gang_members(self, gang_key: str) -> list[tuple[str, "CommitRecord"]]:
         """Committed ledger entries belonging to one gang (by the

@@ -192,6 +192,21 @@ class SchedulerLoop:
             self.slo = None
         self._slo_last_eval = 0.0
         self._quality_last_harvest = 0.0
+        # Continuous rebalancing (core/rebalance.py, ISSUE 12): the
+        # budgeted descheduler acts on the degradation signals the
+        # observers above only measure.  Off by default; with budget 0
+        # or the flag off, placements are bit-identical to no
+        # rebalancer at all (tests/test_rebalance.py).
+        if cfg.enable_rebalance:
+            from kubernetesnetawarescheduler_tpu.core.rebalance import (
+                Rebalancer,
+            )
+
+            self.rebalance: "Rebalancer | None" = Rebalancer(
+                cfg, self.encoder, self.client)
+        else:
+            self.rebalance = None
+        self._rebalance_last = (0, 0)
         # One-shot span tag set by StateChaosInjector._record: the
         # next committed cycle span carries the injected fault class,
         # so a trace reader sees WHICH cycle first ran on corrupted
@@ -624,6 +639,17 @@ class SchedulerLoop:
         fault = (f"state_{state_fault}" if state_fault
                  else "apiserver_brownout" if degraded
                  else "watch_gap" if self._relist_needed else None)
+        # Rebalance accounting: cumulative counters turned into
+        # per-span deltas (the descheduler runs on the maintain path,
+        # so a span carries whatever moved since the previous span).
+        rb_moves = rb_reverts = 0
+        if self.rebalance is not None:
+            mt = int(self.rebalance.moves_total)
+            rt = int(self.rebalance.moves_reverted)
+            last_mt, last_rt = self._rebalance_last
+            self._rebalance_last = (mt, rt)
+            rb_moves = max(mt - last_mt, 0)
+            rb_reverts = max(rt - last_rt, 0)
         # Cap the per-span uid list: a whole-workload bench drain can
         # retire tens of thousands of pods in one span, and the ring
         # holds `capacity` spans — n_pods still carries the true count.
@@ -649,6 +675,8 @@ class SchedulerLoop:
             slo_burning=slo_burning,
             outcome_ring_depth=(self.quality.ring_depth()
                                 if self.quality is not None else 0),
+            rebalance_moves=rb_moves,
+            rebalance_reverts=rb_reverts,
         )
         self.flight.commit(span)
 
@@ -2187,6 +2215,16 @@ class SchedulerLoop:
                 self._slo_last_eval = time.monotonic()
                 self.slo.evaluate(self)
             except Exception:  # noqa: BLE001 — observation only
+                pass
+        # Continuous rebalancing: settle in-flight moves, then scan
+        # for improvement candidates and execute within budget.  The
+        # rebalancer owns its own interval gate; a failure here must
+        # never break the maintain path (moves are crash-safe by the
+        # migration ledger, so a half-executed tick is recoverable).
+        if self.rebalance is not None:
+            try:
+                self.rebalance.tick(self)
+            except Exception:  # noqa: BLE001 — retried next tick
                 pass
 
     def _flush_preemption_waits(self) -> None:

@@ -513,15 +513,29 @@ def execute_preemption(client, encoder: Encoder,
     other deletion takes.  The loop holds the preemptor until those
     confirmations land (see SchedulerLoop._try_preempt).  Returns the
     victims actually deleted."""
+    return evict_as_unit(client, encoder, plan.victims,
+                         grace_seconds=grace_seconds)
+
+
+def evict_as_unit(client, encoder: Encoder,
+                  victims: Sequence[Victim],
+                  grace_seconds: int | None = None
+                  ) -> Sequence[Victim]:
+    """Evict a set of pods as one unit — the shared eviction primitive
+    of preemption (victim sets) and the rebalancer (live-migration
+    member sets, core/rebalance.py).  Best-effort per pod; callers
+    that need all-or-nothing compare ``len(returned)`` against
+    ``len(victims)`` and compensate (the rebalancer reverts the move
+    and re-adds the already-deleted members)."""
     done = []
-    for v in plan.victims:
+    for v in victims:
         try:
             client.delete_pod(v.name, namespace=v.namespace,
                               grace_seconds=grace_seconds)
-            # Planner-side bookkeeping: this victim is no longer live
+            # Planner-side bookkeeping: this pod is no longer live
             # (PDB accounting) nor re-evictable while it terminates.
             encoder.mark_terminating(v.uid)
             done.append(v)
-        except Exception:  # noqa: BLE001 — best-effort per victim
+        except Exception:  # noqa: BLE001 — best-effort per pod
             continue
     return done

@@ -1,0 +1,593 @@
+"""Continuous rebalancing: a budgeted, crash-safe live-migration
+descheduler.
+
+Placement was one-shot before r12: once a pod bound, it kept its node
+forever while links degraded underneath it (netmodel's residual
+monitor and the ingest quarantine already *detect* that — r11's
+QualityObserver even measures the resulting regret — but nothing ever
+*acted*).  This module closes the loop:
+
+- :meth:`Rebalancer.tick` runs at maintain cadence on all four loop
+  paths.  It first settles in-flight moves (completion / timeout
+  revert), then scans every bound pod ON DEVICE: one vmapped+jitted
+  reduction computes each pod's current-placement net score against
+  the best feasible alternative node, reusing
+  :func:`core.score.net_desirability` (same normalization, same
+  loopback pin the scorer optimized) and the winner tie-break
+  contract of :func:`core.score.winner_from_scores` (lowest index of
+  the max — candidate targets are bit-identical with what a fresh
+  schedule of the pod would pick under the frozen snapshot).
+- Candidates pass through hysteresis — minimum relative gain, minimum
+  placement age (CommitRecord.stamp), per-pod move cooldown — so a
+  healthy cluster stays quiet, plus trigger inputs that make a sick
+  one loud: LinkDegraded/LinkQuarantined streaks (serve.py feeds the
+  structured ``(src, dst, reason, streak)`` Event payload back in),
+  QualityObserver outcome-ring regret over the SLO ceiling, and node
+  drain (current node no longer valid) which bypasses the gain bar
+  entirely.
+- Execution is bounded by an explicit eviction budget
+  (``rebalance_evictions_per_hour`` sliding window +
+  ``rebalance_max_moves_per_cycle``) and PDB-style per-group
+  disruption limits (CommitRecord.pdb_min live-member floors, the
+  same accounting the preemption planner enforces).
+- Every move is staged in the encoder's migration ledger
+  (``note_migration_inflight``) BEFORE the first eviction and cleared
+  only when every member is re-bound.  Checkpoints persist the ledger
+  (``migrations_inflight`` in meta, riding the MANIFEST protocol), and
+  restore rolls back every staged member — so a crash mid-move lands
+  fully-moved or fully-reverted, never a half-evicted gang
+  (tests/test_rebalance.py proves it with state_chaos drills).
+
+Move mechanics (the API server cannot rebind a bound pod):
+a single-pod move = stage ledger -> evict (the deletion fans through
+the client's pod-deleted signal, releasing old usage exactly once,
+same path as preemption) -> pin the target by committing the pod at
+the new node -> re-add the cleared pod; when it re-arrives Pending,
+``SchedulerLoop._redirect_committed`` redirects its bind to the
+ledger's pinned node — the exact mechanism checkpoint restore already
+uses.  A gang moves as a unit: all members staged, all evicted
+(preempt's evict-as-a-unit reuse), all re-added; the gang path's
+atomic assume-all/bind_gang/rollback seam re-places them jointly
+all-or-nothing.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import time
+from typing import Any
+
+import numpy as np
+
+from kubernetesnetawarescheduler_tpu.config import SchedulerConfig
+from kubernetesnetawarescheduler_tpu.k8s.types import Pod
+
+__all__ = ["Rebalancer"]
+
+_EPS = 1e-9
+
+
+def _round_pow2(n: int, floor: int = 8) -> int:
+    out = floor
+    while out < n:
+        out *= 2
+    return out
+
+
+def _scan(lat, bw, valid, free, chosen, peers, traffic, req,
+          w_bw, w_lat):
+    """Device-side improvement scan, vmapped over the bound-pod batch.
+
+    Inputs: staging planes ``lat/bw f32[N, N]``, ``valid bool[N]``,
+    ``free f32[N, R]`` (capacity - used); per-pod ``chosen i32[B]``,
+    ``peers i32[B, K]`` (-1 = empty), ``traffic f32[B, K]``, ``req
+    f32[B, R]``; traced scalar score weights.  Returns ``(mine f32[B],
+    best f32[B], target i32[B])`` where ``target`` follows the
+    winner_from_scores tie-break (-1 = no feasible node at all)."""
+    import jax
+    import jax.numpy as jnp
+
+    from kubernetesnetawarescheduler_tpu.core.score import (
+        NEG_INF,
+        net_desirability,
+        winner_from_scores,
+    )
+
+    c = net_desirability(lat, bw, valid, w_bw, w_lat)
+
+    def one(ch, pk, tk, rq):
+        m = pk >= 0
+        safe = jnp.where(m, pk, 0)
+        w = jnp.where(m, tk, 0.0)
+        # Net score of EVERY node against this pod's peers — the same
+        # reduction network_scores does per candidate at decision
+        # time, under the frozen desirability matrix.
+        cost = jnp.sum(c[:, safe] * w[None, :], axis=1)        # [N]
+        # A candidate must be valid and fit the pod's request; the
+        # CURRENT node is exempt from the fit check (its free already
+        # excludes this pod's own usage).
+        cols = jnp.arange(cost.shape[0], dtype=jnp.int32)
+        fits = jnp.all(free >= rq[None, :], axis=1) | (cols == ch)
+        scores = jnp.where(valid & fits, cost, NEG_INF)
+        return cost[ch], scores
+
+    mine, scores = jax.vmap(one)(chosen, peers, traffic, req)
+    best, target = winner_from_scores(scores)
+    return mine, best, target
+
+
+# Module-level jit cache shared by every rebalancer (bench warmups on
+# a throwaway instance warm the executable the measured one hits).
+_SCAN_JIT = None
+
+
+@dataclasses.dataclass
+class _Move:
+    """One staged live migration (single pod, or a whole gang)."""
+
+    key: str
+    gang_key: str                     # "" = single-pod move
+    members: list[list]               # [uid, ns, name, from, to] each
+    deadline: float                   # monotonic revert deadline
+    trigger: str                      # gain | link | regret | drain
+    gain: float
+
+
+class Rebalancer:
+    """Budgeted descheduler over the encoder's committed ledger.
+
+    Single-threaded by construction: ``tick`` runs on the maintain
+    path of whichever loop variant owns the encoder, and the trigger
+    feeds (``note_link_event``) only append to a lock-free dict of
+    floats — worst case a racing scan reads a slightly stale trigger.
+    """
+
+    def __init__(self, cfg: SchedulerConfig, encoder, client) -> None:
+        self.cfg = cfg
+        self.encoder = encoder
+        self.client = client
+        self._seq = 0
+        self._inflight: dict[str, _Move] = {}
+        self._last_move: dict[str, float] = {}      # uid -> monotonic
+        self._evictions: collections.deque[float] = collections.deque()
+        # Trigger feeds: node name -> (monotonic stamp, reason).
+        self._hot_nodes: dict[str, tuple[float, str]] = {}
+        self._last_tick = 0.0
+        # Counters (exact; selfmetrics/debug/bench read these).
+        self.scans_total = 0
+        self.candidates_total = 0
+        self.moves_total = 0
+        self.pods_evicted_total = 0
+        self.moves_completed = 0
+        self.moves_reverted = 0
+        self.half_moved_gangs = 0
+        self.skipped_gain = 0
+        self.skipped_age = 0
+        self.skipped_cooldown = 0
+        self.skipped_budget = 0
+        self.skipped_disruption = 0
+        self.triggers_link = 0
+        self.triggers_regret = 0
+        self.triggers_drain = 0
+        self.last_scan_pods = 0
+        self.last_scan_candidates = 0
+        self.last_scan_moves = 0
+
+    # -- trigger feeds ----------------------------------------------
+
+    def note_link_event(self, src: str, dst: str, reason: str,
+                        streak: int = 1) -> None:
+        """Feed a LinkDegraded/LinkQuarantined Event's structured
+        payload back in: pods currently placed on either endpoint get
+        trigger priority (and a relaxed gain bar) at the next scan."""
+        now = time.monotonic()
+        for node in (src, dst):
+            if node:
+                self._hot_nodes[node] = (now, reason)
+
+    def _node_hot(self, node: str, now: float) -> bool:
+        entry = self._hot_nodes.get(node)
+        if entry is None:
+            return False
+        # Trigger heat decays after two scan intervals (a link that
+        # stopped degrading stops forcing moves), floored at 30s so a
+        # fast-ticking deployment doesn't expire the evidence between
+        # the Event arriving and the very next scan.
+        ttl = max(2.0 * self.cfg.rebalance_interval_s, 30.0)
+        if now - entry[0] > ttl:
+            del self._hot_nodes[node]
+            return False
+        return True
+
+    # -- the maintain-cadence entry point ---------------------------
+
+    def tick(self, loop, now: float | None = None) -> int:
+        """Settle in-flight moves, scan, execute.  Returns the number
+        of moves EXECUTED this tick (0 on a quiet cluster)."""
+        now = time.monotonic() if now is None else now
+        if now - self._last_tick < self.cfg.rebalance_interval_s:
+            return 0
+        self._last_tick = now
+        self._settle(now)
+        if self.cfg.rebalance_max_moves_per_cycle == 0:
+            # Budget 0 is a complete no-op (tests pin bit-identical
+            # placements): no scan, no device work, no Events.
+            return 0
+        return self._scan_and_move(loop, now)
+
+    # -- in-flight settlement ---------------------------------------
+
+    def _settle(self, now: float) -> None:
+        """Completion / timeout pass over staged moves.  A move
+        completes when every member is bound again (the gang seam
+        guarantees all-or-nothing, so mixed states are transient); a
+        timed-out move is reverted: unbound members' target pins are
+        rolled back so the pods re-place freely, and the ledger entry
+        clears either way."""
+        enc, client = self.encoder, self.client
+        for key, mv in list(self._inflight.items()):
+            bound = []
+            for uid, _ns, name, _frm, _to in mv.members:
+                try:
+                    bound.append(bool(client.node_of(name)))
+                except KeyError:
+                    bound.append(False)
+            if all(bound):
+                enc.clear_migration_inflight(key)
+                del self._inflight[key]
+                self.moves_completed += 1
+                continue
+            if now < mv.deadline:
+                continue
+            # Timeout revert.  A gang observed part-bound at its
+            # deadline is exactly the half-moved state the ledger
+            # exists to prevent — count it loudly (the chaos drill
+            # asserts this stays 0) and roll the unbound rest back.
+            if mv.gang_key and any(bound) and not all(bound):
+                self.half_moved_gangs += 1
+            unbound = [m[0] for m, b in zip(mv.members, bound)
+                       if not b]
+            enc.rollback_gang_members(unbound)
+            enc.clear_migration_inflight(key)
+            del self._inflight[key]
+            self.moves_reverted += 1
+
+    # -- scan --------------------------------------------------------
+
+    def _scan_and_move(self, loop, now: float) -> int:
+        enc = self.encoder
+        inflight_uids = {m[0] for mv in self._inflight.values()
+                         for m in mv.members}
+        pods_all = self.client.list_all_pods() or []
+        rows: list[tuple[Pod, Any, int]] = []   # (pod, rec, node_idx)
+        with enc._lock:
+            committed = dict(enc._committed)
+        for pod in pods_all:
+            if not pod.node_name or pod.uid in inflight_uids:
+                continue
+            rec = committed.get(pod.uid)
+            if rec is None:
+                continue
+            idx = enc.node_slot(pod.node_name)
+            if idx is None or idx != rec.node:
+                continue
+            rows.append((pod, rec, int(idx)))
+        self.scans_total += 1
+        self.last_scan_pods = len(rows)
+        self.last_scan_candidates = 0
+        self.last_scan_moves = 0
+        if not rows:
+            return 0
+
+        with enc._lock:
+            lat = np.array(enc._lat, dtype=np.float32)
+            bw = np.array(enc._bw, dtype=np.float32)
+            valid = np.array(enc._node_valid, dtype=bool)
+            free = np.maximum(
+                enc._cap - enc._used, 0.0).astype(np.float32)
+
+        b = len(rows)
+        bpad = _round_pow2(b)
+        k = self.cfg.max_peers
+        r = free.shape[1]
+        chosen = np.zeros((bpad,), np.int32)
+        peers = np.full((bpad, k), -1, np.int32)
+        traffic = np.zeros((bpad, k), np.float32)
+        req = np.zeros((bpad, r), np.float32)
+        for i, (pod, rec, idx) in enumerate(rows):
+            chosen[i] = idx
+            req[i, :] = rec.req
+            kk = 0
+            for peer_name, weight in pod.peers.items():
+                if kk >= k:
+                    break
+                peer_node = loop._peer_node(peer_name)
+                if not peer_node:
+                    continue
+                pidx = enc.node_slot(peer_node)
+                if pidx is None:
+                    continue
+                peers[i, kk] = int(pidx)
+                traffic[i, kk] = float(weight)
+                kk += 1
+
+        global _SCAN_JIT
+        if _SCAN_JIT is None:
+            import jax
+
+            _SCAN_JIT = jax.jit(_scan)
+        import jax.numpy as jnp
+
+        mine, best, target = (np.asarray(x) for x in _SCAN_JIT(
+            jnp.asarray(lat), jnp.asarray(bw), jnp.asarray(valid),
+            jnp.asarray(free), jnp.asarray(chosen),
+            jnp.asarray(peers), jnp.asarray(traffic),
+            jnp.asarray(req),
+            jnp.float32(self.cfg.weights.peer_bw),
+            jnp.float32(self.cfg.weights.peer_lat)))
+
+        # -- hysteresis + triggers (host) ---------------------------
+        cfg = self.cfg
+        candidates = []        # (priority, gain, i, trigger)
+        regrets = self._regret_by_uid(loop)
+        for i, (pod, rec, idx) in enumerate(rows):
+            tgt = int(target[i])
+            gain = float(best[i] - mine[i])
+            if tgt < 0 or tgt == idx or gain <= 0.0:
+                continue
+            trigger = ""
+            if not valid[idx]:
+                trigger = "drain"
+            elif self._node_hot(pod.node_name, now):
+                trigger = "link"
+            elif regrets.get(pod.uid, 0.0) > cfg.slo_regret_ceiling:
+                trigger = "regret"
+            # Hysteresis discipline: an UNTRIGGERED candidate is pure
+            # opportunism (healthy clusters carry structural net
+            # regret — the scheduler trades the net term against
+            # balance/fit, r11's quality bench measures it), so it
+            # faces every gate.  A candidate with degradation
+            # EVIDENCE (link event streak, regret over the SLO
+            # ceiling) bypasses the gain and age bars — the trigger
+            # is the justification — but still honors the per-pod
+            # cooldown; only drain bypasses that too.
+            # Relative gain against the score MAGNITUDE (not the
+            # current score, which sits near zero for marginal
+            # placements and would make any epsilon look huge).
+            rel = gain / max(abs(float(best[i])),
+                             abs(float(mine[i])), _EPS)
+            if not trigger and rel < cfg.rebalance_min_gain:
+                self.skipped_gain += 1
+                continue
+            age = now - rec.stamp
+            if not trigger and age < cfg.rebalance_min_age_s:
+                self.skipped_age += 1
+                continue
+            last = self._last_move.get(pod.uid)
+            if (trigger != "drain" and last is not None
+                    and now - last < cfg.rebalance_cooldown_s):
+                self.skipped_cooldown += 1
+                continue
+            candidates.append((bool(trigger), gain, i,
+                               trigger or "gain"))
+        self.candidates_total += len(candidates)
+        self.last_scan_candidates = len(candidates)
+        if not candidates:
+            return 0
+        candidates.sort(key=lambda c: (c[0], c[1]), reverse=True)
+
+        # -- budgets + execution ------------------------------------
+        moves = 0
+        group_evicted: dict[Any, int] = {}
+        for triggered, gain, i, trigger in candidates:
+            if moves >= cfg.rebalance_max_moves_per_cycle:
+                self.skipped_budget += 1
+                continue
+            pod, rec, idx = rows[i]
+            members = self._move_members(pod, rec)
+            if members is None:
+                continue
+            n_evict = len(members)
+            if not self._eviction_budget_ok(n_evict, now):
+                self.skipped_budget += 1
+                continue
+            if not self._disruption_ok(members, group_evicted,
+                                       committed):
+                self.skipped_disruption += 1
+                continue
+            ok = self._execute(loop, pod, rec, members,
+                               int(target[i]), gain, trigger, now)
+            if ok:
+                moves += 1
+                if trigger == "link":
+                    self.triggers_link += 1
+                elif trigger == "regret":
+                    self.triggers_regret += 1
+                elif trigger == "drain":
+                    self.triggers_drain += 1
+        self.last_scan_moves = moves
+        return moves
+
+    def _regret_by_uid(self, loop) -> dict[str, float]:
+        quality = getattr(loop, "quality", None)
+        if quality is None:
+            return {}
+        try:
+            return {o["pod_uid"]: float(o.get("regret", 0.0))
+                    for o in quality.outcomes()}
+        except Exception:  # noqa: BLE001 — triggers are advisory
+            return {}
+
+    # -- budget gates ------------------------------------------------
+
+    def _eviction_budget_ok(self, n: int, now: float) -> bool:
+        budget = self.cfg.rebalance_evictions_per_hour
+        if budget <= 0:
+            return False
+        while self._evictions and now - self._evictions[0] > 3600.0:
+            self._evictions.popleft()
+        return len(self._evictions) + n <= budget
+
+    def _disruption_ok(self, members: list[tuple[Pod, Any]],
+                       group_evicted: dict[Any, int],
+                       committed: dict[str, Any]) -> bool:
+        """PDB-style floor: a group with ``pdb_min`` live members may
+        not drop below it, counting every eviction this cycle already
+        charged against the group (same accounting the preemption
+        planner's group_budget enforces)."""
+        charges: dict[Any, int] = {}
+        for _pod, rec in members:
+            gk = rec.gang_key or (rec.group_bit or None)
+            if gk is None or rec.pdb_min <= 0:
+                continue
+            charges[gk] = charges.get(gk, 0) + 1
+        for gk, n in charges.items():
+            live = sum(
+                1 for r in committed.values()
+                if (r.gang_key or (r.group_bit or None)) == gk)
+            already = group_evicted.get(gk, 0)
+            pdb_min = max(r.pdb_min for _p, r in members
+                          if (r.gang_key or (r.group_bit or None))
+                          == gk)
+            if live - already - n < pdb_min:
+                return False
+        for gk, n in charges.items():
+            group_evicted[gk] = group_evicted.get(gk, 0) + n
+        return True
+
+    # -- move construction / execution ------------------------------
+
+    def _move_members(self, pod: Pod, rec) -> (
+            list[tuple[Pod, Any]] | None):
+        """Expand a candidate to the unit that must move together: the
+        pod alone, or its whole gang (evicting one slice-job member
+        strands the rest — the preemption planner's rule)."""
+        if not rec.gang_key:
+            return [(pod, rec)]
+        members = []
+        for uid, mrec in self.encoder.gang_members(rec.gang_key):
+            mpod = self.client.get_pod(mrec.name)
+            if mpod is None or not mpod.node_name:
+                return None     # gang mid-churn: not a safe unit now
+            members.append((mpod, mrec))
+        return members or None
+
+    def _execute(self, loop, pod: Pod, rec,
+                 members: list[tuple[Pod, Any]], target_idx: int,
+                 gain: float, trigger: str, now: float) -> bool:
+        """Stage -> evict -> pin -> re-add.  The ledger entry lands
+        BEFORE the first eviction so every crash window restores to
+        fully-reverted; it clears in ``_settle`` once every member is
+        bound again."""
+        from kubernetesnetawarescheduler_tpu.core.preempt import (
+            Victim,
+            evict_as_unit,
+        )
+
+        enc, client = self.encoder, self.client
+        single = len(members) == 1 and not rec.gang_key
+        try:
+            to_node = enc.node_name(target_idx) if single else ""
+        except Exception:  # noqa: BLE001 — slot raced a node delete
+            return False
+        if single and not to_node:
+            return False
+        self._seq += 1
+        key = f"mv{self._seq}-{pod.uid[:8]}"
+        entries = [[p.uid, p.namespace, p.name, p.node_name,
+                    to_node if single else ""]
+                   for p, _r in members]
+        enc.note_migration_inflight(key, entries)
+        victims = [Victim(uid=p.uid, namespace=p.namespace,
+                          name=p.name, priority=r.priority,
+                          node=p.node_name) for p, r in members]
+        done = evict_as_unit(client, enc, victims)
+        if len(done) != len(victims):
+            # Partial eviction failure: the deleted members re-add
+            # below and re-place freely; nothing stays pinned.
+            enc.clear_migration_inflight(key)
+            self.moves_reverted += 1
+            done_uids = {v.uid for v in done}
+            for p, _r in members:
+                if p.uid in done_uids:
+                    self._readd(client, p)
+            return False
+        cleared = [dataclasses.replace(p, node_name="")
+                   for p, _r in members]
+        if single:
+            # Pin the target: the pod re-arrives Pending and
+            # _redirect_committed routes its bind to this node (the
+            # checkpoint-restore mechanism, reused verbatim).
+            enc.commit_many(cleared, [target_idx])
+        added = all(self._readd(client, p) for p in cleared)
+        if not added:
+            # No add_pod surface (live cluster): the eviction IS the
+            # move — the workload controller recreates the pod (new
+            # uid), the pin can never match, and the entry reverts at
+            # its deadline, releasing any pinned usage.
+            pass
+        self._inflight[key] = _Move(
+            key=key, gang_key=rec.gang_key or "", members=entries,
+            deadline=now + self.cfg.rebalance_move_timeout_s,
+            trigger=trigger, gain=gain)
+        wall = time.time()
+        for p, _r in members:
+            self._last_move[p.uid] = now
+            self._evictions.append(wall)
+            self.pods_evicted_total += 1
+        self.moves_total += 1
+        return True
+
+    @staticmethod
+    def _readd(client, pod: Pod) -> bool:
+        add = getattr(client, "add_pod", None)
+        if add is None:
+            return False
+        cleared = (pod if not pod.node_name
+                   else dataclasses.replace(pod, node_name=""))
+        try:
+            add(cleared)
+            return True
+        except Exception:  # noqa: BLE001 — re-add is best-effort
+            return False
+
+    # -- reads -------------------------------------------------------
+
+    def disruption_per_pod_hour(self, n_pods: int) -> float:
+        """Evictions per pod per hour over the sliding window — the
+        number the bench reports beside recovered bandwidth and
+        bench_check Rule 12 compares against the budget."""
+        now = time.time()
+        while self._evictions and now - self._evictions[0] > 3600.0:
+            self._evictions.popleft()
+        return len(self._evictions) / max(1, n_pods)
+
+    def summary(self) -> dict[str, Any]:
+        """One-shot stats block for /debug/rebalance, /metrics and
+        bench artifacts."""
+        return {
+            "enabled": True,
+            "scans_total": self.scans_total,
+            "candidates_total": self.candidates_total,
+            "moves_total": self.moves_total,
+            "moves_completed": self.moves_completed,
+            "moves_reverted": self.moves_reverted,
+            "moves_inflight": len(self._inflight),
+            "pods_evicted_total": self.pods_evicted_total,
+            "half_moved_gangs": self.half_moved_gangs,
+            "skipped_gain": self.skipped_gain,
+            "skipped_age": self.skipped_age,
+            "skipped_cooldown": self.skipped_cooldown,
+            "skipped_budget": self.skipped_budget,
+            "skipped_disruption": self.skipped_disruption,
+            "triggers_link": self.triggers_link,
+            "triggers_regret": self.triggers_regret,
+            "triggers_drain": self.triggers_drain,
+            "last_scan_pods": self.last_scan_pods,
+            "last_scan_candidates": self.last_scan_candidates,
+            "last_scan_moves": self.last_scan_moves,
+            "evictions_window": len(self._evictions),
+            "budget_per_hour":
+                self.cfg.rebalance_evictions_per_hour,
+        }

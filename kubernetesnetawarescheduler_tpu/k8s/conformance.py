@@ -107,6 +107,13 @@ EVENT_SCHEMA: dict = {
                 "generateName": {"type": "string"},
                 "namespace": {"type": "string",
                               "pattern": _DNS_LABEL},
+                # String-valued annotations (the structured link
+                # identity of LinkDegraded/LinkQuarantined rides
+                # here; a real apiserver accepts any annotations).
+                "annotations": {
+                    "type": "object",
+                    "additionalProperties": {"type": "string"},
+                },
             },
         },
         "involvedObject": {

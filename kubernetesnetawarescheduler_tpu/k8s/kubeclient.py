@@ -1269,10 +1269,23 @@ class KubeClient(ClusterClient):
     @staticmethod
     def _event_body(event: Event) -> dict:
         now = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+        metadata: dict = {"generateName": f"{event.involved_pod}."}
+        link = getattr(event, "link", ())
+        if link:
+            # Structured link identity (LinkDegraded/LinkQuarantined):
+            # a stable annotation consumers filter on (jsonpath /
+            # field selectors) instead of parsing the human message.
+            src, dst, reason, streak = link
+            metadata["annotations"] = {
+                "netaware.dev/link-src": str(src),
+                "netaware.dev/link-dst": str(dst),
+                "netaware.dev/link-reason": str(reason),
+                "netaware.dev/link-streak": str(int(streak)),
+            }
         return {
             "apiVersion": "v1",
             "kind": "Event",
-            "metadata": {"generateName": f"{event.involved_pod}."},
+            "metadata": metadata,
             "involvedObject": {
                 "apiVersion": "v1", "kind": "Pod",
                 "name": event.involved_pod,

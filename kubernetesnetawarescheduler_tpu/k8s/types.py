@@ -203,6 +203,30 @@ class Event:
     component: str
     count: int = 1
     type: str = "Normal"
+    # Structured link identity for LinkDegraded/LinkQuarantined:
+    # ``(src, dst, reason, streak)`` — a stable machine-consumable
+    # field the rebalancer (core/rebalance.py) and operators' kubectl
+    # filters can key on instead of parsing the human message.
+    # Empty for every non-link event; defaulted so existing
+    # constructors and wire serializations are unchanged.
+    link: tuple = ()
+
+
+def link_event(src: str, dst: str, reason: str, streak: int,
+               message: str, component: str) -> Event:
+    """A LinkDegraded/LinkQuarantined Warning carrying the structured
+    ``(src, dst, reason, streak)`` payload (ISSUE 12 satellite: the
+    human message used to be the ONLY place the link identity lived,
+    so no consumer could key on it)."""
+    return Event(
+        message=message,
+        reason=reason,
+        involved_pod="",
+        namespace="default",
+        component=component,
+        type="Warning",
+        link=(src, dst, reason, int(streak)),
+    )
 
 
 def scheduled_event(pod: Pod, node_name: str, component: str) -> Event:
@@ -234,4 +258,5 @@ def failed_event(pod: Pod, component: str, why: str) -> Event:
 
 __all__: Sequence[str] = ("Node", "Pod", "PodDisruptionBudget",
                           "Binding", "Event",
-                          "scheduled_event", "failed_event")
+                          "scheduled_event", "failed_event",
+                          "link_event")

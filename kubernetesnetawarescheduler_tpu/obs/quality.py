@@ -70,6 +70,13 @@ class _Pending:
     pred_lat_ms: tuple[float, ...]
     pred_bw_bps: tuple[float, ...]
     score_pred: float | None        # explain store's winner score
+    # Bind generation: the CommitRecord.stamp of the binding this
+    # prediction was made for.  A pod evicted/preempted and re-bound
+    # between note and harvest carries a DIFFERENT stamp — harvesting
+    # the old prediction against the new binding would charge the new
+    # placement with the old one's regret, so mismatches are dropped
+    # (stale_dropped).  Defaulted for pre-r12 pickles/tests.
+    bind_stamp: float = 0.0
 
 
 def _round_pow2(n: int, floor: int = 8) -> int:
@@ -152,6 +159,7 @@ class QualityObserver:
         self.ring_evicted = 0
         self.harvested_total = 0
         self.calibration_samples = 0
+        self.stale_dropped = 0
         # Distributions: regret in score units, calibration residual
         # in log1p-bw units — both small positives near 0.
         self.regret_hist = LogHistogram(lo=1e-6, hi=1e3, window=4096)
@@ -207,6 +215,11 @@ class QualityObserver:
                 rec = flight.get_explain(pod.uid)
                 if rec is not None:
                     score_pred = rec.get("score")
+            # Bind generation: the ledger stamp of THIS binding (a
+            # single-element dict read, same discipline as the
+            # staging scalar reads above).
+            crec = enc._committed.get(pod.uid)
+            bind_stamp = float(crec.stamp) if crec is not None else 0.0
             entry = _Pending(
                 uid=pod.uid, node=node, node_idx=int(idx),
                 cycle_id=int(cycle_id), t_commit=time.time(),
@@ -214,7 +227,8 @@ class QualityObserver:
                 peer_traffic=tuple(peer_w),
                 pred_lat_ms=tuple(pred_lat),
                 pred_bw_bps=tuple(pred_bw),
-                score_pred=score_pred)
+                score_pred=score_pred,
+                bind_stamp=bind_stamp)
             with self._lock:
                 self._pending.pop(pod.uid, None)
                 self._pending[pod.uid] = entry
@@ -243,10 +257,31 @@ class QualityObserver:
                 lat = np.array(enc._lat, dtype=np.float32)
                 bw = np.array(enc._bw, dtype=np.float32)
                 valid = np.array(enc._node_valid, dtype=bool)
+                stamps = {uid: rec.stamp
+                          for uid, rec in enc._committed.items()}
         else:
             lat = np.array(enc._lat, dtype=np.float32)
             bw = np.array(enc._bw, dtype=np.float32)
             valid = np.array(enc._node_valid, dtype=bool)
+            stamps = {uid: rec.stamp
+                      for uid, rec in
+                      getattr(enc, "_committed", {}).items()}
+        # Bind-generation gate: a pod evicted/preempted/rebalanced
+        # since note_commit is no longer the binding this prediction
+        # described — harvesting it would score the NEW placement
+        # with the OLD prediction's peers and staging reads.  Stamp
+        # mismatch (or a vanished ledger entry) drops the entry.
+        fresh = []
+        for e in batch:
+            stamp = stamps.get(e.uid)
+            if (e.bind_stamp and (stamp is None
+                                  or stamp != e.bind_stamp)):
+                self.stale_dropped += 1
+                continue
+            fresh.append(e)
+        batch = fresh
+        if not batch:
+            return 0
         b = len(batch)
         bpad = _round_pow2(b)
         k = self.cfg.max_peers
@@ -340,6 +375,7 @@ class QualityObserver:
             "ring_evicted": self.ring_evicted,
             "harvested_total": self.harvested_total,
             "calibration_samples": self.calibration_samples,
+            "stale_dropped": self.stale_dropped,
             "regret_p50": self.regret_hist.percentile(50),
             "regret_p99": self.regret_hist.percentile(99),
             "bw_residual_log1p_p50":

@@ -196,6 +196,15 @@ def main(argv=None) -> int:
                          "rates, emit SLOBurn Events and degrade "
                          "/readyz while burning; equivalent to "
                          "enable_slo=true in --config")
+    ap.add_argument("--rebalance", action="store_true",
+                    help="continuous rebalancing (core/rebalance.py): "
+                         "a budgeted descheduler revisits bound pods "
+                         "at maintain cadence, live-migrating the "
+                         "worst placements through the crash-safe "
+                         "migration ledger under the eviction budget "
+                         "and PDB-style disruption limits; "
+                         "equivalent to enable_rebalance=true in "
+                         "--config")
     ap.add_argument("--async-static", action="store_true",
                     help="rebuild the batch-invariant static score "
                          "prep on a background thread while batches "
@@ -288,6 +297,16 @@ def main(argv=None) -> int:
         import dataclasses
 
         cfg = dataclasses.replace(cfg, enable_slo=True)
+    if args.rebalance and not cfg.enable_rebalance:
+        import dataclasses
+
+        cfg = dataclasses.replace(cfg, enable_rebalance=True)
+    if cfg.enable_rebalance:
+        print(f"rebalancer enabled: min gain "
+              f"{cfg.rebalance_min_gain}, budget "
+              f"{cfg.rebalance_evictions_per_hour} evictions/h, "
+              f"{cfg.rebalance_max_moves_per_cycle} moves/cycle",
+              file=sys.stderr)
     if cfg.enable_quality_obs:
         print(f"quality observer enabled: outcome ring "
               f"{cfg.quality_ring_size}, harvest every "
@@ -510,7 +529,7 @@ def main(argv=None) -> int:
         from kubernetesnetawarescheduler_tpu.ingest.probe import (
             ProbeOrchestrator,
         )
-        from kubernetesnetawarescheduler_tpu.k8s.types import Event
+        from kubernetesnetawarescheduler_tpu.k8s.types import link_event
 
         orch = ProbeOrchestrator(
             loop.encoder, prober, names, planner=planner,
@@ -523,18 +542,20 @@ def main(argv=None) -> int:
                 orch.run_cycle(budget=64)
                 for ev in orch.drain_quarantine_events():
                     a, b = ev["link"]
+                    rb = getattr(loop, "rebalance", None)
+                    if rb is not None:
+                        rb.note_link_event(a, b, "quarantine",
+                                           int(ev["streak"]))
                     try:
-                        loop.client.create_event(Event(
+                        loop.client.create_event(link_event(
+                            src=a, dst=b, reason="LinkQuarantined",
+                            streak=int(ev["streak"]),
                             message=(
                                 f"link {a}<->{b} probe samples "
                                 f"quarantined {ev['streak']}x in a row "
                                 f"({ev['reason']}: lat={ev['lat_ms']} "
                                 f"ms, bw={ev['bw_bps']} bps)"),
-                            reason="LinkQuarantined",
-                            involved_pod="",
-                            namespace="default",
-                            component=cfg.scheduler_name,
-                            type="Warning"))
+                            component=cfg.scheduler_name))
                     except Exception:
                         # Best-effort, like LinkDegraded below — the
                         # refusals are already counted in /metrics.
@@ -545,16 +566,17 @@ def main(argv=None) -> int:
                         try:
                             a = loop.encoder.node_name(i)
                             b = loop.encoder.node_name(j)
-                            loop.client.create_event(Event(
+                            rb = getattr(loop, "rebalance", None)
+                            if rb is not None:
+                                rb.note_link_event(a, b, "degraded", 1)
+                            loop.client.create_event(link_event(
+                                src=a, dst=b, reason="LinkDegraded",
+                                streak=1,
                                 message=(
                                     f"link {a}<->{b} measured "
                                     f"{meas / 1e9:.2f} Gbps vs expected "
                                     f"{pred / 1e9:.2f} Gbps"),
-                                reason="LinkDegraded",
-                                involved_pod="",
-                                namespace="default",
-                                component=cfg.scheduler_name,
-                                type="Warning"))
+                                component=cfg.scheduler_name))
                         except Exception:
                             # Event emission is best-effort; the
                             # degradation is already counted in

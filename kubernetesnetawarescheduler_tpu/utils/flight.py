@@ -91,6 +91,13 @@ class CycleSpan:
     # pre-r11 spans and crash dumps deserialize unchanged.
     slo_burning: str | None = None
     outcome_ring_depth: int = 0
+    # Continuous rebalancing (ISSUE 12): live migrations executed /
+    # reverted since the previous committed span (the descheduler
+    # runs at maintain cadence, so this is a per-span delta, not a
+    # cumulative count).  Default-valued: pre-r12 spans and crash
+    # dumps deserialize unchanged.
+    rebalance_moves: int = 0
+    rebalance_reverts: int = 0
 
     def to_dict(self) -> dict[str, Any]:
         return {
@@ -115,6 +122,8 @@ class CycleSpan:
             "donation_skipped": self.donation_skipped,
             "slo_burning": self.slo_burning,
             "outcome_ring_depth": self.outcome_ring_depth,
+            "rebalance_moves": self.rebalance_moves,
+            "rebalance_reverts": self.rebalance_reverts,
         }
 
 

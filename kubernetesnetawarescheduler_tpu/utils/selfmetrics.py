@@ -582,4 +582,65 @@ def render_metrics(loop) -> str:
                 f'netaware_slo_burning{{objective="{name}"}} '
                 f"{1 if obj['burning'] else 0}")
 
+    # Continuous rebalancing (r12, core/rebalance.py): how often the
+    # descheduler acted, what held it back (the skip breakdown is the
+    # rebalance-storm runbook's first read), and the crash-safety
+    # canary — half_moved_gangs must stay 0 forever.
+    rb = getattr(loop, "rebalance", None)
+    if rb is not None:
+        rs = rb.summary()
+        counter("netaware_rebalance_scans_total",
+                float(rs["scans_total"]),
+                "Descheduler improvement scans over the bound-pod "
+                "ledger")
+        counter("netaware_rebalance_moves_total",
+                float(rs["moves_total"]),
+                "Live migrations staged in the migration ledger")
+        counter("netaware_rebalance_moves_completed_total",
+                float(rs["moves_completed"]),
+                "Migrations whose every member re-bound (ledger "
+                "entry cleared)")
+        counter("netaware_rebalance_moves_reverted_total",
+                float(rs["moves_reverted"]),
+                "Migrations reverted at their deadline (unbound "
+                "members rolled back)")
+        counter("netaware_rebalance_evictions_total",
+                float(rs["pods_evicted_total"]),
+                "Pods evicted by the rebalancer (the disruption the "
+                "eviction budget bounds)")
+        counter("netaware_rebalance_half_moved_gangs_total",
+                float(rs["half_moved_gangs"]),
+                "Gangs observed part-bound at a revert deadline — "
+                "MUST stay 0 (the migration ledger's atomicity "
+                "canary)")
+        for key, help_txt in (
+                ("skipped_gain", "below the relative-gain bar"),
+                ("skipped_age", "younger than the placement-age "
+                                "floor"),
+                ("skipped_cooldown", "inside the per-pod move "
+                                     "cooldown"),
+                ("skipped_budget", "over the eviction budget"),
+                ("skipped_disruption", "blocked by a PDB-style "
+                                       "group floor")):
+            counter(f"netaware_rebalance_{key}_total",
+                    float(rs[key]),
+                    f"Rebalance candidates skipped: {help_txt}")
+        _register("netaware_rebalance_triggers_total")
+        lines.append("# HELP netaware_rebalance_triggers_total "
+                     "Executed moves by trigger source")
+        lines.append("# TYPE netaware_rebalance_triggers_total "
+                     "counter")
+        for trig in ("link", "regret", "drain"):
+            lines.append(
+                f'netaware_rebalance_triggers_total{{trigger='
+                f'"{trig}"}} {_fmt(float(rs["triggers_" + trig]))}')
+        gauge("netaware_rebalance_moves_inflight",
+              float(rs["moves_inflight"]),
+              "Migrations currently staged in the ledger (crash-safe "
+              "window)")
+        gauge("netaware_rebalance_last_scan_candidates",
+              float(rs["last_scan_candidates"]),
+              "Improvement candidates surviving hysteresis at the "
+              "last scan")
+
     return "\n".join(lines) + "\n"

@@ -554,3 +554,80 @@ def test_quality_shape_validated_when_present():
     quiet["detail"]["score_p99_ms"] = 87.44
     quiet["detail"]["north_star"]["p99_met"] = False
     assert bench_check.check_doc("BENCH_r11.json", quiet) == []
+
+
+def _rebalance(**overrides):
+    """A healthy r12 rebalance block (bench.py _persisted_rebalance
+    shape)."""
+    block = {
+        "enabled": True,
+        "half_moved_gangs": 0,
+        "evictions_per_pod_hour": 0.31,
+        "budget_per_pod_hour": 0.5,
+        "recovered_frac": 0.65,
+        "no_drift_moves": 0,
+        "moves": 157,
+        "source": "suite_rebalance",
+    }
+    block.update(overrides)
+    return block
+
+
+def _r12_doc(**detail_overrides):
+    detail = {"trace_provenance": _trace_prov(),
+              "winner_fusion": _winner_fusion(),
+              "rounds_max": 4,
+              "integrity": _integrity(),
+              "quality": _quality(),
+              "rebalance": _rebalance()}
+    detail.update(detail_overrides)
+    return _headline(detail=detail)
+
+
+def test_rebalance_block_required_from_round12():
+    # r12+ headline claiming the p99 bar without the block: fails.
+    doc = _r11_doc()
+    fails = bench_check.check_doc("BENCH_r12.json", doc)
+    assert any("rebalance" in f for f in fails), fails
+    # Same doc with the block: clean.
+    assert bench_check.check_doc("BENCH_r12.json", _r12_doc()) == []
+    # Committed r11 history predates the descheduler: exempt.
+    assert bench_check.check_doc("BENCH_r11.json", doc) == []
+    # A doc not claiming the bar may omit the block even at r12+.
+    quiet = _r11_doc()
+    quiet["detail"]["score_p99_ms"] = 87.44
+    quiet["detail"]["north_star"]["p99_met"] = False
+    assert bench_check.check_doc("BENCH_r12.json", quiet) == []
+
+
+def test_rebalance_shape_validated_when_present():
+    # A leg that ran without the descheduler is no evidence at all.
+    fails = bench_check.check_doc("BENCH_r12.json", _r12_doc(
+        rebalance=_rebalance(enabled=False)))
+    assert any("enabled is false" in f for f in fails), fails
+    # A half-moved gang breaks the ledger's all-or-nothing contract —
+    # failed regardless of what the headline claims.
+    fails = bench_check.check_doc("BENCH_r12.json", _r12_doc(
+        rebalance=_rebalance(half_moved_gangs=1)))
+    assert any("half_moved_gangs=1" in f for f in fails), fails
+    # A p99 claim bought with churn over the eviction budget.
+    fails = bench_check.check_doc("BENCH_r12.json", _r12_doc(
+        rebalance=_rebalance(evictions_per_pod_hour=0.9)))
+    assert any("unbudgeted churn" in f for f in fails), fails
+    # Missing accounting keys.
+    bad = _rebalance()
+    del bad["budget_per_pod_hour"]
+    fails = bench_check.check_doc("BENCH_r12.json", _r12_doc(
+        rebalance=bad))
+    assert any("rebalance missing" in f for f in fails), fails
+    # Validated even on a pre-r12 filename: carrying the block opts in.
+    fails = bench_check.check_doc("BENCH_r11.json", _r11_doc(
+        rebalance=_rebalance(half_moved_gangs=2)))
+    assert any("half_moved_gangs=2" in f for f in fails), fails
+    # Disruption over budget but not claiming the bar: clean — the
+    # budget gates the p99 claim, not history (atomicity still must
+    # hold, checked above).
+    quiet = _r12_doc(rebalance=_rebalance(evictions_per_pod_hour=0.9))
+    quiet["detail"]["score_p99_ms"] = 87.44
+    quiet["detail"]["north_star"]["p99_met"] = False
+    assert bench_check.check_doc("BENCH_r12.json", quiet) == []

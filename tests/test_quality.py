@@ -216,8 +216,58 @@ def test_summary_key_set_is_stable():
     assert set(s) == {
         "pending", "ring_depth", "ring_size", "noted_total",
         "no_peer_total", "pending_dropped", "ring_evicted",
-        "harvested_total", "calibration_samples", "regret_p50",
-        "regret_p99", "bw_residual_log1p_p50",
+        "harvested_total", "calibration_samples", "stale_dropped",
+        "regret_p50", "regret_p99", "bw_residual_log1p_p50",
         "bw_residual_log1p_p99"}
     assert s["ring_depth"] == 2
     assert s["harvested_total"] == 2
+
+
+def test_stale_binding_outcomes_dropped_at_harvest():
+    """A pod evicted (or preempted/rebalanced) and re-bound between
+    note_commit and harvest carries a different bind generation —
+    harvesting the old prediction would charge the NEW binding with
+    the OLD placement's regret, so the entry is dropped (ISSUE 12
+    satellite)."""
+    cluster, loop = make_loop()
+    loop.quality = QualityObserver(loop.cfg)
+    workload = _workload(num_pods=12, peer_fraction=0.6)
+    drain(loop, cluster, workload)
+    obs = loop.quality
+    enc = loop.encoder
+    pend = {u: e for u, e in obs._pending.items()}
+    assert pend, "workload produced no peered pendings"
+    # Every pending entry carries the live binding's stamp.
+    for uid, e in pend.items():
+        assert e.bind_stamp == enc._committed[uid].stamp
+    # Simulate an eviction + re-bind for ONE pod: the ledger record
+    # is replaced, so its stamp (bind generation) changes.
+    victim_uid = next(iter(pend))
+    with enc._lock:
+        rec = enc._committed[victim_uid]
+        enc._committed[victim_uid] = rec._replace(
+            stamp=rec.stamp + 1000.0)
+    n_pending = len(pend)
+    harvested = obs.harvest(enc)
+    assert obs.stale_dropped == 1
+    assert harvested == n_pending - 1
+    assert obs.outcome(victim_uid) is None
+    assert obs.summary()["stale_dropped"] == 1
+
+
+def test_vanished_binding_outcomes_dropped_at_harvest():
+    """A pod deleted outright between note and harvest has no binding
+    to evaluate at all — same drop path as a stamp mismatch."""
+    cluster, loop = make_loop()
+    loop.quality = QualityObserver(loop.cfg)
+    workload = _workload(num_pods=12, peer_fraction=0.6)
+    drain(loop, cluster, workload)
+    obs = loop.quality
+    enc = loop.encoder
+    assert obs._pending
+    victim_uid = next(iter(obs._pending))
+    with enc._lock:
+        del enc._committed[victim_uid]
+    obs.harvest(enc)
+    assert obs.stale_dropped == 1
+    assert obs.outcome(victim_uid) is None

@@ -133,6 +133,80 @@ def test_ledger_without_decision_reported_not_failed(tmp_path):
     assert report["decisions"]["ledger_without_decision"] == ["p0"]
 
 
+def test_migration_ledger_clean_and_pin_mismatch(tmp_path):
+    """r12: a checkpoint written mid-move carries the staged move;
+    the audit passes when the pin agrees with the committed ledger and
+    fires when a member is pinned somewhere else (the half-moved
+    placement a restore must never rebuild)."""
+    enc = _encoder()
+    pod = Pod(name="p0", requests={"cpu": 1.0})
+    enc.commit(pod, "n1")  # the move's pin: committed at the target
+    enc.note_migration_inflight(
+        "mv1-x", [[pod.uid, "default", "p0", "n0", "n1"]])
+    path = _checkpoint(tmp_path, enc)
+    report = state_audit.run_audit(path)
+    assert report["ok"]
+    assert report["migrations"]["moves_inflight"] == 1
+    assert report["migrations"]["members_staged"] == 1
+    assert report["migrations"]["errors"] == []
+
+    # Same snapshot, but the staged move claims a DIFFERENT target
+    # than the pin: the ledger describes a state rollback cannot
+    # produce.
+    enc.clear_migration_inflight("mv1-x")
+    enc.note_migration_inflight(
+        "mv2-x", [[pod.uid, "default", "p0", "n0", "n3"]])
+    path2 = str(tmp_path / "ck2")
+    save_checkpoint(path2, enc)
+    report = state_audit.run_audit(path2)
+    assert not report["ok"]
+    assert any("pinned at 'n1'" in e
+               for e in report["migrations"]["errors"])
+
+
+def test_migration_ledger_cross_checks_decisions(tmp_path):
+    """With --decisions, a member whose from_node matches neither its
+    last logged decision nor the move target is flagged: the eviction
+    was recorded against a placement the log never decided."""
+    enc = _encoder()
+    pod = Pod(name="p0", requests={"cpu": 1.0})
+    enc.commit(pod, "n1")
+    enc.note_migration_inflight(
+        "mv1-x", [[pod.uid, "default", "p0", "n0", "n1"]])
+    path = _checkpoint(tmp_path, enc)
+
+    dec = str(tmp_path / "decisions.jsonl")
+    log = DecisionLog(dec)
+    log.append("p0", "n0")  # pre-move placement
+    log.append("p0", "n1")  # the move's re-decision: matches to_node
+    log.close()
+    assert state_audit.run_audit(path, decisions=dec)["ok"]
+
+    log = DecisionLog(dec)
+    log.append("p0", "n2")  # contradicts both from and to
+    log.close()
+    report = state_audit.run_audit(path, decisions=dec)
+    assert not report["migrations"]["ok"]
+    assert any("diverged mid-move" in e
+               for e in report["migrations"]["errors"])
+
+
+def test_migration_ledger_malformed_and_double_staged(tmp_path):
+    enc = _encoder()
+    p0 = Pod(name="p0", requests={"cpu": 1.0})
+    enc.commit(p0, "n0")
+    enc.note_migration_inflight("mv1-x", [[p0.uid, "default", "p0"]])
+    enc.note_migration_inflight(
+        "mv2-x", [[p0.uid, "default", "p0", "n1", "n0"],
+                  [p0.uid, "default", "p0", "n1", "n0"]])
+    path = _checkpoint(tmp_path, enc)
+    report = state_audit.run_audit(path)
+    assert not report["ok"]
+    errors = report["migrations"]["errors"]
+    assert any("malformed entry" in e for e in errors)
+    assert any("two moves" in e for e in errors)
+
+
 def test_main_entrypoint_exit_codes(tmp_path, capsys):
     path = _checkpoint(tmp_path)
     assert state_audit.main([path]) == 0

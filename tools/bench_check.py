@@ -560,6 +560,65 @@ def check_doc(path: str, doc: dict) -> list[str]:
                         f"{name}: quality.bit_identical is false — "
                         "observation changed placements; it must be "
                         "a pure ride-along")
+
+    # Rule 12 — continuous-rebalancing provenance (round 12+): a
+    # headline claiming the p99 bar must prove the number was measured
+    # with the live-migration descheduler active and disciplined — a
+    # ``rebalance`` block from the ``bench.py --suite rebalance`` leg
+    # with the rebalancer enabled, ZERO half-moved gangs (the
+    # migration ledger's one invariant; a nonzero count is an
+    # atomicity hole whatever the filename says), and disruption
+    # (evictions/pod/hour) inside the configured budget.  Round-gated
+    # by filename like Rules 8-11; the block's shape is validated
+    # wherever it appears.
+    if not grandfathered:
+        ns = detail.get("north_star")
+        p99_met = isinstance(ns, dict) and bool(ns.get("p99_met"))
+        reb = detail.get("rebalance")
+        rnd = _round_of(name)
+        if reb is None:
+            if p99_met and rnd is not None and rnd >= 12:
+                fails.append(
+                    f"{name}: north_star.p99_met without a rebalance "
+                    "block (round 12+ requires the --suite rebalance "
+                    "leg's disruption-budget + gang-atomicity "
+                    "evidence behind any claimed p99)")
+        elif not isinstance(reb, dict):
+            fails.append(f"{name}: rebalance is not an object")
+        else:
+            required = {"enabled", "half_moved_gangs",
+                        "evictions_per_pod_hour",
+                        "budget_per_pod_hour"}
+            missing = required - set(reb)
+            if missing:
+                fails.append(f"{name}: rebalance missing "
+                             f"{sorted(missing)}")
+            else:
+                try:
+                    half = int(reb["half_moved_gangs"])
+                    disr = float(reb["evictions_per_pod_hour"])
+                    budget = float(reb["budget_per_pod_hour"])
+                except (TypeError, ValueError):
+                    fails.append(f"{name}: rebalance not numeric")
+                else:
+                    if not reb.get("enabled"):
+                        fails.append(
+                            f"{name}: rebalance.enabled is false — "
+                            "the leg ran without the descheduler, "
+                            "which is no evidence at all")
+                    if half != 0:
+                        fails.append(
+                            f"{name}: rebalance.half_moved_gangs="
+                            f"{half} — a gang was left part-moved; "
+                            "the migration ledger's all-or-nothing "
+                            "contract is broken")
+                    if p99_met and disr > budget:
+                        fails.append(
+                            f"{name}: north_star.p99_met with "
+                            f"rebalance disruption {disr} over the "
+                            f"budget {budget} evictions/pod/hour — "
+                            "the claimed p99 was bought with "
+                            "unbudgeted churn")
     return fails
 
 

@@ -24,6 +24,14 @@ scheduler.  Four independent checks:
   every committed pod's node matches its LAST logged decision.  A
   mismatch means the ledger and the decision record diverged — the
   state-drift analog at the commit layer.
+* **migration ledger** (r12) — every ``migrations_inflight`` entry in
+  the checkpoint meta is well-formed (5 fields, no uid staged in two
+  moves), and a pinned member's committed node equals the move's
+  ``to_node`` — a pin pointing anywhere else is exactly the
+  half-moved state a crash restore must never reconstruct.  With
+  ``--decisions``, each member's ``from_node`` must match the pod's
+  last logged decision (the placement it was evicted FROM) or its
+  ``to_node`` (the move already re-decided).
 
 Exit 0 when every requested check passes, 1 otherwise; ``--json``
 emits the full report for machines.  Exercised by tier-1 via
@@ -109,7 +117,11 @@ def audit_roundtrip(path: str) -> dict:
     )
 
     stored = read_state_arrays(path)
-    enc = load_checkpoint(path)
+    # Pristine read: the serving restore settles in-flight gangs and
+    # migrations (rolling their members back mutates used/group
+    # planes); the losslessness check is about DESERIALIZATION, so it
+    # skips settlement — audit_migrations judges the staged moves.
+    enc = load_checkpoint(path, settle_inflight=False)
     restored = {name.lstrip("_"): getattr(enc, name)
                 for name in _STATE_ARRAYS}
     drift = compare_row_digests(host_row_digests(restored),
@@ -157,6 +169,69 @@ def audit_decisions(path: str, decisions_path: str) -> dict:
     }
 
 
+def audit_migrations(path: str,
+                     decisions_path: str | None = None) -> dict:
+    """Migration-ledger invariants (r12): a checkpoint written mid-move
+    carries the staged move in ``meta["migrations_inflight"]``; restore
+    rolls every staged member back (fully-reverted), so the ledger must
+    describe a state that rollback can actually produce."""
+    from kubernetesnetawarescheduler_tpu.core.checkpoint import (
+        DecisionLog,
+        resolve_checkpoint_dir,
+    )
+
+    base = resolve_checkpoint_dir(path)
+    with open(os.path.join(base, "meta.json"), encoding="utf-8") as fh:
+        meta = json.load(fh)
+    inflight = meta.get("migrations_inflight", {})
+    names = meta["node_names"]
+    committed = {uid: rec for uid, rec in meta["committed"].items()}
+    last: dict[str, str] = {}
+    if decisions_path is not None:
+        for d in DecisionLog.load(decisions_path):
+            last[d.pod] = d.node
+    errors: list[str] = []
+    seen_uids: dict[str, str] = {}
+    members = 0
+    for key, entries in sorted(inflight.items()):
+        for entry in entries:
+            members += 1
+            if not isinstance(entry, (list, tuple)) or len(entry) != 5:
+                errors.append(f"{key}: malformed entry {entry!r} "
+                              "(want [uid, ns, name, from, to])")
+                continue
+            uid, _ns, pod_name, from_node, to_node = entry
+            if uid in seen_uids:
+                errors.append(
+                    f"{key}: member {uid} also staged in "
+                    f"{seen_uids[uid]} — one pod in two moves can "
+                    "never settle consistently")
+            seen_uids[uid] = key
+            rec = committed.get(uid)
+            if rec is not None and to_node:
+                pinned = names[rec[0]]
+                if pinned != to_node:
+                    errors.append(
+                        f"{key}: {pod_name} pinned at {pinned!r} but "
+                        f"the move targets {to_node!r} — a crash "
+                        "restore would rebuild a half-moved "
+                        "placement")
+            if last and pod_name in last:
+                if last[pod_name] not in (from_node, to_node):
+                    errors.append(
+                        f"{key}: {pod_name} last decided to "
+                        f"{last[pod_name]!r}, but the move records "
+                        f"from={from_node!r} to={to_node!r} — the "
+                        "ledger and the decision log diverged "
+                        "mid-move")
+    return {
+        "ok": not errors,
+        "moves_inflight": len(inflight),
+        "members_staged": members,
+        "errors": errors,
+    }
+
+
 def run_audit(path: str, decisions: str | None = None) -> dict:
     """Every check that applies to ``path``; ``report["ok"]`` is the
     conjunction."""
@@ -167,6 +242,7 @@ def run_audit(path: str, decisions: str | None = None) -> dict:
     if report["manifest"]["resolved"] is not None:
         report["staging"] = audit_staging(path)
         report["roundtrip"] = audit_roundtrip(path)
+        report["migrations"] = audit_migrations(path, decisions)
         if decisions is not None:
             report["decisions"] = audit_decisions(path, decisions)
     report["ok"] = all(
@@ -191,7 +267,8 @@ def main(argv: list[str] | None = None) -> int:
     if args.json:
         print(json.dumps(report, indent=2))
     else:
-        for key in ("manifest", "staging", "roundtrip", "decisions"):
+        for key in ("manifest", "staging", "roundtrip", "migrations",
+                    "decisions"):
             section = report.get(key)
             if section is None:
                 continue

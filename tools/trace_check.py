@@ -24,6 +24,10 @@ Checks:
     have a non-negative integer ``outcome_ring_depth`` and a
     null-or-string ``slo_burning`` (pre-r11 dumps carry neither
     and stay clean)
+  * rebalancing — cycle spans carrying the r12 args
+    (``rebalance_moves``/``rebalance_reverts``) must be non-negative
+    integers; validated only when present, so pre-r12 dumps lint
+    clean
 
 A cycle's phase set is NOT prescribed: the r9 fused single-dispatch
 step collapses score+assign+commit into one ``score_assign`` phase
@@ -106,7 +110,8 @@ def check_trace(doc: Any) -> list[str]:
             # r9 fused-step accounting, validated only when present
             # (pre-r9 dumps carry none of these and stay clean).
             for k in ("rounds", "donated", "donation_skipped",
-                      "outcome_ring_depth"):
+                      "outcome_ring_depth", "rebalance_moves",
+                      "rebalance_reverts"):
                 v = args.get(k)
                 if v is not None and (not isinstance(v, int)
                                       or v < 0):
