@@ -162,6 +162,7 @@ class Rebalancer:
         self.moves_completed = 0
         self.moves_reverted = 0
         self.half_moved_gangs = 0
+        self.pins_skipped = 0
         self.skipped_gain = 0
         self.skipped_age = 0
         self.skipped_cooldown = 0
@@ -392,13 +393,20 @@ class Rebalancer:
             if not self._eviction_budget_ok(n_evict, now):
                 self.skipped_budget += 1
                 continue
-            if not self._disruption_ok(members, group_evicted,
-                                       committed):
+            charges = self._disruption_charges(members, group_evicted,
+                                               committed)
+            if charges is None:
                 self.skipped_disruption += 1
                 continue
             ok = self._execute(loop, pod, rec, members,
                                int(target[i]), gain, trigger, now)
             if ok:
+                # Charge PDB headroom only for moves that actually
+                # happened — a failed _execute (raced node delete,
+                # partial eviction) must not consume the group's
+                # budget for later valid candidates this cycle.
+                for gk, n in charges.items():
+                    group_evicted[gk] = group_evicted.get(gk, 0) + n
                 moves += 1
                 if trigger == "link":
                     self.triggers_link += 1
@@ -429,13 +437,17 @@ class Rebalancer:
             self._evictions.popleft()
         return len(self._evictions) + n <= budget
 
-    def _disruption_ok(self, members: list[tuple[Pod, Any]],
-                       group_evicted: dict[Any, int],
-                       committed: dict[str, Any]) -> bool:
+    def _disruption_charges(self, members: list[tuple[Pod, Any]],
+                            group_evicted: dict[Any, int],
+                            committed: dict[str, Any]) -> (
+            dict[Any, int] | None):
         """PDB-style floor: a group with ``pdb_min`` live members may
         not drop below it, counting every eviction this cycle already
         charged against the group (same accounting the preemption
-        planner's group_budget enforces)."""
+        planner's group_budget enforces).  Returns the per-group
+        charges for the caller to apply AFTER the move executes (a
+        failed move must not consume the group's headroom), or None
+        when any group would drop below its floor."""
         charges: dict[Any, int] = {}
         for _pod, rec in members:
             gk = rec.gang_key or (rec.group_bit or None)
@@ -451,10 +463,8 @@ class Rebalancer:
                           if (r.gang_key or (r.group_bit or None))
                           == gk)
             if live - already - n < pdb_min:
-                return False
-        for gk, n in charges.items():
-            group_evicted[gk] = group_evicted.get(gk, 0) + n
-        return True
+                return None
+        return charges
 
     # -- move construction / execution ------------------------------
 
@@ -505,10 +515,17 @@ class Rebalancer:
         done = evict_as_unit(client, enc, victims)
         if len(done) != len(victims):
             # Partial eviction failure: the deleted members re-add
-            # below and re-place freely; nothing stays pinned.
+            # below and re-place freely; nothing stays pinned.  Their
+            # deletions were still real disruption, so they count
+            # against the sliding budget window and the eviction
+            # totals — otherwise repeated partial failures would churn
+            # pods invisibly and unboundedly.
             enc.clear_migration_inflight(key)
             self.moves_reverted += 1
             done_uids = {v.uid for v in done}
+            for _v in done:
+                self._evictions.append(now)
+                self.pods_evicted_total += 1
             for p, _r in members:
                 if p.uid in done_uids:
                     self._readd(client, p)
@@ -519,7 +536,18 @@ class Rebalancer:
             # Pin the target: the pod re-arrives Pending and
             # _redirect_committed routes its bind to this node (the
             # checkpoint-restore mechanism, reused verbatim).
-            enc.commit_many(cleared, [target_idx])
+            # commit_many silently skips uids that are still committed
+            # (its duplicate-delivery guard), and with a watch-based
+            # client the eviction's DELETED event — which releases the
+            # old record — can land AFTER this point.  Only commit
+            # once the old record is gone, then VERIFY the pin took;
+            # a miss is counted (pins_skipped) rather than hidden, and
+            # the move degrades to a bare eviction that reverts at its
+            # deadline.
+            if enc.committed_node(pod.uid) is None:
+                enc.commit_many(cleared, [target_idx])
+            if enc.committed_node(pod.uid) != to_node:
+                self.pins_skipped += 1
         added = all(self._readd(client, p) for p in cleared)
         if not added:
             # No add_pod surface (live cluster): the eviction IS the
@@ -531,10 +559,14 @@ class Rebalancer:
             key=key, gang_key=rec.gang_key or "", members=entries,
             deadline=now + self.cfg.rebalance_move_timeout_s,
             trigger=trigger, gain=gain)
-        wall = time.time()
         for p, _r in members:
             self._last_move[p.uid] = now
-            self._evictions.append(wall)
+            # The sliding-hour window lives entirely on the monotonic
+            # clock tick() runs on — mixing in time.time() here would
+            # make _eviction_budget_ok's prune comparison (monotonic
+            # minus epoch, hugely negative) never fire, silently
+            # turning the per-hour budget into a lifetime cap.
+            self._evictions.append(now)
             self.pods_evicted_total += 1
         self.moves_total += 1
         return True
@@ -557,8 +589,9 @@ class Rebalancer:
     def disruption_per_pod_hour(self, n_pods: int) -> float:
         """Evictions per pod per hour over the sliding window — the
         number the bench reports beside recovered bandwidth and
-        bench_check Rule 12 compares against the budget."""
-        now = time.time()
+        bench_check Rule 12 compares against the budget.  Prunes with
+        the same monotonic clock the window's stamps use."""
+        now = time.monotonic()
         while self._evictions and now - self._evictions[0] > 3600.0:
             self._evictions.popleft()
         return len(self._evictions) / max(1, n_pods)
@@ -576,6 +609,7 @@ class Rebalancer:
             "moves_inflight": len(self._inflight),
             "pods_evicted_total": self.pods_evicted_total,
             "half_moved_gangs": self.half_moved_gangs,
+            "pins_skipped": self.pins_skipped,
             "skipped_gain": self.skipped_gain,
             "skipped_age": self.skipped_age,
             "skipped_cooldown": self.skipped_cooldown,

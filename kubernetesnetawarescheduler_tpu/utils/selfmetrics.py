@@ -613,6 +613,11 @@ def render_metrics(loop) -> str:
                 "Gangs observed part-bound at a revert deadline — "
                 "MUST stay 0 (the migration ledger's atomicity "
                 "canary)")
+        counter("netaware_rebalance_pins_skipped_total",
+                float(rs["pins_skipped"]),
+                "Single-pod moves whose target pin could not land "
+                "(uid still committed when the pin was attempted) — "
+                "the move degrades to a bare eviction")
         for key, help_txt in (
                 ("skipped_gain", "below the relative-gain bar"),
                 ("skipped_age", "younger than the placement-age "

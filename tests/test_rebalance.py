@@ -320,6 +320,145 @@ def test_eviction_budget_caps_moves():
     loop.stop_bind_worker()
 
 
+def test_eviction_window_prunes_on_the_tick_clock():
+    """Regression (REVIEW r12 high): _execute used to stamp the
+    sliding window with time.time() while _eviction_budget_ok pruned
+    with tick()'s time.monotonic(); monotonic-minus-epoch is hugely
+    negative, the prune never fired, and the per-hour budget silently
+    became a lifetime cap — rebalancing stalled forever once
+    cumulative evictions reached it."""
+    import time as _time
+
+    cluster, loop = make_loop(**dict(
+        AGGRESSIVE, rebalance_evictions_per_hour=2.0))
+    drain(loop, cluster, _workload())
+    rb = loop.rebalance
+    tick(loop, n=2)
+    assert rb.pods_evicted_total >= 1
+    now = _time.monotonic()
+    # Every window stamp is recent ON THE MONOTONIC CLOCK — the clock
+    # the prune comparison runs on.
+    assert all(0.0 <= now - t < 3600.0 for t in rb._evictions)
+    # Fresh evictions are visible to the disruption report (it prunes
+    # with the same clock).
+    assert rb.disruption_per_pod_hour(32) > 0.0
+    # The window is full right now...
+    assert not rb._eviction_budget_ok(2, now)
+    # ...and SLIDES: an hour later the stamps prune and the budget
+    # frees up again (with mixed clocks this never happened).
+    assert rb._eviction_budget_ok(2, now + 3601.0)
+    assert len(rb._evictions) == 0
+    loop.stop_bind_worker()
+
+
+def test_delayed_delete_fanout_skips_pin_and_counts_it():
+    """Regression (REVIEW r12 medium): with a watch-based client the
+    eviction's DELETED event — which releases the old committed
+    record — lands AFTER _execute, so commit_many's duplicate-
+    delivery guard silently dropped the target pin.  The rebalancer
+    must detect the miss (pins_skipped) instead of hiding it, leave
+    no stray pin behind, and revert the move cleanly at deadline."""
+    import time as _time
+
+    cluster, loop = make_loop(**AGGRESSIVE)
+    drain(loop, cluster, _workload())
+    enc = loop.encoder
+    rb = loop.rebalance
+
+    # Simulate the watch: delete removes the pod server-side but the
+    # DELETED fan-out (and the release it drives) is deferred.
+    deferred = []
+
+    def delayed_delete(name, namespace="default",
+                       grace_seconds=None):
+        with cluster._lock:
+            pod = cluster._pods.pop(name, None)
+        if pod is None:
+            raise KeyError(name)
+        deferred.append(pod)
+
+    orig_delete = cluster.delete_pod
+    cluster.delete_pod = delayed_delete
+    try:
+        rb._last_tick = 0.0
+        assert rb.tick(loop) >= 1
+    finally:
+        cluster.delete_pod = orig_delete
+    singles = [mv for mv in rb._inflight.values() if not mv.gang_key]
+    assert singles, "aggressive knobs must surface single-pod moves"
+    # Every single-pod move found its uid still committed: pin
+    # skipped and COUNTED, never silently dropped.
+    assert rb.pins_skipped == len(singles)
+    assert rb.summary()["pins_skipped"] == rb.pins_skipped
+    for mv in singles:
+        uid, _ns, _name, from_node, to_node = mv.members[0]
+        assert to_node and to_node != from_node
+        # The old record is untouched (release hasn't landed) — the
+        # pin was NOT laid over it.
+        assert enc.committed_node(uid) == from_node
+
+    # The DELETED events finally arrive: the releases pop the old
+    # records and no stray pin remains anywhere.
+    with cluster._lock:
+        handlers = list(cluster._deleted_handlers)
+    for pod in deferred:
+        for h in handlers:
+            h(pod)
+    for mv in singles:
+        assert enc.committed_node(mv.members[0][0]) is None
+
+    # The unpinned move degrades to a bare eviction and reverts
+    # cleanly at its deadline.
+    for mv in rb._inflight.values():
+        mv.deadline = 0.0
+    rb._settle(_time.monotonic())
+    assert rb.moves_reverted >= len(singles)
+    assert enc.migrations_inflight() == {}
+    loop.stop_bind_worker()
+
+
+def test_partial_eviction_failure_charges_budget():
+    """Regression (REVIEW r12 low): members actually deleted in a
+    partial-eviction failure are real disruption — they must count
+    against the sliding budget window and pods_evicted_total even
+    though the move itself reverts."""
+    cluster, loop = make_loop(enable_rebalance=True,
+                              rebalance_interval_s=1e-4)
+    gang = _gang_pods("pg", 3)
+    drain(loop, cluster, gang, batch=3)
+    rb = loop.rebalance
+    enc = loop.encoder
+    before = placements(cluster)
+    hot = before["pg-w0"]
+    _degrade_node(enc, hot)
+    rb.note_link_event(hot, "", "degraded", streak=3)
+
+    orig_delete = cluster.delete_pod
+    calls = {"n": 0}
+
+    def flaky_delete(name, namespace="default", grace_seconds=None):
+        calls["n"] += 1
+        if calls["n"] > 1:
+            raise RuntimeError("apiserver hiccup")
+        orig_delete(name, namespace=namespace,
+                    grace_seconds=grace_seconds)
+
+    cluster.delete_pod = flaky_delete
+    try:
+        rb._last_tick = 0.0
+        assert rb.tick(loop) == 0      # the gang move failed mid-evict
+    finally:
+        cluster.delete_pod = orig_delete
+    assert rb.moves_reverted >= 1
+    assert rb.moves_total == 0
+    # Exactly the one real deletion is charged to the totals AND the
+    # budget window (previously invisible and unbounded).
+    assert rb.pods_evicted_total == 1
+    assert len(rb._evictions) == 1
+    assert enc.migrations_inflight() == {}
+    loop.stop_bind_worker()
+
+
 def test_per_cycle_cap_limits_each_tick():
     cluster, loop = make_loop(**dict(
         AGGRESSIVE, rebalance_max_moves_per_cycle=1))
@@ -461,11 +600,12 @@ def test_summary_key_set_is_stable():
     assert set(s) == {
         "enabled", "scans_total", "candidates_total", "moves_total",
         "moves_completed", "moves_reverted", "moves_inflight",
-        "pods_evicted_total", "half_moved_gangs", "skipped_gain",
-        "skipped_age", "skipped_cooldown", "skipped_budget",
-        "skipped_disruption", "triggers_link", "triggers_regret",
-        "triggers_drain", "last_scan_pods", "last_scan_candidates",
-        "last_scan_moves", "evictions_window", "budget_per_hour"}
+        "pods_evicted_total", "half_moved_gangs", "pins_skipped",
+        "skipped_gain", "skipped_age", "skipped_cooldown",
+        "skipped_budget", "skipped_disruption", "triggers_link",
+        "triggers_regret", "triggers_drain", "last_scan_pods",
+        "last_scan_candidates", "last_scan_moves",
+        "evictions_window", "budget_per_hour"}
     assert s["enabled"] is True
     loop.stop_bind_worker()
 
