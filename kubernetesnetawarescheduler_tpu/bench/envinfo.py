@@ -30,9 +30,25 @@ def git_sha(repo_dir: str | None = None) -> str:
         return ""
 
 
+def accel_platform() -> str:
+    """Accelerator backend the producing process executed on ('cpu',
+    'tpu', 'gpu'; '' when jax is absent).  Only consults an
+    already-imported jax — bench processes have it loaded long before
+    they stamp artifacts, and jax-free tools (artifact linters) must
+    not pay a jax import to read a hostname."""
+    import sys
+    jax = sys.modules.get("jax")
+    if jax is None:
+        return ""
+    try:
+        return str(jax.default_backend())
+    except Exception:
+        return ""
+
+
 def bench_env() -> dict:
-    """{host, cpu_count, loadavg_1m, git_sha} — the provenance block
-    every bench artifact embeds as ``bench_env``."""
+    """{host, cpu_count, loadavg_1m, platform, git_sha} — the
+    provenance block every bench artifact embeds as ``bench_env``."""
     try:
         load1 = round(os.getloadavg()[0], 2)
     except OSError:  # platforms without getloadavg
@@ -41,5 +57,6 @@ def bench_env() -> dict:
         "host": socket.gethostname(),
         "cpu_count": os.cpu_count() or 0,
         "loadavg_1m": load1,
+        "platform": accel_platform(),
         "git_sha": git_sha(),
     }

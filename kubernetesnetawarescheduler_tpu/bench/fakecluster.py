@@ -32,6 +32,28 @@ from kubernetesnetawarescheduler_tpu.k8s.types import Node, Pod
 
 
 @dataclasses.dataclass(frozen=True)
+class NodeClassSpec:
+    """One heterogeneous node class (the VirtualFlow-style hardware
+    decoupling, PAPERS.md): capacity ranges override the ClusterSpec
+    defaults and the link scales shift every link touching a node of
+    this class (a slow NIC bounds the link, so a pair's latency takes
+    the WORSE class's scale and its bandwidth the SMALLER one).
+
+    ``fraction`` is the class's share of the fleet; classes partition
+    the node index range deterministically (largest-first by spec
+    order), so the assignment never consumes generator randomness and
+    the single-class default stays bit-identical."""
+
+    name: str
+    fraction: float
+    cpu_range: tuple[float, float] | None = None
+    mem_range: tuple[float, float] | None = None
+    netbw_range: tuple[float, float] | None = None
+    lat_scale: float = 1.0   # multiplies latencies on the node's links
+    bw_scale: float = 1.0    # multiplies bandwidths on the node's links
+
+
+@dataclasses.dataclass(frozen=True)
 class ClusterSpec:
     """Shape of a generated cluster."""
 
@@ -39,6 +61,11 @@ class ClusterSpec:
     zones: int = 2
     racks_per_zone: int = 4
     seed: int = 0
+
+    # Heterogeneous node classes; () = today's single-class fleet
+    # (the default MUST stay bit-identical: the class path is gated,
+    # pinned by tests/test_scenario.py::test_fakecluster_default_parity).
+    node_classes: tuple[NodeClassSpec, ...] = ()
 
     # Link model (lat ms / bw bits-per-sec) by proximity tier.
     lat_same_rack: float = 0.1
@@ -96,6 +123,31 @@ class WorkloadSpec:
     netbw_range: tuple[float, float] = (0.05, 2.0)
 
 
+def _assign_node_classes(spec: ClusterSpec
+                         ) -> list[NodeClassSpec] | None:
+    """Deterministic node-index -> class map (None when the spec has
+    no classes).  Largest-remainder apportionment over contiguous
+    index blocks: no generator randomness is consumed, so adding
+    classes never perturbs the capacity/taint/jitter draw stream."""
+    if not spec.node_classes:
+        return None
+    total = sum(c.fraction for c in spec.node_classes)
+    if total <= 0:
+        raise ValueError("node_classes fractions must sum > 0")
+    n = spec.num_nodes
+    quotas = [c.fraction / total * n for c in spec.node_classes]
+    counts = [int(q) for q in quotas]
+    remainders = sorted(range(len(quotas)),
+                        key=lambda k: (quotas[k] - counts[k], -k),
+                        reverse=True)
+    for k in remainders[:n - sum(counts)]:
+        counts[k] += 1
+    out: list[NodeClassSpec] = []
+    for cls, cnt in zip(spec.node_classes, counts):
+        out.extend([cls] * cnt)
+    return out
+
+
 def build_fake_cluster(spec: ClusterSpec, client_cls=FakeCluster,
                        chaos=None,
                        **client_kw) -> tuple[FakeCluster, np.ndarray,
@@ -117,18 +169,30 @@ def build_fake_cluster(spec: ClusterSpec, client_cls=FakeCluster,
     n = spec.num_nodes
     zones = np.arange(n) % spec.zones
     racks = (np.arange(n) // spec.zones) % spec.racks_per_zone
+    classes = _assign_node_classes(spec)
 
     for i in range(n):
+        cls = classes[i] if classes is not None else None
+        cpu_range = spec.cpu_range
+        mem_range = spec.mem_range
+        netbw_range = spec.netbw_range
+        extra: frozenset[str] = frozenset()
+        if cls is not None:
+            cpu_range = cls.cpu_range or cpu_range
+            mem_range = cls.mem_range or mem_range
+            netbw_range = cls.netbw_range or netbw_range
+            extra = frozenset({f"nodeclass={cls.name}"})
         tainted = rng.random() < spec.taint_fraction
         cluster.add_node(Node(
             name=f"node-{i:04d}",
             capacity={
-                "cpu": float(rng.uniform(*spec.cpu_range)),
-                "mem": float(rng.uniform(*spec.mem_range)),
-                "net_bw": float(rng.uniform(*spec.netbw_range)),
+                "cpu": float(rng.uniform(*cpu_range)),
+                "mem": float(rng.uniform(*mem_range)),
+                "net_bw": float(rng.uniform(*netbw_range)),
             },
             labels=frozenset({f"zone={zones[i]}", f"rack={racks[i]}",
-                              f"disk={'ssd' if i % 2 == 0 else 'hdd'}"}),
+                              f"disk={'ssd' if i % 2 == 0 else 'hdd'}"})
+            | extra,
             taints=frozenset({"dedicated"}) if tainted else frozenset(),
             zone=f"zone-{zones[i]}",
             rack=f"rack-{zones[i]}-{racks[i]}",
@@ -146,6 +210,13 @@ def build_fake_cluster(spec: ClusterSpec, client_cls=FakeCluster,
     noise = np.clip((noise + noise.T) / 2, 0.5, 1.5)
     lat = lat * noise
     bw = bw / noise
+    if classes is not None:
+        ls = np.array([classes[i].lat_scale for i in range(n)],
+                      np.float32)
+        bs = np.array([classes[i].bw_scale for i in range(n)],
+                      np.float32)
+        lat = lat * np.maximum.outer(ls, ls)
+        bw = bw * np.minimum.outer(bs, bs)
     np.fill_diagonal(lat, 0.0)
     np.fill_diagonal(bw, bw.max())
     if chaos is not None:

@@ -46,9 +46,11 @@ from typing import Callable, Sequence
 
 import numpy as np
 
+from kubernetesnetawarescheduler_tpu.bench.artifact import write_artifact
 from kubernetesnetawarescheduler_tpu.bench.density import run_density
 from kubernetesnetawarescheduler_tpu.bench.fakecluster import (
     ClusterSpec,
+    NodeClassSpec,
     WorkloadSpec,
     build_fake_cluster,
     feed_metrics,
@@ -1737,12 +1739,8 @@ def run_integrity_config(out_dir: str | None = None,
             "bench_env": bench_env(),
         },
     }
-    artifacts = []
-    if out_dir:
-        path = os.path.join(out_dir, "integrity.json")
-        with open(path, "w", encoding="utf-8") as fh:
-            json.dump(doc, fh, indent=2)
-        artifacts.append(path)
+    artifacts: list[str] = []
+    write_artifact(out_dir, "integrity.json", doc, artifacts)
     return SuiteResult("integrity", doc, artifacts)
 
 
@@ -1929,12 +1927,8 @@ def run_quality_config(out_dir: str | None = None,
             "bench_env": bench_env(),
         },
     }
-    artifacts = []
-    if out_dir:
-        path = os.path.join(out_dir, "quality.json")
-        with open(path, "w", encoding="utf-8") as fh:
-            json.dump(doc, fh, indent=2)
-        artifacts.append(path)
+    artifacts: list[str] = []
+    write_artifact(out_dir, "quality.json", doc, artifacts)
     return SuiteResult("quality", doc, artifacts)
 
 
@@ -2181,13 +2175,162 @@ def run_rebalance_config(out_dir: str | None = None,
             "bench_env": bench_env(),
         },
     }
-    artifacts = []
-    if out_dir:
-        path = os.path.join(out_dir, "rebalance.json")
-        with open(path, "w", encoding="utf-8") as fh:
-            json.dump(doc, fh, indent=2)
-        artifacts.append(path)
+    artifacts: list[str] = []
+    write_artifact(out_dir, "rebalance.json", doc, artifacts)
     return SuiteResult("rebalance", doc, artifacts)
+
+
+def run_scenario_config(out_dir: str | None = None,
+                        num_nodes: int = 256,
+                        duration_s: float = 2900.0,
+                        base_rate: float = 360.0,
+                        batch: int = 256, seed: int = 0,
+                        gang_fraction: float = 0.0005,
+                        oracle_sample: int = 2048,
+                        slo_budget_ms: float = 250.0,
+                        keep_trace: bool = False) -> SuiteResult:
+    """Trace-driven scenario campaign (ISSUE 14): generate a
+    million-pod diurnal workload trace and stream it through the REAL
+    SchedulerLoop — chaos proxy, link-degradation bursts, node churn,
+    state faults, budgeted rebalancing and the quality observer all
+    live — then publish the outcome scorecard.
+
+    Unlike every other leg this one measures the SYSTEM over hours of
+    virtual time, not one subsystem over one drain: the headline is
+    streaming throughput (pods per wall second), and the evidence the
+    Rule 13 gate wants rides in ``detail.scenario``-shaped fields —
+    ``pods_streamed``, the full scorecard, ``half_moved_gangs == 0``
+    and peak-RSS proof that memory stayed bounded while the trace
+    streamed (default full shape: ~1.04M pods on a 256-node fleet at
+    ~56% steady-state CPU occupancy, diurnal peaks to ~75% — sized so
+    the cluster never saturates: a saturated campaign turns into an
+    unschedulable retry storm that overflows the informer queue and
+    trips the queue_dropped bar, measured at 192 nodes/410 pods/s).
+
+    The trace itself is written to a TEMP dir (gzip) and deleted
+    after the replay — it is multi-GB-scale raw and reproducible from
+    (seed, spec) by construction, so committing it would be waste;
+    ``keep_trace`` retains it for debugging.
+    """
+    import tempfile
+
+    from kubernetesnetawarescheduler_tpu.scenario.generate import (
+        ScenarioSpec,
+        generate_trace,
+    )
+    from kubernetesnetawarescheduler_tpu.scenario.replay import (
+        replay_trace,
+    )
+    from kubernetesnetawarescheduler_tpu.scenario.scorecard import (
+        build_scorecard,
+        check_scorecard,
+    )
+
+    spec = ScenarioSpec(
+        seed=seed,
+        duration_s=duration_s,
+        base_rate=base_rate,
+        diurnal_amplitude=0.3,
+        day_s=max(duration_s / 4.0, 60.0),
+        gang_fraction=gang_fraction,
+        gang_sizes=(8,),
+        longrun_fraction=0.003,
+        serving_lifetime_s=12.0,
+        batch_lifetime_s=6.0,
+        gang_lifetime_s=10.0,
+        lifetime_floor_s=2.0,
+        link_burst_rate_per_s=0.01,
+        link_burst_duration_s=15.0,
+        node_churn_rate_per_s=0.002,
+        node_down_duration_s=20.0,
+        state_fault_rate_per_s=0.002,
+        chaos_seed=seed + 17,
+        cluster=ClusterSpec(
+            num_nodes=num_nodes, seed=seed,
+            node_classes=(
+                NodeClassSpec("std", 0.5),
+                NodeClassSpec("highmem", 0.25,
+                              mem_range=(64.0, 256.0)),
+                NodeClassSpec("edge", 0.25, cpu_range=(8.0, 32.0),
+                              lat_scale=2.0, bw_scale=0.5),
+            )),
+    )
+
+    tmp = tempfile.mkdtemp(prefix="scenario_trace_")
+    trace_path = os.path.join(tmp, "trace.jsonl.gz")
+    t0 = time.perf_counter()
+    gen_stats = generate_trace(spec, trace_path)
+    gen_wall = time.perf_counter() - t0
+    trace_bytes = os.path.getsize(trace_path)
+
+    sampler = UsageSampler(period_s=0.5)
+    sampler.start()
+    t0 = time.perf_counter()
+    try:
+        res = replay_trace(
+            trace_path, batch=batch, oracle_sample=oracle_sample,
+            slo_budget_ms=slo_budget_ms)
+    finally:
+        sampler.stop()
+        if not keep_trace:
+            try:
+                os.remove(trace_path)
+                os.rmdir(tmp)
+            except OSError:
+                pass
+    replay_wall = time.perf_counter() - t0
+
+    card = build_scorecard(res, evictions_per_hour_budget=512.0)
+    problems = check_scorecard(card)
+    peak_rss = int(max([res.peak_rss_bytes] + sampler.mem)
+                   if sampler.mem else res.peak_rss_bytes)
+    pods_per_sec = res.pods_streamed / max(replay_wall, 1e-9)
+    half_moved = int(card["rebalance"]["half_moved_gangs"])
+    inv = res.invariants or {}
+
+    doc = {
+        "metric": "scenario_campaign",
+        "value": round(float(pods_per_sec), 3),
+        "unit": "pods_per_wall_second",
+        "seed": seed,
+        "detail": {
+            "num_nodes": num_nodes,
+            "batch": batch,
+            "duration_virtual_s": float(res.duration_virtual_s),
+            "replay_wall_s": float(replay_wall),
+            "gen_wall_s": float(gen_wall),
+            "trace_bytes_gz": int(trace_bytes),
+            "gen_stats": {k: int(v) for k, v in gen_stats.items()},
+            "pods_streamed": int(res.pods_streamed),
+            "pods_bound": int(res.pods_bound),
+            "events_consumed": int(res.events_consumed),
+            "queue_dropped": int(res.queue_dropped),
+            "unschedulable_events": int(res.unschedulable),
+            "scorecard": card,
+            "scorecard_problems": problems,
+            "half_moved_gangs": half_moved,
+            "peak_rss_bytes": peak_rss,
+            "rss_first_bytes": int(res.rss_samples[0]
+                                   if res.rss_samples else 0),
+            "rss_last_bytes": int(res.rss_samples[-1]
+                                  if res.rss_samples else 0),
+            "pods_double_bound": int(inv.get("pods_double_bound", 0)),
+            "invariants": {k: int(v) for k, v in inv.items()},
+            "cycle_p50_ms": float(res.cycle_ms.percentile(50.0)),
+            "cycle_p99_ms": float(res.cycle_ms.percentile(99.0)),
+            "spec": {
+                "base_rate": float(base_rate),
+                "gang_fraction": float(gang_fraction),
+                "oracle_sample": int(oracle_sample),
+                "slo_budget_ms": float(slo_budget_ms),
+                "node_classes": [c.name for c in
+                                 spec.cluster.node_classes],
+            },
+        },
+    }
+    artifacts: list[str] = []
+    write_artifact(out_dir, "scenario.json", doc, artifacts)
+    return SuiteResult("scenario", doc, artifacts)
 
 
 CONFIGS: dict[str, Callable[..., SuiteResult]] = {
@@ -2204,6 +2347,7 @@ CONFIGS: dict[str, Callable[..., SuiteResult]] = {
     "integrity": run_integrity_config,
     "quality": run_quality_config,
     "rebalance": run_rebalance_config,
+    "scenario": run_scenario_config,
 }
 
 # Reduced shapes for smoke runs / CPU CI.
@@ -2225,6 +2369,9 @@ SMALL = {
     "quality": dict(num_nodes=64, num_pods=96, batch=32),
     "rebalance": dict(num_nodes=64, num_pods=96, batch=32,
                       drift_nodes=8, rounds=4),
+    "scenario": dict(num_nodes=64, duration_s=30.0, base_rate=30.0,
+                     batch=32, gang_fraction=0.01,
+                     oracle_sample=64),
 }
 
 

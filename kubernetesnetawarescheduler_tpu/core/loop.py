@@ -212,6 +212,12 @@ class SchedulerLoop:
         # so a trace reader sees WHICH cycle first ran on corrupted
         # state.
         self._state_fault_pending: str | None = None
+        # Scenario replay (scenario/replay.py) sets these before each
+        # cycle so committed spans carry the trace join key; outside a
+        # replay they keep their pre-r13 defaults and spans serialize
+        # unchanged.
+        self.scenario_phase: str | None = None
+        self.trace_offset = 0
         # "fresh" | "restored" | "ignored": serve.py records its
         # checkpoint-restore decision here; /readyz reports it.
         self.checkpoint_state = "fresh"
@@ -677,6 +683,8 @@ class SchedulerLoop:
                                 if self.quality is not None else 0),
             rebalance_moves=rb_moves,
             rebalance_reverts=rb_reverts,
+            scenario_phase=self.scenario_phase,
+            trace_offset=int(self.trace_offset),
         )
         self.flight.commit(span)
 

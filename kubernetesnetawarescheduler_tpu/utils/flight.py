@@ -98,6 +98,14 @@ class CycleSpan:
     # dumps deserialize unchanged.
     rebalance_moves: int = 0
     rebalance_reverts: int = 0
+    # Scenario replay (ISSUE 14): which trace phase the replay
+    # harness was in when this cycle committed (None = not a replay)
+    # and how many trace events had been consumed — the join key
+    # between a flight export and the scenario trace that drove it.
+    # Default-valued: pre-r13 spans and crash dumps deserialize
+    # unchanged.
+    scenario_phase: str | None = None
+    trace_offset: int = 0
 
     def to_dict(self) -> dict[str, Any]:
         return {
@@ -124,6 +132,8 @@ class CycleSpan:
             "outcome_ring_depth": self.outcome_ring_depth,
             "rebalance_moves": self.rebalance_moves,
             "rebalance_reverts": self.rebalance_reverts,
+            "scenario_phase": self.scenario_phase,
+            "trace_offset": self.trace_offset,
         }
 
 

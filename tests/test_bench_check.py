@@ -631,3 +631,81 @@ def test_rebalance_shape_validated_when_present():
     quiet["detail"]["score_p99_ms"] = 87.44
     quiet["detail"]["north_star"]["p99_met"] = False
     assert bench_check.check_doc("BENCH_r12.json", quiet) == []
+
+
+def _scenario(**overrides):
+    """A healthy r13 scenario block (bench.py _persisted_scenario
+    shape).  The scorecard here is envelope-minimal on purpose: Rule
+    13 checks presence/non-emptiness; the full shape lint lives in
+    scenario/scorecard.check_scorecard (tests/test_scenario.py)."""
+    block = {
+        "pods_streamed": 1_050_000,
+        "scorecard": {"pods": {"streamed": 1_050_000},
+                      "slo": {"breach_fraction": 0.01}},
+        "half_moved_gangs": 0,
+        "peak_rss_bytes": 4 << 30,
+        "pods_per_wall_second": 520.0,
+        "source": "suite_scenario",
+    }
+    block.update(overrides)
+    return block
+
+
+def _r13_doc(**detail_overrides):
+    detail = {"trace_provenance": _trace_prov(),
+              "winner_fusion": _winner_fusion(),
+              "rounds_max": 4,
+              "integrity": _integrity(),
+              "quality": _quality(),
+              "rebalance": _rebalance(),
+              "scenario": _scenario()}
+    detail.update(detail_overrides)
+    return _headline(detail=detail)
+
+
+def test_scenario_block_required_from_round13():
+    # r13+ headline claiming the p99 bar without the block: fails.
+    doc = _r12_doc()
+    fails = bench_check.check_doc("BENCH_r13.json", doc)
+    assert any("scenario" in f for f in fails), fails
+    # Same doc with the block: clean.
+    assert bench_check.check_doc("BENCH_r13.json", _r13_doc()) == []
+    # Committed r12 history predates the scenario engine: exempt.
+    assert bench_check.check_doc("BENCH_r12.json", doc) == []
+    # A doc not claiming the bar may omit the block even at r13+.
+    quiet = _r12_doc()
+    quiet["detail"]["score_p99_ms"] = 87.44
+    quiet["detail"]["north_star"]["p99_met"] = False
+    assert bench_check.check_doc("BENCH_r13.json", quiet) == []
+
+
+def test_scenario_shape_validated_when_present():
+    # A campaign that streamed nothing proves nothing.
+    fails = bench_check.check_doc("BENCH_r13.json", _r13_doc(
+        scenario=_scenario(pods_streamed=0)))
+    assert any("streamed nothing" in f for f in fails), fails
+    # An empty scorecard is just a count with no outcomes.
+    fails = bench_check.check_doc("BENCH_r13.json", _r13_doc(
+        scenario=_scenario(scorecard={})))
+    assert any("scorecard" in f for f in fails), fails
+    # A half-moved gang is fatal regardless of the headline claim.
+    fails = bench_check.check_doc("BENCH_r13.json", _r13_doc(
+        scenario=_scenario(half_moved_gangs=1)))
+    assert any("half_moved_gangs=1" in f for f in fails), fails
+    # Missing envelope keys.
+    bad = _scenario()
+    del bad["scorecard"]
+    fails = bench_check.check_doc("BENCH_r13.json", _r13_doc(
+        scenario=bad))
+    assert any("scenario missing" in f for f in fails), fails
+    # Validated even on a pre-r13 filename: carrying the block opts
+    # in (same contract as every other provenance block).
+    fails = bench_check.check_doc("BENCH_r12.json", _r12_doc(
+        scenario=_scenario(half_moved_gangs=2)))
+    assert any("half_moved_gangs=2" in f for f in fails), fails
+    # Atomicity holds even when the doc is not claiming the bar.
+    quiet = _r13_doc(scenario=_scenario(half_moved_gangs=3))
+    quiet["detail"]["score_p99_ms"] = 87.44
+    quiet["detail"]["north_star"]["p99_met"] = False
+    fails = bench_check.check_doc("BENCH_r13.json", quiet)
+    assert any("half_moved_gangs=3" in f for f in fails), fails

@@ -1,0 +1,381 @@
+"""Scenario engine (kubernetesnetawarescheduler_tpu/scenario/).
+
+Determinism is the engine's whole warrant: a trace is REPLAYABLE
+evidence only if the same seed+spec produces byte-identical bytes,
+and replay is an EXPERIMENT only if driving the same pods through the
+loop directly places them on the same nodes.  Both are pinned here,
+along with the heterogeneous-fleet satellite's bit-identical-default
+regression (golden digests recorded BEFORE the node-class code
+existed) and the scorecard/trace shape lints the tools share.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import importlib.util
+import json
+import os
+
+import numpy as np
+import pytest
+
+from kubernetesnetawarescheduler_tpu.bench.fakecluster import (
+    ClusterSpec,
+    NodeClassSpec,
+    build_fake_cluster,
+)
+from kubernetesnetawarescheduler_tpu.scenario.generate import (
+    TRACE_FORMAT,
+    TRACE_VERSION,
+    ScenarioSpec,
+    generate_trace,
+    pod_from_event,
+    read_trace,
+    spec_from_json,
+    spec_to_json,
+)
+
+_TOOL = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "tools", "scenario_check.py")
+_spec = importlib.util.spec_from_file_location("scenario_check", _TOOL)
+scenario_check = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(scenario_check)
+
+
+# ---------------------------------------------------------------------------
+# Satellite: heterogeneous node classes, default bit-identical.
+# ---------------------------------------------------------------------------
+
+# sha256 digests of build_fake_cluster output captured on the commit
+# BEFORE NodeClassSpec existed.  If either moves, the single-class
+# default changed and every committed bench number silently refers to
+# a different cluster.
+_GOLDEN = {
+    (32, 3): ("bcad11d239ca47b912b3d1f401058ffb"
+              "538043164351b24a1295523e8db44680"),
+    (64, 0): ("0f39b86ea955825cf73744b72bdcef9a"
+              "2cd2b3cea56cc5ad166fa173c0bc201d"),
+}
+
+
+def _cluster_digest(spec: ClusterSpec) -> str:
+    cluster, lat, bw = build_fake_cluster(spec)
+    h = hashlib.sha256()
+    for node in cluster.list_nodes():
+        h.update(repr((node.name, sorted(node.capacity.items()),
+                       sorted(node.labels), sorted(node.taints),
+                       node.zone, node.rack)).encode())
+    h.update(lat.tobytes())
+    h.update(bw.tobytes())
+    return h.hexdigest()
+
+
+def test_fakecluster_default_parity():
+    for (n, seed), want in _GOLDEN.items():
+        got = _cluster_digest(ClusterSpec(num_nodes=n, seed=seed))
+        assert got == want, (
+            f"default cluster (num_nodes={n}, seed={seed}) is no "
+            f"longer bit-identical to the pre-node-class build: "
+            f"{got} != {want}")
+
+
+def test_fakecluster_node_classes():
+    classes = (NodeClassSpec("highmem", 0.25,
+                             mem_range=(512.0, 1024.0)),
+               NodeClassSpec("edge", 0.25, cpu_range=(2.0, 4.0),
+                             lat_scale=4.0, bw_scale=0.25),
+               NodeClassSpec("std", 0.5))
+    spec = ClusterSpec(num_nodes=32, seed=3, node_classes=classes)
+    cluster, lat, bw = build_fake_cluster(spec)
+    nodes = list(cluster.list_nodes())
+    by_class: dict[str, list[int]] = {}
+    for i, node in enumerate(nodes):
+        tag = next(lb.split("=")[1] for lb in node.labels
+                   if lb.startswith("nodeclass="))
+        by_class.setdefault(tag, []).append(i)
+    assert {k: len(v) for k, v in by_class.items()} == {
+        "highmem": 8, "edge": 8, "std": 16}
+    for i in by_class["highmem"]:
+        assert 512.0 <= nodes[i].capacity["mem"] <= 1024.0
+    for i in by_class["edge"]:
+        assert 2.0 <= nodes[i].capacity["cpu"] <= 4.0
+    # Link scaling: an edge<->std link is worse than the same
+    # std<->std tier — compare against the unclassed build of the
+    # SAME spec (identical rng stream by construction).
+    base_cluster, base_lat, base_bw = build_fake_cluster(
+        dataclasses.replace(spec, node_classes=()))
+    e, s = by_class["edge"][0], by_class["std"][0]
+    assert lat[e, s] == pytest.approx(base_lat[e, s] * 4.0)
+    assert bw[e, s] == pytest.approx(base_bw[e, s] * 0.25)
+    s2 = by_class["std"][1]
+    assert lat[s, s2] == pytest.approx(base_lat[s, s2])
+
+
+# ---------------------------------------------------------------------------
+# Generator determinism + trace format.
+# ---------------------------------------------------------------------------
+
+def _small_spec(**overrides) -> ScenarioSpec:
+    kw = dict(seed=5, duration_s=30.0, base_rate=8.0, tick_s=1.0,
+              gang_fraction=0.1, gang_sizes=(4,),
+              serving_lifetime_s=10.0, batch_lifetime_s=5.0,
+              gang_lifetime_s=8.0, lifetime_floor_s=2.0,
+              cluster=ClusterSpec(num_nodes=32, seed=3))
+    kw.update(overrides)
+    return ScenarioSpec(**kw)
+
+
+def test_trace_byte_identical(tmp_path):
+    spec = _small_spec()
+    p1, p2 = str(tmp_path / "a.jsonl"), str(tmp_path / "b.jsonl")
+    s1 = generate_trace(spec, p1)
+    s2 = generate_trace(spec, p2)
+    assert s1 == s2
+    b1, b2 = open(p1, "rb").read(), open(p2, "rb").read()
+    assert b1 == b2
+    assert s1["pods"] > 0 and s1["gangs"] > 0
+    # gzip output carries the same logical stream.
+    pz = str(tmp_path / "a.jsonl.gz")
+    generate_trace(spec, pz)
+    _, ev_plain = read_trace(p1)
+    _, ev_gz = read_trace(pz)
+    assert list(ev_plain) == list(ev_gz)
+
+
+def test_header_version_roundtrip(tmp_path):
+    spec = _small_spec()
+    path = str(tmp_path / "t.jsonl")
+    generate_trace(spec, path)
+    header, events = read_trace(path)
+    list(events)  # drain so the file handle closes
+    assert header["format"] == TRACE_FORMAT
+    assert header["version"] == TRACE_VERSION
+    assert header["seed"] == spec.seed
+    assert spec_from_json(header["spec"]) == spec
+    # json round-trip of the spec alone is lossless too (tuples and
+    # the nested ClusterSpec survive).
+    assert spec_from_json(
+        json.loads(json.dumps(spec_to_json(spec)))) == spec
+    # The tool's header lint agrees.
+    assert scenario_check.check_trace_header(header) == []
+    bad = dict(header)
+    bad["format"] = "bogus/v9"
+    assert scenario_check.check_trace_header(bad) != []
+
+
+def test_read_trace_rejects_wrong_format(tmp_path):
+    path = str(tmp_path / "bad.jsonl")
+    with open(path, "w", encoding="utf-8") as fh:
+        fh.write(json.dumps({"kind": "header", "format": "nope",
+                             "version": 1}) + "\n")
+    with pytest.raises(ValueError):
+        read_trace(path)
+
+
+def test_events_monotonic_and_typed(tmp_path):
+    spec = _small_spec(link_burst_rate_per_s=0.1,
+                       node_churn_rate_per_s=0.05,
+                       state_fault_rate_per_s=0.05)
+    path = str(tmp_path / "t.jsonl")
+    generate_trace(spec, path)
+    _, events = read_trace(path)
+    last_t = -1.0
+    kinds = set()
+    for ev in events:
+        assert ev["t"] >= last_t
+        last_t = ev["t"]
+        kinds.add(ev["kind"])
+        if ev["kind"] == "pod":
+            pod = pod_from_event(ev, "netAwareScheduler")
+            assert pod.requests["cpu"] > 0
+    assert "pod" in kinds and "delete" in kinds
+
+
+# ---------------------------------------------------------------------------
+# Replay determinism (the tentpole's property tests).
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def small_trace(tmp_path_factory):
+    # Lifetimes LONGER than the trace: every delete trails the last
+    # pod event, so the direct-drive comparison sees identical wave
+    # boundaries (pod_waves ignores non-pod events by contract).
+    spec = _small_spec(duration_s=20.0, base_rate=10.0,
+                       serving_lifetime_s=500.0,
+                       batch_lifetime_s=500.0,
+                       gang_lifetime_s=500.0,
+                       lifetime_floor_s=400.0)
+    path = str(tmp_path_factory.mktemp("trace") / "t.jsonl")
+    stats = generate_trace(spec, path)
+    return path, stats
+
+
+def _replay_kwargs():
+    return dict(batch=16, chaos=False, drift=False,
+                state_faults=False, rebalance=False, quality=False,
+                oracle_sample=0, compact=False,
+                collect_placements=True, queue_capacity=1024)
+
+
+@pytest.mark.slow
+def test_replay_twice_bit_identical(small_trace):
+    from kubernetesnetawarescheduler_tpu.scenario.replay import (
+        replay_trace,
+    )
+
+    path, stats = small_trace
+    r1 = replay_trace(path, **_replay_kwargs())
+    r2 = replay_trace(path, **_replay_kwargs())
+    assert r1.pods_streamed == stats["pods"]
+    assert r1.pods_bound > 0
+    assert r1.placements == r2.placements
+    assert r1.pods_bound == r2.pods_bound
+
+
+@pytest.mark.slow
+def test_replay_matches_direct_drive(small_trace):
+    """Knobs-off replay is placement-bit-identical to feeding the
+    same pods straight through a fresh SchedulerLoop at the public
+    pod_waves boundaries — the harness adds NOTHING to placement."""
+    from kubernetesnetawarescheduler_tpu.scenario.replay import (
+        _build_loop,
+        pod_waves,
+        replay_trace,
+    )
+
+    path, _stats = small_trace
+    res = replay_trace(path, **_replay_kwargs())
+
+    header, events = read_trace(path)
+    spec = spec_from_json(header["spec"])
+    batch = 16
+    loop, cfg, client, _nodes, _lat, _bw = _build_loop(
+        header, batch, "parallel", chaos=False, queue_capacity=1024)
+    for _t, wave in pod_waves(events, batch, spec.tick_s,
+                              cfg.scheduler_name):
+        client.add_pods(wave)
+        loop.run_once(timeout=0.0)
+        stall = 0
+        while len(loop.queue) > 2 * batch and stall < 8:
+            before = (loop.scheduled, len(loop.queue))
+            loop.run_once(timeout=0.0)
+            stall = (stall + 1
+                     if (loop.scheduled, len(loop.queue)) == before
+                     else 0)
+    loop.run_until_drained()
+    loop.flush_binds()
+    direct = {b.pod_name: b.node_name for b in client.bindings}
+    loop.stop_bind_worker()
+
+    assert direct == res.placements
+
+
+@pytest.mark.slow
+def test_replay_with_drift_deterministic(small_trace, tmp_path):
+    """Link drift changes placements deterministically: two replays
+    of a bursty trace agree with each other."""
+    from kubernetesnetawarescheduler_tpu.scenario.replay import (
+        replay_trace,
+    )
+
+    spec = _small_spec(duration_s=20.0, base_rate=10.0,
+                       link_burst_rate_per_s=0.3,
+                       link_burst_duration_s=5.0)
+    path = str(tmp_path / "bursty.jsonl")
+    generate_trace(spec, path)
+    kw = _replay_kwargs()
+    kw["drift"] = True
+    r1 = replay_trace(path, **kw)
+    r2 = replay_trace(path, **kw)
+    assert r1.link_bursts_applied == r2.link_bursts_applied
+    assert r1.placements == r2.placements
+
+
+@pytest.mark.slow
+def test_replay_repairs_state_faults(tmp_path):
+    """State-fault injection rides with the r10 auditor: faults are
+    detected and repaired (unrepaired == 0) and binding keeps working
+    after a nan_poison — an unpaired injector froze a 1M-pod campaign
+    at its first fault."""
+    from kubernetesnetawarescheduler_tpu.scenario.replay import (
+        replay_trace,
+    )
+
+    spec = _small_spec(seed=1, duration_s=60.0, base_rate=25.0,
+                       gang_fraction=0.0,
+                       state_fault_rate_per_s=0.1)
+    path = str(tmp_path / "faulty.jsonl")
+    stats = generate_trace(spec, path)
+    assert stats["state_faults"] > 0
+    r = replay_trace(path, batch=16, chaos=False, drift=False,
+                     state_faults=True, rebalance=False, quality=False,
+                     oracle_sample=0, queue_capacity=1024)
+    assert sum(r.state_faults.values()) > 0
+    assert r.integrity is not None
+    assert r.integrity["unrepaired"] == 0
+    # The run stayed functional: the vast majority of pods bound.
+    assert r.pods_bound >= 0.9 * r.pods_streamed
+    assert r.queue_dropped == 0
+
+
+# ---------------------------------------------------------------------------
+# Scorecard shape.
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_scorecard_shape_and_artifact_lint(small_trace):
+    from kubernetesnetawarescheduler_tpu.scenario.replay import (
+        replay_trace,
+    )
+    from kubernetesnetawarescheduler_tpu.scenario.scorecard import (
+        build_scorecard,
+        check_scorecard,
+    )
+
+    path, _stats = small_trace
+    res = replay_trace(path, **_replay_kwargs())
+    card = build_scorecard(res)
+    assert check_scorecard(card) == []
+    # json round-trip stays clean (the committed-artifact path).
+    assert check_scorecard(json.loads(json.dumps(card))) == []
+    # The artifact-envelope lint accepts the leg's doc shape...
+    doc = {"metric": "scenario_campaign", "value": 1.0,
+           "detail": {"pods_streamed": res.pods_streamed,
+                      "half_moved_gangs": 0,
+                      "scorecard": card}}
+    assert scenario_check.check_artifact(doc) == []
+    # ...and fires on the failure shapes.
+    assert scenario_check.check_artifact(
+        {"detail": {"pods_streamed": 0, "half_moved_gangs": 0,
+                    "scorecard": card}}) != []
+    assert scenario_check.check_artifact(
+        {"detail": {"pods_streamed": 10, "half_moved_gangs": 1,
+                    "scorecard": card}}) != []
+    mangled = json.loads(json.dumps(card))
+    del mangled["slo"]
+    assert check_scorecard(mangled) != []
+
+
+def test_pod_waves_contract():
+    """Waves split on batch-full and on tick-bucket boundaries, and
+    non-pod events never land in a wave."""
+    from kubernetesnetawarescheduler_tpu.scenario.replay import (
+        pod_waves,
+    )
+
+    def pod_ev(t, name):
+        return {"kind": "pod", "t": t,
+                "pod": {"name": name, "cpu": 0.1, "mem": 0.2,
+                        "net_bw": 0.05}}
+
+    events = ([pod_ev(0.1, f"a{i}") for i in range(5)]
+              + [{"kind": "link_degrade", "t": 0.5, "nodes": [],
+                  "factor": 2.0}]
+              + [pod_ev(1.2, f"b{i}") for i in range(3)]
+              + [pod_ev(2.7, "c0")])
+    waves = list(pod_waves(iter(events), batch=4, tick_s=1.0))
+    names = [[p.name for p in w] for _t, w in waves]
+    # batch-full split inside bucket 0, boundary splits after.
+    assert names == [["a0", "a1", "a2", "a3"], ["a4"],
+                     ["b0", "b1", "b2"], ["c0"]]

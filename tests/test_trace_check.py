@@ -173,3 +173,45 @@ def test_pre_r11_traces_stay_lint_clean():
             ev["args"].pop("slo_burning", None)
             ev["args"].pop("outcome_ring_depth", None)
     assert trace_check.check_trace(doc) == []
+
+
+def test_r13_scenario_args_validated_when_present():
+    # Valid values pass.
+    doc = _recorded_trace()
+    ok = copy.deepcopy(doc)
+    cyc = next(e for e in ok["traceEvents"]
+               if e.get("cat") == "cycle")
+    cyc["args"]["scenario_phase"] = "steady"
+    cyc["args"]["trace_offset"] = 12345
+    assert trace_check.check_trace(ok) == []
+    # Null scenario_phase (not a replay) passes too.
+    ok2 = copy.deepcopy(doc)
+    cyc = next(e for e in ok2["traceEvents"]
+               if e.get("cat") == "cycle")
+    cyc["args"]["scenario_phase"] = None
+    cyc["args"]["trace_offset"] = 0
+    assert trace_check.check_trace(ok2) == []
+    # Wrong types fire.
+    bad = copy.deepcopy(doc)
+    cyc = next(e for e in bad["traceEvents"]
+               if e.get("cat") == "cycle")
+    cyc["args"]["scenario_phase"] = 7
+    fails = trace_check.check_trace(bad)
+    assert any("scenario_phase" in f for f in fails), fails
+    bad = copy.deepcopy(doc)
+    cyc = next(e for e in bad["traceEvents"]
+               if e.get("cat") == "cycle")
+    cyc["args"]["trace_offset"] = -3
+    fails = trace_check.check_trace(bad)
+    assert any("trace_offset" in f for f in fails), fails
+
+
+def test_pre_r13_traces_stay_lint_clean():
+    # A dump from before the r13 scenario fields (neither key
+    # present) must keep linting clean.
+    doc = _recorded_trace()
+    for ev in doc["traceEvents"]:
+        if ev.get("cat") == "cycle":
+            ev["args"].pop("scenario_phase", None)
+            ev["args"].pop("trace_offset", None)
+    assert trace_check.check_trace(doc) == []

@@ -619,6 +619,62 @@ def check_doc(path: str, doc: dict) -> list[str]:
                             f"budget {budget} evictions/pod/hour — "
                             "the claimed p99 was bought with "
                             "unbudgeted churn")
+
+    # Rule 13 — scenario provenance (round 13+): a headline claiming
+    # the p99 bar must prove the stack survived a trace-driven
+    # scenario campaign — a ``scenario`` block from the ``bench.py
+    # --suite scenario`` leg with the streamed-pod count, the full
+    # outcome scorecard, and ZERO half-moved gangs (the same
+    # atomicity invariant Rule 12 pins, re-checked here because the
+    # scenario leg exercises it under churn the rebalance leg never
+    # sees).  Round-gated by filename like Rules 8-12; the block's
+    # shape is validated wherever it appears.
+    if not grandfathered:
+        ns = detail.get("north_star")
+        p99_met = isinstance(ns, dict) and bool(ns.get("p99_met"))
+        scen = detail.get("scenario")
+        rnd = _round_of(name)
+        if scen is None:
+            if p99_met and rnd is not None and rnd >= 13:
+                fails.append(
+                    f"{name}: north_star.p99_met without a scenario "
+                    "block (round 13+ requires the --suite scenario "
+                    "leg's streamed-campaign evidence behind any "
+                    "claimed p99)")
+        elif not isinstance(scen, dict):
+            fails.append(f"{name}: scenario is not an object")
+        else:
+            required = {"pods_streamed", "scorecard",
+                        "half_moved_gangs"}
+            missing = required - set(scen)
+            if missing:
+                fails.append(f"{name}: scenario missing "
+                             f"{sorted(missing)}")
+            else:
+                card = scen["scorecard"]
+                try:
+                    streamed = int(scen["pods_streamed"])
+                    half = int(scen["half_moved_gangs"])
+                except (TypeError, ValueError):
+                    fails.append(f"{name}: scenario not numeric")
+                else:
+                    if streamed <= 0:
+                        fails.append(
+                            f"{name}: scenario.pods_streamed="
+                            f"{streamed} — a campaign that streamed "
+                            "nothing proves nothing")
+                    if not isinstance(card, dict) or not card:
+                        fails.append(
+                            f"{name}: scenario.scorecard missing or "
+                            "empty — the leg must publish the full "
+                            "outcome scorecard, not just a count")
+                    if half != 0:
+                        fails.append(
+                            f"{name}: scenario.half_moved_gangs="
+                            f"{half} — a gang was left part-moved "
+                            "during the campaign; the migration "
+                            "ledger's all-or-nothing contract is "
+                            "broken")
     return fails
 
 

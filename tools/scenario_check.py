@@ -1,0 +1,150 @@
+#!/usr/bin/env python3
+"""Lint scenario traces and scenario-leg artifacts.
+
+Two artifact families come out of the scenario engine
+(kubernetesnetawarescheduler_tpu/scenario/):
+
+* **traces** (``*.jsonl`` / ``*.jsonl.gz``) — the generator's event
+  stream.  Only the versioned header is read (streaming a multi-GB
+  trace to lint it would defeat the engine's bounded-memory point):
+  format tag, version, seed, and the embedded spec must be present
+  and well-formed, or every downstream replay is built on sand.
+* **scorecard artifacts** (``*.json``) — the ``bench.py --suite
+  scenario`` leg's output.  The scorecard shape lint is
+  :func:`~kubernetesnetawarescheduler_tpu.scenario.scorecard.check_scorecard`
+  — the SAME function the leg ran at publish time, so a hand-edited
+  or truncated artifact fails here exactly like a miscomputed one —
+  plus the Rule 13 envelope fields (``pods_streamed``,
+  ``half_moved_gangs``).
+
+Usage: ``scenario_check.py [paths...]``; default is the committed
+``bench_artifacts/scenario.json`` (if present).  Exits nonzero on any
+failure.  ``check_trace_header(header)`` and ``check_artifact(doc)``
+are importable for tests (tests/test_scenario.py).
+
+Imports stay numpy-light: the scenario package's lazy ``__init__``
+keeps the jax-backed replay harness out of this tool's import graph.
+"""
+
+from __future__ import annotations
+
+import gzip
+import io
+import json
+import os
+import sys
+from typing import Any
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+from kubernetesnetawarescheduler_tpu.scenario.generate import (  # noqa: E402
+    TRACE_FORMAT,
+    TRACE_VERSION,
+)
+from kubernetesnetawarescheduler_tpu.scenario.scorecard import (  # noqa: E402
+    check_scorecard,
+)
+
+_SPEC_REQUIRED = ("seed", "duration_s", "tick_s", "base_rate",
+                  "cluster")
+
+
+def check_trace_header(header: Any) -> list[str]:
+    """Problems with a trace's header line (empty = clean)."""
+    fails: list[str] = []
+    if not isinstance(header, dict):
+        return ["header: not a JSON object"]
+    if header.get("kind") != "header":
+        fails.append(f"header.kind is {header.get('kind')!r}, "
+                     "expected 'header'")
+    if header.get("format") != TRACE_FORMAT:
+        fails.append(f"header.format is {header.get('format')!r}, "
+                     f"expected {TRACE_FORMAT!r}")
+    v = header.get("version")
+    if not isinstance(v, int) or v < 1:
+        fails.append(f"header.version invalid: {v!r}")
+    elif v > TRACE_VERSION:
+        fails.append(f"header.version {v} is newer than this "
+                     f"tree's reader ({TRACE_VERSION})")
+    if not isinstance(header.get("seed"), int):
+        fails.append(f"header.seed invalid: {header.get('seed')!r}")
+    spec = header.get("spec")
+    if not isinstance(spec, dict):
+        fails.append("header.spec missing or not an object")
+    else:
+        for k in _SPEC_REQUIRED:
+            if k not in spec:
+                fails.append(f"header.spec.{k} missing")
+    return fails
+
+
+def check_artifact(doc: Any) -> list[str]:
+    """Problems with a scenario-leg artifact doc (empty = clean)."""
+    fails: list[str] = []
+    if not isinstance(doc, dict):
+        return ["artifact: not a JSON object"]
+    detail = doc.get("detail")
+    if not isinstance(detail, dict):
+        return ["artifact: detail missing or not an object"]
+    streamed = detail.get("pods_streamed")
+    if not isinstance(streamed, int) or streamed <= 0:
+        fails.append(f"detail.pods_streamed invalid: {streamed!r}")
+    half = detail.get("half_moved_gangs")
+    if not isinstance(half, int):
+        fails.append(f"detail.half_moved_gangs invalid: {half!r}")
+    elif half != 0:
+        fails.append(f"detail.half_moved_gangs={half} — gang "
+                     "atomicity broken during the campaign")
+    fails.extend(check_scorecard(detail.get("scorecard")))
+    return fails
+
+
+def _read_header(path: str) -> Any:
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rb") as raw:
+        with io.TextIOWrapper(raw, encoding="utf-8") as fh:
+            line = fh.readline()
+    return json.loads(line)
+
+
+def run(paths: list[str]) -> int:
+    failures = 0
+    for path in paths:
+        try:
+            if path.endswith((".jsonl", ".jsonl.gz")):
+                fails = check_trace_header(_read_header(path))
+            else:
+                with open(path, encoding="utf-8") as fh:
+                    fails = check_artifact(json.load(fh))
+        except (OSError, ValueError) as exc:
+            fails = [f"unreadable: {exc}"]
+        if fails:
+            failures += 1
+            print(f"FAIL {path}")
+            for f in fails:
+                print(f"  - {f}")
+        else:
+            print(f"ok   {path}")
+    return failures
+
+
+def main(argv: list[str]) -> int:
+    paths = argv or [
+        p for p in
+        (os.path.join(_REPO, "bench_artifacts", "scenario.json"),)
+        if os.path.exists(p)
+    ]
+    if not paths:
+        print("scenario_check: nothing to lint", file=sys.stderr)
+        return 0
+    failures = run(paths)
+    if failures:
+        print(f"{failures} file(s) failed", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv[1:]))

@@ -28,6 +28,10 @@ Checks:
     (``rebalance_moves``/``rebalance_reverts``) must be non-negative
     integers; validated only when present, so pre-r12 dumps lint
     clean
+  * scenario replay — cycle spans carrying the r13 args must have a
+    non-negative integer ``trace_offset`` and a null-or-string
+    ``scenario_phase``; validated only when present, so pre-r13
+    dumps lint clean
 
 A cycle's phase set is NOT prescribed: the r9 fused single-dispatch
 step collapses score+assign+commit into one ``score_assign`` phase
@@ -111,7 +115,7 @@ def check_trace(doc: Any) -> list[str]:
             # (pre-r9 dumps carry none of these and stay clean).
             for k in ("rounds", "donated", "donation_skipped",
                       "outcome_ring_depth", "rebalance_moves",
-                      "rebalance_reverts"):
+                      "rebalance_reverts", "trace_offset"):
                 v = args.get(k)
                 if v is not None and (not isinstance(v, int)
                                       or v < 0):
@@ -124,6 +128,13 @@ def check_trace(doc: Any) -> list[str]:
                 if v is not None and not isinstance(v, str):
                     fails.append(f"event[{i}] ({ev.get('name')}) "
                                  f"args.slo_burning invalid: {v!r}")
+            # r13 scenario-replay join key: null (not a replay, or
+            # pre-r13 dump) or the replay phase name.
+            if "scenario_phase" in args:
+                v = args["scenario_phase"]
+                if v is not None and not isinstance(v, str):
+                    fails.append(f"event[{i}] ({ev.get('name')}) "
+                                 f"args.scenario_phase invalid: {v!r}")
         elif cat == "phase":
             phases.append((ts, ts + dur, i,
                            (key, args.get("cycle_id"))))
