@@ -599,6 +599,24 @@ class ExtenderHandlers:
                 "quality": (quality.summary() if quality is not None
                             else {"enabled": False}),
             })
+        if path == "/debug/policy":
+            # The learned scoring policy's full state: term
+            # multipliers (EMA read), ring/training counters, shadow
+            # disagreement, and the last promotion's gate decision —
+            # the first stop of the "promoting / rolling back a
+            # learned policy" runbook (docs/OPERATIONS.md).  The
+            # dataset join counters ride along so an empty ring is
+            # attributable (no explains vs no outcomes vs unlabelable).
+            policy = getattr(self._loop, "policy", None)
+            if policy is None:
+                return self._json({"enabled": False})
+            out = policy.summary()
+            ds = getattr(self._loop, "policy_dataset", None)
+            out["dataset"] = (ds.summary() if ds is not None
+                              else None)
+            out["eval_trace"] = getattr(self._loop,
+                                        "policy_eval_trace", None)
+            return self._json(out)
         if path == "/debug/rebalance":
             # The descheduler's full state: scan/candidate/move
             # counters, the skip breakdown (which hysteresis gate or

@@ -459,6 +459,48 @@ class SchedulerConfig:
     rebalance_evictions_per_hour: float = 60.0
     rebalance_move_timeout_s: float = 120.0
 
+    # ---- learned scoring policy (policy/) ----
+    # Off by default: with the policy disabled, scoring consumes the
+    # hand-tuned ScoreWeights constants bit-identically to a build
+    # without the subsystem (same discipline as enable_netmodel).
+    # When enabled the policy SHADOW-scores first — candidate weights
+    # are never promoted into the live scorer without winning the
+    # counterfactual-replay gate (policy/replay_eval.py) by at least
+    # policy_promote_margin.
+    enable_learned_score: bool = False
+
+    # Bounded example ring the Adam step samples from (one example per
+    # harvested scheduling decision), mini-batch size, steps per
+    # train() call and PEAK learning rate (inverse-sqrt decay in total
+    # steps, floored at lr/8 — same schedule as netmodel.fit).
+    # ring >= batch so a batch never aliases.
+    policy_ring: int = 4096
+    policy_batch: int = 128
+    policy_steps: int = 4
+    policy_lr: float = 0.05
+
+    # Minimum harvested examples before the first train step runs —
+    # a near-empty ring would overfit a handful of decisions.
+    policy_min_examples: int = 64
+
+    # Maintain-cadence intervals: dataset-harvest + train tick, and
+    # the (much rarer) counterfactual evaluation / promotion tick.
+    policy_train_interval_s: float = 10.0
+    policy_eval_interval_s: float = 120.0
+
+    # Promotion margin: a candidate must beat the incumbent's
+    # counterfactual replay outcome (realized-bandwidth-vs-oracle
+    # ratio) by at least this much to be promoted.  Below the margin
+    # the candidate keeps shadow-scoring and only the disagreement
+    # rate is exported.
+    policy_promote_margin: float = 0.02
+
+    # Regret tolerance when labeling harvested decisions: an outcome
+    # whose quality-observer regret is <= this is treated as "the
+    # shipped choice was right"; above it the hindsight-best candidate
+    # becomes the training target.
+    policy_regret_margin: float = 0.05
+
     def __post_init__(self) -> None:
         if self.max_nodes <= 0 or self.max_pods <= 0 or self.max_peers <= 0:
             raise ValueError("shape limits must be positive")
@@ -559,6 +601,24 @@ class SchedulerConfig:
                 "rebalance_evictions_per_hour must be >= 0")
         if self.rebalance_move_timeout_s <= 0:
             raise ValueError("rebalance_move_timeout_s must be > 0")
+        if self.policy_batch < 1:
+            raise ValueError("policy_batch must be >= 1")
+        if self.policy_ring < self.policy_batch:
+            raise ValueError("policy_ring must be >= policy_batch")
+        if self.policy_steps < 0:
+            raise ValueError("policy_steps must be >= 0")
+        if self.policy_lr <= 0:
+            raise ValueError("policy_lr must be > 0")
+        if self.policy_min_examples < 1:
+            raise ValueError("policy_min_examples must be >= 1")
+        if self.policy_train_interval_s <= 0:
+            raise ValueError("policy_train_interval_s must be > 0")
+        if self.policy_eval_interval_s <= 0:
+            raise ValueError("policy_eval_interval_s must be > 0")
+        if self.policy_promote_margin < 0:
+            raise ValueError("policy_promote_margin must be >= 0")
+        if self.policy_regret_margin < 0:
+            raise ValueError("policy_regret_margin must be >= 0")
 
 
 # ---------------------------------------------------------------------------

@@ -250,9 +250,18 @@ def read_state_arrays(path: str) -> "dict[str, np.ndarray]":
 # ---------------------------------------------------------------------------
 
 
-def save_checkpoint(path: str, encoder: Encoder) -> None:
+def save_checkpoint(path: str, encoder: Encoder,
+                    policy=None) -> None:
     """Write the encoder's full staging state (the host mirror of the
-    HBM matrices) + naming/interning tables under ``path``."""
+    HBM matrices) + naming/interning tables under ``path``.
+
+    ``policy``, when given, is the loop's learned
+    :class:`~kubernetesnetawarescheduler_tpu.policy.ScoringPolicy`:
+    its parameters/optimizer/example ring land in ``policy.npz``
+    beside the encoder state, and the promotion provenance (which
+    parameter version shipped, under which gate decision) rides the
+    manifest-verified meta so tools/state_audit.py can cross-check
+    them offline."""
     os.makedirs(path, exist_ok=True)
     with encoder._lock:
         # Deep copies under the lock: serialization happens after the
@@ -324,6 +333,12 @@ def save_checkpoint(path: str, encoder: Encoder) -> None:
                 for key, (ml, exprs)
                 in encoder._selector_defs.items()},
         }
+    if policy is not None:
+        meta["policy"] = {
+            "version": int(policy.version),
+            "promoted_version": int(policy.promoted_version),
+            "last_promotion": policy.last_promotion,
+        }
     # Staged commit (r10): every payload file is written to .staging/
     # first, the CURRENT good set is preserved under previous/, the
     # payload files rename into place, and the MANIFEST rename is the
@@ -348,6 +363,12 @@ def save_checkpoint(path: str, encoder: Encoder) -> None:
     if encoder.netmodel is not None:
         encoder.netmodel.save(os.path.join(staging, "netmodel.npz"))
         payload.append("netmodel.npz")
+    # Learned scoring policy (policy/): same attach-only discipline as
+    # the netmodel file — written when the loop runs one, dropped from
+    # the manifest (and removed post-commit) when it does not.
+    if policy is not None:
+        policy.save(os.path.join(staging, "policy.npz"))
+        payload.append("policy.npz")
     manifest = {
         "format_version": FORMAT_VERSION,
         "files": {name: _sha256_file(os.path.join(staging, name))
@@ -383,6 +404,9 @@ def save_checkpoint(path: str, encoder: Encoder) -> None:
     npz = os.path.join(path, "netmodel.npz")
     if encoder.netmodel is None and os.path.exists(npz):
         os.remove(npz)
+    pol_npz = os.path.join(path, "policy.npz")
+    if policy is None and os.path.exists(pol_npz):
+        os.remove(pol_npz)
     shutil.rmtree(staging, ignore_errors=True)
 
 
@@ -570,6 +594,31 @@ def load_checkpoint(path: str,
     for key in enc._dirty:
         enc._dirty[key] = True
     return enc
+
+
+def load_policy(path: str, cfg: SchedulerConfig, seed: int = 0):
+    """Restore the learned scoring policy saved beside the encoder
+    state.  Returns None when the config does not want one or the
+    checkpoint carries none; a shape mismatch (explain_top_k /
+    max_zones / policy_ring changed) starts the policy fresh rather
+    than failing — same degradation contract as the netmodel
+    restore."""
+    if not cfg.enable_learned_score:
+        return None
+    path = resolve_checkpoint_dir(path)
+    npz = os.path.join(path, "policy.npz")
+    if not os.path.exists(npz):
+        return None
+    from kubernetesnetawarescheduler_tpu.policy import ScoringPolicy
+
+    try:
+        return ScoringPolicy.load(npz, cfg, seed=seed)
+    except ValueError as exc:
+        import sys
+
+        print(f"WARNING: policy checkpoint not restored: {exc}; "
+              "starting with a fresh policy", file=sys.stderr)
+        return ScoringPolicy(cfg, seed=seed)
 
 
 def replay_decisions(encoder: Encoder, pods: Sequence,

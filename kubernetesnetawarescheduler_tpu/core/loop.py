@@ -125,8 +125,9 @@ class SchedulerLoop:
         self.timer = HistogramPhaseTimer()
         # Decision-level tracing (utils/flight.py): every serving cycle
         # commits one CycleSpan into this bounded ring buffer, and
-        # (with cfg.enable_explain) serial/gang cycles retain a per-pod
-        # score-decomposition record.  Observation only — nothing here
+        # (with cfg.enable_explain) every serving path — serial, gang,
+        # burst, pipelined — retains a per-pod score-decomposition
+        # record at its commit seam.  Observation only — nothing here
         # feeds back into scoring.  cfg.flight_recorder_size=0
         # disables the recorder entirely (NULL_SPAN no-ops).
         self.flight: FlightRecorder | None = (
@@ -192,6 +193,37 @@ class SchedulerLoop:
             self.slo = None
         self._slo_last_eval = 0.0
         self._quality_last_harvest = 0.0
+        # Learned scoring policy (policy/, ISSUE 15): trains term
+        # multipliers off the explain/outcome join and shadow-scores
+        # recorded decisions; candidate weights reach the live scorer
+        # ONLY through the counterfactual promotion gate (a seeded
+        # scenario replay it must WIN).  Disabled (default) nothing is
+        # constructed and scoring is bit-identical to cfg.weights
+        # (tests/test_policy.py).
+        if cfg.enable_learned_score:
+            from kubernetesnetawarescheduler_tpu.policy import (
+                PolicyDataset,
+                ScoringPolicy,
+            )
+
+            self.policy: "ScoringPolicy | None" = ScoringPolicy(cfg)
+            self.policy_dataset: "PolicyDataset | None" = (
+                PolicyDataset(cfg, self.policy.k_pad))
+        else:
+            self.policy = None
+            self.policy_dataset = None
+        # Replay trace the eval tick's promotion gate replays; no
+        # trace -> the gate refuses (shadow-only fail-safe).  serve.py
+        # --policy-eval-trace sets it.
+        self.policy_eval_trace: str | None = None
+        self._policy_last_train = 0.0
+        self._policy_last_eval = 0.0
+        # Last-seen cumulative shadow-disagreement count, for the
+        # per-span delta (the rebalance accounting pattern), and the
+        # newest explain t_wall already shadow-ranked (the eval tick
+        # must not re-count retained records).
+        self._policy_shadow_last = 0
+        self._policy_shadow_twall = 0.0
         # Continuous rebalancing (core/rebalance.py, ISSUE 12): the
         # budgeted descheduler acts on the degradation signals the
         # observers above only measure.  Off by default; with budget 0
@@ -358,7 +390,8 @@ class SchedulerLoop:
         # leak committed usage.  Guarded by _parked_lock.
         self._parked_binds: deque = deque()
         # In-flight pipelined burst: (pods, device out, with_stats,
-        # node_table, n_real, dispatch t0).  Owned by the cycle thread
+        # node_table, n_real, dispatch t0, snapshot state, static).
+        # Owned by the cycle thread
         # (run_once / flush_binds callers); retired before any state
         # read that must see its placements.
         self._pipe_inflight: tuple | None = None
@@ -656,6 +689,14 @@ class SchedulerLoop:
             self._rebalance_last = (mt, rt)
             rb_moves = max(mt - last_mt, 0)
             rb_reverts = max(rt - last_rt, 0)
+        # Policy accounting: same cumulative->per-span-delta shape
+        # (shadow ranking runs on the maintain path).
+        pol_disagree = pol_version = 0
+        if self.policy is not None:
+            sd = int(self.policy.shadow_disagreement_total)
+            pol_disagree = max(sd - self._policy_shadow_last, 0)
+            self._policy_shadow_last = sd
+            pol_version = int(self.policy.version)
         # Cap the per-span uid list: a whole-workload bench drain can
         # retire tens of thousands of pods in one span, and the ring
         # holds `capacity` spans — n_pods still carries the true count.
@@ -685,6 +726,8 @@ class SchedulerLoop:
             rebalance_reverts=rb_reverts,
             scenario_phase=self.scenario_phase,
             trace_offset=int(self.trace_offset),
+            policy_shadow_disagreements=pol_disagree,
+            policy_version=pol_version,
         )
         self.flight.commit(span)
 
@@ -696,11 +739,14 @@ class SchedulerLoop:
         with the score decomposition and the gates that filtered the
         rest).  Host-side, AFTER the jitted score/assign already ran —
         gated by cfg.enable_explain, so when off the serving path is
-        untouched and placements are bit-identical.  Serial and gang
-        cycles only: burst/pipelined streams resolve in-stream peers
-        against mid-burst placements the snapshot no longer matches,
-        and an approximate decomposition would violate the
-        "reproduces the winner's score" contract."""
+        untouched and placements are bit-identical.  All four serving
+        paths call this at their retire/commit seam: serial and gang
+        pass the exact cycle batch (the decomposition reproduces the
+        winner's score, tests/test_score.py); burst/pipelined pass
+        per-chunk re-encodes built at commit time (see
+        :meth:`_capture_explains_burst`), whose in-stream peers
+        resolve against the now-published placements — the totals are
+        a post-hoc decomposition there, not the in-scan score."""
         if (self.flight is None or not self.cfg.enable_explain
                 or not pods):
             return
@@ -725,6 +771,9 @@ class SchedulerLoop:
             prov = {"network": "direct_probe"}
         k = min(self.cfg.explain_top_k, len(table_names))
         total = comps["total"]
+        # Node class for the learned policy's per-class adjustment:
+        # the encoder's interned zone index (-1 = no zone label).
+        zones = np.asarray(state.node_zone, dtype=np.int64)
         now = time.time()
         for i, pod in enumerate(pods):
             row = total[i]
@@ -739,6 +788,7 @@ class SchedulerLoop:
                 candidates.append({
                     "node": name,
                     "node_index": j,
+                    "zone": int(zones[j]) if j < len(zones) else -1,
                     "total": float(row[j]),
                     "feasible": bool(comps["ok"][i, j]),
                     "components": {
@@ -772,6 +822,34 @@ class SchedulerLoop:
             if extra:
                 record.update(extra)
             self.flight.put_explain(record)
+
+    def _capture_explains_burst(self, pods: Sequence[Pod],
+                                assignment: np.ndarray, state, static,
+                                node_table, cycle_id: int,
+                                path: str) -> None:
+        """Explain capture for the burst/pipelined paths, run at the
+        retire/commit seam AFTER the assume/bind published this
+        burst's placements.  The scanned device step never
+        materializes per-batch score planes, so each max_pods chunk is
+        re-encoded here — in-stream peers then resolve against the
+        placements the scan actually produced — and decomposed through
+        the same :meth:`_capture_explains` body.  Observation only:
+        encode errors drop the remaining chunks, never the cycle."""
+        if (self.flight is None or not self.cfg.enable_explain
+                or not pods):
+            return
+        cap = self.cfg.max_pods
+        for off in range(0, len(pods), cap):
+            chunk = list(pods[off:off + cap])
+            try:
+                batch = self.encoder.encode_pods(
+                    chunk, node_of=self._peer_node, lenient=True)
+            except Exception:  # noqa: BLE001 — observation never breaks serving
+                return
+            self._capture_explains(chunk, batch,
+                                   assignment[off:off + cap],
+                                   state, static, node_table,
+                                   cycle_id, path)
 
     # ------------------------------------------------------------------
 
@@ -901,6 +979,7 @@ class SchedulerLoop:
                           count=n_real)
         self._emit_degraded_events()
         t0 = time.perf_counter()
+        static = None
         with self._profile_step(sb.cycle_id):
             if self._sharded_burst is not None:
                 # Mesh path: the shared-placer sharded scan (node axis
@@ -949,6 +1028,8 @@ class SchedulerLoop:
         self.timer.record("burst_wall",
                           time.perf_counter() - cycle_t0)
         self.burst_cycles += 1
+        self._capture_explains_burst(pods, assignment, state, static,
+                                     node_table, sb.cycle_id, "burst")
         self._span_commit(sb, pods, static_version=version,
                           rounds=cycle_rounds)
         return bound
@@ -998,6 +1079,7 @@ class SchedulerLoop:
         state, version = self.encoder.snapshot_versioned()
         node_table = self.encoder.node_table()
         self._emit_degraded_events()
+        static = None
         with self._profile_step(sb.cycle_id):
             if self._sharded_burst is not None:
                 out, with_stats = self._sharded_burst(state, stream)
@@ -1017,7 +1099,8 @@ class SchedulerLoop:
                           count=n_real)
         self._note_dispatch()
         self._pipe_inflight = (pods, out, with_stats, node_table,
-                               n_real, time.perf_counter())
+                               n_real, time.perf_counter(),
+                               state, static)
         self._pipe_span = (sb, version)
         self.burst_cycles += 1
         return bound
@@ -1032,8 +1115,8 @@ class SchedulerLoop:
         if inflight is None:
             return 0
         self._pipe_inflight = None
-        pods, out, with_stats, node_table, n_real, t_dispatch = \
-            inflight
+        (pods, out, with_stats, node_table, n_real, t_dispatch,
+         state, static) = inflight
         sb, span_version = (self._pipe_span
                             if self._pipe_span is not None
                             else (NULL_SPAN, None))
@@ -1067,6 +1150,9 @@ class SchedulerLoop:
                           count=n_real)
         self.timer.record("burst_wall",
                           time.perf_counter() - t_dispatch)
+        self._capture_explains_burst(pods, assignment, state, static,
+                                     node_table, sb.cycle_id,
+                                     "pipelined")
         self._span_commit(sb, pods, static_version=span_version,
                           rounds=cycle_rounds)
         return bound
@@ -2234,6 +2320,103 @@ class SchedulerLoop:
                 self.rebalance.tick(self)
             except Exception:  # noqa: BLE001 — retried next tick
                 pass
+        # Learned scoring policy: harvest the explain/outcome join
+        # into the example ring and run the bounded Adam step burst
+        # (train tick), then shadow-score the retained decisions and
+        # — when a replay trace is configured — run the full
+        # counterfactual promotion gate (eval tick).  Both strictly
+        # off the scoring hot path and exception-swallowed like every
+        # other maintain block.
+        if self.policy is not None:
+            try:
+                now = time.monotonic()
+                if (now - self._policy_last_train
+                        >= self.cfg.policy_train_interval_s):
+                    self._policy_last_train = now
+                    self._policy_train_tick()
+            except Exception:  # noqa: BLE001 — observation only
+                pass
+            try:
+                now = time.monotonic()
+                if (now - self._policy_last_eval
+                        >= self.cfg.policy_eval_interval_s):
+                    self._policy_last_eval = now
+                    self._policy_eval_tick()
+            except Exception:  # noqa: BLE001 — observation only
+                pass
+
+    def _policy_train_tick(self) -> None:
+        """One train tick: join fresh quality outcomes with their
+        explain records, feed the ring, dispatch the jitted steps."""
+        if self.policy_dataset is not None:
+            batch = self.policy_dataset.collect(self.flight,
+                                                self.quality)
+            if batch is not None:
+                self.policy.add_examples(batch.comps, batch.feas,
+                                         batch.target, batch.cls)
+        self.policy.train()
+
+    def _policy_eval_tick(self) -> None:
+        """One eval tick: shadow-rank the retained explain records
+        (disagreement accounting), then run the counterfactual
+        promotion gate.  A promotion swaps cfg.weights IN PLACE OF
+        the incumbent via dataclasses.replace and invalidates the
+        static cache — one jit retrace, after which every path scores
+        under the promoted weights."""
+        explains = (self.flight.explains()
+                    if self.flight is not None else [])
+        # Shadow-rank only records newer than the last tick — the
+        # explain store retains records across ticks and re-counting
+        # them would inflate the disagreement series.
+        newest = self._policy_shadow_twall
+        for rec in explains:
+            tw = float(rec.get("t_wall", 0.0))
+            if tw <= self._policy_shadow_twall:
+                continue
+            newest = max(newest, tw)
+            self.policy.shadow_rank(rec)
+        self._policy_shadow_twall = newest
+        self.policy.evals_total += 1
+        from kubernetesnetawarescheduler_tpu.policy.replay_eval import (
+            evaluate_candidate,
+        )
+
+        candidate = self.policy.to_score_weights(self.cfg.weights)
+        decision = evaluate_candidate(
+            self.cfg, candidate, self.cfg.weights, explains,
+            trace_path=self.policy_eval_trace,
+            k_pad=self.policy.k_pad)
+        if not decision.promote:
+            self.policy.rejections_total += 1
+            return
+        self._apply_promotion(decision)
+
+    def _apply_promotion(self, decision) -> None:
+        """Install gate-approved weights: replace cfg (frozen
+        dataclass — the loop, not the shared object, owns its config)
+        and drop the static cache so the next cycle re-derives the
+        normalization under the promoted weights."""
+        import dataclasses as _dc
+
+        self.cfg = _dc.replace(self.cfg,
+                               weights=decision.candidate_weights)
+        self.policy.cfg = self.cfg
+        if self.policy_dataset is not None:
+            self.policy_dataset.cfg = self.cfg
+        if getattr(self, "_static_version", None) is not None:
+            self._static_version = None
+            self._static_val = None
+        with self._static_lock:
+            self._static_ex = None
+        self.policy.note_promotion(decision.to_dict(),
+                                   decision.candidate_weights)
+        if self.flight is not None:
+            self.flight.meta["policy_promotion"] = {
+                "version": self.policy.promoted_version,
+                "reason": decision.reason,
+                "replay_delta": decision.replay_delta,
+                "t_wall": decision.t_wall,
+            }
 
     def _flush_preemption_waits(self) -> None:
         """Requeue preemptors whose confirmation deadline passed (a

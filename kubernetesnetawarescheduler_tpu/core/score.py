@@ -49,6 +49,25 @@ from kubernetesnetawarescheduler_tpu.core.state import ClusterState, PodBatch
 NEG_INF = np.float32(-1e30)
 _EPS = 1e-9
 
+# Canonical order of the flat weight vector the scoring functions
+# optionally accept as a TRACED argument (policy/ counterfactual
+# re-scoring: weight changes become new scalar inputs to the SAME
+# compiled program instead of a retrace).  Matches ScoreWeights field
+# order; policy/model.WEIGHT_FIELDS mirrors it.
+WVEC_FIELDS = ("cpu", "mem", "net_tx", "net_rx", "bandwidth", "disk",
+               "peer_bw", "peer_lat", "balance", "soft_affinity",
+               "spread")
+
+
+def weights_vector(weights) -> np.ndarray:
+    """Flatten a :class:`ScoreWeights` into the canonical ``f32[11]``
+    vector the ``wvec`` arguments below consume.  Passing
+    ``weights_vector(cfg.weights)`` is numerically identical to
+    passing ``wvec=None`` (the constants default) — pinned by
+    tests/test_policy.py."""
+    return np.asarray([float(getattr(weights, f))
+                       for f in WVEC_FIELDS], np.float32)
+
 
 def normalize_metrics(metrics: jax.Array, node_valid: jax.Array,
                       goodness: jax.Array) -> jax.Array:
@@ -72,7 +91,8 @@ def normalize_metrics(metrics: jax.Array, node_valid: jax.Array,
     return jnp.where(valid, flipped, 0.0)
 
 
-def metric_scores(state: ClusterState, cfg: SchedulerConfig) -> jax.Array:
+def metric_scores(state: ClusterState, cfg: SchedulerConfig,
+                  wvec: jax.Array | None = None) -> jax.Array:
     """Pod-independent per-node score ``f32[N]``: the weighted continuous
     vote over normalized metrics, decayed by staleness.
 
@@ -83,11 +103,20 @@ def metric_scores(state: ClusterState, cfg: SchedulerConfig) -> jax.Array:
     from the normalization span, so a silent node's last (possibly
     extreme) readings cannot stretch the span and make every fresh node
     look bad while the silent one coasts on the neutral blend.
+
+    ``wvec`` (see :data:`WVEC_FIELDS`): optional traced weight vector;
+    ``None`` (the default) bakes ``cfg.weights`` in as constants —
+    bit-identical to the pre-policy scorer.
     """
     goodness = jnp.asarray(GOODNESS + (0.0,) * (cfg.num_metrics - len(GOODNESS)),
                            jnp.float32)
-    w = jnp.asarray(cfg.weights.metric_vector() +
-                    (0.0,) * (cfg.num_metrics - len(GOODNESS)), jnp.float32)
+    if wvec is None:
+        w = jnp.asarray(cfg.weights.metric_vector() +
+                        (0.0,) * (cfg.num_metrics - len(GOODNESS)),
+                        jnp.float32)
+    else:
+        w = jnp.pad(wvec[:len(GOODNESS)].astype(jnp.float32),
+                    (0, cfg.num_metrics - len(GOODNESS)))
     conf = jnp.exp(-state.metrics_age / cfg.staleness_tau_s)
     span_valid = state.node_valid & (conf > cfg.stale_conf_floor)
     norm = normalize_metrics(state.metrics, span_valid, goodness)
@@ -132,7 +161,8 @@ def net_desirability(lat: jax.Array, bw: jax.Array,
     return jnp.where(pair_valid, c, 0.0)
 
 
-def net_cost_matrix(state: ClusterState, cfg: SchedulerConfig) -> jax.Array:
+def net_cost_matrix(state: ClusterState, cfg: SchedulerConfig,
+                    wvec: jax.Array | None = None) -> jax.Array:
     """``C[N, N] = w_bw * bw_norm - w_lat * lat_norm``, the desirability
     of placing one end of a flow on row-node given the other end on
     column-node.  Normalized by the max over valid pairs so weights are
@@ -143,10 +173,13 @@ def net_cost_matrix(state: ClusterState, cfg: SchedulerConfig) -> jax.Array:
     beats — regardless of what the probe pipeline wrote into
     ``bw[i, i]`` (iperf never measures a node against itself;
     run.sh:12 probes client->server pairs only)."""
+    if wvec is None:
+        w_bw = jnp.float32(cfg.weights.peer_bw)
+        w_lat = jnp.float32(cfg.weights.peer_lat)
+    else:
+        w_bw, w_lat = wvec[6], wvec[7]
     return net_desirability(
-        state.lat, state.bw, state.node_valid,
-        jnp.float32(cfg.weights.peer_bw),
-        jnp.float32(cfg.weights.peer_lat))
+        state.lat, state.bw, state.node_valid, w_bw, w_lat)
 
 
 def _use_bf16(cfg: SchedulerConfig) -> bool:
@@ -169,7 +202,8 @@ def prep_net_matrix(c: jax.Array, cfg: SchedulerConfig) -> jax.Array:
     return ct.astype(jnp.bfloat16) if _use_bf16(cfg) else ct
 
 
-def static_node_scores(state: ClusterState, cfg: SchedulerConfig
+def static_node_scores(state: ClusterState, cfg: SchedulerConfig,
+                       wvec: jax.Array | None = None
                        ) -> tuple[jax.Array, jax.Array]:
     """The two batch-invariant score ingredients: the per-node metric
     vote ``base f32[N]`` and the PREPARED net-desirability matrix
@@ -181,8 +215,9 @@ def static_node_scores(state: ClusterState, cfg: SchedulerConfig
     re-deriving ~3 HBM passes over the N×N matrices per batch (the
     device-side analog of the reference re-scraping every node per pod,
     scheduler.go:275-279)."""
-    return (metric_scores(state, cfg),
-            prep_net_matrix(net_cost_matrix(state, cfg), cfg))
+    return (metric_scores(state, cfg, wvec=wvec),
+            prep_net_matrix(net_cost_matrix(state, cfg, wvec=wvec),
+                            cfg))
 
 
 class NetExtrema(NamedTuple):
@@ -341,7 +376,8 @@ def network_scores(state: ClusterState, pods: PodBatch,
 
 def soft_affinity_scores(state: ClusterState, pods: PodBatch,
                          cfg: SchedulerConfig,
-                         transposed: bool = False) -> jax.Array:
+                         transposed: bool = False,
+                         wvec: jax.Array | None = None) -> jax.Array:
     """Weighted preferred-affinity score term ``f32[P, N]``
     (``f32[N, P]`` with ``transposed=True`` — the dead branch then
     materializes node-major zeros directly, so constraint-free
@@ -394,7 +430,10 @@ def soft_affinity_scores(state: ClusterState, pods: PodBatch,
         group_term = jnp.sum(
             jnp.where(group_match, pods.soft_grp_w[:, :, None], 0.0),
             axis=1)
-        scale = jnp.float32(cfg.weights.soft_affinity / 100.0)
+        if wvec is None:
+            scale = jnp.float32(cfg.weights.soft_affinity / 100.0)
+        else:
+            scale = wvec[9] / 100.0
         out = scale * (label_term + group_term)
         return out.T if transposed else out
 
@@ -404,12 +443,13 @@ def soft_affinity_scores(state: ClusterState, pods: PodBatch,
     bank = jax.lax.cond(pred, live,
                         lambda _: jnp.zeros(shape, jnp.float32), None)
     return bank + soft_zone_scores(state, pods, cfg,
-                                   transposed=transposed)
+                                   transposed=transposed, wvec=wvec)
 
 
 def soft_zone_scores(state: ClusterState, pods: PodBatch,
                      cfg: SchedulerConfig,
-                     transposed: bool = False) -> jax.Array:
+                     transposed: bool = False,
+                     wvec: jax.Array | None = None) -> jax.Array:
     """Zone-scoped preferred pod (anti-)affinity term, ``f32[P, N]``:
     bonus ``w_t`` on nodes whose ZONE hosts a member of the term's
     group (``gz_counts`` presence, like the hard
@@ -438,7 +478,11 @@ def soft_zone_scores(state: ClusterState, pods: PodBatch,
                   & has_zone[None, None, :])                # [P, T, N]
         term = jnp.sum(
             jnp.where(zmatch, pods.soft_zone_w[:, :, None], 0.0), axis=1)
-        out = jnp.float32(cfg.weights.soft_affinity / 100.0) * term
+        if wvec is None:
+            scale = jnp.float32(cfg.weights.soft_affinity / 100.0)
+        else:
+            scale = wvec[9] / 100.0
+        out = scale * term
         return out.T if transposed else out
 
     shape = (n, p) if transposed else (p, n)
@@ -460,7 +504,8 @@ def spread_active(pods: PodBatch) -> jax.Array:
 def spread_terms(state: ClusterState, pods: PodBatch,
                  cfg: SchedulerConfig,
                  gz_counts: jax.Array | None = None,
-                 static_ok: jax.Array | None = None
+                 static_ok: jax.Array | None = None,
+                 wvec: jax.Array | None = None
                  ) -> tuple[jax.Array, jax.Array]:
     """Topology-spread penalty and mask, ``(f32[P, N], bool[P, N])``.
 
@@ -525,8 +570,12 @@ def spread_terms(state: ClusterState, pods: PodBatch,
         excess = jnp.maximum(
             skew_after - pods.spread_maxskew[:, None],
             0).astype(jnp.float32)
+        if wvec is None:
+            w_spread = jnp.float32(cfg.weights.spread)
+        else:
+            w_spread = wvec[10]
         penalty = jnp.where(violates & ~pods.spread_hard[:, None],
-                            jnp.float32(cfg.weights.spread) * excess, 0.0)
+                            w_spread * excess, 0.0)
         return penalty, ok
 
     def dead(_):
@@ -751,22 +800,31 @@ def feasibility_mask(state: ClusterState, pods: PodBatch,
 
 
 def score_pods(state: ClusterState, pods: PodBatch,
-               cfg: SchedulerConfig, static=None) -> jax.Array:
+               cfg: SchedulerConfig, static=None,
+               wvec: jax.Array | None = None) -> jax.Array:
     """Full masked score matrix ``f32[P, N]``; -inf marks infeasible.
 
     ``static``, if given, is a precomputed :func:`static_node_scores`
     pair — serving paths (the extender webhook batcher) cache it across
     requests so a dispatch does not re-derive the O(N²) normalization
     work per call; it depends only on metrics/network/validity state,
-    never on placements."""
+    never on placements.
+
+    ``wvec``, if given, is a traced :func:`weights_vector` array that
+    replaces every ``cfg.weights`` constant in the score expression —
+    the counterfactual-replay seam (policy/).  ``None`` (every serving
+    path) keeps the exact constant-folded expressions, bit-identical
+    to the pre-wvec scorer."""
     if static is None:
-        static = static_node_scores(state, cfg)
+        static = static_node_scores(state, cfg, wvec=wvec)
     base, ct = static
     net = network_scores(state, pods, cfg, ct=ct)
-    soft = soft_affinity_scores(state, pods, cfg)
-    bal = cfg.weights.balance * balance_penalty(state, pods)
+    soft = soft_affinity_scores(state, pods, cfg, wvec=wvec)
+    w_bal = cfg.weights.balance if wvec is None else wvec[8]
+    bal = w_bal * balance_penalty(state, pods)
     sok = static_feasibility(state, pods)  # one compute, both uses
-    spread_pen, spread_ok = spread_terms(state, pods, cfg, static_ok=sok)
+    spread_pen, spread_ok = spread_terms(state, pods, cfg,
+                                         static_ok=sok, wvec=wvec)
     raw = base[None, :] + net + soft - bal - spread_pen
     ok = feasibility_mask(state, pods, static_ok=sok) & spread_ok
     return jnp.where(ok, raw, NEG_INF)
@@ -797,7 +855,8 @@ def winner_from_scores(scores: jax.Array) -> tuple[jax.Array, jax.Array]:
 
 
 def score_winner(state: ClusterState, pods: PodBatch,
-                 cfg: SchedulerConfig, static=None
+                 cfg: SchedulerConfig, static=None,
+                 wvec: jax.Array | None = None
                  ) -> tuple[jax.Array, jax.Array]:
     """Fused score→winner: ``(best f32[P], node i32[P])`` in ONE
     compiled program — the masked-argmax epilogue runs inside the same
@@ -806,24 +865,27 @@ def score_winner(state: ClusterState, pods: PodBatch,
     (XLA fuses the row reduction with its producer; the Pallas twin in
     core/pallas_score.py makes the same fusion explicit per tile).
     Same tie-break contract as :func:`winner_from_scores`."""
-    return winner_from_scores(score_pods(state, pods, cfg, static))
+    return winner_from_scores(score_pods(state, pods, cfg, static,
+                                         wvec=wvec))
 
 
 def _explain_terms(state: ClusterState, pods: PodBatch,
-                   cfg: SchedulerConfig, static=None) -> dict:
+                   cfg: SchedulerConfig, static=None,
+                   wvec: jax.Array | None = None) -> dict:
     """Pure-JAX body of :func:`explain_scores`: every additive term and
     every individual feasibility gate, as ``[P, N]`` (or broadcastable)
     arrays.  Kept separate so tests can jit it once for the 64-instance
     property sweep while production calls stay eager via the wrapper."""
     if static is None:
-        static = static_node_scores(state, cfg)
+        static = static_node_scores(state, cfg, wvec=wvec)
     base, ct = static
     net = network_scores(state, pods, cfg, ct=ct)
-    soft = soft_affinity_scores(state, pods, cfg)
-    bal = cfg.weights.balance * balance_penalty(state, pods)
+    soft = soft_affinity_scores(state, pods, cfg, wvec=wvec)
+    w_bal = cfg.weights.balance if wvec is None else wvec[8]
+    bal = w_bal * balance_penalty(state, pods)
     sok = static_feasibility(state, pods)
     spread_pen, spread_ok = spread_terms(state, pods, cfg,
-                                         static_ok=sok)
+                                         static_ok=sok, wvec=wvec)
     free = state.cap - state.used
     fits = jnp.all(pods.req[:, None, :] <= free[None, :, :] + _EPS,
                    axis=-1)
@@ -850,7 +912,8 @@ def _explain_terms(state: ClusterState, pods: PodBatch,
 
 
 def explain_scores(state: ClusterState, pods: PodBatch,
-                   cfg: SchedulerConfig, static=None
+                   cfg: SchedulerConfig, static=None,
+                   wvec: jax.Array | None = None
                    ) -> dict[str, np.ndarray]:
     """Host-side score decomposition for placement explainability.
 
@@ -869,7 +932,7 @@ def explain_scores(state: ClusterState, pods: PodBatch,
     bit-field tests are restated here because the fused mask never
     materializes them separately).
     """
-    terms = _explain_terms(state, pods, cfg, static=static)
+    terms = _explain_terms(state, pods, cfg, static=static, wvec=wvec)
     shape = np.asarray(terms["net"]).shape
 
     def _f32(x):

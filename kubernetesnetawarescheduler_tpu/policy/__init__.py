@@ -1,0 +1,40 @@
+"""Learned scoring policy (``enable_learned_score``).
+
+Three parts, wired through the serving loop's maintain cadence:
+
+- :mod:`.model` — term-level multiplier model (one jitted Adam step
+  over a bounded example ring, EMA read, npz checkpoint);
+- :mod:`.dataset` — off-hot-path join of explain records and quality
+  outcomes into training examples;
+- :mod:`.replay_eval` — the counterfactual promotion gate (recorded
+  re-score + seeded scenario replay through the r13 scorecard).
+
+Disabled (the default) the subsystem is never constructed and
+scoring is bit-identical to the hand-tuned weights.
+"""
+
+from kubernetesnetawarescheduler_tpu.policy.dataset import (
+    PolicyDataset,
+)
+from kubernetesnetawarescheduler_tpu.policy.model import (
+    TERMS,
+    PolicyParams,
+    ScoringPolicy,
+)
+from kubernetesnetawarescheduler_tpu.policy.replay_eval import (
+    PromotionDecision,
+    evaluate_candidate,
+    rescore_records,
+    term_multipliers,
+)
+
+__all__ = [
+    "PolicyDataset",
+    "PolicyParams",
+    "PromotionDecision",
+    "ScoringPolicy",
+    "TERMS",
+    "evaluate_candidate",
+    "rescore_records",
+    "term_multipliers",
+]

@@ -147,12 +147,18 @@ def _rss_bytes() -> int:
 
 
 def _build_loop(header: dict[str, Any], batch: int, method: str,
-                chaos: bool, queue_capacity: int
+                chaos: bool, queue_capacity: int,
+                score_weights: ScoreWeights | None = None
                 ) -> tuple[SchedulerLoop, SchedulerConfig, FakeCluster,
                            list[Node], np.ndarray, np.ndarray]:
     """The serving stack for a trace header: cluster (optionally
     chaos-proxied), loop, ground-truth matrices, and the node list
-    (node_up re-adds need the objects)."""
+    (node_up re-adds need the objects).
+
+    ``score_weights`` substitutes the scoring weight vector for the
+    whole replay — the policy promotion gate's counterfactual seam.
+    ``None`` keeps :data:`REPLAY_WEIGHTS` exactly (golden-digest
+    parity is pinned on this default)."""
     spec = spec_from_json(header["spec"])
     cspec = spec.cluster
     chaos_seed = spec.chaos_seed if chaos else None
@@ -164,7 +170,8 @@ def _build_loop(header: dict[str, Any], batch: int, method: str,
         max_nodes=round_up(cspec.num_nodes, 128),
         max_pods=batch,
         max_peers=max(4, spec.max_peers),
-        weights=REPLAY_WEIGHTS,
+        weights=(REPLAY_WEIGHTS if score_weights is None
+                 else score_weights),
         queue_capacity=queue_capacity,
     )
     loop = SchedulerLoop(cluster, cfg, method=method)
@@ -189,6 +196,7 @@ def replay_trace(path: str, *,
                  maintain_every: int = 16,
                  slo_budget_ms: float = 250.0,
                  queue_capacity: int = 4096,
+                 score_weights: ScoreWeights | None = None,
                  progress: Any = None) -> ReplayResult:
     """Stream the trace at ``path`` through a real SchedulerLoop.
 
@@ -201,6 +209,11 @@ def replay_trace(path: str, *,
     ``collect_placements`` retains the full pod->node map (small
     traces / property tests only — it defeats the bounded-memory
     contract for million-pod runs).
+
+    ``score_weights`` replays the SAME trace under a different
+    scoring weight vector (policy/ promotion gate); ``None`` is the
+    incumbent :data:`REPLAY_WEIGHTS`, bit-identical to a replay that
+    never heard of the override.
     """
     header, events = read_trace(path)
     spec = spec_from_json(header["spec"])
@@ -208,7 +221,7 @@ def replay_trace(path: str, *,
     t_wall0 = time.perf_counter()
 
     loop, cfg, client, nodes, lat0, bw0 = _build_loop(
-        header, batch, method, chaos, queue_capacity)
+        header, batch, method, chaos, queue_capacity, score_weights)
     inner = client.inner if hasattr(client, "inner") else client
     node_by_name = {nd.name: nd for nd in nodes}
     node_idx = {nd.name: i for i, nd in enumerate(nodes)}

@@ -205,6 +205,23 @@ def main(argv=None) -> int:
                          "and PDB-style disruption limits; "
                          "equivalent to enable_rebalance=true in "
                          "--config")
+    ap.add_argument("--learned-score", action="store_true",
+                    help="learned scoring policy (policy/): fit "
+                         "term-level score multipliers on the "
+                         "explain/outcome join, shadow-score recorded "
+                         "decisions, and promote candidate weights "
+                         "ONLY through the counterfactual replay "
+                         "gate; equivalent to "
+                         "enable_learned_score=true in --config. "
+                         "Needs explain capture (cfg.enable_explain) "
+                         "and the quality observer for training "
+                         "signal")
+    ap.add_argument("--policy-eval-trace", default="",
+                    help="scenario trace (scenario/trace.py format) "
+                         "the policy promotion gate replays "
+                         "counterfactually; without one the gate "
+                         "refuses every promotion and the policy "
+                         "stays shadow-only")
     ap.add_argument("--async-static", action="store_true",
                     help="rebuild the batch-invariant static score "
                          "prep on a background thread while batches "
@@ -301,6 +318,18 @@ def main(argv=None) -> int:
         import dataclasses
 
         cfg = dataclasses.replace(cfg, enable_rebalance=True)
+    if args.learned_score and not cfg.enable_learned_score:
+        import dataclasses
+
+        cfg = dataclasses.replace(cfg, enable_learned_score=True)
+    if cfg.enable_learned_score:
+        print(f"learned scoring policy enabled (shadow-first): ring "
+              f"{cfg.policy_ring}, train every "
+              f"{cfg.policy_train_interval_s}s, gate margin "
+              f"{cfg.policy_promote_margin}"
+              + ("" if args.policy_eval_trace else
+                 "; no --policy-eval-trace, promotions disabled"),
+              file=sys.stderr)
     if cfg.enable_rebalance:
         print(f"rebalancer enabled: min gain "
               f"{cfg.rebalance_min_gain}, budget "
@@ -411,6 +440,32 @@ def main(argv=None) -> int:
     # (empty-but-versioned, never silently blank).
     if loop.flight is not None:
         loop.flight.meta["checkpoint_state"] = loop.checkpoint_state
+
+    # Learned scoring policy: resume parameters/optimizer/example ring
+    # from policy.npz when the restored checkpoint carries one (same
+    # resume-not-relearn reasoning as the netmodel restore); the
+    # promotion gate's replay trace comes from the CLI.
+    if (cfg.enable_learned_score and args.checkpoint_dir
+            and loop.checkpoint_state == "restored"):
+        from kubernetesnetawarescheduler_tpu.core.checkpoint import (
+            load_policy,
+        )
+
+        try:
+            restored_policy = load_policy(args.checkpoint_dir, cfg,
+                                          seed=args.seed)
+        except Exception as exc:  # noqa: BLE001 — policy is optional
+            restored_policy = None
+            print(f"WARNING: policy checkpoint load failed: {exc}",
+                  file=sys.stderr)
+        if restored_policy is not None:
+            loop.policy = restored_policy
+            print("restored learned-score policy "
+                  f"(version {restored_policy.version}, promoted "
+                  f"{restored_policy.promoted_version})",
+                  file=sys.stderr)
+    if args.policy_eval_trace:
+        loop.policy_eval_trace = args.policy_eval_trace
 
     if args.decision_log:
         from kubernetesnetawarescheduler_tpu.core.checkpoint import (
@@ -750,7 +805,8 @@ def main(argv=None) -> int:
             from kubernetesnetawarescheduler_tpu.core.checkpoint import (
                 save_checkpoint,
             )
-            save_checkpoint(args.checkpoint_dir, loop.encoder)
+            save_checkpoint(args.checkpoint_dir, loop.encoder,
+                            policy=loop.policy)
             print(f"checkpoint saved to {args.checkpoint_dir}",
                   file=sys.stderr)
         if loop.decision_log is not None:

@@ -106,6 +106,15 @@ class CycleSpan:
     # unchanged.
     scenario_phase: str | None = None
     trace_offset: int = 0
+    # Learned scoring policy (ISSUE 15): shadow decisions the policy
+    # would have placed differently since the previous committed span
+    # (per-span delta, rebalance_moves pattern — shadow ranking runs
+    # at maintain cadence) and the policy-parameter version live when
+    # this cycle committed (0 = hand-tuned weights, never promoted).
+    # Default-valued: pre-r15 spans and crash dumps deserialize
+    # unchanged.
+    policy_shadow_disagreements: int = 0
+    policy_version: int = 0
 
     def to_dict(self) -> dict[str, Any]:
         return {
@@ -134,6 +143,9 @@ class CycleSpan:
             "rebalance_reverts": self.rebalance_reverts,
             "scenario_phase": self.scenario_phase,
             "trace_offset": self.trace_offset,
+            "policy_shadow_disagreements":
+                self.policy_shadow_disagreements,
+            "policy_version": self.policy_version,
         }
 
 

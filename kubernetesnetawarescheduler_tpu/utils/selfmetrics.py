@@ -648,4 +648,44 @@ def render_metrics(loop) -> str:
               "Improvement candidates surviving hysteresis at the "
               "last scan")
 
+    # Learned scoring policy (r15, policy/): training volume, shadow
+    # disagreement (the promotion runbook's first read — a promotion
+    # with near-zero disagreement changes nothing; one with high
+    # disagreement is high-variance), and the gate's verdict history.
+    policy = getattr(loop, "policy", None)
+    if policy is not None:
+        ps = policy.summary()
+        counter("netaware_policy_train_steps_total",
+                float(ps["steps_total"]),
+                "Adam mini-batch steps dispatched over the example "
+                "ring")
+        counter("netaware_policy_examples_total",
+                float(ps["examples_total"]),
+                "Training examples harvested from the explain/"
+                "outcome join")
+        counter("netaware_policy_promotions_total",
+                float(ps["promotions_total"]),
+                "Candidate weight vectors promoted through the "
+                "counterfactual replay gate")
+        counter("netaware_policy_rejections_total",
+                float(ps["rejections_total"]),
+                "Gate runs that refused promotion (no trace, records "
+                "regression, or below the replay margin)")
+        counter("netaware_policy_shadow_disagreement_total",
+                float(ps["shadow_disagreement_total"]),
+                "Recorded decisions the shadow policy would have "
+                "placed on a different node")
+        counter("netaware_policy_shadow_agree_total",
+                float(ps["shadow_agree_total"]),
+                "Recorded decisions the shadow policy agrees with")
+        gauge("netaware_policy_ring_depth",
+              float(ps["ring_depth"]),
+              "Training examples resident in the bounded ring")
+        gauge("netaware_policy_version", float(ps["version"]),
+              "Policy parameter version (increments per train tick)")
+        gauge("netaware_policy_promoted_version",
+              float(ps["promoted_version"]),
+              "Parameter version live in the scorer (0 = hand-tuned "
+              "weights, never promoted)")
+
     return "\n".join(lines) + "\n"

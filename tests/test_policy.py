@@ -1,0 +1,682 @@
+"""Learned scoring policy + counterfactual promotion gate (policy/).
+
+The r14 invariants, each pinned here:
+
+* ``enable_learned_score=False`` (the default) is the exact
+  pre-policy scheduler: no policy objects constructed, placements
+  bit-identical — and attaching the policy in shadow mode must not
+  move a single placement either (shadow reads explain records, never
+  the hot path);
+* all four serving paths (serial, gang, burst, pipelined) populate
+  the flight recorder's explain store at their retire/commit seam,
+  and turning explain on/off leaves placements bit-identical;
+* ``ScoringPolicy`` save -> load -> predict is exact (parameters,
+  optimizer slots, EMA, ring, counters all survive), and the
+  checkpoint integration (``save_checkpoint(policy=)`` /
+  ``load_policy``) round-trips through the manifest discipline;
+* the promotion gate refuses without a replay trace, refuses a
+  candidate that regresses the recorded evidence (before spending a
+  replay), refuses a below-margin replay, and promotes only a replay
+  winner; the loop's ``_apply_promotion`` swaps live weights and
+  stamps provenance;
+* shadow scoring counts agreement/disagreement without affecting
+  placements;
+* ``scenario.replay`` with ``score_weights=None`` is the bit-exact
+  default campaign (parity pinned structurally here, and end-to-end
+  under ``slow``);
+* bench_check Rule 14 and state_audit's policy section fire on the
+  failure shapes they exist for.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import importlib.util
+import json
+import os
+
+import numpy as np
+import pytest
+
+from kubernetesnetawarescheduler_tpu.bench.fakecluster import (
+    ClusterSpec,
+    WorkloadSpec,
+    build_fake_cluster,
+    feed_metrics,
+    generate_gang_workload,
+    generate_workload,
+)
+from kubernetesnetawarescheduler_tpu.config import (
+    SchedulerConfig,
+    ScoreWeights,
+)
+from kubernetesnetawarescheduler_tpu.core.checkpoint import (
+    load_policy,
+    save_checkpoint,
+    update_manifest,
+)
+from kubernetesnetawarescheduler_tpu.core.loop import SchedulerLoop
+from kubernetesnetawarescheduler_tpu.policy import (
+    PolicyDataset,
+    ScoringPolicy,
+    evaluate_candidate,
+    term_multipliers,
+)
+from kubernetesnetawarescheduler_tpu.policy.model import TERMS
+
+_TOOLS = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "tools")
+
+
+def _load_tool(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(_TOOLS, f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+WEIGHTS = ScoreWeights(cpu=0.5, mem=0.5, net_tx=0.0, net_rx=0.0,
+                       bandwidth=1.0, disk=0.0, peer_bw=3.0,
+                       peer_lat=2.0, balance=0.5)
+
+
+def make_loop(num_nodes=24, seed=3, **cfg_overrides):
+    cfg = SchedulerConfig(max_nodes=32, max_pods=16, max_peers=4,
+                          weights=WEIGHTS, queue_capacity=128)
+    if cfg_overrides:
+        cfg = dataclasses.replace(cfg, **cfg_overrides)
+    cluster, lat, bw = build_fake_cluster(
+        ClusterSpec(num_nodes=num_nodes, seed=seed))
+    loop = SchedulerLoop(cluster, cfg)
+    loop.encoder.set_network(lat, bw)
+    feed_metrics(cluster, loop.encoder, np.random.default_rng(0))
+    return cluster, loop
+
+
+def drain(loop, cluster, pods, batch=16):
+    for start in range(0, len(pods), batch):
+        cluster.add_pods(pods[start:start + batch])
+        loop.run_once()
+    loop.run_until_drained()
+    loop.flush_binds()
+    loop.stop_bind_worker()
+    return sorted((b.namespace, b.pod_name, b.node_name)
+                  for b in cluster.bindings)
+
+
+def _workload(num_pods=48, seed=21, peer_fraction=0.5):
+    return generate_workload(WorkloadSpec(
+        num_pods=num_pods, seed=seed, services=6,
+        peer_fraction=peer_fraction))
+
+
+def _policy_cfg(**over):
+    kw = dict(max_nodes=32, max_pods=16, max_peers=4,
+              weights=WEIGHTS, queue_capacity=128,
+              enable_learned_score=True, enable_explain=True,
+              policy_ring=256, policy_batch=32, policy_steps=2,
+              policy_min_examples=8)
+    kw.update(over)
+    return SchedulerConfig(**kw)
+
+
+# ---------------------------------------------------------------------------
+# Disabled path: bit-identity is the fallback contract.
+# ---------------------------------------------------------------------------
+
+
+def test_default_loop_builds_no_policy():
+    _, loop = make_loop()
+    assert loop.cfg.enable_learned_score is False
+    assert loop.policy is None
+    assert loop.policy_dataset is None
+
+
+def test_placements_bit_identical_with_shadow_policy():
+    """Shadow scoring reads explain records AFTER commit — attaching
+    the policy and shadow-ranking every decision must not move a
+    placement (the same attach-direct trick the bench uses, so both
+    legs compile the same jit program)."""
+    def run(shadowed: bool):
+        cluster, loop = make_loop(enable_explain=True)
+        policy = ScoringPolicy(loop.cfg) if shadowed else None
+        bindings = drain(loop, cluster, _workload())
+        if shadowed:
+            for rec in loop.flight.explains():
+                policy.shadow_rank(rec)
+            total = (policy.shadow_agree_total
+                     + policy.shadow_disagreement_total)
+            # Records without a feasible candidate (unschedulable
+            # pods) are skipped, not counted.
+            assert 0 < total <= len(loop.flight.explains())
+        return bindings
+
+    assert run(shadowed=False) == run(shadowed=True)
+
+
+def test_explain_on_off_bit_identical():
+    def run(explain: bool):
+        cluster, loop = make_loop(enable_explain=explain)
+        return drain(loop, cluster, _workload())
+
+    assert run(explain=False) == run(explain=True)
+
+
+# ---------------------------------------------------------------------------
+# Explain capture: all four serving paths feed the store.
+# ---------------------------------------------------------------------------
+
+
+def _paths_of(loop):
+    return {rec["path"] for rec in loop.flight.explains()}
+
+
+def test_serial_path_captures_explains():
+    cluster, loop = make_loop(enable_explain=True)
+    drain(loop, cluster, _workload(num_pods=24))
+    assert "serial" in _paths_of(loop)
+    # Each record decomposes its winner and carries the policy's
+    # training features: zone + signed components per candidate.
+    rec = loop.flight.explains()[0]
+    cand = rec["candidates"][0]
+    assert set(cand["components"]) == set(TERMS)
+    assert "zone" in cand and "node_index" in cand
+
+
+@pytest.mark.slow  # gang placement pays per-shape XLA compiles
+def test_gang_path_captures_explains():
+    cluster, loop = make_loop(enable_explain=True)
+    pods = _workload(num_pods=8) + generate_gang_workload(
+        num_gangs=3, member_counts=(4,), filler_pods=0,
+        cpu=0.5, mem=1.0)
+    drain(loop, cluster, pods)
+    assert "gang" in _paths_of(loop)
+
+
+def _burst_loop(pipelined: bool):
+    cfg = SchedulerConfig(max_nodes=64, max_pods=16, max_peers=4,
+                          weights=WEIGHTS, queue_capacity=128,
+                          enable_explain=True)
+    cluster, lat, bw = build_fake_cluster(
+        ClusterSpec(num_nodes=48, seed=51))
+    loop = SchedulerLoop(cluster, cfg, method="parallel",
+                         burst_batches=4, pipelined=pipelined)
+    loop.encoder.set_network(lat, bw)
+    feed_metrics(cluster, loop.encoder, np.random.default_rng(52))
+    pods = generate_workload(
+        WorkloadSpec(num_pods=96, seed=53, services=8,
+                     peer_fraction=0.5),
+        scheduler_name=cfg.scheduler_name)
+    cluster.add_pods(pods)
+    loop.run_until_drained()
+    loop.flush_binds()
+    loop.stop_bind_worker()
+    return loop
+
+
+@pytest.mark.slow  # compiles the 64-node parallel-scan program
+def test_burst_path_captures_explains():
+    loop = _burst_loop(pipelined=False)
+    assert loop.burst_cycles > 0
+    assert "burst" in _paths_of(loop)
+    # Every bound pod in the burst got a record, not just chunk one.
+    bound = {rec["pod_uid"] for rec in loop.flight.explains()
+             if rec["decision"] == "bound"}
+    assert len(bound) == loop.scheduled
+
+
+@pytest.mark.slow  # compiles the 64-node parallel-scan program
+def test_pipelined_path_captures_explains():
+    loop = _burst_loop(pipelined=True)
+    assert "pipelined" in _paths_of(loop)
+
+
+# ---------------------------------------------------------------------------
+# Model: exact persistence round-trip.
+# ---------------------------------------------------------------------------
+
+
+def _trained_policy(cfg=None, seed=7):
+    cfg = cfg or _policy_cfg()
+    pol = ScoringPolicy(cfg, seed=seed)
+    rng = np.random.default_rng(11)
+    b, k = 24, pol.k_pad
+    comps = rng.normal(size=(b, k, len(TERMS))).astype(np.float32)
+    feas = np.ones((b, k), np.float32)
+    target = rng.integers(0, k, size=b).astype(np.int32)
+    cls = rng.integers(0, 4, size=(b, k)).astype(np.int32)
+    pol.add_examples(comps, feas, target, cls)
+    pol.train()
+    assert pol.steps_total > 0
+    return pol
+
+
+def test_checkpoint_roundtrip_is_exact(tmp_path):
+    cfg = _policy_cfg()
+    pol = _trained_policy(cfg)
+    pol.note_promotion({"reason": "replay_win", "promote": True},
+                       pol.to_score_weights())
+    path = str(tmp_path / "policy.npz")
+    pol.save(path)
+    back = ScoringPolicy.load(path, cfg, seed=7)
+
+    rng = np.random.default_rng(12)
+    comps = rng.normal(size=(4, pol.k_pad, len(TERMS))).astype(
+        np.float32)
+    feas = np.ones((4, pol.k_pad), np.float32)
+    cls = np.zeros((4, pol.k_pad), np.int32)
+    np.testing.assert_array_equal(pol.predict(comps, feas, cls),
+                                  back.predict(comps, feas, cls))
+    for field in ("examples_total", "steps_total", "trains_total",
+                  "promotions_total", "promoted_version"):
+        assert getattr(back, field) == getattr(pol, field)
+    assert back.version == pol.version
+    assert back.promoted_weights == pol.promoted_weights
+    # Training resumes from the restored optimizer state, not zero.
+    assert float(back._opt_t) == float(pol._opt_t) > 0
+
+
+def test_load_rejects_shape_skew(tmp_path):
+    pol = _trained_policy()
+    path = str(tmp_path / "policy.npz")
+    pol.save(path)
+    skewed = dataclasses.replace(_policy_cfg(), max_zones=8)
+    with pytest.raises(ValueError, match="max_zones"):
+        ScoringPolicy.load(path, skewed)
+
+
+def test_save_checkpoint_carries_policy(tmp_path):
+    cfg = _policy_cfg()
+    cluster, lat, bw = build_fake_cluster(
+        ClusterSpec(num_nodes=8, seed=1))
+    loop = SchedulerLoop(cluster, cfg)
+    loop.encoder.set_network(lat, bw)
+    pol = _trained_policy(cfg)
+    ck = str(tmp_path / "ck")
+    save_checkpoint(ck, loop.encoder, policy=pol)
+    loop.stop_bind_worker()
+
+    with open(os.path.join(ck, "meta.json")) as fh:
+        meta = json.load(fh)
+    assert meta["policy"]["version"] == pol.version
+    back = load_policy(ck, cfg, seed=7)
+    assert back is not None
+    assert back.steps_total == pol.steps_total
+    # Disabled config never loads a policy, whatever is on disk.
+    off = dataclasses.replace(cfg, enable_learned_score=False)
+    assert load_policy(ck, off) is None
+
+
+# ---------------------------------------------------------------------------
+# The promotion gate.
+# ---------------------------------------------------------------------------
+
+
+def _explain_record(uid="u0"):
+    """Two feasible candidates: the shipped winner n0 carries the
+    high net term, n1 wins on base alone — exactly the decision a
+    net-blind candidate would flip."""
+    def cand(idx, total, base, net):
+        return {"node": f"n{idx}", "node_index": idx, "zone": idx,
+                "total": total, "feasible": True,
+                "components": {"base": base, "net": net, "soft": 0.0,
+                               "balance": 0.0, "spread": 0.0},
+                "gates": {}}
+    return {"pod_uid": uid, "node_index": 0, "t_wall": 1.0,
+            "candidates": [cand(0, 10.0, 2.0, 8.0),
+                           cand(1, 9.0, 8.5, 0.5)]}
+
+
+def test_term_multipliers_identity_and_zeroing():
+    np.testing.assert_allclose(term_multipliers(WEIGHTS, WEIGHTS),
+                               np.ones(len(TERMS)))
+    blind = dataclasses.replace(WEIGHTS, peer_bw=0.0, peer_lat=0.0)
+    mult = term_multipliers(blind, WEIGHTS)
+    assert mult[TERMS.index("net")] == 0.0
+    assert mult[TERMS.index("base")] == 1.0
+
+
+def test_gate_refuses_without_trace():
+    cfg = _policy_cfg()
+    d = evaluate_candidate(cfg, WEIGHTS, WEIGHTS,
+                           [_explain_record()], trace_path=None)
+    assert not d.promote and d.reason == "no_replay_trace"
+    assert d.records_evaluated == 1
+
+
+def test_gate_refuses_records_regression_before_replay(tmp_path):
+    """A net-blind candidate flips the recorded winner to the
+    low-net node: the cheap records leg must refuse WITHOUT running
+    the replay (the trace path here does not even exist)."""
+    cfg = _policy_cfg()
+    blind = dataclasses.replace(WEIGHTS, peer_bw=0.0, peer_lat=0.0)
+    d = evaluate_candidate(
+        cfg, blind, WEIGHTS,
+        [_explain_record(f"u{i}") for i in range(4)],
+        trace_path=str(tmp_path / "never_generated.jsonl"))
+    assert not d.promote and d.reason == "records_regression"
+    assert d.records_delta < 0.0
+    assert d.disagreement_rate == 1.0
+    assert d.incumbent_ratio == -1.0  # replay never ran
+
+
+def _patch_replay(monkeypatch, ratio_of):
+    import kubernetesnetawarescheduler_tpu.scenario.replay as rp
+    import kubernetesnetawarescheduler_tpu.scenario.scorecard as sc
+
+    monkeypatch.setattr(
+        rp, "replay_trace",
+        lambda trace_path, score_weights=None, **kw: score_weights)
+    monkeypatch.setattr(
+        sc, "build_scorecard",
+        lambda res: {"bandwidth":
+                     {"realized_bw_ratio_vs_oracle": ratio_of(res)}})
+
+
+def test_gate_promotes_replay_winner(monkeypatch, tmp_path):
+    cfg = _policy_cfg()
+    blind = dataclasses.replace(WEIGHTS, peer_bw=0.0, peer_lat=0.0)
+    _patch_replay(monkeypatch,
+                  lambda w: 0.9 if w.peer_bw > 0 else 0.3)
+    d = evaluate_candidate(cfg, WEIGHTS, blind, [],
+                           trace_path=str(tmp_path / "t.jsonl"))
+    assert d.promote and d.reason == "replay_win"
+    assert d.replay_delta == pytest.approx(0.6)
+    assert d.candidate_weights == WEIGHTS
+
+
+def test_gate_refuses_below_margin_and_no_oracle(monkeypatch,
+                                                 tmp_path):
+    cfg = _policy_cfg()
+    trace = str(tmp_path / "t.jsonl")
+    _patch_replay(monkeypatch, lambda w: 0.5)
+    d = evaluate_candidate(cfg, WEIGHTS, WEIGHTS, [],
+                           trace_path=trace)
+    assert not d.promote and d.reason == "replay_below_margin"
+    _patch_replay(monkeypatch, lambda w: float("nan"))
+    d = evaluate_candidate(cfg, WEIGHTS, WEIGHTS, [],
+                           trace_path=trace)
+    assert not d.promote and d.reason == "replay_no_oracle_sample"
+
+
+# ---------------------------------------------------------------------------
+# Loop integration: ticks, promotion swap, dataset join.
+# ---------------------------------------------------------------------------
+
+
+def _policy_loop():
+    cfg = _policy_cfg()
+    cluster, lat, bw = build_fake_cluster(
+        ClusterSpec(num_nodes=24, seed=3))
+    loop = SchedulerLoop(cluster, cfg)
+    loop.encoder.set_network(lat, bw)
+    feed_metrics(cluster, loop.encoder, np.random.default_rng(0))
+    return cluster, loop
+
+
+def test_enabled_loop_constructs_policy_stack():
+    _, loop = _policy_loop()
+    assert isinstance(loop.policy, ScoringPolicy)
+    assert isinstance(loop.policy_dataset, PolicyDataset)
+    loop.stop_bind_worker()
+
+
+def test_eval_tick_without_trace_counts_rejection():
+    cluster, loop = _policy_loop()
+    before = loop.cfg.weights
+    drain(loop, cluster, _workload())
+    loop._policy_eval_tick()
+    pol = loop.policy
+    assert pol.evals_total == 1 and pol.rejections_total == 1
+    assert pol.promotions_total == 0
+    assert loop.cfg.weights == before
+    # Shadow ranking ran over the retained explains exactly once
+    # (records without a feasible candidate are skipped) — a second
+    # tick with no new records adds nothing.
+    total = pol.shadow_agree_total + pol.shadow_disagreement_total
+    assert 0 < total <= len(loop.flight.explains())
+    loop._policy_eval_tick()
+    assert (pol.shadow_agree_total
+            + pol.shadow_disagreement_total) == total
+
+
+def test_train_tick_joins_outcomes_into_ring():
+    from kubernetesnetawarescheduler_tpu.obs.quality import (
+        QualityObserver,
+    )
+
+    cluster, loop = _policy_loop()
+    loop.quality = QualityObserver(loop.cfg)
+    drain(loop, cluster, _workload(peer_fraction=0.6))
+    loop.quality.harvest(loop.encoder)
+    loop._policy_train_tick()
+    assert loop.policy.ring_depth() > 0
+    assert loop.policy_dataset.joined_total == loop.policy.ring_depth()
+
+
+@pytest.mark.slow  # the live weight swap forces a full jit retrace
+def test_apply_promotion_swaps_live_weights():
+    cluster, loop = _policy_loop()
+    drain(loop, cluster, _workload(num_pods=16))
+    candidate = dataclasses.replace(loop.cfg.weights, peer_bw=4.5)
+    decision = evaluate_candidate(
+        loop.cfg, candidate, loop.cfg.weights, [], trace_path=None)
+    decision = dataclasses.replace(decision, promote=True,
+                                   reason="replay_win")
+    loop._apply_promotion(decision)
+    assert loop.cfg.weights == candidate
+    assert loop.policy.cfg is loop.cfg
+    assert loop.policy.promotions_total == 1
+    assert loop.policy.promoted_weights == candidate
+    stamp = loop.flight.meta["policy_promotion"]
+    assert stamp["reason"] == "replay_win"
+    # The swapped weights actually serve: another wave still binds.
+    cluster.add_pods(_workload(num_pods=8, seed=91))
+    loop.run_until_drained()
+    loop.flush_binds()
+    loop.stop_bind_worker()
+    assert loop.scheduled > 16 - loop.unschedulable
+
+
+# ---------------------------------------------------------------------------
+# scenario.replay score_weights seam.
+# ---------------------------------------------------------------------------
+
+
+def test_replay_build_loop_score_weights_default():
+    """``score_weights=None`` IS the default campaign — same weights
+    object, so the golden-digest contract reduces to the replay
+    determinism already pinned by tests/test_scenario.py."""
+    from kubernetesnetawarescheduler_tpu.scenario.generate import (
+        ScenarioSpec,
+        spec_to_json,
+    )
+    from kubernetesnetawarescheduler_tpu.scenario.replay import (
+        REPLAY_WEIGHTS,
+        _build_loop,
+    )
+
+    spec = ScenarioSpec(seed=1, duration_s=5.0, base_rate=2.0,
+                        cluster=ClusterSpec(num_nodes=8, seed=1))
+    header = {"spec": spec_to_json(spec)}
+    _loop, cfg, *_rest = _build_loop(header, 8, "parallel",
+                                     chaos=False, queue_capacity=64)
+    assert cfg.weights == REPLAY_WEIGHTS
+    _loop2, cfg2, *_rest = _build_loop(header, 8, "parallel",
+                                       chaos=False, queue_capacity=64,
+                                       score_weights=None)
+    assert cfg2.weights == REPLAY_WEIGHTS
+    custom = dataclasses.replace(REPLAY_WEIGHTS, peer_bw=9.0)
+    _loop3, cfg3, *_rest = _build_loop(header, 8, "parallel",
+                                       chaos=False, queue_capacity=64,
+                                       score_weights=custom)
+    assert cfg3.weights == custom
+    for lp in (_loop, _loop2, _loop3):
+        lp.stop_bind_worker()
+
+
+@pytest.mark.slow
+def test_replay_score_weights_none_parity(tmp_path):
+    """End-to-end: an explicit ``score_weights=None`` campaign is
+    placement-bit-identical to the arg omitted entirely."""
+    from kubernetesnetawarescheduler_tpu.scenario.generate import (
+        ScenarioSpec,
+        generate_trace,
+    )
+    from kubernetesnetawarescheduler_tpu.scenario.replay import (
+        replay_trace,
+    )
+
+    spec = ScenarioSpec(seed=5, duration_s=10.0, base_rate=6.0,
+                        tick_s=1.0, gang_fraction=0.0,
+                        serving_lifetime_s=500.0,
+                        batch_lifetime_s=500.0,
+                        gang_lifetime_s=500.0,
+                        lifetime_floor_s=400.0,
+                        cluster=ClusterSpec(num_nodes=16, seed=3))
+    path = str(tmp_path / "t.jsonl")
+    generate_trace(spec, path)
+    kw = dict(batch=16, chaos=False, drift=False, state_faults=False,
+              rebalance=False, quality=False, oracle_sample=0,
+              compact=False, collect_placements=True,
+              queue_capacity=256)
+    r1 = replay_trace(path, **kw)
+    r2 = replay_trace(path, score_weights=None, **kw)
+    assert r1.placements == r2.placements
+    assert r1.pods_bound == r2.pods_bound > 0
+
+
+# ---------------------------------------------------------------------------
+# Rule 14 + state_audit policy section.
+# ---------------------------------------------------------------------------
+
+
+def _policy_block(**over):
+    block = {"shadow_overhead_fraction": 0.0101,
+             "disabled_bit_identical": True,
+             "gate_rejects_loser": True,
+             "promoted": True,
+             "promotion": {"promote": True, "reason": "replay_win"},
+             "oracle_gain_recovered_fraction": 0.69,
+             "source": "suite_policy"}
+    block.update(over)
+    return block
+
+
+def _r14_doc(policy="default"):
+    bench_check = _load_tool("bench_check")
+    doc = {
+        "metric": "density_pods_per_sec_n5120", "value": 12000.0,
+        "unit": "pods/s",
+        "detail": {
+            "score_p99_ms": 3.4,
+            "score_p99_source": "device_scan_amortized",
+            "bench_env": {"host": "x", "git_sha": "abc1234"},
+            "north_star": {"pods_per_sec_target": 10000.0,
+                           "p99_bar_ms": 5.0,
+                           "pods_per_sec_met": True, "p99_met": True,
+                           "p99_source": "device_scan_amortized"},
+        },
+    }
+    if policy is not None:
+        doc["detail"]["policy"] = (_policy_block()
+                                   if policy == "default" else policy)
+    return bench_check, doc
+
+
+def test_bench_check_rule14_requires_policy_block():
+    bench_check, doc = _r14_doc(policy=None)
+    fails = bench_check.check_doc("BENCH_r14.json", doc)
+    assert any("policy block" in f for f in fails), fails
+    # Pre-r14 filename: exempt.
+    assert not any("policy" in f for f in bench_check.check_doc(
+        "BENCH_r13.json", doc))
+    # Not claiming the bar: exempt.
+    bench_check, quiet = _r14_doc(policy=None)
+    quiet["detail"]["north_star"]["p99_met"] = False
+    assert not any("policy" in f for f in bench_check.check_doc(
+        "BENCH_r14.json", quiet))
+
+
+def test_bench_check_rule14_validates_shape_wherever_present():
+    bench_check, doc = _r14_doc()
+    assert not any("policy" in f
+                   for f in bench_check.check_doc("BENCH_r14.json",
+                                                  doc)), doc
+    # A diverged disabled path breaks the fallback contract — fatal
+    # even on a pre-r14 filename (carrying the block opts in).
+    bench_check, doc = _r14_doc(
+        policy=_policy_block(disabled_bit_identical=False))
+    fails = bench_check.check_doc("BENCH_r13.json", doc)
+    assert any("disabled_bit_identical" in f for f in fails), fails
+    # A gate that waved the seeded loser through is no gate.
+    bench_check, doc = _r14_doc(
+        policy=_policy_block(gate_rejects_loser=False))
+    fails = bench_check.check_doc("BENCH_r14.json", doc)
+    assert any("gate_rejects_loser" in f for f in fails), fails
+    # Over-budget shadow overhead invalidates the p99 claim.
+    bench_check, doc = _r14_doc(
+        policy=_policy_block(shadow_overhead_fraction=0.05))
+    fails = bench_check.check_doc("BENCH_r14.json", doc)
+    assert any("shadow_overhead_fraction" in f for f in fails), fails
+    # A promotion with no decision record is an unrecorded swap.
+    bench_check, doc = _r14_doc(
+        policy=_policy_block(promotion={}))
+    fails = bench_check.check_doc("BENCH_r14.json", doc)
+    assert any("promotion decision" in f for f in fails), fails
+    # Missing required keys.
+    bad = _policy_block()
+    del bad["shadow_overhead_fraction"]
+    bench_check, doc = _r14_doc(policy=bad)
+    fails = bench_check.check_doc("BENCH_r14.json", doc)
+    assert any("policy missing" in f for f in fails), fails
+
+
+def test_state_audit_policy_section(tmp_path):
+    state_audit = _load_tool("state_audit")
+    cfg = _policy_cfg()
+    cluster, lat, bw = build_fake_cluster(
+        ClusterSpec(num_nodes=8, seed=1))
+    loop = SchedulerLoop(cluster, cfg)
+    loop.encoder.set_network(lat, bw)
+    pol = _trained_policy(cfg)
+    ck = str(tmp_path / "ck")
+    # No policy: the section is absent-and-ok (pre-r14 checkpoints).
+    save_checkpoint(ck, loop.encoder)
+    rep = state_audit.audit_policy(ck)
+    assert rep["ok"] and not rep["present"]
+    # Healthy policy checkpoint: present-and-ok.
+    save_checkpoint(ck, loop.encoder, policy=pol)
+    loop.stop_bind_worker()
+    rep = state_audit.audit_policy(ck)
+    assert rep["ok"] and rep["present"], rep
+    assert state_audit.run_audit(ck)["ok"]
+
+    # NaN parameters: the section must fire (manifest re-blessed so
+    # only the policy check is under test).
+    npz_path = os.path.join(ck, "policy.npz")
+    with np.load(npz_path) as data:
+        arrays = {k: data[k].copy() for k in data.files}
+    arrays["param_theta"][0] = np.nan
+    np.savez_compressed(npz_path, **arrays)
+    update_manifest(ck)
+    rep = state_audit.audit_policy(ck)
+    assert not rep["ok"]
+    assert any("non-finite" in e for e in rep["errors"]), rep
+
+    # Promotion counted in the npz but meta carries no provenance:
+    # the lineage cross-check must fire.
+    pol.note_promotion({"reason": "replay_win", "promote": True},
+                       pol.to_score_weights())
+    pol.save(npz_path)
+    meta_path = os.path.join(ck, "meta.json")
+    with open(meta_path) as fh:
+        meta = json.load(fh)
+    meta.pop("policy", None)
+    with open(meta_path, "w") as fh:
+        json.dump(meta, fh)
+    update_manifest(ck)
+    rep = state_audit.audit_policy(ck)
+    assert not rep["ok"]
+    assert any("provenance" in e for e in rep["errors"]), rep

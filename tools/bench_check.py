@@ -675,6 +675,77 @@ def check_doc(path: str, doc: dict) -> list[str]:
                             "during the campaign; the migration "
                             "ledger's all-or-nothing contract is "
                             "broken")
+
+    # Rule 14 — learned-scoring provenance (round 14+): a headline
+    # claiming the p99 bar must prove the number was measured with the
+    # learned scoring policy's shadow path accounted for and the
+    # promotion gate disciplined — a ``policy`` block from the
+    # ``bench.py --suite policy`` leg with the shadow-scoring overhead
+    # under the 2% budget, the disabled path PROVEN bit-identical
+    # (enable_learned_score=False must be the exact pre-policy
+    # scheduler, not a near miss), and promotion provenance: the gate
+    # refusing a seeded loser, and any promotion carrying its
+    # counterfactual-replay decision record.  Round-gated by filename
+    # like Rules 8-13; the block's shape is validated wherever it
+    # appears.
+    if not grandfathered:
+        ns = detail.get("north_star")
+        p99_met = isinstance(ns, dict) and bool(ns.get("p99_met"))
+        pol = detail.get("policy")
+        rnd = _round_of(name)
+        if pol is None:
+            if p99_met and rnd is not None and rnd >= 14:
+                fails.append(
+                    f"{name}: north_star.p99_met without a policy "
+                    "block (round 14+ requires the --suite policy "
+                    "leg's shadow-overhead + promotion-gate evidence "
+                    "behind any claimed p99)")
+        elif not isinstance(pol, dict):
+            fails.append(f"{name}: policy is not an object")
+        else:
+            required = {"shadow_overhead_fraction",
+                        "disabled_bit_identical",
+                        "gate_rejects_loser"}
+            missing = required - set(pol)
+            if missing:
+                fails.append(f"{name}: policy missing "
+                             f"{sorted(missing)}")
+            else:
+                try:
+                    overhead = float(pol["shadow_overhead_fraction"])
+                except (TypeError, ValueError):
+                    fails.append(f"{name}: policy not numeric")
+                else:
+                    if pol.get("disabled_bit_identical") is not True:
+                        fails.append(
+                            f"{name}: policy.disabled_bit_identical "
+                            "is not true — the default path diverged "
+                            "from the pre-policy scheduler; the "
+                            "always-available fallback contract is "
+                            "broken")
+                    if not pol.get("gate_rejects_loser"):
+                        fails.append(
+                            f"{name}: policy.gate_rejects_loser is "
+                            "false — the promotion gate waved a "
+                            "seeded regression through; its veto is "
+                            "no evidence at all")
+                    if p99_met and overhead >= 0.02:
+                        fails.append(
+                            f"{name}: north_star.p99_met with "
+                            f"policy.shadow_overhead_fraction="
+                            f"{overhead} >= 0.02 — shadow scoring "
+                            "costs more than the 2% budget, so the "
+                            "claimed p99 excludes a real production "
+                            "overhead")
+            if isinstance(pol, dict) and pol.get("promoted"):
+                prom = pol.get("promotion")
+                if not isinstance(prom, dict) or not prom.get(
+                        "promote"):
+                    fails.append(
+                        f"{name}: policy.promoted without a "
+                        "promotion decision record — every live "
+                        "weight swap must trace to a counterfactual-"
+                        "replay win, not an unrecorded nudge")
     return fails
 
 

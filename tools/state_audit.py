@@ -24,6 +24,13 @@ scheduler.  Four independent checks:
   every committed pod's node matches its LAST logged decision.  A
   mismatch means the ledger and the decision record diverged — the
   state-drift analog at the commit layer.
+* **policy checkpoint** (r14) — when a ``policy.npz`` rides the
+  checkpoint, its learnable weights are finite, the Adam/EMA slots
+  agree with the parameter shapes (a shape-skewed optimizer resumes
+  training into garbage), its counters are internally consistent, and
+  its promotion lineage matches the ``meta.json`` provenance block —
+  a promoted version the meta never recorded is a weight swap with no
+  counterfactual evidence behind it.
 * **migration ledger** (r12) — every ``migrations_inflight`` entry in
   the checkpoint meta is well-formed (5 fields, no uid staged in two
   moves), and a pinned member's committed node equals the move's
@@ -232,6 +239,105 @@ def audit_migrations(path: str,
     }
 
 
+def audit_policy(path: str) -> dict:
+    """Learned-policy checkpoint invariants (r14): ``policy.npz`` is
+    optional (absent pre-r14 or with ``enable_learned_score`` off —
+    that is OK, not a failure), but when present it must be a state
+    the policy can actually resume from: finite parameters, optimizer
+    and EMA slots shaped like the parameters they track, counters
+    that add up, and a promotion lineage the checkpoint meta
+    corroborates."""
+    import numpy as np
+
+    from kubernetesnetawarescheduler_tpu.core.checkpoint import (
+        resolve_checkpoint_dir,
+    )
+
+    base = resolve_checkpoint_dir(path)
+    npz = os.path.join(base, "policy.npz")
+    if not os.path.exists(npz):
+        return {"ok": True, "present": False, "errors": []}
+    errors: list[str] = []
+    with np.load(npz) as data:
+        fields = sorted(k[len("param_"):] for k in data.files
+                        if k.startswith("param_"))
+        if not fields:
+            errors.append("policy.npz carries no param_* arrays")
+        for name in fields:
+            shape = data[f"param_{name}"].shape
+            for slot in ("param", "opt_m", "opt_v", "ema"):
+                key = f"{slot}_{name}"
+                if key not in data:
+                    errors.append(f"missing {key} — the optimizer/"
+                                  "EMA state is incomplete")
+                    continue
+                arr = data[key]
+                if arr.shape != shape:
+                    errors.append(
+                        f"{key} shape {arr.shape} != param shape "
+                        f"{shape} — resuming Adam with skewed slots "
+                        "trains into garbage")
+                if not np.all(np.isfinite(arr)):
+                    errors.append(f"{key} carries non-finite values")
+        sc = data["scalars"] if "scalars" in data else None
+        version = promoted_version = promotions = None
+        if sc is None or len(sc) < 12:
+            errors.append("scalars vector missing or short — the "
+                          "counter block cannot be restored")
+        elif not np.all(np.isfinite(sc)) or np.any(sc < 0):
+            errors.append("scalars carry non-finite or negative "
+                          "counters")
+        else:
+            promotions = int(sc[6])
+            promoted_version = int(sc[10])
+            version = int(sc[11])
+            if promoted_version > version:
+                errors.append(
+                    f"promoted_version {promoted_version} > version "
+                    f"{version} — a promotion from a version that "
+                    "never existed")
+            if promotions > 0 and "promoted_weights" not in data:
+                errors.append(
+                    f"{promotions} promotion(s) counted but no "
+                    "promoted_weights vector persisted — the live "
+                    "weight swap left no restorable evidence")
+        if "promoted_weights" in data:
+            pw = data["promoted_weights"]
+            if pw.shape != (11,) or not np.all(np.isfinite(pw)):
+                errors.append(
+                    f"promoted_weights malformed (shape {pw.shape})")
+    # Lineage cross-check: the checkpoint meta's provenance block must
+    # agree with what the npz says happened.
+    meta_path = os.path.join(base, "meta.json")
+    meta_policy = None
+    if os.path.exists(meta_path):
+        with open(meta_path, encoding="utf-8") as fh:
+            meta_policy = json.load(fh).get("policy")
+    if meta_policy is None:
+        errors.append("policy.npz present but meta.json carries no "
+                      "policy provenance block — the weight state "
+                      "and the checkpoint disagree about whether a "
+                      "policy exists")
+    elif version is not None:
+        if int(meta_policy.get("version", -1)) != version:
+            errors.append(
+                f"meta policy.version {meta_policy.get('version')} "
+                f"!= npz version {version}")
+        if int(meta_policy.get("promoted_version",
+                               -1)) != promoted_version:
+            errors.append(
+                "meta policy.promoted_version "
+                f"{meta_policy.get('promoted_version')} != npz "
+                f"promoted_version {promoted_version}")
+        if (promotions and promoted_version
+                and not meta_policy.get("last_promotion")):
+            errors.append(
+                "promotions counted but meta records no "
+                "last_promotion decision — a promoted policy must "
+                "trace to its counterfactual-replay win")
+    return {"ok": not errors, "present": True, "errors": errors}
+
+
 def run_audit(path: str, decisions: str | None = None) -> dict:
     """Every check that applies to ``path``; ``report["ok"]`` is the
     conjunction."""
@@ -243,6 +349,7 @@ def run_audit(path: str, decisions: str | None = None) -> dict:
         report["staging"] = audit_staging(path)
         report["roundtrip"] = audit_roundtrip(path)
         report["migrations"] = audit_migrations(path, decisions)
+        report["policy"] = audit_policy(path)
         if decisions is not None:
             report["decisions"] = audit_decisions(path, decisions)
     report["ok"] = all(
@@ -268,7 +375,7 @@ def main(argv: list[str] | None = None) -> int:
         print(json.dumps(report, indent=2))
     else:
         for key in ("manifest", "staging", "roundtrip", "migrations",
-                    "decisions"):
+                    "policy", "decisions"):
             section = report.get(key)
             if section is None:
                 continue
