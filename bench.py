@@ -322,6 +322,49 @@ def _persisted_policy() -> dict | None:
         return None
 
 
+def _persisted_fleet() -> dict | None:
+    """The ``--suite fleet`` leg's artifact
+    (bench_artifacts/fleet.json), compressed to the block r15+
+    density artifacts must carry when claiming the p99 bar
+    (tools/bench_check Rule 15): the per-tenant isolation proof
+    (every tenant's placements bit-identical to solo serving), the
+    per-tenant SLO blocks, and the consolidation numbers.  None when
+    the leg has not run in this tree."""
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "bench_artifacts", "fleet.json")
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+        flt = doc["detail"]["fleet"]
+        return {
+            "isolation_bit_identical": bool(
+                flt["isolation_bit_identical"]),
+            "tenants": {
+                name: {"slo": dict(t.get("slo", {})),
+                       "score_p99_ms": float(
+                           t.get("score_p99_ms", 0.0)),
+                       "bit_identical_to_solo": bool(
+                           t.get("bit_identical_to_solo", False))}
+                for name, t in flt["tenants"].items()},
+            "aggregate_pods_per_sec": float(
+                flt["aggregate_pods_per_sec"]),
+            "single_tenant_pods_per_sec": float(
+                flt["single_tenant_pods_per_sec"]),
+            "speedup": float(flt["speedup"]),
+            "transfer": {
+                "examples_to_promotion_cold": flt.get(
+                    "transfer", {}).get("examples_to_promotion_cold"),
+                "examples_to_promotion_warm": flt.get(
+                    "transfer", {}).get("examples_to_promotion_warm"),
+                "warm_lt_cold": bool(flt.get("transfer", {}).get(
+                    "warm_lt_cold", False)),
+            },
+            "source": "suite_fleet",
+        }
+    except (OSError, ValueError, KeyError, TypeError):
+        return None
+
+
 def _mark_driver_active():
     """Touch driver.intent and take chip.lock so the round-long
     watcher yields the single-owner chip to this run (it re-checks the
@@ -583,6 +626,14 @@ def _assemble_doc(res, *, num_nodes: int, batch: int, method: str,
         # bit-identical, and every promotion traces to a
         # counterfactual-replay win (--suite policy leg).
         detail["policy"] = pol
+    flt = _persisted_fleet()
+    if flt is not None:
+        # Fleet-consolidation provenance (r15, bench_check Rule 15):
+        # the p99 claim only counts alongside proof that batching
+        # many tenants' planes into one device state kept every
+        # tenant's placements bit-identical to solo serving and each
+        # tenant's SLO block published (--suite fleet leg).
+        detail["fleet"] = flt
     if device_lat is not None:
         detail.update({
             "score_p50_ms": device_lat["p50_ms"],
@@ -909,6 +960,29 @@ def _run_suite_bench(name: str) -> None:
                        "< 1M pods at the full shape")
         if bad:
             print("WARNING: scenario bars unmet: " + "; ".join(bad),
+                  file=sys.stderr)
+            sys.exit(1)
+    if name == "fleet":
+        flt = res.metrics.get("detail", {}).get("fleet", {})
+        # Isolation is structural and holds at every shape.  The
+        # consolidation speedup and the transfer win are full-shape
+        # properties (smoke shapes under-train the policy and let
+        # snapshot-rebuild spikes dominate tiny drains).
+        bad = []
+        if flt.get("isolation_bit_identical") is not True:
+            bad.append("a tenant's placements DIVERGED from solo "
+                       "serving")
+        if not small and not flt.get("speedup_over_4x"):
+            bad.append(f"consolidation speedup {flt.get('speedup')} "
+                       "< 4x the single-tenant rate")
+        if not small and not flt.get("transfer", {}).get(
+                "warm_lt_cold"):
+            bad.append("warm-started tenant did not promote with "
+                       "strictly fewer examples than cold "
+                       f"(warm={flt.get('transfer', {}).get('examples_to_promotion_warm')}, "
+                       f"cold={flt.get('transfer', {}).get('examples_to_promotion_cold')})")
+        if bad:
+            print("WARNING: fleet bars unmet: " + "; ".join(bad),
                   file=sys.stderr)
             sys.exit(1)
 

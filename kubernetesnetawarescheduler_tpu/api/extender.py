@@ -617,6 +617,20 @@ class ExtenderHandlers:
             out["eval_trace"] = getattr(self._loop,
                                         "policy_eval_trace", None)
             return self._json(out)
+        if path == "/debug/fleet":
+            # Fleet-of-clusters view (fleet/server.py): which padding
+            # bucket this tenant's loop shares with whom, batched-
+            # dispatch volume (lanes/dispatch = the live consolidation
+            # ratio), per-tenant queue depth and SLO state, and the
+            # transfer registry's donors — the first stop of the
+            # "onboarding a tenant" and "noisy neighbor" runbooks
+            # (docs/OPERATIONS.md).  Solo deployments report
+            # enabled=false; the FleetServer surfaces itself on each
+            # tenant loop at add_tenant time.
+            fleet = getattr(self._loop, "fleet", None)
+            if fleet is None:
+                return self._json({"enabled": False})
+            return self._json(fleet.summary())
         if path == "/debug/rebalance":
             # The descheduler's full state: scan/candidate/move
             # counters, the skip breakdown (which hysteresis gate or

@@ -501,6 +501,14 @@ class SchedulerConfig:
     # becomes the training target.
     policy_regret_margin: float = 0.05
 
+    # ---- fleet-of-clusters serving (fleet/) ----
+    # Smallest node-count padding bucket the FleetServer packs a
+    # tenant into: tenant configs are rounded up to the next
+    # power-of-two bucket >= this floor, so many small tenants share
+    # ONE jit cache entry instead of each retracing at its exact
+    # node count.  Must be a power of two.
+    fleet_bucket_min: int = 64
+
     def __post_init__(self) -> None:
         if self.max_nodes <= 0 or self.max_pods <= 0 or self.max_peers <= 0:
             raise ValueError("shape limits must be positive")
@@ -619,6 +627,30 @@ class SchedulerConfig:
             raise ValueError("policy_promote_margin must be >= 0")
         if self.policy_regret_margin < 0:
             raise ValueError("policy_regret_margin must be >= 0")
+        if (self.fleet_bucket_min < 1
+                or self.fleet_bucket_min & (self.fleet_bucket_min - 1)):
+            raise ValueError("fleet_bucket_min must be a power of two")
+
+    def startup_warnings(
+            self, policy_eval_trace: str | None = None) -> list[str]:
+        """Config combinations that are VALID but silently weaker than
+        they look — returned as explicit WARN lines for serve start
+        (r15 satellite; the r14 behavior was a one-line banner aside
+        that named no flag).  ``policy_eval_trace`` is the serve-level
+        trace path (it lives on the loop, not the config).
+
+        Unlike ``__post_init__`` these never raise: each is a legal
+        configuration, just one an operator has regretted before."""
+        warns: list[str] = []
+        if self.enable_learned_score and not policy_eval_trace:
+            warns.append(
+                "enable_learned_score is on but no evaluation trace "
+                "is configured: the policy trains and shadow-scores "
+                "but can NEVER be promoted — the counterfactual-"
+                "replay promotion gate needs a seeded scenario "
+                "trace.  Pass --policy-eval-trace to enable "
+                "promotion.")
+        return warns
 
 
 # ---------------------------------------------------------------------------

@@ -251,7 +251,8 @@ def read_state_arrays(path: str) -> "dict[str, np.ndarray]":
 
 
 def save_checkpoint(path: str, encoder: Encoder,
-                    policy=None) -> None:
+                    policy=None,
+                    extra_meta: dict | None = None) -> None:
     """Write the encoder's full staging state (the host mirror of the
     HBM matrices) + naming/interning tables under ``path``.
 
@@ -261,7 +262,14 @@ def save_checkpoint(path: str, encoder: Encoder,
     beside the encoder state, and the promotion provenance (which
     parameter version shipped, under which gate decision) rides the
     manifest-verified meta so tools/state_audit.py can cross-check
-    them offline."""
+    them offline.
+
+    ``extra_meta`` (r15): caller-owned top-level meta entries — the
+    fleet server stamps ``{"fleet": {"cluster_id": ...}}`` so a
+    tenant's checkpoint directory is self-identifying.  Keys must not
+    collide with the reserved encoder/policy meta; collisions raise.
+    The MANIFEST protocol (staging, previous/ rotation, digest
+    verification) is unchanged."""
     os.makedirs(path, exist_ok=True)
     with encoder._lock:
         # Deep copies under the lock: serialization happens after the
@@ -339,6 +347,13 @@ def save_checkpoint(path: str, encoder: Encoder,
             "promoted_version": int(policy.promoted_version),
             "last_promotion": policy.last_promotion,
         }
+    if extra_meta:
+        clash = set(extra_meta) & set(meta)
+        if clash:
+            raise ValueError(
+                f"extra_meta keys collide with reserved checkpoint "
+                f"meta: {sorted(clash)}")
+        meta.update(extra_meta)
     # Staged commit (r10): every payload file is written to .staging/
     # first, the CURRENT good set is preserved under previous/, the
     # payload files rename into place, and the MANIFEST rename is the

@@ -873,6 +873,30 @@ class Encoder:
         with self._lock:
             return list(self._node_names), list(self._node_gen)
 
+    def topology_features(self) -> dict[str, float]:
+        """Size/topology fingerprint of this cluster for the fleet
+        transfer registry (r15): valid node count, zone-class count,
+        and the mean/std of the OBSERVED (nonzero) latency/bandwidth
+        entries.  Donor matching compares these — a policy learned on
+        a similar-shaped, similar-fabric cluster is the best
+        warm-start candidate."""
+        with self._lock:
+            valid = self._node_valid.copy()
+            zones = self._node_zone[valid]
+            lat = self._lat[np.ix_(valid, valid)]
+            bw = self._bw[np.ix_(valid, valid)]
+        n = int(valid.sum())
+        lat_obs = lat[lat > 0]
+        bw_obs = bw[bw > 0]
+        return {
+            "nodes": float(n),
+            "zones": float(len({int(z) for z in zones if z >= 0})),
+            "lat_mean": float(lat_obs.mean()) if lat_obs.size else 0.0,
+            "lat_std": float(lat_obs.std()) if lat_obs.size else 0.0,
+            "bw_mean": float(bw_obs.mean()) if bw_obs.size else 0.0,
+            "bw_std": float(bw_obs.std()) if bw_obs.size else 0.0,
+        }
+
     def slot_generation(self, idx: int) -> int:
         with self._lock:
             return self._node_gen[idx]

@@ -250,6 +250,11 @@ class SchedulerLoop:
         # unchanged.
         self.scenario_phase: str | None = None
         self.trace_offset = 0
+        # Fleet tenancy (r15): the FleetServer stamps each tenant
+        # loop with its logical cluster name so committed spans carry
+        # the tenant join key; solo loops keep None and spans
+        # serialize unchanged (pre-r15 traces still lint clean).
+        self.cluster_id: str | None = None
         # "fresh" | "restored" | "ignored": serve.py records its
         # checkpoint-restore decision here; /readyz reports it.
         self.checkpoint_state = "fresh"
@@ -620,7 +625,9 @@ class SchedulerLoop:
 
     def _span_commit(self, sb, pods: Sequence[Pod],
                      static_version: int | None = None,
-                     rounds: int = 0) -> None:
+                     rounds: int = 0,
+                     donated: int = 0,
+                     donation_skipped: int = 1) -> None:
         """Freeze and commit a cycle span.  Called where the cycle's
         effects commit: end of the serial/burst/gang cycle, or at
         RETIRE for the pipelined path — so a crash never leaves a span
@@ -713,12 +720,14 @@ class SchedulerLoop:
             full_bytes=max(fb - last_fb, 0),
             rounds=int(rounds),
             # Cycle-level donation disposition mirrors the loop-wide
-            # counters: serving dispatches never donate (snapshot is
-            # encoder-owned), so spans carry donated=0 and one skip —
-            # a trace reader sees WHY the single-dispatch step still
-            # copies state, per cycle, not just in aggregate.
-            donated=0,
-            donation_skipped=1,
+            # counters: solo serving dispatches never donate (snapshot
+            # is encoder-owned), so spans carry donated=0 and one skip
+            # — a trace reader sees WHY the single-dispatch step still
+            # copies state, per cycle, not just in aggregate.  Fleet
+            # cycles (r15) override both: the batched FleetState is
+            # fleet-owned, so its dispatches DO donate.
+            donated=int(donated),
+            donation_skipped=int(donation_skipped),
             slo_burning=slo_burning,
             outcome_ring_depth=(self.quality.ring_depth()
                                 if self.quality is not None else 0),
@@ -728,6 +737,7 @@ class SchedulerLoop:
             trace_offset=int(self.trace_offset),
             policy_shadow_disagreements=pol_disagree,
             policy_version=pol_version,
+            cluster_id=self.cluster_id,
         )
         self.flight.commit(span)
 
@@ -1157,8 +1167,15 @@ class SchedulerLoop:
                           rounds=cycle_rounds)
         return bound
 
-    def schedule_pods(self, pods: Sequence[Pod]) -> int:
-        sb = self._span_begin("serial")
+    def _cycle_inputs(self, sb, pods: Sequence[Pod]):
+        """Encode half of a serial cycle: batch encode + atomic state
+        snapshot + node table, degraded-constraint events emitted.
+
+        Split out of :meth:`schedule_pods` (r15) so the fleet server
+        can run the SAME host-side semantics per tenant, dispatch all
+        tenants in ONE batched device call, then hand each tenant back
+        to :meth:`_cycle_outputs` — host behavior identical to solo
+        serving by construction."""
         with sb.phase("encode"), self.timer.phase("encode"):
             # Lenient: pods arrive from the watch (untrusted
             # manifests), and one pod with un-internable constraints
@@ -1181,6 +1198,33 @@ class SchedulerLoop:
             # slot's new tenant.
             node_table = self.encoder.node_table()
         self._emit_degraded_events()
+        return batch, state, static_version, node_table
+
+    def _cycle_outputs(self, sb, pods: Sequence[Pod], batch, state,
+                       static, node_table, assignment: np.ndarray,
+                       rounds: int, static_version: int, *,
+                       donated: int = 0, donation_skipped: int = 1,
+                       path: str = "serial") -> int:
+        """Bind half of a serial cycle: bind/assume, explain capture,
+        span commit.  The fleet server calls this per tenant after the
+        shared batched dispatch (see :meth:`_cycle_inputs`)."""
+        with sb.phase("bind"), self.timer.phase("bind"):
+            if self.async_bind:
+                bound = self._assume_and_enqueue(pods, assignment,
+                                                 node_table)
+            else:
+                bound = self._bind_all(pods, assignment, node_table)
+        self._capture_explains(pods, batch, assignment, state, static,
+                               node_table, sb.cycle_id, path)
+        self._span_commit(sb, pods, static_version=static_version,
+                          rounds=rounds, donated=donated,
+                          donation_skipped=donation_skipped)
+        return bound
+
+    def schedule_pods(self, pods: Sequence[Pod]) -> int:
+        sb = self._span_begin("serial")
+        batch, state, static_version, node_table = \
+            self._cycle_inputs(sb, pods)
         static = None
         with sb.phase("score_assign"), self.timer.phase("score_assign"):
             stats = self.method == "parallel"
@@ -1204,17 +1248,9 @@ class SchedulerLoop:
                 else:
                     assignment = np.asarray(jax_block(out))
                 self._note_dispatch()
-        with sb.phase("bind"), self.timer.phase("bind"):
-            if self.async_bind:
-                bound = self._assume_and_enqueue(pods, assignment,
-                                                 node_table)
-            else:
-                bound = self._bind_all(pods, assignment, node_table)
-        self._capture_explains(pods, batch, assignment, state, static,
-                               node_table, sb.cycle_id, "serial")
-        self._span_commit(sb, pods, static_version=static_version,
-                          rounds=cycle_rounds)
-        return bound
+        return self._cycle_outputs(sb, pods, batch, state, static,
+                                   node_table, assignment,
+                                   cycle_rounds, static_version)
 
     def _static_for(self, state, version: int):
         """Version-keyed cache of the batch-invariant assign static

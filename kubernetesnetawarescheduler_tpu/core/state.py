@@ -414,3 +414,35 @@ def pad_axis(x: jax.Array, size: int, axis: int = 0,
     widths = [(0, 0)] * x.ndim
     widths[axis] = (0, size - cur)
     return jnp.pad(x, widths, constant_values=fill)
+
+
+# ---------------------------------------------------------------------------
+# Fleet batching (r15): leading cluster axis over whole-state pytrees.
+# ---------------------------------------------------------------------------
+
+
+def stack_trees(trees):
+    """Stack same-shape pytrees along a NEW leading cluster axis.
+
+    Every PLANES array (and every PodBatch column) of tenant ``k``
+    lands at ``out.<leaf>[k]`` — the batched device state the fleet
+    vmaps the fused step over.  All inputs must share one treedef and
+    per-leaf shape/dtype (one padding bucket); a mismatch raises
+    through ``jnp.stack``."""
+    if not trees:
+        raise ValueError("stack_trees needs at least one tree")
+    return jax.tree_util.tree_map(lambda *ls: jnp.stack(ls), *trees)
+
+
+def index_tree(tree, k: int):
+    """Tenant ``k``'s row of a :func:`stack_trees` result (device-side
+    slice; no host copy)."""
+    return jax.tree_util.tree_map(lambda a: a[k], tree)
+
+
+def set_tree_row(tree, k: int, row):
+    """Functionally replace tenant ``k``'s row — the per-tenant state
+    refresh between fleet cycles (donated under jit, so the batched
+    buffer updates in place)."""
+    return jax.tree_util.tree_map(
+        lambda a, r: a.at[k].set(r), tree, row)

@@ -1,0 +1,38 @@
+"""Fleet-of-clusters serving (r15).
+
+Many logical clusters (tenants), one device program: every tenant's
+PLANES are stacked along a leading cluster axis into one batched
+device state, and the fused ``score -> conflict-resolve -> commit``
+step is vmapped over that axis — at N=2048 the single-dispatch step
+uses a fraction of a v5e core, so the chip's spare capacity becomes
+tenant capacity instead of idle silicon.
+
+Layout:
+
+- :mod:`.batch` — cluster-axis device step: tree stacking, the
+  vmapped assign dispatch (serving) and the vmapped fused step with
+  commit + donation (bench/forward path).
+- :mod:`.server` — :class:`FleetServer`: SchedulerLoop-per-tenant
+  facade over the shared dispatch, with power-of-two node-count
+  padding buckets bounding retrace.
+- :mod:`.transfer` — :class:`TransferRegistry`: promoted scoring
+  policies warm-start new tenants by size/topology match; promotion
+  stays strictly per-tenant through the r14 counterfactual gate.
+
+Isolation contract (property-tested): every tenant's placements are
+bit-identical to the same tenant served alone, including under
+another tenant's injected state faults.
+"""
+
+from kubernetesnetawarescheduler_tpu.fleet.batch import (  # noqa: F401
+    fleet_assign,
+    fleet_fused_step,
+    node_bucket,
+)
+from kubernetesnetawarescheduler_tpu.fleet.server import (  # noqa: F401
+    FleetServer,
+    Tenant,
+)
+from kubernetesnetawarescheduler_tpu.fleet.transfer import (  # noqa: F401
+    TransferRegistry,
+)

@@ -1,0 +1,119 @@
+"""Cluster-axis device step: the fleet's ONE shared program.
+
+Every tenant in a padding bucket shares one ``SchedulerConfig`` shape
+(``cfg.max_nodes`` = the bucket's power-of-two node count), so their
+whole-state pytrees stack leaf-for-leaf along a NEW leading cluster
+axis (``core.state.stack_trees``) and the fused per-batch decision
+vmaps over it.  Two entry points:
+
+- :func:`fleet_assign` — the SERVING dispatch: vmapped
+  ``assign_parallel`` (score + device-resident conflict resolution),
+  no commit.  Mirrors the solo serial path exactly — durable usage
+  commits flow through each tenant's bind/watch path, and the batched
+  snapshot stack stays encoder-derived — which is what makes the
+  per-tenant bit-identity contract provable rather than aspirational.
+- :func:`fleet_fused_step` — the vmapped r9 fused
+  ``score -> conflict-resolve -> commit`` step with the cluster-stacked
+  state DONATED, for state chains the caller owns (bench folds, replay;
+  the forward path once a mesh dimension absorbs the cluster axis).
+
+``sharded_winner_fn``'s contract (parallel/sharding.py) is untouched:
+the vmap axis is OUTSIDE the per-cluster winner reduction, so a mesh
+dimension can later absorb it by sharding the leading axis —
+per-cluster semantics are already batch-invariant.
+
+Idle lanes are free: an ``init_pod_batch`` lane has ``pod_valid`` all
+False, ``assign_parallel`` maps invalid pods to UNASSIGNED, and
+``commit_assignments`` of an UNASSIGNED batch is the identity — a
+bucket dispatches at its padded tenant capacity every cycle with one
+jit cache entry, whatever subset of tenants has work.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from kubernetesnetawarescheduler_tpu.config import SchedulerConfig
+from kubernetesnetawarescheduler_tpu.core.assign import assign_parallel
+from kubernetesnetawarescheduler_tpu.core.state import (
+    ClusterState,
+    PodBatch,
+    commit_assignments,
+)
+
+
+def node_bucket(n_nodes: int, floor: int = 64) -> int:
+    """The padding bucket for a tenant with ``n_nodes`` nodes: the
+    next power of two >= max(n_nodes, floor).  Buckets bound retrace —
+    every tenant in a bucket shares one jit cache entry."""
+    if n_nodes < 1:
+        raise ValueError("n_nodes must be >= 1")
+    b = max(int(floor), 1)
+    while b < n_nodes:
+        b *= 2
+    return b
+
+
+@partial(jax.jit, static_argnames=("cfg",))
+def fleet_assign(states: ClusterState, pods: PodBatch, statics,
+                 cfg: SchedulerConfig):
+    """Vmapped serving dispatch over the leading cluster axis.
+
+    ``states``/``pods``/``statics`` are :func:`~..core.state.stack_trees`
+    results (``[K, ...]`` per leaf); returns
+    ``(assignment i32[K, P], rounds i32[K])``.  Per-lane results are
+    bit-identical to calling ``assign_parallel`` per tenant (the
+    fleet isolation property test pins this all the way to
+    placements)."""
+
+    def one(st, pd, stc):
+        return assign_parallel(st, pd, cfg, stc, with_stats=True)
+
+    return jax.vmap(one)(states, pods, statics)
+
+
+@partial(jax.jit, static_argnames=("cfg",), donate_argnums=(0,))
+def fleet_fused_step(states: ClusterState, pods: PodBatch, statics,
+                     cfg: SchedulerConfig):
+    """Vmapped fused step: assign + usage commit per lane, the
+    cluster-stacked ``states`` DONATED (the caller must own it — a
+    bench/replay chain, never the encoder-cached snapshots).  Returns
+    ``(new_states, assignment i32[K, P], rounds i32[K])``."""
+
+    def one(st, pd, stc):
+        assignment, rounds = assign_parallel(st, pd, cfg, stc,
+                                             with_stats=True)
+        return commit_assignments(st, pd, assignment), assignment, rounds
+
+    return jax.vmap(one)(states, pods, statics)
+
+
+@partial(jax.jit, static_argnames=("cfg",))
+def fleet_assign_lanes(states, pods, statics, cfg: SchedulerConfig):
+    """The serving dispatch as ONE device call per bucket cycle:
+    ``states``/``pods``/``statics`` are length-K tuples of per-tenant
+    pytrees (K = the bucket's padded tenant capacity), stacked along
+    the cluster axis INSIDE the jit — stacking, scoring, and conflict
+    resolution for every tenant fuse into a single program, so the
+    per-dispatch overhead a solo loop pays K times is paid once.
+    Retrace is keyed on K and the bucket config only."""
+    st = jax.tree_util.tree_map(lambda *ls: jnp.stack(ls), *states)
+    pd = jax.tree_util.tree_map(lambda *ls: jnp.stack(ls), *pods)
+    stc = stack_statics(statics)
+
+    def one(s, p, c_):
+        return assign_parallel(s, p, cfg, c_, with_stats=True)
+
+    return jax.vmap(one)(st, pd, stc)
+
+
+def stack_statics(statics):
+    """Stack per-tenant assign statics (the
+    ``compute_assign_static_incremental`` result pytrees) along the
+    cluster axis.  Scalar leaves promote to arrays so every leaf gains
+    the leading axis the vmap maps over."""
+    return jax.tree_util.tree_map(
+        lambda *ls: jnp.stack([jnp.asarray(x) for x in ls]), *statics)

@@ -380,6 +380,53 @@ class ScoringPolicy:
             self.promoted_weights = weights
             self.last_promotion = dict(decision)
 
+    # -- fleet transfer (r15) -----------------------------------------
+
+    def export_params(self) -> dict[str, np.ndarray]:
+        """EMA-read parameters as plain numpy — the fleet transfer
+        registry's donor payload (decoupled from this policy's jax
+        buffers, so a registry entry outlives the donor tenant)."""
+        with self._lock:
+            p = self._eval_params_locked()
+            return {"theta": np.asarray(p.theta, np.float32).copy(),
+                    "class_adj": np.asarray(p.class_adj,
+                                            np.float32).copy()}
+
+    def warm_start_from(self, theta: np.ndarray,
+                        class_adj: np.ndarray) -> None:
+        """Seed parameters from a donor tenant (fleet transfer).
+
+        Optimizer state starts FRESH (``opt_t=0``, so the eval read
+        returns the seeded parameters verbatim until this tenant's own
+        first train step), and ``class_adj`` is zero-padded/truncated
+        to this config's zone-class count — donor and recipient need
+        not share ``max_zones``.  Transfer changes only where learning
+        STARTS: the seeded policy still serves shadow-only until it
+        wins this tenant's own counterfactual-replay gate."""
+        import jax.numpy as jnp
+
+        th = np.asarray(theta, np.float32).reshape(-1)
+        if th.shape[0] != NUM_TERMS:
+            raise ValueError(
+                f"donor theta has {th.shape[0]} terms, "
+                f"expected {NUM_TERMS}")
+        ca = np.zeros((self.num_classes,), np.float32)
+        src = np.asarray(class_adj, np.float32).reshape(-1)
+        n = min(self.num_classes, src.shape[0])
+        ca[:n] = src[:n]
+        with self._lock:
+            self._params = PolicyParams(theta=jnp.asarray(th),
+                                        class_adj=jnp.asarray(ca))
+            self._opt_m = PolicyParams(*(jnp.zeros_like(p)
+                                         for p in self._params))
+            self._opt_v = PolicyParams(*(jnp.zeros_like(p)
+                                         for p in self._params))
+            self._opt_t = jnp.zeros((), jnp.float32)
+            self._ema = PolicyParams(*(jnp.zeros_like(p)
+                                       for p in self._params))
+            self._version += 1
+            self._refresh_np_locked()
+
     def summary(self) -> dict[str, Any]:
         """One-shot stats block for /debug/policy, /metrics, bench."""
         with self._lock:

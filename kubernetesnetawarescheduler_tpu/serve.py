@@ -326,10 +326,13 @@ def main(argv=None) -> int:
         print(f"learned scoring policy enabled (shadow-first): ring "
               f"{cfg.policy_ring}, train every "
               f"{cfg.policy_train_interval_s}s, gate margin "
-              f"{cfg.policy_promote_margin}"
-              + ("" if args.policy_eval_trace else
-                 "; no --policy-eval-trace, promotions disabled"),
-              file=sys.stderr)
+              f"{cfg.policy_promote_margin}", file=sys.stderr)
+    # Explicit startup WARNs (r15): legal-but-weaker configurations —
+    # e.g. learned scoring without an eval trace silently pinned to
+    # shadow-only — are named loudly, with the flag that fixes them.
+    for warn in cfg.startup_warnings(
+            policy_eval_trace=args.policy_eval_trace or None):
+        print(f"WARN: {warn}", file=sys.stderr)
     if cfg.enable_rebalance:
         print(f"rebalancer enabled: min gain "
               f"{cfg.rebalance_min_gain}, budget "

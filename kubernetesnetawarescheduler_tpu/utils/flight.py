@@ -115,6 +115,11 @@ class CycleSpan:
     # unchanged.
     policy_shadow_disagreements: int = 0
     policy_version: int = 0
+    # r15 fleet: which logical cluster (tenant) this cycle served.
+    # None on solo loops — the pre-r15-compatible default, so old
+    # traces and crash dumps deserialize unchanged and trace_check
+    # validates it only-when-present.
+    cluster_id: str | None = None
 
     def to_dict(self) -> dict[str, Any]:
         return {
@@ -146,6 +151,7 @@ class CycleSpan:
             "policy_shadow_disagreements":
                 self.policy_shadow_disagreements,
             "policy_version": self.policy_version,
+            "cluster_id": self.cluster_id,
         }
 
 

@@ -709,3 +709,126 @@ def test_scenario_shape_validated_when_present():
     quiet["detail"]["north_star"]["p99_met"] = False
     fails = bench_check.check_doc("BENCH_r13.json", quiet)
     assert any("half_moved_gangs=3" in f for f in fails), fails
+
+
+def _policy(**overrides):
+    """A healthy r14 policy block (bench.py _persisted_policy shape,
+    Rule-14 envelope only)."""
+    block = {
+        "shadow_overhead_fraction": 0.004,
+        "disabled_bit_identical": True,
+        "gate_rejects_loser": True,
+        "promoted": False,
+        "source": "suite_policy",
+    }
+    block.update(overrides)
+    return block
+
+
+def _r14_doc(**detail_overrides):
+    detail = {"trace_provenance": _trace_prov(),
+              "winner_fusion": _winner_fusion(),
+              "rounds_max": 4,
+              "integrity": _integrity(),
+              "quality": _quality(),
+              "rebalance": _rebalance(),
+              "scenario": _scenario(),
+              "policy": _policy()}
+    detail.update(detail_overrides)
+    return _headline(detail=detail)
+
+
+def _fleet(**overrides):
+    """A healthy r15 fleet block (bench.py _persisted_fleet shape,
+    Rule-15 envelope only — the full artifact shape lives in the
+    --suite fleet leg, tests/test_fleet.py)."""
+    block = {
+        "isolation_bit_identical": True,
+        "tenants": {
+            "tenant-00": {"slo": {"burning": [], "objectives": {}},
+                          "score_p99_ms": 0.9,
+                          "bit_identical_to_solo": True},
+            "tenant-01": {"slo": {"burning": [], "objectives": {}},
+                          "score_p99_ms": 1.1,
+                          "bit_identical_to_solo": True},
+        },
+        "aggregate_pods_per_sec": 30000.0,
+        "single_tenant_pods_per_sec": 2500.0,
+        "speedup": 12.0,
+        "transfer": {"examples_to_promotion_cold": 128,
+                     "examples_to_promotion_warm": 0,
+                     "warm_lt_cold": True},
+        "source": "suite_fleet",
+    }
+    block.update(overrides)
+    return block
+
+
+def _r15_doc(**detail_overrides):
+    detail = {"trace_provenance": _trace_prov(),
+              "winner_fusion": _winner_fusion(),
+              "rounds_max": 4,
+              "integrity": _integrity(),
+              "quality": _quality(),
+              "rebalance": _rebalance(),
+              "scenario": _scenario(),
+              "policy": _policy(),
+              "fleet": _fleet()}
+    detail.update(detail_overrides)
+    return _headline(detail=detail)
+
+
+def test_fleet_block_required_from_round15():
+    # r15+ headline claiming the p99 bar without the block: fails.
+    doc = _r14_doc()
+    fails = bench_check.check_doc("BENCH_r15.json", doc)
+    assert any("fleet" in f for f in fails), fails
+    # Same doc with the block: clean.
+    assert bench_check.check_doc("BENCH_r15.json", _r15_doc()) == []
+    # Committed r14 history predates the fleet subsystem: exempt.
+    assert bench_check.check_doc("BENCH_r14.json", doc) == []
+    # A doc not claiming the bar may omit the block even at r15+.
+    quiet = _r14_doc()
+    quiet["detail"]["score_p99_ms"] = 87.44
+    quiet["detail"]["north_star"]["p99_met"] = False
+    assert bench_check.check_doc("BENCH_r15.json", quiet) == []
+
+
+def test_fleet_shape_validated_when_present():
+    # A tenant that diverged from solo serving poisons the artifact —
+    # fatal wherever the block appears, whatever the headline claims.
+    fails = bench_check.check_doc("BENCH_r15.json", _r15_doc(
+        fleet=_fleet(isolation_bit_identical=False)))
+    assert any("isolation_bit_identical" in f for f in fails), fails
+    # Missing envelope keys.
+    bad = _fleet()
+    del bad["tenants"]
+    fails = bench_check.check_doc("BENCH_r15.json", _r15_doc(
+        fleet=bad))
+    assert any("fleet missing" in f for f in fails), fails
+    # An aggregate with no per-tenant blocks is unauditable.
+    fails = bench_check.check_doc("BENCH_r15.json", _r15_doc(
+        fleet=_fleet(tenants={})))
+    assert any("tenants missing or empty" in f for f in fails), fails
+    # Every consolidated tenant must carry its own SLO block.
+    noslo = _fleet()
+    noslo["tenants"] = dict(noslo["tenants"])
+    noslo["tenants"]["tenant-01"] = {"score_p99_ms": 1.1}
+    fails = bench_check.check_doc("BENCH_r15.json", _r15_doc(
+        fleet=noslo))
+    assert any("lacks an slo block" in f for f in fails), fails
+    # Not an object at all.
+    fails = bench_check.check_doc("BENCH_r15.json", _r15_doc(
+        fleet=["not", "a", "dict"]))
+    assert any("fleet is not an object" in f for f in fails), fails
+    # Validated even on a pre-r15 filename: carrying the block opts
+    # in (same contract as every other provenance block).
+    fails = bench_check.check_doc("BENCH_r14.json", _r14_doc(
+        fleet=_fleet(isolation_bit_identical=False)))
+    assert any("isolation_bit_identical" in f for f in fails), fails
+    # Isolation is fatal even when the doc is not claiming the bar.
+    quiet = _r15_doc(fleet=_fleet(isolation_bit_identical=False))
+    quiet["detail"]["score_p99_ms"] = 87.44
+    quiet["detail"]["north_star"]["p99_met"] = False
+    fails = bench_check.check_doc("BENCH_r15.json", quiet)
+    assert any("isolation_bit_identical" in f for f in fails), fails

@@ -317,3 +317,82 @@ def test_decision_log_agrees_with_ledger_on_redirect(tmp_path):
     log.close()
     entries = DecisionLog.load(dec)
     assert [d.node for d in entries if d.pod == pod.name] == [other]
+
+
+def test_sibling_tenant_checkpoints_never_cross_contaminate(tmp_path):
+    """Fleet serving (r15) checkpoints each tenant into its OWN
+    sibling directory.  Two tenants saving concurrently — racing
+    through several previous/ rotations each — must end with each
+    directory holding ONLY its own tenant's state: manifests verify,
+    meta carries the right fleet.cluster_id stamp, restored arrays
+    match the right encoder, and each previous/ rotation is that
+    tenant's own prior save (not the sibling's)."""
+    import json
+    import os
+    import threading
+
+    from kubernetesnetawarescheduler_tpu.core.checkpoint import (
+        verify_manifest,
+    )
+
+    loops = {}
+    for name, seed in (("blue", 0), ("green", 7)):
+        _, loop = _warm_encoder(seed=seed)
+        loops[name] = loop
+    dirs = {name: str(tmp_path / "fleet" / name) for name in loops}
+
+    rounds = 4
+    barrier = threading.Barrier(len(loops))
+    errors: list = []
+
+    def _saver(name):
+        loop = loops[name]
+        rng = np.random.default_rng(hash(name) % 1000)
+        try:
+            for r in range(rounds):
+                if r:
+                    # Mutate between rotations so every save differs.
+                    feed_metrics(loop.client, loop.encoder, rng)
+                barrier.wait()  # maximize interleaving per rotation
+                save_checkpoint(
+                    dirs[name], loop.encoder,
+                    extra_meta={"fleet": {"cluster_id": name}})
+        except Exception as exc:  # noqa: BLE001 — surfaced below
+            errors.append((name, exc))
+
+    threads = [threading.Thread(target=_saver, args=(n,))
+               for n in loops]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert errors == []
+
+    for name, loop in loops.items():
+        path = dirs[name]
+        # Current set verifies and is self-identifying.
+        assert verify_manifest(path) == []
+        with open(os.path.join(path, "meta.json"),
+                  encoding="utf-8") as fh:
+            meta = json.load(fh)
+        assert meta["fleet"] == {"cluster_id": name}
+        # Restored arrays are THIS tenant's final state.
+        enc2 = load_checkpoint(path)
+        np.testing.assert_array_equal(enc2._metrics,
+                                      loop.encoder._metrics)
+        np.testing.assert_array_equal(enc2._cap, loop.encoder._cap)
+        assert enc2._node_names == loop.encoder._node_names
+        # The rotated previous/ set verifies and is the SAME
+        # tenant's prior save, not the sibling's.
+        prev = os.path.join(path, "previous")
+        assert verify_manifest(prev) == []
+        with open(os.path.join(prev, "meta.json"),
+                  encoding="utf-8") as fh:
+            pmeta = json.load(fh)
+        assert pmeta["fleet"] == {"cluster_id": name}
+
+    # The two directories really diverged (no shared payload).
+    blue = load_checkpoint(dirs["blue"])
+    green = load_checkpoint(dirs["green"])
+    assert not np.array_equal(blue._metrics, green._metrics)
+    assert not np.array_equal(blue._cap, green._cap)

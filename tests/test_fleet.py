@@ -1,0 +1,446 @@
+"""Fleet-of-clusters serving (fleet/).
+
+The contract under test is the one that makes consolidation safe to
+ship: batching many tenants' planes into one device state must change
+WHERE the dispatch runs, never WHAT any tenant decides.  The property
+test pins every tenant's placements bit-identical to solo serving —
+including while another tenant's state is being actively corrupted by
+the chaos injector — and the unit tests pin the pieces that identity
+rests on: power-of-two padding buckets (bounded retrace), inert
+filler lanes, vmapped-step parity with the solo fused step, and a
+transfer registry that only ever seeds from gate-promoted donors.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import jax
+
+from kubernetesnetawarescheduler_tpu.config import SchedulerConfig
+from kubernetesnetawarescheduler_tpu.bench.fakecluster import (
+    ClusterSpec,
+    WorkloadSpec,
+    build_fake_cluster,
+    feed_metrics,
+    generate_workload,
+)
+from kubernetesnetawarescheduler_tpu.core.assign import fused_schedule_step
+from kubernetesnetawarescheduler_tpu.core.loop import SchedulerLoop
+from kubernetesnetawarescheduler_tpu.core.state import stack_trees
+from kubernetesnetawarescheduler_tpu.core.state_chaos import (
+    StateChaosInjector,
+)
+from kubernetesnetawarescheduler_tpu.fleet import (
+    FleetServer,
+    TransferRegistry,
+    fleet_fused_step,
+    node_bucket,
+)
+from kubernetesnetawarescheduler_tpu.fleet.batch import (
+    fleet_assign_lanes,
+    stack_statics,
+)
+from kubernetesnetawarescheduler_tpu.policy.model import ScoringPolicy
+
+# One small shape for every device test in this file: a single jit
+# cache entry per program across the whole module.
+CFG = SchedulerConfig(max_nodes=16, max_pods=4, max_peers=2,
+                      fleet_bucket_min=16, enable_explain=False)
+
+
+def _mk_cluster(seed, num_nodes=12):
+    return build_fake_cluster(ClusterSpec(num_nodes=num_nodes,
+                                          seed=seed))
+
+
+def _solo_loop(cluster, lat, bw, seed, cfg=CFG):
+    loop = SchedulerLoop(cluster, cfg, method="parallel")
+    loop.encoder.set_network(lat, bw)
+    feed_metrics(cluster, loop.encoder, np.random.default_rng(seed))
+    return loop
+
+
+def _placements(loop):
+    return sorted((b.namespace, b.pod_name, b.node_name)
+                  for b in loop.client.bindings)
+
+
+def _workload(n, seed):
+    return generate_workload(WorkloadSpec(num_pods=n, seed=seed,
+                                          services=3,
+                                          peer_fraction=0.5))
+
+
+# -- padding buckets --------------------------------------------------
+
+
+def test_node_bucket_rounds_to_power_of_two():
+    assert node_bucket(1, 64) == 64        # floored
+    assert node_bucket(64, 64) == 64       # exact
+    assert node_bucket(65, 64) == 128      # next doubling
+    assert node_bucket(48, 32) == 64
+    assert node_bucket(200, 64) == 256
+    assert node_bucket(3, 1) == 4 or node_bucket(3, 4) == 4
+    with pytest.raises(ValueError):
+        node_bucket(0, 64)
+
+
+def test_bucket_lane_capacity_is_power_of_two():
+    """Lane count pads to the next power of two, so a bucket's jit
+    cache entry survives tenant churn in O(log tenants) retraces."""
+    fleet = FleetServer()
+    caps = []
+    for k in range(5):
+        cluster, lat, bw = _mk_cluster(seed=k)
+        t = fleet.add_tenant(f"t{k}", cluster, CFG, n_nodes=12)
+        assert t.bucket_nodes == 16
+        bucket = next(iter(fleet._buckets.values()))
+        caps.append(bucket.capacity)
+    assert caps == [1, 2, 4, 4, 8]
+    # Same-shaped tenants all landed in ONE bucket.
+    assert len(fleet._buckets) == 1
+    fleet.close()
+
+
+def test_add_tenant_rounds_config_into_bucket():
+    """A tenant config under the bucket floor is padded up (one cache
+    entry for every small tenant), and duplicate names are refused."""
+    fleet = FleetServer()
+    cluster, lat, bw = _mk_cluster(seed=1)
+    small = SchedulerConfig(max_nodes=8, max_pods=4, max_peers=2,
+                            fleet_bucket_min=16)
+    t = fleet.add_tenant("a", cluster, small, n_nodes=6)
+    assert t.bucket_nodes == 16
+    assert t.loop.cfg.max_nodes == 16
+    with pytest.raises(ValueError):
+        fleet.add_tenant("a", cluster, small, n_nodes=6)
+    fleet.close()
+
+
+# -- device-step parity -----------------------------------------------
+
+
+def _encoded_lane(seed, n_pods=4):
+    """One tenant's (state, batch, static) triple plus its loop, the
+    exact encode half the fleet stacks per cycle."""
+    cluster, lat, bw = _mk_cluster(seed=seed)
+    loop = _solo_loop(cluster, lat, bw, seed + 100)
+    pods = _workload(n_pods, seed + 200)
+    batch = loop.encoder.encode_pods(pods, node_of=lambda *_: None,
+                                     lenient=True)
+    state, version = loop.encoder.snapshot_versioned()
+    static = loop._static_for(state, version)
+    return loop, state, batch, static
+
+
+def _copy(tree):
+    import jax.numpy as jnp
+    return jax.tree_util.tree_map(jnp.copy, tree)
+
+
+@pytest.mark.slow  # pure XLA-compile cost (~8 s): two fresh device
+# programs on a tier-1 budget with no headroom; the same parity is
+# re-proven end-to-end by the slow isolation property tests below.
+def test_fleet_fused_step_matches_solo_fused_step():
+    """Each lane of the vmapped fused step is bit-identical to the
+    solo ``fused_schedule_step`` on that tenant alone — assignment,
+    rounds, AND the committed usage planes."""
+    lanes = [_encoded_lane(seed) for seed in (7, 19)]
+    states = stack_trees([_copy(ln[1]) for ln in lanes])
+    batches = stack_trees([ln[2] for ln in lanes])
+    statics = stack_statics([ln[3] for ln in lanes])
+    new_states, asg, rounds = fleet_fused_step(states, batches,
+                                               statics, CFG)
+    for k, (loop, state, batch, static) in enumerate(lanes):
+        s_new, s_asg, s_rounds = fused_schedule_step(
+            _copy(state), batch, CFG, static)
+        np.testing.assert_array_equal(np.asarray(asg)[k],
+                                      np.asarray(s_asg))
+        assert int(np.asarray(rounds)[k]) == int(np.asarray(s_rounds))
+        for fl, sl in zip(jax.tree_util.tree_leaves(new_states),
+                          jax.tree_util.tree_leaves(s_new)):
+            np.testing.assert_array_equal(np.asarray(fl)[k],
+                                          np.asarray(sl))
+
+
+@pytest.mark.slow  # same: one K=4 vmap compile dominates the test
+def test_filler_lanes_are_inert():
+    """Padding a bucket with empty filler lanes changes the lane
+    count (a new jit entry) but not one bit of any real lane's
+    output."""
+    from kubernetesnetawarescheduler_tpu.fleet.server import _Bucket
+
+    lanes = [_encoded_lane(seed) for seed in (31, 43)]
+    triples = [(ln[1], ln[2], ln[3]) for ln in lanes]
+    asg2, rounds2 = fleet_assign_lanes(
+        tuple(t[0] for t in triples), tuple(t[1] for t in triples),
+        tuple(t[2] for t in triples), CFG)
+    filler = _Bucket(CFG).filler()
+    padded = triples + [filler, filler]
+    asg4, rounds4 = fleet_assign_lanes(
+        tuple(t[0] for t in padded), tuple(t[1] for t in padded),
+        tuple(t[2] for t in padded), CFG)
+    np.testing.assert_array_equal(np.asarray(asg4)[:2],
+                                  np.asarray(asg2))
+    np.testing.assert_array_equal(np.asarray(rounds4)[:2],
+                                  np.asarray(rounds2))
+    # The filler lanes themselves scheduled nothing.
+    assert (np.asarray(asg4)[2:] < 0).all()
+
+
+# -- the isolation property -------------------------------------------
+
+
+def _drive_solo(seed, wseed, n_pods, chunk=4):
+    cluster, lat, bw = _mk_cluster(seed=seed)
+    loop = _solo_loop(cluster, lat, bw, seed + 1)
+    pods = _workload(n_pods, wseed)
+    i = 0
+    while i < len(pods) or len(loop.queue):
+        if i < len(pods):
+            loop.client.add_pods(pods[i:i + chunk])
+            i += chunk
+        loop.run_once()
+    return _placements(loop)
+
+
+def _drive_fleet(seeds, wseeds, n_pods, chunk=4, chaos_on=None):
+    """Serve all tenants through one FleetServer; optionally run the
+    state-chaos injector against tenant index ``chaos_on`` between
+    cycles (its lane may corrupt and heal — the OTHER tenants must
+    not notice)."""
+    fleet = FleetServer()
+    tenants = []
+    for k, (seed, wseed) in enumerate(zip(seeds, wseeds)):
+        cluster, lat, bw = _mk_cluster(seed=seed)
+        t = fleet.add_tenant(f"t{k}", cluster, CFG, n_nodes=12)
+        t.loop.encoder.set_network(lat, bw)
+        feed_metrics(cluster, t.loop.encoder,
+                     np.random.default_rng(seed + 1))
+        tenants.append((t, _workload(n_pods, wseed)))
+    chaos = None
+    if chaos_on is not None:
+        victim = tenants[chaos_on][0].loop
+        chaos = StateChaosInjector(victim.encoder, seed=5,
+                                   loop=victim)
+    i = 0
+    step = 0
+    while True:
+        fed = False
+        for t, pods in tenants:
+            if pods[i:i + chunk]:
+                t.loop.client.add_pods(pods[i:i + chunk])
+                fed = True
+        i += chunk
+        if not fed and not any(len(t.loop.queue) for t, _ in tenants):
+            break
+        while any(len(t.loop.queue) for t, _ in tenants):
+            fleet.step()
+            step += 1
+            if chaos is not None and step % 3 == 0:
+                chaos.inject("bit_flip")
+    fleet.close()
+    return [_placements(t.loop) for t, _ in tenants], chaos
+
+
+@pytest.mark.slow  # replay-heavy: full serving of K tenants twice
+def test_fleet_placements_bit_identical_to_solo():
+    """The tentpole property: every tenant served from the batched
+    device state places every pod on exactly the node solo serving
+    would have picked."""
+    seeds, wseeds = [11, 22, 33], [101, 202, 303]
+    fleet_p, _ = _drive_fleet(seeds, wseeds, n_pods=16)
+    solo_p = [_drive_solo(s, w, n_pods=16)
+              for s, w in zip(seeds, wseeds)]
+    for k, (f, s) in enumerate(zip(fleet_p, solo_p)):
+        assert f == s, f"tenant {k} diverged from solo serving"
+    assert all(len(p) > 0 for p in fleet_p)
+
+
+@pytest.mark.slow  # replay-heavy: full serving of K tenants twice
+def test_fleet_isolation_under_neighbor_state_chaos():
+    """Noisy-neighbor worst case: one tenant's device planes are
+    actively bit-flipped mid-serving; the OTHER tenants' placements
+    stay bit-identical to solo serving (their lanes never read the
+    victim's state)."""
+    seeds, wseeds = [11, 22, 33], [101, 202, 303]
+    fleet_p, chaos = _drive_fleet(seeds, wseeds, n_pods=16,
+                                  chaos_on=1)
+    assert chaos is not None and chaos.injected["bit_flip"] > 0
+    for k in (0, 2):
+        solo = _drive_solo(seeds[k], wseeds[k], n_pods=16)
+        assert fleet_p[k] == solo, (
+            f"tenant {k} diverged while tenant 1 was under chaos")
+
+
+# -- cross-cluster policy transfer ------------------------------------
+
+
+def _promoted_policy(seed, theta):
+    """A policy carrying a fake promotion at known parameters."""
+    cfg = SchedulerConfig(max_nodes=16, max_pods=4, max_peers=2)
+    pol = ScoringPolicy(cfg, seed=seed)
+    pol.warm_start_from(np.asarray(theta, np.float32),
+                        np.zeros_like(pol.export_params()["class_adj"]))
+    pol._version = 1
+    pol.note_promotion({"promote": True, "reason": "test"},
+                       cfg.weights)
+    return pol
+
+
+def test_registry_refuses_unpromoted_donor():
+    """Shadow-only policies never seed peers: register() is a no-op
+    below promoted_version 1."""
+    reg = TransferRegistry()
+    cfg = SchedulerConfig(max_nodes=16, max_pods=4, max_peers=2)
+    pol = ScoringPolicy(cfg, seed=0)
+    assert reg.register("a", {"nodes": 16.0}, pol) is None
+    assert reg.summary()["donors"] == {}
+    assert reg.closest({"nodes": 16.0}) is None
+
+
+def test_registry_picks_closest_donor_and_excludes_self():
+    reg = TransferRegistry()
+    small = _promoted_policy(1, [0.1] * 5)
+    big = _promoted_policy(2, [0.9] * 5)
+    reg.register("small", {"nodes": 16.0, "zones": 2.0,
+                           "lat_mean": 1.0, "bw_mean": 1.0}, small)
+    reg.register("big", {"nodes": 512.0, "zones": 8.0,
+                         "lat_mean": 4.0, "bw_mean": 10.0}, big)
+    near_small = {"nodes": 24.0, "zones": 2.0, "lat_mean": 1.1,
+                  "bw_mean": 0.9}
+    assert reg.closest(near_small).cluster_id == "small"
+    near_big = {"nodes": 480.0, "zones": 8.0, "lat_mean": 4.2,
+                "bw_mean": 9.0}
+    assert reg.closest(near_big).cluster_id == "big"
+    # Self-transfer is meaningless: the excluded tenant never wins.
+    assert reg.closest(near_small,
+                       exclude="small").cluster_id == "big"
+
+
+def test_warm_start_seeds_exact_donor_parameters():
+    """warm_start copies the donor's EMA parameters verbatim (fresh
+    optimizer, so the recipient's eval read returns them unchanged)
+    and leaves the recipient UNPROMOTED — the gate stays per-tenant."""
+    reg = TransferRegistry()
+    theta = [0.3, 1.2, -0.4, 0.05, 0.7]
+    donor = _promoted_policy(3, theta)
+    reg.register("donor", {"nodes": 16.0, "zones": 2.0,
+                           "lat_mean": 1.0, "bw_mean": 1.0}, donor)
+    cfg = SchedulerConfig(max_nodes=16, max_pods=4, max_peers=2)
+    recip = ScoringPolicy(cfg, seed=9)
+    rec = reg.warm_start(recip, {"nodes": 20.0, "zones": 2.0,
+                                 "lat_mean": 1.1, "bw_mean": 1.0})
+    assert rec is not None and rec.cluster_id == "donor"
+    np.testing.assert_allclose(recip.export_params()["theta"],
+                               np.asarray(theta, np.float32),
+                               rtol=0, atol=1e-6)
+    assert recip.promoted_version == 0
+    assert reg.transfers_total == 1
+
+
+def test_fleet_registers_donor_only_on_new_promotion():
+    """FleetServer.register_donor pushes a tenant's policy exactly
+    once per promotion (re-running maintain doesn't spam the
+    registry)."""
+    fleet = FleetServer()
+    cluster, lat, bw = _mk_cluster(seed=2)
+    cfg = SchedulerConfig(max_nodes=16, max_pods=4, max_peers=2,
+                          fleet_bucket_min=16,
+                          enable_learned_score=True)
+    t = fleet.add_tenant("a", cluster, cfg, n_nodes=12)
+    t.loop.encoder.set_network(lat, bw)
+    feed_metrics(cluster, t.loop.encoder, np.random.default_rng(3))
+    assert fleet.register_donor("a") is False  # never promoted
+    t.loop.policy._version = 1
+    t.loop.policy.note_promotion({"promote": True}, cfg.weights)
+    assert fleet.register_donor("a") is True
+    assert fleet.register_donor("a") is False  # same promotion
+    assert "a" in fleet.registry.summary()["donors"]
+    fleet.close()
+
+
+def test_new_tenant_warm_starts_from_fleet_registry():
+    """Onboarding a learned-score tenant seeds its policy from the
+    closest promoted donor and records the provenance on the
+    Tenant."""
+    reg = TransferRegistry()
+    theta = [0.2, 0.8, 0.1, 0.0, 0.4]
+    donor = _promoted_policy(5, theta)
+    reg.register("elder", {"nodes": 12.0, "zones": 2.0,
+                           "lat_mean": 1.0, "bw_mean": 1.0}, donor)
+    fleet = FleetServer(registry=reg)
+    cluster, lat, bw = _mk_cluster(seed=4)
+    cfg = SchedulerConfig(max_nodes=16, max_pods=4, max_peers=2,
+                          fleet_bucket_min=16,
+                          enable_learned_score=True)
+    t = fleet.add_tenant("young", cluster, cfg, n_nodes=12)
+    t.loop.encoder.set_network(lat, bw)
+    feed_metrics(cluster, t.loop.encoder, np.random.default_rng(5))
+    # Encoder had no nodes at add time; maintain retries the seed.
+    fleet.maintain()
+    assert t.transfer_donor is not None
+    assert t.transfer_donor["cluster_id"] == "elder"
+    np.testing.assert_allclose(
+        t.loop.policy.export_params()["theta"],
+        np.asarray(theta, np.float32), rtol=0, atol=1e-6)
+    assert t.loop.policy.promoted_version == 0  # still shadow-only
+    fleet.close()
+
+
+# -- observability ----------------------------------------------------
+
+
+def test_summary_shape():
+    fleet = FleetServer()
+    cluster, lat, bw = _mk_cluster(seed=6)
+    fleet.add_tenant("t0", cluster, CFG, n_nodes=12)
+    s = fleet.summary()
+    assert s["enabled"] is True
+    assert s["tenants"]["t0"]["bucket_nodes"] == 16
+    assert "16" in s["buckets"]
+    assert s["buckets"]["16"]["tenants"] == ["t0"]
+    assert s["transfer"]["donors"] == {}
+    fleet.close()
+
+
+def test_debug_fleet_route_and_metrics_render():
+    """/debug/fleet on a tenant's extender serves the fleet summary;
+    a solo loop answers {"enabled": false}; render_fleet_metrics
+    round-trips through the repo's own Prometheus parser."""
+    import json
+
+    from kubernetesnetawarescheduler_tpu.api.extender import (
+        ExtenderHandlers,
+    )
+    from kubernetesnetawarescheduler_tpu.ingest.prometheus import (
+        parse_prometheus_text,
+    )
+    from kubernetesnetawarescheduler_tpu.utils.selfmetrics import (
+        render_fleet_metrics,
+    )
+
+    fleet = FleetServer()
+    cluster, lat, bw = _mk_cluster(seed=9)
+    tenant = fleet.add_tenant("t-dbg", cluster, CFG, n_nodes=12)
+    doc = json.loads(ExtenderHandlers(tenant.loop)
+                     .handle("/debug/fleet", b""))
+    assert doc["enabled"] is True
+    assert doc["tenants"]["t-dbg"]["bucket_nodes"] == 16
+
+    parsed = parse_prometheus_text(render_fleet_metrics(fleet))
+    flat = {name: next(iter(series.values()))
+            for name, series in parsed.items() if len(series) == 1}
+    assert flat["netaware_fleet_cycles_total"] == 0
+    assert flat["netaware_fleet_registry_donors"] == 0
+    tenants = parsed["netaware_fleet_tenants"]
+    assert next(iter(tenants.values())) == 1
+    fleet.close()
+
+    solo_cluster, solo_lat, solo_bw = _mk_cluster(seed=10)
+    solo = _solo_loop(solo_cluster, solo_lat, solo_bw, seed=10)
+    doc = json.loads(ExtenderHandlers(solo)
+                     .handle("/debug/fleet", b""))
+    assert doc == {"enabled": False}

@@ -396,3 +396,38 @@ def test_cycle_spans_carry_outcome_observability():
                   if e.get("cat") == "cycle"]
     assert any("outcome_ring_depth" in a for a in cycle_args)
     assert trace_check.check_trace(trace) == []
+
+
+def test_pre_r15_spans_default_cluster_id_none():
+    """Spans constructed without the r15 tenancy field (solo loops,
+    pre-r15 crash dumps) default cluster_id to None and serialize it
+    honestly — old traces deserialize unchanged."""
+    span = CycleSpan(
+        cycle_id=1, path="serial", t_wall=0.0, t_mono=0.0,
+        dur_s=0.001, n_pods=2, pod_uids=("a", "b"), queue_depth=0,
+        phases=())
+    assert span.cluster_id is None
+    assert span.to_dict()["cluster_id"] is None
+
+
+def test_cycle_spans_carry_cluster_id_when_tenant_named():
+    """A loop serving as a fleet tenant stamps every cycle span with
+    its cluster_id; the chrome-trace args expose it and trace_check
+    lints the result clean. A solo loop keeps it null."""
+    cluster, loop = _make_loop(_cfg(), seed=3)
+    loop.cluster_id = "tenant-blue"
+    _drain(cluster, loop, num_pods=6, seed=3)
+    spans = [s for s in loop.flight.spans() if s.n_pods > 0]
+    assert spans
+    assert all(s.cluster_id == "tenant-blue" for s in spans)
+    trace = loop.flight.to_chrome_trace()
+    cycle_args = [e["args"] for e in trace["traceEvents"]
+                  if e.get("cat") == "cycle"]
+    assert any(a.get("cluster_id") == "tenant-blue"
+               for a in cycle_args)
+    assert trace_check.check_trace(trace) == []
+
+    solo_cluster, solo = _make_loop(_cfg(), seed=4)
+    _drain(solo_cluster, solo, num_pods=4, seed=4)
+    assert all(s.cluster_id is None
+               for s in solo.flight.spans() if s.n_pods > 0)

@@ -135,3 +135,30 @@ api.stop(); sys.exit(rc)
     # Loose bound: the restart must not be SLOWER, and in practice is
     # much faster; equality would mean the cache was never consulted.
     assert times[1] < times[0], times
+
+
+def test_startup_warns_learned_score_without_eval_trace(tmp_path,
+                                                        capsys):
+    """r15 satellite: enable_learned_score without an eval trace is
+    legal but pins the policy to shadow-only forever (the promotion
+    gate needs a trace to replay).  Startup must say so loudly and
+    name the flag; with the trace configured the WARN disappears."""
+    from kubernetesnetawarescheduler_tpu.config import SchedulerConfig
+
+    # Config-level contract (serve prints whatever this returns).
+    cfg = SchedulerConfig(enable_learned_score=True)
+    warns = cfg.startup_warnings(policy_eval_trace=None)
+    assert len(warns) == 1
+    assert "NEVER be promoted" in warns[0]
+    assert "--policy-eval-trace" in warns[0]
+    assert cfg.startup_warnings(
+        policy_eval_trace="/tmp/trace.jsonl.gz") == []
+    assert SchedulerConfig().startup_warnings() == []
+
+    # End to end: the serve banner carries the WARN line.
+    uds = str(tmp_path / "scorer.sock")
+    rc = serve.main(["--cluster", "fake:16", "--uds", uds,
+                     "--learned-score", "--once"])
+    assert rc == 0
+    err = capsys.readouterr().err
+    assert "WARN:" in err and "NEVER be promoted" in err

@@ -215,3 +215,31 @@ def test_pre_r13_traces_stay_lint_clean():
             ev["args"].pop("scenario_phase", None)
             ev["args"].pop("trace_offset", None)
     assert trace_check.check_trace(doc) == []
+
+
+def test_r15_cluster_id_validated_when_present():
+    # Null (solo loop) and string (tenant) both pass.
+    doc = _recorded_trace()
+    for value in (None, "tenant-07"):
+        ok = copy.deepcopy(doc)
+        cyc = next(e for e in ok["traceEvents"]
+                   if e.get("cat") == "cycle")
+        cyc["args"]["cluster_id"] = value
+        assert trace_check.check_trace(ok) == []
+    # A non-string tenant name fires.
+    bad = copy.deepcopy(doc)
+    cyc = next(e for e in bad["traceEvents"]
+               if e.get("cat") == "cycle")
+    cyc["args"]["cluster_id"] = 7
+    fails = trace_check.check_trace(bad)
+    assert any("cluster_id" in f for f in fails), fails
+
+
+def test_pre_r15_traces_stay_lint_clean():
+    # A dump from before the r15 tenancy field must keep linting
+    # clean with the key absent entirely.
+    doc = _recorded_trace()
+    for ev in doc["traceEvents"]:
+        if ev.get("cat") == "cycle":
+            ev["args"].pop("cluster_id", None)
+    assert trace_check.check_trace(doc) == []

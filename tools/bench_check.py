@@ -746,6 +746,61 @@ def check_doc(path: str, doc: dict) -> list[str]:
                         "promotion decision record — every live "
                         "weight swap must trace to a counterfactual-"
                         "replay win, not an unrecorded nudge")
+
+    # Rule 15 — fleet-consolidation provenance (round 15+): once many
+    # tenants' planes share one batched device state, a headline
+    # claiming the p99 bar must prove consolidation never leaked
+    # between tenants — a ``fleet`` block from the ``bench.py --suite
+    # fleet`` leg with ``isolation_bit_identical`` true (every
+    # tenant's placements bit-identical to solo serving) and a
+    # per-tenant SLO block published for each consolidated tenant.
+    # Round-gated by filename like Rules 8-14; the block's shape is
+    # validated wherever it appears (a malformed fleet block is fatal
+    # in any round's artifact).
+    if not grandfathered:
+        ns = detail.get("north_star")
+        p99_met = isinstance(ns, dict) and bool(ns.get("p99_met"))
+        flt = detail.get("fleet")
+        rnd = _round_of(name)
+        if flt is None:
+            if p99_met and rnd is not None and rnd >= 15:
+                fails.append(
+                    f"{name}: north_star.p99_met without a fleet "
+                    "block (round 15+ requires the --suite fleet "
+                    "leg's isolation + per-tenant SLO evidence "
+                    "behind any claimed p99)")
+        elif not isinstance(flt, dict):
+            fails.append(f"{name}: fleet is not an object")
+        else:
+            required = {"isolation_bit_identical", "tenants"}
+            missing = required - set(flt)
+            if missing:
+                fails.append(f"{name}: fleet missing "
+                             f"{sorted(missing)}")
+            else:
+                if flt.get("isolation_bit_identical") is not True:
+                    fails.append(
+                        f"{name}: fleet.isolation_bit_identical is "
+                        "not true — a tenant's placements diverged "
+                        "from solo serving; consolidation leaked "
+                        "between tenants and every number in this "
+                        "artifact is suspect")
+                tenants = flt.get("tenants")
+                if not isinstance(tenants, dict) or not tenants:
+                    fails.append(
+                        f"{name}: fleet.tenants missing or empty — "
+                        "the leg must publish each consolidated "
+                        "tenant's block, not just an aggregate")
+                else:
+                    for tname, blk in tenants.items():
+                        if not isinstance(blk, dict) or not isinstance(
+                                blk.get("slo"), dict):
+                            fails.append(
+                                f"{name}: fleet.tenants[{tname!r}] "
+                                "lacks an slo block — a consolidated "
+                                "tenant without its own SLO evidence "
+                                "is a noisy-neighbor claim nobody "
+                                "can audit")
     return fails
 
 

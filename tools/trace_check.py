@@ -32,6 +32,9 @@ Checks:
     non-negative integer ``trace_offset`` and a null-or-string
     ``scenario_phase``; validated only when present, so pre-r13
     dumps lint clean
+  * fleet tenancy — cycle spans carrying the r15 ``cluster_id`` arg
+    must have it null (solo loop) or a string (tenant name);
+    validated only when present, so pre-r15 dumps lint clean
 
 A cycle's phase set is NOT prescribed: the r9 fused single-dispatch
 step collapses score+assign+commit into one ``score_assign`` phase
@@ -135,6 +138,13 @@ def check_trace(doc: Any) -> list[str]:
                 if v is not None and not isinstance(v, str):
                     fails.append(f"event[{i}] ({ev.get('name')}) "
                                  f"args.scenario_phase invalid: {v!r}")
+            # r15 fleet tenant join key: null (solo loop, or pre-r15
+            # dump) or the logical cluster name.
+            if "cluster_id" in args:
+                v = args["cluster_id"]
+                if v is not None and not isinstance(v, str):
+                    fails.append(f"event[{i}] ({ev.get('name')}) "
+                                 f"args.cluster_id invalid: {v!r}")
         elif cat == "phase":
             phases.append((ts, ts + dur, i,
                            (key, args.get("cycle_id"))))
