@@ -496,9 +496,66 @@ def _measure_device_leg(num_nodes: int, batch: int,
         return None
 
 
+def _measure_multicycle_leg(num_nodes: int, batch: int,
+                            backend: str) -> dict | None:
+    """Device-BOUNDARY per-cycle latency of the persistent K-cycle
+    window (ISSUE 17): one replay dispatch over K device-resident
+    waves + ONE assignments fetch, wall / K — the cost a retire
+    actually pays, amortized by the window instead of paid per cycle
+    (r5's 87 ms gap).  K from BENCH_MULTICYCLE (default 8; <=1 skips
+    the leg).  None on failure; detail.multicycle then carries no
+    boundary block and Rule 16 withholds the p99 claim."""
+    try:
+        k = int(os.environ.get("BENCH_MULTICYCLE", "8"))
+        if k <= 1:
+            return None
+        from kubernetesnetawarescheduler_tpu.bench.density import (
+            measure_multicycle_latency,
+        )
+
+        reps = int(os.environ.get("BENCH_MULTICYCLE_REPS", "20"))
+        if reps <= 0:
+            return None
+        return measure_multicycle_latency(num_nodes, batch, k=k,
+                                          score_backend=backend,
+                                          reps=reps)
+    except Exception as exc:  # noqa: BLE001 — same survival contract
+        # as the device-latency leg
+        print(f"WARNING: multicycle-latency leg failed: "
+              f"{type(exc).__name__}: {exc}", file=sys.stderr)
+        return None
+
+
+def _multicycle_identity_leg() -> dict | None:
+    """Placement bit-identity A/B (ISSUE 17 acceptance): a seeded
+    drain at multicycle K + coalesced binds vs the SAME drain at K=1
+    with coalescing off (exactly the r15 per-cycle path).  Small
+    shape on purpose — identity is structural, not scale-dependent —
+    and CPU-cheap enough to ride every run."""
+    try:
+        k = int(os.environ.get("BENCH_MULTICYCLE", "8"))
+        if k <= 1:
+            return None
+        from kubernetesnetawarescheduler_tpu.bench.density import (
+            multicycle_identity_check,
+        )
+
+        return multicycle_identity_check(
+            num_nodes=128, batch_size=16, k=k,
+            coalesce=int(os.environ.get("BENCH_BIND_COALESCE", "4")),
+            inflight=int(os.environ.get("BENCH_BIND_INFLIGHT", "2")),
+            num_pods=192)
+    except Exception as exc:  # noqa: BLE001
+        print(f"WARNING: multicycle identity leg failed: "
+              f"{type(exc).__name__}: {exc}", file=sys.stderr)
+        return None
+
+
 def _assemble_doc(res, *, num_nodes: int, batch: int, method: str,
                   mode: str, executed_backend: str, score_backend: str,
-                  mesh_desc: str, device_lat: dict | None) -> dict:
+                  mesh_desc: str, device_lat: dict | None,
+                  multicycle_lat: dict | None = None,
+                  multicycle_ab: dict | None = None) -> dict:
     """The headline JSON doc for one fully-executed density leg.
 
     ``score_p50/p99_ms`` are the SCAN-AMORTIZED device percentiles of
@@ -581,6 +638,18 @@ def _assemble_doc(res, *, num_nodes: int, batch: int, method: str,
             "rtt_p99_ms": round(getattr(res, "bind_rtt_p99_ms", 0.0),
                                 3),
             "retry_count": int(getattr(res, "bind_retry_count", 0)),
+            # Coalesced async binds (r16, bench_check Rule 16): the
+            # inflight bound the drain ran under, its measured
+            # high-water mark, and how many queued batches were folded
+            # into an adjacent batch's fanout.
+            "max_inflight": int(
+                getattr(res, "bind_max_inflight", 1) or 1),
+            "coalesce_window": int(
+                getattr(res, "bind_coalesce_window", 1) or 1),
+            "coalesced_total": int(
+                getattr(res, "bind_coalesced_total", 0)),
+            "inflight_peak": int(
+                getattr(res, "bind_inflight_peak", 0)),
         }
     if getattr(res, "trace_provenance", None):
         # Decision-level trace provenance (r8, bench_check Rule 8):
@@ -673,6 +742,41 @@ def _assemble_doc(res, *, num_nodes: int, batch: int, method: str,
             "score_samples": res.score_samples,
             "score_p99_source": "host_observed",
         })
+    if multicycle_lat is not None or getattr(res, "multicycle_k",
+                                             0) > 1:
+        # Persistent multi-cycle provenance (r16, bench_check Rule
+        # 16): any r16+ artifact claiming the p99 bar must say which
+        # K it amortized over, how deep the device wave queue was,
+        # and how late waves retired — plus the boundary-vs-kernel
+        # ratio the window exists to close (ISSUE 17: boundary p99
+        # within 2x of the scan-amortized in-kernel p99).
+        mc: dict = {
+            "k": int(getattr(res, "multicycle_k", 0)
+                     or (multicycle_lat or {}).get("multicycle_k", 0)),
+            "device_queue_depth": int(
+                getattr(res, "multicycle_queue_depth", 0)),
+            "windows": int(getattr(res, "multicycle_windows", 0)),
+            "overflow": int(getattr(res, "multicycle_overflow", 0)),
+            "retire_lag_p99": float(
+                getattr(res, "retire_lag_p99", 0.0)),
+        }
+        if multicycle_lat is not None:
+            mc["device_boundary"] = multicycle_lat
+            if mc["k"] <= 1:
+                mc["k"] = int(multicycle_lat.get("multicycle_k", 0))
+            if not mc["device_queue_depth"]:
+                # Microbench stages the whole window device-resident
+                # — the ring depth it models equals K.
+                mc["device_queue_depth"] = int(
+                    multicycle_lat.get("multicycle_k", 0))
+            if device_lat is not None and device_lat.get("p99_ms"):
+                ratio = (multicycle_lat["p99_ms"]
+                         / device_lat["p99_ms"])
+                mc["boundary_over_scan_ratio"] = round(ratio, 2)
+                mc["within_2x_scan"] = ratio <= 2.0
+        if multicycle_ab is not None:
+            mc["identity_ab"] = multicycle_ab
+        detail["multicycle"] = mc
     return {
         "metric": f"density_pods_per_sec_n{num_nodes}",
         "value": round(res.pods_per_sec, 1),
@@ -1215,6 +1319,17 @@ def main() -> None:
                     chunk_batches=chunk_batches, score_backend=backend,
                     mesh=mesh, churn_links=churn_links,
                     trace_out=trace_out or None,
+                    # r16: the host-mode drain serves through the
+                    # persistent K-cycle window with coalesced async
+                    # binds (pipeline/device modes ignore these — the
+                    # monolithic replay is already one dispatch).
+                    multicycle=int(os.environ.get(
+                        "BENCH_MULTICYCLE", "8")) if mode == "host"
+                    else 1,
+                    bind_coalesce_window=int(os.environ.get(
+                        "BENCH_BIND_COALESCE", "4")),
+                    bind_max_inflight=int(os.environ.get(
+                        "BENCH_BIND_INFLIGHT", "2")),
                     # Host mode defaults to the three-stage pipelined
                     # datapath (encode-ahead ∥ device step ∥ async
                     # bind); BENCH_HOST_PIPELINED=0 reverts to the
@@ -1229,11 +1344,18 @@ def main() -> None:
             # The device-boundary microbench shares this process (and
             # so the single-owner chip) with the drain above.
             device_lat = _measure_device_leg(num_nodes, batch, backend)
+            # r16 legs: the K-window boundary microbench and the
+            # K=1-vs-K placement-identity A/B (both opt out via
+            # BENCH_MULTICYCLE<=1).
+            multicycle_lat = _measure_multicycle_leg(num_nodes, batch,
+                                                     backend)
+            multicycle_ab = _multicycle_identity_leg()
             results[backend] = _assemble_doc(
                 res, num_nodes=num_nodes, batch=batch, method=method,
                 mode=mode, executed_backend=executed_backend,
                 score_backend=backend, mesh_desc=mesh_desc,
-                device_lat=device_lat)
+                device_lat=device_lat, multicycle_lat=multicycle_lat,
+                multicycle_ab=multicycle_ab)
     if (not results and not force_cpu
             and "BENCH_CHILD" not in os.environ):
         # Top-level invocations only: a comparison-mode CHILD leg

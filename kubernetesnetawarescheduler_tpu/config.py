@@ -509,6 +509,28 @@ class SchedulerConfig:
     # node count.  Must be a power of two.
     fleet_bucket_min: int = 64
 
+    # ---- persistent multi-cycle serving + coalesced binds (r16) ----
+    # Logical cycles per device dispatch: the serving loop encodes a
+    # K-wave window once, stages the waves in a device ring, and runs
+    # ONE donated scan over all of them — per-dispatch overhead
+    # (Python dispatch, launch path, transport on a tunneled chip)
+    # amortizes to 1/K of a cycle.  1 = today's per-cycle path,
+    # bit-identical by construction.
+    multicycle: int = 1
+    # Device wave-ring capacity in waves (pre-encoded pod batches
+    # staged device-side awaiting the scan).  A window larger than the
+    # ring falls back to per-cycle dispatch for the overflow waves and
+    # counts it — never drops pods.
+    multicycle_queue_depth: int = 4
+    # Bind coalescing: how many queued bind batches one worker drain
+    # may merge into a single client fanout (1 = off — every batch
+    # binds alone, the pre-r16 behavior, bit-identical).
+    bind_coalesce_window: int = 1
+    # Bound on concurrent bind workers draining the async bind queue
+    # (1 = the single pre-r16 worker).  Inflight is capped, never
+    # unbounded: the breaker + retry budget still gate every fanout.
+    bind_max_inflight: int = 1
+
     def __post_init__(self) -> None:
         if self.max_nodes <= 0 or self.max_pods <= 0 or self.max_peers <= 0:
             raise ValueError("shape limits must be positive")
@@ -630,6 +652,14 @@ class SchedulerConfig:
         if (self.fleet_bucket_min < 1
                 or self.fleet_bucket_min & (self.fleet_bucket_min - 1)):
             raise ValueError("fleet_bucket_min must be a power of two")
+        if self.multicycle < 1:
+            raise ValueError("multicycle must be >= 1")
+        if self.multicycle_queue_depth < 1:
+            raise ValueError("multicycle_queue_depth must be >= 1")
+        if self.bind_coalesce_window < 1:
+            raise ValueError("bind_coalesce_window must be >= 1")
+        if self.bind_max_inflight < 1:
+            raise ValueError("bind_max_inflight must be >= 1")
 
     def startup_warnings(
             self, policy_eval_trace: str | None = None) -> list[str]:

@@ -812,3 +812,42 @@ def fused_schedule_step(state: ClusterState, pods: PodBatch,
         return new_state, assignment, rounds, plane_digest_vector(
             new_state)
     return new_state, assignment, rounds
+
+
+@partial(jax.jit, static_argnames=("cfg", "method"),
+         donate_argnums=(0,))
+def fused_schedule_window(state: ClusterState, pods_window,
+                          cfg: SchedulerConfig, static=None,
+                          method: str = "parallel"):
+    """K fused per-batch steps as ONE donated dispatch (ISSUE 17): a
+    ``lax.scan`` over a stacked ``[K, P, ...]`` window of
+    :class:`~.state.PodBatch` leaves, each step the exact
+    :func:`fused_schedule_step` body (score + device-resident conflict
+    resolution + commit) with the carry threading each step's
+    committed state into the next — the in-kernel reference the
+    multicycle serving path is test-pinned bit-identical against.
+    Returns ``(new_state, assignment i32[K, P], rounds i32[K])``.
+
+    ``pods_window`` must be a PodBatch whose every leaf carries a
+    leading window axis (``jax.tree_util.tree_map(stack, *batches)``);
+    peers are node indices, already resolved — cross-batch in-stream
+    peer resolution lives in core/replay.py's scan, not here.  Same
+    donation contract as :func:`fused_schedule_step`: the caller must
+    own ``state`` and not read it afterwards.
+    """
+    if method not in ("greedy", "parallel"):
+        raise ValueError(f"unknown method {method!r}")
+
+    def body(carry, batch):
+        if method == "greedy":
+            assignment = assign_greedy(carry, batch, cfg, static)
+            rounds = jnp.int32(1)
+        else:
+            assignment, rounds = assign_parallel(
+                carry, batch, cfg, static, with_stats=True)
+        return (commit_assignments(carry, batch, assignment),
+                (assignment, rounds))
+
+    new_state, (assignment, rounds) = jax.lax.scan(
+        body, state, pods_window)
+    return new_state, assignment, rounds

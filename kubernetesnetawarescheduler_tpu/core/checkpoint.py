@@ -269,7 +269,17 @@ def save_checkpoint(path: str, encoder: Encoder,
     tenant's checkpoint directory is self-identifying.  Keys must not
     collide with the reserved encoder/policy meta; collisions raise.
     The MANIFEST protocol (staging, previous/ rotation, digest
-    verification) is unchanged."""
+    verification) is unchanged.
+
+    Multi-cycle serving (r16) rides the same seam: serve.py stamps
+    ``{"multicycle": {"k", "waves_inflight", "last_retired_cycle"}}``
+    (SchedulerLoop.multicycle_meta()).  Usage commits only at wave
+    RETIRE, so the ledger here never contains a dispatched-but-
+    unretired wave — a mid-window crash restores to
+    ``last_retired_cycle`` by construction, and the unretired waves'
+    pods re-arrive Pending through the informer resync.  Optional
+    key, read via .get: no format bump, pre-r16 checkpoints load
+    unchanged."""
     os.makedirs(path, exist_ok=True)
     with encoder._lock:
         # Deep copies under the lock: serialization happens after the
@@ -589,6 +599,20 @@ def load_checkpoint(path: str,
     if settle_inflight:
         for key, entries in meta.get("migrations_inflight", {}).items():
             enc.rollback_gang_members(e[0] for e in entries)
+    # Multi-cycle provenance (r16, optional): the ledger already holds
+    # only RETIRED waves (commit-at-retire), so there is nothing to
+    # settle — but a checkpoint taken mid-window names its restore
+    # point, and saying so out loud makes the "lands on the last
+    # retired cycle" contract auditable in restore logs.
+    mc = meta.get("multicycle")
+    if isinstance(mc, dict) and mc.get("waves_inflight"):
+        import sys
+
+        print(f"checkpoint taken mid multicycle window "
+              f"(K={mc.get('k')}, {mc.get('waves_inflight')} waves "
+              f"unretired): restoring to last retired cycle "
+              f"{mc.get('last_retired_cycle')}; unretired waves' pods "
+              "re-arrive Pending via resync", file=sys.stderr)
     # Learned topology model: restore beside the encoder when the
     # config wants one and the checkpoint carries it.  A shape mismatch
     # (dims/rank/max_nodes changed) starts the model fresh rather than

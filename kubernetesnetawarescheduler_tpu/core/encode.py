@@ -2544,3 +2544,71 @@ class Encoder:
                 self._resolve_peer_slots(i, pod, prepared.stream_index,
                                          ar, node_of)
         return _stream_slice(ar, 0, len(prepared.pods))
+
+
+# ---------------------------------------------------------------------
+# Device wave ring (ISSUE 17): bounded device-side staging for the
+# persistent multi-cycle serving program.
+
+
+def split_stream_waves(stream, wave_pods: int) -> list:
+    """Slice an encoded (padded) PodStream into per-wave pytree
+    segments of ``wave_pods`` pods each.  Pure views — concatenating
+    the segments back in order reproduces the original arrays bit for
+    bit, which is what keeps the multicycle window's single dispatch
+    placement-identical to the per-cycle path."""
+    return [
+        jax.tree_util.tree_map(lambda x: x[a:a + wave_pods], stream)
+        for a in range(0, stream.num_pods, wave_pods)
+    ]
+
+
+def concat_stream_waves(waves: list):
+    """Re-join per-wave PodStream segments along the pod axis (the
+    inverse of :func:`split_stream_waves`).  Runs as device ops on
+    already-staged waves, so the serving loop's window dispatch
+    consumes device-resident inputs — no bulk host re-upload at
+    dispatch time (the r5/r6 device-boundary lesson)."""
+    if len(waves) == 1:
+        return waves[0]
+    return jax.tree_util.tree_map(
+        lambda *xs: jnp.concatenate(xs, axis=0), *waves)
+
+
+class DeviceWaveRing:
+    """Bounded ring of pre-encoded pod waves staged on device.
+
+    The host enqueues each wave (one batch's slice of the encoded
+    window) with :meth:`push` — a ``jax.device_put`` per segment, the
+    only host→device traffic the multicycle path pays per wave — and
+    the serving loop drains the whole ring into one scan window with
+    :meth:`pop_window`.  ``push`` returns False (and counts
+    ``overflow_total``) when the ring is full: the caller falls back
+    to per-cycle dispatch for the overflow waves instead of dropping
+    or blocking, so a mis-tuned ``multicycle_queue_depth`` degrades
+    throughput, never correctness."""
+
+    def __init__(self, capacity: int) -> None:
+        self.capacity = max(1, int(capacity))
+        self._waves: list = []
+        self.pushed_total = 0
+        self.overflow_total = 0
+
+    def __len__(self) -> int:
+        return len(self._waves)
+
+    def push(self, wave) -> bool:
+        if len(self._waves) >= self.capacity:
+            self.overflow_total += 1
+            return False
+        self._waves.append(jax.device_put(wave))
+        self.pushed_total += 1
+        return True
+
+    def pop_window(self):
+        """Drain every staged wave as one concatenated stream (None
+        when the ring is empty)."""
+        waves, self._waves = self._waves, []
+        if not waves:
+            return None
+        return concat_stream_waves(waves)

@@ -71,7 +71,8 @@ class SchedulerLoop:
                  encoder: Encoder | None = None, mesh=None,
                  async_bind: bool = False,
                  burst_batches: int = 8,
-                 pipelined: bool = False) -> None:
+                 pipelined: bool = False,
+                 multicycle: int | None = None) -> None:
         self.cfg = cfg
         self.client = client
         self.method = method
@@ -100,6 +101,21 @@ class SchedulerLoop:
         # exactly as sequential cycles would (pinned by
         # tests/test_replay.py and test_burst.py).  0 or 1 disables.
         self.burst_batches = burst_batches
+        # Persistent multi-cycle serving program (ISSUE 17): under a
+        # deep backlog, encode a K-wave window ONCE, stage the waves
+        # through a device ring (core/encode.DeviceWaveRing) and run
+        # ONE donated scan over all K logical cycles — per-dispatch
+        # overhead amortizes to 1/K of a cycle, the same move the
+        # scan-amortized bench methodology proves out.  Waves are
+        # RETIRED asynchronously (fetch + assume + bind enqueue), one
+        # logical cycle per retire; usage commits ONLY at retire, so a
+        # mid-window crash restores to the last retired cycle with no
+        # half-committed wave.  K=1 (the default) is today's path,
+        # bit-identical by construction; K>1 is test-pinned placement-
+        # bit-identical to K sequential fused steps
+        # (tests/test_multicycle.py).
+        self.multicycle = int(multicycle if multicycle is not None
+                              else getattr(cfg, "multicycle", 1))
         # Assume-then-bind (kube-scheduler's own cache pattern): the
         # cycle commits usage to the encoder IMMEDIATELY after the
         # kernel decides ("assume") and hands the network bind to a
@@ -404,20 +420,57 @@ class SchedulerLoop:
         # at retire alongside the usage commit (crash-safety parity:
         # a span only exists for cycles whose placements landed).
         self._pipe_span: tuple | None = None
+        # Multicycle retire queue: one record per LOGICAL cycle of the
+        # in-flight window, sharing a single device output (fetched
+        # once, at the first retire).  Owned by the cycle thread, like
+        # _pipe_inflight; drained by _retire_multicycle before any
+        # state read that must see its placements.
+        self._mc_inflight: "deque" = deque()
+        # Device wave ring (core/encode.DeviceWaveRing), built lazily
+        # at first multicycle window so K=1 loops never touch it.
+        self._wave_ring = None
+        self.multicycle_windows = 0        # windows dispatched
+        self.multicycle_overflow_total = 0  # waves past ring capacity
+        # Last RETIRED logical cycle id: the restore point a mid-window
+        # crash lands on (checkpoint meta provenance; -1 = none yet).
+        self.multicycle_last_retired = -1
+        # Retire lag in logical cycles (wave j of a window retires j
+        # cycles after the window head) — small ints, so doubling
+        # buckets from 1 keep them exact (round_samples pattern).
+        self._retire_lag = LogHistogram(
+            lo=1.0, hi=1024.0, growth=2.0, window=2048)
+        # Coalesced async binds (ISSUE 17): items folded into an
+        # earlier batch's fanout, and how many workers are inside a
+        # bind fanout right now (gauge + high-water mark; bounded by
+        # cfg.bind_max_inflight).
+        self.bind_coalesced_total = 0
+        self.bind_inflight = 0
+        self.bind_inflight_peak = 0
+        self._bind_inflight_lock = threading.Lock()
         self._encode_pool = None
         if self.pipelined:
             import concurrent.futures
 
             self._encode_pool = concurrent.futures.ThreadPoolExecutor(
                 max_workers=1, thread_name_prefix="encode-ahead")
+        self._bind_workers: list[threading.Thread] = []
         if async_bind:
             # Bounded: a dead/slow API server must apply backpressure
             # to the cycle, not buffer unbounded assumed state.
             self._bind_q = queue_mod.Queue(maxsize=8)
-            self._bind_worker = threading.Thread(
-                target=self._bind_worker_main, daemon=True,
-                name="bind-worker")
-            self._bind_worker.start()
+            # Bounded-inflight worker pool (cfg.bind_max_inflight,
+            # default 1 = the pre-r16 single worker).  Each worker may
+            # additionally coalesce up to cfg.bind_coalesce_window
+            # queued batches into one fanout — see _bind_worker_main.
+            n_workers = max(1, int(getattr(cfg, "bind_max_inflight",
+                                           1)))
+            for wi in range(n_workers):
+                w = threading.Thread(
+                    target=self._bind_worker_main, daemon=True,
+                    name=f"bind-worker-{wi}")
+                self._bind_workers.append(w)
+                w.start()
+            self._bind_worker = self._bind_workers[0]
 
         # Gang scheduling (core/gang.py): annotated pods are diverted
         # into the registry's gate by run_once and scheduled as whole
@@ -627,7 +680,9 @@ class SchedulerLoop:
                      static_version: int | None = None,
                      rounds: int = 0,
                      donated: int = 0,
-                     donation_skipped: int = 1) -> None:
+                     donation_skipped: int = 1,
+                     scan_window_k: int | None = None,
+                     retire_lag_cycles: int | None = None) -> None:
         """Freeze and commit a cycle span.  Called where the cycle's
         effects commit: end of the serial/burst/gang cycle, or at
         RETIRE for the pipelined path — so a crash never leaves a span
@@ -738,6 +793,8 @@ class SchedulerLoop:
             policy_shadow_disagreements=pol_disagree,
             policy_version=pol_version,
             cluster_id=self.cluster_id,
+            scan_window_k=scan_window_k,
+            retire_lag_cycles=retire_lag_cycles,
         )
         self.flight.commit(span)
 
@@ -881,6 +938,25 @@ class SchedulerLoop:
         if self._parked_binds:
             self._drain_parked_binds()
         batch = self.cfg.max_pods
+        # Persistent multi-cycle window (r16): with K>1 and a deep
+        # backlog, pop up to K batches and serve them as ONE scanned
+        # device program — run_once still counts/retires per logical
+        # cycle.  Plain path only: the mesh burst fn compiles for the
+        # burst shape, and gang groups retire the window first (same
+        # snapshot ordering as the pipelined path).
+        if (self.multicycle > 1 and self._sharded_burst is None
+                and len(self.queue) >= 2 * batch):
+            pods = self.queue.pop_batch(self.multicycle * batch,
+                                        timeout)
+            pods, ready = self._gang_gate(pods)
+            bound = 0
+            if pods:
+                bound = self.schedule_pods_multicycle(pods)
+            if ready:
+                bound += self._retire_multicycle()
+            for key, members in ready:
+                bound += self._schedule_gang(key, members)
+            return bound
         if (self.burst_batches > 1
                 and len(self.queue) >= 2 * batch):
             pods = self.queue.pop_batch(self.burst_batches * batch,
@@ -904,10 +980,11 @@ class SchedulerLoop:
             for key, members in ready:
                 bound += self._schedule_gang(key, members)
             return bound
-        # Shallow queue: a pipelined burst still in flight is retired
-        # first — its placements must land before (or instead of) any
-        # per-batch cycle.
-        bound = self._retire_inflight()
+        # Shallow queue: a pipelined burst or multicycle window still
+        # in flight is retired first — its placements must land before
+        # (or instead of) any per-batch cycle.
+        bound = self._retire_multicycle() if self._mc_inflight else 0
+        bound += self._retire_inflight()
         pods = self.queue.pop_batch(batch, timeout)
         pods, ready = self._gang_gate(pods)
         if not pods and not ready:
@@ -1165,6 +1242,180 @@ class SchedulerLoop:
                                      "pipelined")
         self._span_commit(sb, pods, static_version=span_version,
                           rounds=cycle_rounds)
+        return bound
+
+    def schedule_pods_multicycle(self, pods: Sequence[Pod]) -> int:
+        """Serve up to ``multicycle`` batches as ONE persistent device
+        program: encode the whole K-wave window once (global in-stream
+        peer index space — waves must NOT be encoded separately, or
+        cross-wave peers would miss earlier waves' placements), stage
+        the waves through the device ring, and run one donated scan
+        over all of them.  Waves retire asynchronously through
+        :meth:`_retire_multicycle`; usage commits only at retire.
+
+        Returns pods bound/assumed from the PREVIOUS window's retire
+        plus any ring-overflow fallback; this window's own waves are
+        counted by the cycles that retire them (the next window, the
+        shallow-queue path, or flush_binds).  Placements are
+        bit-identical to K sequential fused per-batch steps: the
+        replay scan threads commits across waves exactly as
+        sequential cycles would (tests/test_multicycle.py)."""
+        from kubernetesnetawarescheduler_tpu.core.encode import (
+            DeviceWaveRing,
+            split_stream_waves,
+        )
+        from kubernetesnetawarescheduler_tpu.core.replay import (
+            pad_stream,
+            replay_stream_static,
+        )
+
+        # Previous window first: its placements must be published
+        # before this window's encode resolves peers (the sequential
+        # snapshot-ordering contract, same as the pipelined path).
+        bound = self._retire_multicycle()
+        k = self.multicycle
+        cap = self.cfg.max_pods
+        t_enc = time.perf_counter()
+        stream = self.encoder.encode_stream(
+            pods, node_of=self._peer_node, lenient=True)
+        # Fixed K*cap window shape: one XLA compile per K (the burst
+        # path's padding rationale — variable depths each pay a fresh
+        # compile; masked pad waves cost ~nothing on device).
+        stream = pad_stream(stream, k * cap)
+        state, version = self.encoder.snapshot_versioned()
+        node_table = self.encoder.node_table()
+        encode_s = time.perf_counter() - t_enc
+        self._emit_degraded_events()
+
+        depth = int(getattr(self.cfg, "multicycle_queue_depth", k))
+        ring = self._wave_ring
+        if ring is None or ring.capacity != depth:
+            ring = self._wave_ring = DeviceWaveRing(depth)
+        waves = split_stream_waves(stream, cap)
+        staged = 0
+        for wave in waves:
+            if not ring.push(wave):
+                break
+            staged += 1
+        if staged < len(waves):
+            self.multicycle_overflow_total += len(waves) - staged
+        window = ring.pop_window()
+        real_in_window = min(len(pods), staged * cap)
+        n_live = max(1, -(-real_in_window // cap))
+        # One span builder PER logical cycle, opened at dispatch and
+        # committed at the retire seam (spans stay one-per-logical-
+        # cycle; phase costs are amortized shares of the window's).
+        sbs = [self._span_begin("multicycle") for _ in range(n_live)]
+        for sb in sbs:
+            sb.add_phase("encode", t_enc, encode_s / n_live)
+        self.timer.record("encode", encode_s / n_live, count=n_live)
+        t0 = time.perf_counter()
+        with_stats = self.method == "parallel"
+        static = self._static_for(state, version)
+        with self._profile_step(sbs[0].cycle_id):
+            out = replay_stream_static(state, window, static,
+                                       self.cfg, self.method,
+                                       with_stats=with_stats)
+        self._note_dispatch()
+        dispatch_s = time.perf_counter() - t0
+        for sb in sbs:
+            sb.add_phase("dispatch", t0, dispatch_s / n_live)
+        shared = {"out": out, "with_stats": with_stats,
+                  "fetched": None, "rounds": None, "n_live": n_live,
+                  "state": state, "static": static,
+                  "t_dispatch": time.perf_counter()}
+        for j in range(n_live):
+            a = j * cap
+            self._mc_inflight.append(
+                (sbs[j], list(pods[a:min(a + cap, real_in_window)]),
+                 j, staged, shared, node_table, version))
+        self.multicycle_windows += 1
+        if len(pods) > staged * cap:
+            # Ring overflow: waves past the device-queue depth fall
+            # back to the per-cycle/burst dispatch path AFTER the
+            # window retires, so their re-encode sees the window's
+            # published placements — a mis-tuned depth degrades
+            # amortization, never placements (counter above is the
+            # observability seam).
+            bound += self._retire_multicycle()
+            leftover = list(pods[staged * cap:])
+            if len(leftover) > cap and self.burst_batches > 1:
+                bound += self.schedule_pods_burst(leftover)
+            else:
+                for a in range(0, len(leftover), cap):
+                    bound += self.schedule_pods(leftover[a:a + cap])
+        return bound
+
+    def _retire_multicycle(self, max_waves: int | None = None) -> int:
+        """Retire pending multicycle waves: fetch the window's device
+        output ONCE (at the first retire), then per wave run the
+        assume/bind tail and commit its span.  Usage lands HERE —
+        never at dispatch — so a crash mid-window restores to the
+        last retired cycle with no half-committed wave (checkpoint
+        contract, tests/test_multicycle.py).  ``max_waves`` bounds how
+        many waves retire this call (the mid-window checkpoint seam);
+        default drains all.  Returns pods bound/assumed."""
+        bound = 0
+        retired = 0
+        cap = self.cfg.max_pods
+        shared = None
+        while self._mc_inflight:
+            if max_waves is not None and retired >= max_waves:
+                break
+            (sb, wave_pods, j, k_eff, shared, node_table,
+             version) = self._mc_inflight.popleft()
+            t0 = time.perf_counter()
+            if shared["fetched"] is None:
+                if shared["with_stats"]:
+                    a_dev, _final, r_dev = shared["out"]
+                    shared["fetched"] = np.asarray(jax_block(a_dev))
+                    shared["rounds"] = np.asarray(r_dev)
+                    with self._round_lock:
+                        self.round_samples.extend(
+                            int(r) for r in
+                            shared["rounds"][:shared["n_live"]])
+                else:
+                    a_dev, _final = shared["out"]
+                    shared["fetched"] = np.asarray(jax_block(a_dev))
+                shared["out"] = None
+                # The exposed device wait, amortized over the
+                # window's logical cycles: the device-boundary score
+                # latency the bench compares to the in-kernel number.
+                self.timer.record(
+                    "score_assign",
+                    (time.perf_counter() - t0) / shared["n_live"],
+                    count=shared["n_live"])
+            sb.add_phase("score_assign", t0,
+                         time.perf_counter() - t0)
+            assignment = shared["fetched"][
+                j * cap:j * cap + len(wave_pods)]
+            rounds_j = 0
+            if (shared["rounds"] is not None
+                    and j < len(shared["rounds"])):
+                rounds_j = int(shared["rounds"][j])
+            t0 = time.perf_counter()
+            if self.async_bind:
+                bound += self._assume_and_enqueue(
+                    wave_pods, assignment, node_table)
+            else:
+                bound += self._bind_all(wave_pods, assignment,
+                                        node_table)
+            sb.add_phase("bind", t0, time.perf_counter() - t0)
+            self.timer.record("bind", time.perf_counter() - t0)
+            self._retire_lag.append(float(j))
+            self._capture_explains_burst(
+                wave_pods, assignment, shared["state"],
+                shared["static"], node_table, sb.cycle_id,
+                "multicycle")
+            self._span_commit(sb, wave_pods, static_version=version,
+                              rounds=rounds_j, scan_window_k=k_eff,
+                              retire_lag_cycles=j)
+            self.multicycle_last_retired = sb.cycle_id
+            retired += 1
+        if retired and not self._mc_inflight and shared is not None:
+            self.timer.record(
+                "burst_wall",
+                time.perf_counter() - shared["t_dispatch"])
         return bound
 
     def _cycle_inputs(self, sb, pods: Sequence[Pod]):
@@ -2191,24 +2442,88 @@ class SchedulerLoop:
                              table_gens, events, comp, assumed))
         return len(fresh)
 
+    def _merge_bind_items(self, items: list[tuple]) -> tuple:
+        """Coalesce several queued bind batches into ONE fanout item.
+        Safe only in assume mode: every real queue item carries its
+        ``assumed`` uid set (never None — only the shutdown sentinel
+        is), and ``_finish_bind`` ignores ``table_gens`` entirely when
+        ``assumed`` is a set, so concatenating the keep lists, merging
+        the events, and unioning the assumed sets loses nothing.
+        Merged bindings are re-grouped by (node, namespace) so
+        adjacent binds to one node land together in the client
+        fanout — the per-node/namespace batching window."""
+        keep_p: list = []
+        keep_i: list = []
+        keep_n: list = []
+        events: list = []
+        assumed: set = set()
+        for it in items:
+            keep_p.extend(it[0])
+            keep_i.extend(it[1])
+            keep_n.extend(it[2])
+            events.extend(it[4])
+            assumed |= it[6]
+        order = sorted(range(len(keep_p)),
+                       key=lambda x: (keep_n[x],
+                                      keep_p[x].namespace))
+        self.bind_coalesced_total += len(items) - 1
+        return ([keep_p[x] for x in order],
+                [keep_i[x] for x in order],
+                [keep_n[x] for x in order],
+                items[0][3], events, items[0][5], assumed)
+
     def _bind_worker_main(self) -> None:
+        import queue as queue_mod
+
+        window = max(1, int(getattr(self.cfg, "bind_coalesce_window",
+                                    1)))
         while True:
             item = self._bind_q.get()
             if item is None:
                 self._bind_q.task_done()
                 return
+            # Coalesce: drain up to window-1 already-queued batches
+            # into this fanout (window=1 = off, the pre-r16 shape).
+            items = [item]
+            while len(items) < window:
+                try:
+                    extra = self._bind_q.get_nowait()
+                except queue_mod.Empty:
+                    break
+                if extra is None:
+                    # A shutdown sentinel belongs to a BLOCKING get
+                    # (each worker consumes exactly one) — recycle it
+                    # to the back of the queue, accounting-exact.
+                    self._bind_q.task_done()
+                    self._bind_q.put(None)
+                    break
+                items.append(extra)
             try:
+                merged = (items[0] if len(items) == 1
+                          else self._merge_bind_items(items))
                 keep_p, keep_i, keep_n, gens, events, comp, assumed = \
-                    item
-                with self.timer.phase("bind_net"):
-                    self._finish_bind(keep_p, keep_i, keep_n, gens,
-                                      events, comp, assumed)
+                    merged
+                with self._bind_inflight_lock:
+                    self.bind_inflight += 1
+                    self.bind_inflight_peak = max(
+                        self.bind_inflight_peak, self.bind_inflight)
+                try:
+                    with self.timer.phase("bind_net"):
+                        self._finish_bind(keep_p, keep_i, keep_n,
+                                          gens, events, comp, assumed)
+                finally:
+                    with self._bind_inflight_lock:
+                        self.bind_inflight -= 1
             except BaseException as exc:  # noqa: BLE001 — surfaced on
                 # the next cycle / flush; a dead worker must fail the
                 # serving loop loudly, not strand assumed pods.
                 self._bind_worker_err.append(exc)
             finally:
-                self._bind_q.task_done()
+                # One task_done PER QUEUE ITEM — flush_binds polls
+                # unfinished_tasks, which must reach zero exactly when
+                # every enqueued batch (coalesced or not) completed.
+                for _ in items:
+                    self._bind_q.task_done()
 
     def flush_binds(self, timeout: float | None = None) -> None:
         """Block until every queued bind batch has been processed
@@ -2216,10 +2531,12 @@ class SchedulerLoop:
         first worker error if one occurred.  Call before reading
         bind-dependent state (checkpoints, tests, shutdown).
 
-        Pipelined mode: retires any in-flight burst first — its
-        assumes must land before the queue can be considered
-        drained.  (Same cycle-thread ownership contract as
-        run_once.)"""
+        Pipelined/multicycle mode: retires any in-flight burst or
+        multicycle window first — their assumes must land before the
+        queue can be considered drained.  (Same cycle-thread
+        ownership contract as run_once.)"""
+        if self._mc_inflight:
+            self._retire_multicycle()
         if self._pipe_inflight is not None:
             self._retire_inflight()
         if self._bind_q is None:
@@ -2240,6 +2557,19 @@ class SchedulerLoop:
         if self._bind_worker_err:
             raise self._bind_worker_err[0]
 
+    def multicycle_meta(self) -> dict:
+        """Checkpoint provenance for the multi-cycle window (r16):
+        stamped into checkpoint meta via ``extra_meta`` so a restore
+        can name the cycle it lands on.  Usage commits only at retire,
+        so ``waves_inflight`` waves are NOT in the ledger — a restore
+        resumes from ``last_retired_cycle`` and the unretired waves'
+        pods re-arrive Pending through the informer resync."""
+        return {
+            "k": int(self.multicycle),
+            "waves_inflight": len(self._mc_inflight),
+            "last_retired_cycle": int(self.multicycle_last_retired),
+        }
+
     def stop_bind_worker(self, timeout: float | None = 30.0) -> None:
         """Drain outstanding binds and stop the worker (shutdown
         path; the loop cannot schedule in async mode afterwards)."""
@@ -2250,8 +2580,12 @@ class SchedulerLoop:
         if self._bind_q is None:
             return
         self.flush_binds(timeout)
-        self._bind_q.put(None)
-        self._bind_worker.join(timeout)
+        # One sentinel per worker: each consumes exactly one from its
+        # blocking get (a sentinel seen mid-coalesce is recycled).
+        for _ in self._bind_workers:
+            self._bind_q.put(None)
+        for w in self._bind_workers:
+            w.join(timeout)
 
     def run_until_drained(self, max_cycles: int = 10_000) -> int:
         """Drain the queue; returns total pods bound (assume-then-bind

@@ -1012,6 +1012,13 @@ class KubeClient(ClusterClient):
         self._sleep = time.sleep  # injectable for tests
         self._gap_handlers: list[Callable[[str], None]] = []
         self.watch_gaps = 0
+        # Bind POST concurrency, measured at the wire (r16): the
+        # loop's bind_max_inflight bounds worker threads, the pool
+        # bounds connections — this gauge proves the bound held where
+        # the POSTs actually leave (bench bind_split.max_inflight).
+        self.bind_posts_inflight = 0
+        self.bind_posts_peak = 0
+        self._bind_gauge_lock = threading.Lock()
 
     def configure_resilience(self, failure_threshold: int = 5,
                              window_s: float = 30.0,
@@ -1234,6 +1241,10 @@ class KubeClient(ClusterClient):
         self._record_bound(binding)
 
     def _bind_one(self, binding: Binding) -> Exception | None:
+        with self._bind_gauge_lock:
+            self.bind_posts_inflight += 1
+            if self.bind_posts_inflight > self.bind_posts_peak:
+                self.bind_posts_peak = self.bind_posts_inflight
         try:
             self._request(
                 "POST",
@@ -1245,6 +1256,9 @@ class KubeClient(ClusterClient):
         except Exception as exc:  # noqa: BLE001 — per-pod outcome
             self._record_write_outcome(exc)
             return exc
+        finally:
+            with self._bind_gauge_lock:
+                self.bind_posts_inflight -= 1
 
     def bind_many(self, bindings: Sequence[Binding]
                   ) -> list[Exception | None]:

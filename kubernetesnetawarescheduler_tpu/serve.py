@@ -179,6 +179,24 @@ def main(argv=None) -> int:
                          "binds of burst k-1 (implies --async-bind); "
                          "assignments are identical to the serial "
                          "cycle on the same feed")
+    ap.add_argument("--multicycle", type=int, default=None,
+                    help="persistent multi-cycle serving: dispatch K "
+                         "scheduling cycles as ONE donated device scan "
+                         "over a device-resident wave queue, retiring "
+                         "per-cycle winners asynchronously (commits "
+                         "only at retire, so a mid-window crash "
+                         "restores to the last retired cycle); 1 = "
+                         "per-cycle dispatch (default). Placements "
+                         "are bit-identical to K sequential fused "
+                         "steps on the same feed")
+    ap.add_argument("--bind-coalesce-window", type=int, default=None,
+                    help="coalesce up to this many queued async bind "
+                         "batches into one API pass (sorted by "
+                         "node/namespace); 1 disables coalescing")
+    ap.add_argument("--bind-max-inflight", type=int, default=None,
+                    help="bound on concurrent async bind batches "
+                         "in flight (worker threads); 1 = serial "
+                         "binder (default)")
     ap.add_argument("--quality-obs", action="store_true",
                     help="outcome observability (obs/quality.py): "
                          "join each bound pod's score-time network "
@@ -322,6 +340,24 @@ def main(argv=None) -> int:
         import dataclasses
 
         cfg = dataclasses.replace(cfg, enable_learned_score=True)
+    # r16 multi-cycle serving + coalesced-bind knobs: CLI overrides
+    # win over --config (None = keep the config's value).  Validation
+    # lives in SchedulerConfig.__post_init__ — replace() re-runs it.
+    _mc_over = {k: v for k, v in (
+        ("multicycle", args.multicycle),
+        ("bind_coalesce_window", args.bind_coalesce_window),
+        ("bind_max_inflight", args.bind_max_inflight),
+    ) if v is not None}
+    if _mc_over:
+        import dataclasses
+
+        cfg = dataclasses.replace(cfg, **_mc_over)
+    if cfg.multicycle > 1:
+        print(f"multi-cycle serving enabled: K={cfg.multicycle}, "
+              f"device queue depth {cfg.multicycle_queue_depth}, "
+              f"bind coalesce window {cfg.bind_coalesce_window}, "
+              f"bind max inflight {cfg.bind_max_inflight}",
+              file=sys.stderr)
     if cfg.enable_learned_score:
         print(f"learned scoring policy enabled (shadow-first): ring "
               f"{cfg.policy_ring}, train every "
@@ -808,8 +844,10 @@ def main(argv=None) -> int:
             from kubernetesnetawarescheduler_tpu.core.checkpoint import (
                 save_checkpoint,
             )
+            extra = ({"multicycle": loop.multicycle_meta()}
+                     if loop.multicycle > 1 else None)
             save_checkpoint(args.checkpoint_dir, loop.encoder,
-                            policy=loop.policy)
+                            policy=loop.policy, extra_meta=extra)
             print(f"checkpoint saved to {args.checkpoint_dir}",
                   file=sys.stderr)
         if loop.decision_log is not None:

@@ -120,6 +120,15 @@ class CycleSpan:
     # traces and crash dumps deserialize unchanged and trace_check
     # validates it only-when-present.
     cluster_id: str | None = None
+    # Persistent multi-cycle serving (ISSUE 17): the scan-window size
+    # K this logical cycle was dispatched under, and how many cycles
+    # after dispatch its retire landed (0 = first wave of its window).
+    # None on pre-r16 paths (per-cycle dispatch) — spans are still
+    # emitted one-per-logical-cycle from the retire seam, and
+    # trace_check validates these only-when-present so old traces
+    # lint clean.
+    scan_window_k: int | None = None
+    retire_lag_cycles: int | None = None
 
     def to_dict(self) -> dict[str, Any]:
         return {
@@ -152,6 +161,8 @@ class CycleSpan:
                 self.policy_shadow_disagreements,
             "policy_version": self.policy_version,
             "cluster_id": self.cluster_id,
+            "scan_window_k": self.scan_window_k,
+            "retire_lag_cycles": self.retire_lag_cycles,
         }
 
 

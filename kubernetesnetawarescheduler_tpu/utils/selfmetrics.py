@@ -177,6 +177,17 @@ def render_metrics(loop) -> str:
     gauge("netaware_parked_binds_backlog",
           float(len(getattr(loop, "_parked_binds", ()))),
           "Bind batches currently parked awaiting breaker recovery")
+    # Coalesced async binds + multi-cycle serving (r16): the bounded-
+    # inflight proof and the coalescing win, scrapeable live (the
+    # bench artifact's bind_split block is the offline counterpart).
+    gauge("netaware_bind_inflight",
+          float(getattr(loop, "bind_inflight", 0)),
+          "Async bind batches inside their API fanout right now "
+          "(bounded by cfg.bind_max_inflight)")
+    counter("netaware_bind_coalesced_total",
+            float(getattr(loop, "bind_coalesced_total", 0)),
+            "Queued bind batches folded into an adjacent batch's "
+            "fanout (sorted by node/namespace before POSTing)")
 
     # Learned topology model (netmodel/): direct-probe pair coverage,
     # prediction-residual quantiles, planner selection entropy and the
@@ -483,7 +494,10 @@ def render_metrics(loop) -> str:
              "(log-bucketed native histogram)"),
             ("round_samples", "netaware_conflict_rounds_hist",
              "Conflict-resolution rounds per scheduled batch "
-             "(log-bucketed native histogram)")):
+             "(log-bucketed native histogram)"),
+            ("_retire_lag", "netaware_multicycle_retire_lag",
+             "Logical cycles between a multicycle wave's dispatch "
+             "and its retire (log-bucketed native histogram)")):
         h = getattr(loop, attr, None)
         snap_fn = getattr(h, "snapshot", None)
         if snap_fn is not None:

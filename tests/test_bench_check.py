@@ -832,3 +832,126 @@ def test_fleet_shape_validated_when_present():
     quiet["detail"]["north_star"]["p99_met"] = False
     fails = bench_check.check_doc("BENCH_r15.json", quiet)
     assert any("isolation_bit_identical" in f for f in fails), fails
+
+
+def _multicycle(**overrides):
+    """A healthy r16 multicycle block (bench.py detail.multicycle
+    shape, Rule-16 envelope only)."""
+    block = {
+        "k": 8,
+        "device_queue_depth": 8,
+        "windows": 12,
+        "overflow": 0,
+        "retire_lag_p99": 7.0,
+        "identity_ab": {"identical": True,
+                        "baseline": "k1_coalescing_off_r15_path"},
+    }
+    block.update(overrides)
+    return block
+
+
+def _bind_split(**overrides):
+    """An r16 bind_split block with the bounded-inflight evidence."""
+    block = {
+        "bind_p99_ms": 41.0,
+        "max_inflight": 2,
+        "inflight_peak": 2,
+        "coalesce_window": 4,
+        "coalesced_total": 37,
+    }
+    block.update(overrides)
+    return block
+
+
+def _r16_doc(**detail_overrides):
+    detail = {"trace_provenance": _trace_prov(),
+              "winner_fusion": _winner_fusion(),
+              "rounds_max": 4,
+              "integrity": _integrity(),
+              "quality": _quality(),
+              "rebalance": _rebalance(),
+              "scenario": _scenario(),
+              "policy": _policy(),
+              "fleet": _fleet(),
+              "multicycle": _multicycle(),
+              "bind_split": _bind_split()}
+    detail.update(detail_overrides)
+    return _headline(detail=detail)
+
+
+def test_unamortized_boundary_p99_fatal_any_round():
+    """Claiming p99_met on a per-cycle device_boundary number is the
+    r5 87-vs-3.4 ms methodology error — fatal regardless of round."""
+    doc = _r16_doc()
+    doc["detail"]["score_p99_source"] = "device_boundary"
+    doc["detail"]["north_star"]["p99_source"] = "device_boundary"
+    fails = bench_check.check_doc("BENCH_r16.json", doc)
+    assert any("unamortized" in f for f in fails), fails
+    # The multicycle-amortized label is an accepted scan source.
+    ok = _r16_doc()
+    ok["detail"]["score_p99_source"] = "device_boundary_multicycle"
+    ok["detail"]["north_star"]["p99_source"] = \
+        "device_boundary_multicycle"
+    assert bench_check.check_doc("BENCH_r16.json", ok) == []
+
+
+def test_multicycle_block_required_from_round16():
+    # r16+ headline claiming the p99 bar without the block: fails.
+    doc = _r15_doc()
+    fails = bench_check.check_doc("BENCH_r16.json", doc)
+    assert any("multicycle block" in f for f in fails), fails
+    # Same doc with multicycle + bind_split: clean.
+    assert bench_check.check_doc("BENCH_r16.json", _r16_doc()) == []
+    # Committed r15 history predates the subsystem: exempt.
+    assert bench_check.check_doc("BENCH_r15.json", doc) == []
+    # A doc not claiming the bar may omit the block even at r16+.
+    quiet = _r15_doc()
+    quiet["detail"]["score_p99_ms"] = 87.44
+    quiet["detail"]["north_star"]["p99_met"] = False
+    assert bench_check.check_doc("BENCH_r16.json", quiet) == []
+
+
+def test_bind_split_inflight_bound_required_from_round16():
+    doc = _r16_doc()
+    del doc["detail"]["bind_split"]
+    fails = bench_check.check_doc("BENCH_r16.json", doc)
+    assert any("bind_split" in f for f in fails), fails
+    # Unbounded (or absent) inflight cap is exactly the 905 ms tail.
+    fails = bench_check.check_doc("BENCH_r16.json", _r16_doc(
+        bind_split=_bind_split(max_inflight=0)))
+    assert any("max_inflight invalid" in f for f in fails), fails
+    # A peak above the cap means the bound did not hold.
+    fails = bench_check.check_doc("BENCH_r16.json", _r16_doc(
+        bind_split=_bind_split(inflight_peak=5)))
+    assert any("exceeds max_inflight" in f for f in fails), fails
+
+
+def test_multicycle_shape_validated_when_present():
+    # K<2 cannot claim window amortization.
+    fails = bench_check.check_doc("BENCH_r16.json", _r16_doc(
+        multicycle=_multicycle(k=1)))
+    assert any("at least 2 cycles" in f for f in fails), fails
+    # Negative / missing numerics.
+    fails = bench_check.check_doc("BENCH_r16.json", _r16_doc(
+        multicycle=_multicycle(retire_lag_p99=-1.0)))
+    assert any("retire_lag_p99 invalid" in f for f in fails), fails
+    bad = _multicycle()
+    del bad["device_queue_depth"]
+    fails = bench_check.check_doc("BENCH_r16.json", _r16_doc(
+        multicycle=bad))
+    assert any("device_queue_depth invalid" in f for f in fails), fails
+    # Not an object at all.
+    fails = bench_check.check_doc("BENCH_r16.json", _r16_doc(
+        multicycle=["not", "a", "dict"]))
+    assert any("multicycle is not an object" in f for f in fails), fails
+    # A failed identity A/B poisons the whole artifact — fatal on a
+    # pre-r16 filename and on a doc not claiming the bar.
+    fails = bench_check.check_doc("BENCH_r15.json", _r15_doc(
+        multicycle=_multicycle(identity_ab={"identical": False})))
+    assert any("identity_ab" in f for f in fails), fails
+    quiet = _r16_doc(multicycle=_multicycle(
+        identity_ab={"identical": False}))
+    quiet["detail"]["score_p99_ms"] = 87.44
+    quiet["detail"]["north_star"]["p99_met"] = False
+    fails = bench_check.check_doc("BENCH_r16.json", quiet)
+    assert any("identity_ab" in f for f in fails), fails

@@ -431,3 +431,49 @@ def test_cycle_spans_carry_cluster_id_when_tenant_named():
     _drain(solo_cluster, solo, num_pods=4, seed=4)
     assert all(s.cluster_id is None
                for s in solo.flight.spans() if s.n_pods > 0)
+
+
+def test_multicycle_span_fields_lint_clean():
+    """r16: spans from the multicycle path carry the window shape
+    (scan_window_k) and the retire seam (retire_lag_cycles); both are
+    only-when-present — serial spans keep them null and still lint."""
+    rec = FlightRecorder(capacity=8)
+    sb = rec.begin("multicycle")
+    with sb.phase("encode"):
+        pass
+    rec.commit(sb.finish(n_pods=2, pod_uids=("a", "b"), queue_depth=0,
+                         scan_window_k=4, retire_lag_cycles=3))
+    serial = rec.begin("serial")
+    rec.commit(serial.finish(n_pods=1, pod_uids=("c",), queue_depth=0))
+    doc = rec.to_chrome_trace()
+    assert trace_check.check_trace(doc) == []
+    args = [e["args"] for e in doc["traceEvents"]
+            if e.get("cat") == "cycle"]
+    assert {"scan_window_k": 4, "retire_lag_cycles": 3}.items() <= \
+        [a for a in args if a.get("path") == "multicycle"][0].items()
+    assert [a for a in args if a.get("path") == "serial"][0][
+        "retire_lag_cycles"] is None
+
+
+def test_multicycle_span_fields_validated_when_present():
+    rec = FlightRecorder(capacity=4)
+    sb = rec.begin("multicycle")
+    rec.commit(sb.finish(n_pods=1, pod_uids=("a",), queue_depth=0,
+                         scan_window_k=4, retire_lag_cycles=-1))
+    fails = trace_check.check_trace(rec.to_chrome_trace())
+    assert any("retire_lag_cycles" in f for f in fails), fails
+
+
+def test_loop_multicycle_spans_carry_window_shape():
+    """End-to-end: a K=4 drain emits one span per logical cycle with
+    k and a 0..k-1 retire lag, and the trace lints clean."""
+    cfg = _cfg(queue_capacity=4096)
+    cluster, loop = _make_loop(cfg, seed=6)
+    loop.multicycle = 4
+    _drain(cluster, loop, num_pods=64, seed=6)
+    mc = [s for s in loop.flight.spans() if s.path == "multicycle"]
+    assert mc
+    assert all(s.scan_window_k and s.scan_window_k >= 1 for s in mc)
+    lags = sorted({s.retire_lag_cycles for s in mc})
+    assert lags[0] == 0 and lags[-1] <= 3
+    assert trace_check.check_trace(loop.flight.to_chrome_trace()) == []

@@ -1,0 +1,239 @@
+"""Persistent multi-cycle serving program (ISSUE 17 / r16).
+
+The contract under test: a K-wave window served as ONE donated scan
+must place every pod exactly where K sequential fused per-batch
+cycles would (bit-identity), usage must commit only at wave RETIRE
+(so a mid-window checkpoint restores to the last retired cycle), and
+a too-shallow device ring must fall back — counted, never dropped or
+misplaced.
+"""
+
+import numpy as np
+import pytest
+
+from kubernetesnetawarescheduler_tpu.config import SchedulerConfig
+from kubernetesnetawarescheduler_tpu.core.checkpoint import (
+    load_checkpoint,
+    save_checkpoint,
+)
+from kubernetesnetawarescheduler_tpu.core.loop import SchedulerLoop
+from kubernetesnetawarescheduler_tpu.bench.fakecluster import (
+    ClusterSpec,
+    WorkloadSpec,
+    build_fake_cluster,
+    feed_metrics,
+    generate_workload,
+)
+
+
+def _make_loop(seed=3, num_nodes=24, multicycle=None, async_bind=False,
+               burst_batches=8, **cfg_kw):
+    kw = dict(max_nodes=32, max_pods=16, max_peers=4,
+              queue_capacity=4096)
+    kw.update(cfg_kw)
+    cfg = SchedulerConfig(**kw)
+    cluster, lat, bw = build_fake_cluster(ClusterSpec(num_nodes=num_nodes,
+                                                      seed=seed))
+    loop = SchedulerLoop(cluster, cfg, multicycle=multicycle,
+                         async_bind=async_bind,
+                         burst_batches=burst_batches)
+    loop.encoder.set_network(lat, bw)
+    feed_metrics(cluster, loop.encoder, np.random.default_rng(seed + 1))
+    return cluster, loop
+
+
+def _drain(num_pods, seed=7, **make_kw):
+    cluster, loop = _make_loop(**make_kw)
+    pods = generate_workload(WorkloadSpec(num_pods=num_pods, seed=seed),
+                             scheduler_name=loop.cfg.scheduler_name)
+    cluster.add_pods(pods)
+    loop.run_until_drained()
+    loop.flush_binds()
+    loop.stop_bind_worker()
+    return cluster, loop, {b.pod_name: b.node_name
+                           for b in cluster.bindings}
+
+
+# -- placement bit-identity ----------------------------------------------
+
+
+def test_k1_is_the_default_path():
+    """K=1 (the default) must not open windows at all — it IS the
+    r15 path, not a degenerate window around it."""
+    _, loop, placed = _drain(48, multicycle=1)
+    assert loop.multicycle_windows == 0
+    assert loop.multicycle_last_retired == -1
+    assert len(placed) > 0
+
+
+@pytest.mark.parametrize(
+    "k", [2, pytest.param(8, marks=pytest.mark.slow)])
+def test_multicycle_placements_bit_identical(k):
+    """K-window scan vs K sequential fused per-batch steps on the
+    same seeded feed: identical pod->node map, and the window path
+    actually ran."""
+    n = 10 * 16  # several windows at K=2, >1 window at K=8
+    _, base_loop, base = _drain(n, multicycle=1, burst_batches=1)
+    _, mc_loop, mc = _drain(n, multicycle=k, multicycle_queue_depth=k)
+    assert mc_loop.multicycle_windows > 0
+    assert mc == base
+    assert mc_loop.multicycle_overflow_total == 0
+
+
+@pytest.mark.slow
+def test_multicycle_identity_replay_heavy():
+    """Deep-backlog soak shape: K=8 over a 640-pod feed (several full
+    windows plus a ragged tail) stays bit-identical to the serial
+    fused path."""
+    n = 640
+    _, _, base = _drain(n, multicycle=1, burst_batches=1)
+    _, mc_loop, mc = _drain(n, multicycle=8)
+    assert mc_loop.multicycle_windows >= 4
+    assert mc == base
+
+
+def test_ring_overflow_falls_back_with_counter():
+    """A device ring shallower than K degrades amortization, never
+    placements: the overflow waves re-dispatch through the per-cycle
+    path after the window retires, and the loss is counted."""
+    n = 8 * 16
+    _, _, base = _drain(n, multicycle=1, burst_batches=1)
+    _, mc_loop, mc = _drain(n, multicycle=4, multicycle_queue_depth=2)
+    assert mc_loop.multicycle_overflow_total > 0
+    assert mc == base
+
+
+@pytest.mark.slow
+def test_coalesced_async_binds_identical():
+    """Multicycle + async binder with a coalescing window and a
+    bounded inflight cap: same placements, and the bound held."""
+    n = 8 * 16
+    _, _, base = _drain(n, multicycle=1, burst_batches=1)
+    _, loop, mc = _drain(n, multicycle=4, async_bind=True,
+                         bind_coalesce_window=4, bind_max_inflight=2)
+    assert mc == base
+    assert loop.bind_inflight == 0  # all drained after stop
+    assert loop.bind_inflight_peak <= loop.cfg.bind_max_inflight
+
+
+# -- retire semantics / checkpoint safety --------------------------------
+
+
+def _window_loop(k=4):
+    cluster, loop = _make_loop(multicycle=k)
+    pods = generate_workload(WorkloadSpec(num_pods=k * 16, seed=9),
+                             scheduler_name=loop.cfg.scheduler_name)
+    cluster.add_pods(pods)
+    return cluster, loop, pods
+
+
+def test_usage_commits_only_at_retire():
+    cluster, loop, pods = _window_loop(k=4)
+    queued = loop.queue.pop_batch(4 * 16, 0.0)
+    loop.schedule_pods_multicycle(queued)
+    # Window dispatched, nothing retired: no usage, no binds.
+    assert len(loop._mc_inflight) == 4
+    assert len(loop.encoder._committed) == 0
+    assert len(cluster.bindings) == 0
+    bound = loop._retire_multicycle(max_waves=1)
+    assert bound > 0
+    assert len(loop._mc_inflight) == 3
+    wave0 = {p.name for p in queued[:16]}
+    assert {b.pod_name for b in cluster.bindings} <= wave0
+    assert all(rec.name in wave0
+               for rec in loop.encoder._committed.values())
+    # Draining the rest retires the remaining waves' usage + binds.
+    loop._retire_multicycle()
+    assert len(loop._mc_inflight) == 0
+    assert {b.pod_name for b in cluster.bindings} - wave0
+
+
+def test_mid_window_checkpoint_restores_last_retired(tmp_path, capfd):
+    """Checkpoint taken with 3 of 4 waves unretired: the restored
+    ledger holds ONLY the retired wave's pods (commit-at-retire), the
+    meta names the restore point, and load announces it."""
+    cluster, loop, _ = _window_loop(k=4)
+    queued = loop.queue.pop_batch(4 * 16, 0.0)
+    loop.schedule_pods_multicycle(queued)
+    loop._retire_multicycle(max_waves=1)
+    meta = loop.multicycle_meta()
+    assert meta["k"] == 4
+    assert meta["waves_inflight"] == 3
+    assert meta["last_retired_cycle"] == loop.multicycle_last_retired
+    assert meta["last_retired_cycle"] >= 0
+    committed_at_save = set(loop.encoder._committed)
+    save_checkpoint(str(tmp_path / "ckpt"), loop.encoder,
+                    extra_meta={"multicycle": meta})
+    # The unretired waves retire after the save — the crash window.
+    loop._retire_multicycle()
+    assert set(loop.encoder._committed) > committed_at_save
+
+    enc2 = load_checkpoint(str(tmp_path / "ckpt"))
+    err = capfd.readouterr().err
+    assert "mid multicycle window" in err
+    assert "last retired cycle" in err
+    # Restored ledger == exactly the waves retired before the save;
+    # the in-flight waves' pods are absent (they re-arrive Pending).
+    assert set(enc2._committed) == committed_at_save
+    wave0 = {p.name for p in queued[:16]}
+    assert all(rec.name in wave0 for rec in enc2._committed.values())
+
+
+def test_fully_retired_checkpoint_loads_silently(tmp_path, capfd):
+    cluster, loop, _ = _window_loop(k=2)
+    queued = loop.queue.pop_batch(2 * 16, 0.0)
+    loop.schedule_pods_multicycle(queued)
+    loop._retire_multicycle()
+    save_checkpoint(str(tmp_path / "ckpt"), loop.encoder,
+                    extra_meta={"multicycle": loop.multicycle_meta()})
+    load_checkpoint(str(tmp_path / "ckpt"))
+    assert "mid multicycle window" not in capfd.readouterr().err
+
+
+def test_multicycle_meta_shape():
+    _, loop = _make_loop(multicycle=4)
+    assert loop.multicycle_meta() == {
+        "k": 4, "waves_inflight": 0, "last_retired_cycle": -1}
+
+
+# -- device ring ---------------------------------------------------------
+
+
+def test_device_wave_ring_bounds_and_roundtrip():
+    from kubernetesnetawarescheduler_tpu.core.encode import (
+        DeviceWaveRing,
+        concat_stream_waves,
+        split_stream_waves,
+    )
+    from kubernetesnetawarescheduler_tpu.core.replay import pad_stream
+
+    _, loop = _make_loop()
+    pods = generate_workload(WorkloadSpec(num_pods=4 * 16, seed=5),
+                             scheduler_name=loop.cfg.scheduler_name)
+    stream = loop.encoder.encode_stream(pods, node_of=loop._peer_node,
+                                        lenient=True)
+    stream = pad_stream(stream, 4 * 16)
+    waves = split_stream_waves(stream, 16)
+    assert len(waves) == 4
+
+    # split -> concat is the identity on every array leaf.
+    import jax
+
+    rt = concat_stream_waves(waves)
+    orig_leaves = jax.tree_util.tree_leaves(stream)
+    rt_leaves = jax.tree_util.tree_leaves(rt)
+    assert len(orig_leaves) == len(rt_leaves) > 0
+    for a, b in zip(orig_leaves, rt_leaves):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    ring = DeviceWaveRing(2)
+    accepted = [ring.push(w) for w in waves]
+    assert accepted == [True, True, False, False]
+    assert ring.overflow_total == 2
+    assert len(ring) == 2
+    window = ring.pop_window()
+    assert window is not None
+    assert len(ring) == 0
+    assert ring.pop_window() is None
+    # Ring re-accepts after a drain.
+    assert ring.push(waves[2])

@@ -255,3 +255,55 @@ def test_quality_slo_families_absent_when_disabled():
     body = render_metrics(loop)
     assert "netaware_quality_" not in body
     assert "netaware_slo_" not in body
+
+
+def test_multicycle_and_coalesced_bind_families_exposed():
+    """r16: the bounded-inflight gauge + coalescing counter render
+    unconditionally; the retire-lag native histogram rides the r11
+    LogHistogram family seam once the multicycle path has retired
+    waves — and none of them double-declare (duplicate-family guard)."""
+    import dataclasses
+
+    cfg = dataclasses.replace(CFG, queue_capacity=4096,
+                              bind_coalesce_window=4,
+                              bind_max_inflight=2)
+    cluster, lat, bw = build_fake_cluster(ClusterSpec(num_nodes=20,
+                                                      seed=7))
+    loop = SchedulerLoop(cluster, cfg, multicycle=4, async_bind=True)
+    loop.encoder.set_network(lat, bw)
+    feed_metrics(cluster, loop.encoder, np.random.default_rng(8))
+    pods = generate_workload(WorkloadSpec(num_pods=64, seed=7),
+                             scheduler_name=cfg.scheduler_name)
+    cluster.add_pods(pods)
+    loop.run_until_drained()
+    loop.flush_binds()
+    loop.stop_bind_worker()
+    assert len(loop._retire_lag) > 0  # multicycle path actually ran
+
+    body = render_metrics(loop)
+    assert "# TYPE netaware_bind_inflight gauge" in body
+    assert "# TYPE netaware_bind_coalesced_total counter" in body
+    assert "# TYPE netaware_multicycle_retire_lag histogram" in body
+    hist_lines = [l for l in body.splitlines()
+                  if l.startswith("netaware_multicycle_retire_lag")]
+    assert any('le="+Inf"' in l for l in hist_lines)
+    # Values agree with the loop's own counters.
+    parsed = parse_prometheus_text(body)
+    flat = {name: next(iter(series.values()))
+            for name, series in parsed.items() if len(series) == 1}
+    assert flat["netaware_bind_inflight"] == loop.bind_inflight == 0
+    assert flat["netaware_bind_coalesced_total"] == \
+        loop.bind_coalesced_total
+    # Duplicate-family guard: each header exactly once in this body.
+    declared = [line.split()[2] for line in body.splitlines()
+                if line.startswith("# TYPE ")]
+    assert len(declared) == len(set(declared))
+
+
+def test_retire_lag_family_absent_when_multicycle_idle():
+    """K=1 serving never records retire lags: the family stays out of
+    the body entirely (only-when-present, like the other r11 hists)."""
+    loop = _run_loop(seed=9)
+    body = render_metrics(loop)
+    assert "netaware_multicycle_retire_lag" not in body
+    assert "# TYPE netaware_bind_inflight gauge" in body

@@ -35,6 +35,10 @@ Checks:
   * fleet tenancy — cycle spans carrying the r15 ``cluster_id`` arg
     must have it null (solo loop) or a string (tenant name);
     validated only when present, so pre-r15 dumps lint clean
+  * multi-cycle serving — cycle spans carrying the r16 args
+    (``scan_window_k``/``retire_lag_cycles``) must be non-negative
+    integers; null means per-cycle dispatch and pre-r16 dumps carry
+    neither, so old traces lint clean
 
 A cycle's phase set is NOT prescribed: the r9 fused single-dispatch
 step collapses score+assign+commit into one ``score_assign`` phase
@@ -118,7 +122,8 @@ def check_trace(doc: Any) -> list[str]:
             # (pre-r9 dumps carry none of these and stay clean).
             for k in ("rounds", "donated", "donation_skipped",
                       "outcome_ring_depth", "rebalance_moves",
-                      "rebalance_reverts", "trace_offset"):
+                      "rebalance_reverts", "trace_offset",
+                      "scan_window_k", "retire_lag_cycles"):
                 v = args.get(k)
                 if v is not None and (not isinstance(v, int)
                                       or v < 0):
