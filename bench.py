@@ -263,6 +263,34 @@ def _persisted_rebalance() -> dict | None:
         return None
 
 
+def _persisted_reshape() -> dict | None:
+    """The ``--suite reshape`` leg's artifact
+    (bench_artifacts/reshape.json), compressed to the block r17+
+    artifacts must carry when claiming gang or rebalance results
+    (tools/bench_check Rule 17): reshaping enabled, ZERO half-shaped
+    gangs, and reshape disruption beside the configured eviction
+    budget.  None when the leg has not run in this tree."""
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "bench_artifacts", "reshape.json")
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+        d = doc["detail"]["reshape"]
+        return {
+            "enabled": bool(d["enabled"]),
+            "half_shaped_gangs": int(d["half_shaped_gangs"]),
+            "evictions_per_pod_hour": float(
+                d["evictions_per_pod_hour"]),
+            "budget_per_pod_hour": float(d["budget_per_pod_hour"]),
+            "recovered_frac": float(d.get("recovered_frac", 0.0)),
+            "reshapes_total": int(d.get("reshapes_total", 0)),
+            "no_outage_reshapes": int(d.get("no_outage_reshapes", 0)),
+            "source": "suite_reshape",
+        }
+    except (OSError, ValueError, KeyError, TypeError):
+        return None
+
+
 def _persisted_scenario() -> dict | None:
     """The ``--suite scenario`` leg's artifact
     (bench_artifacts/scenario.json), compressed to the block r13+
@@ -680,6 +708,14 @@ def _assemble_doc(res, *, num_nodes: int, batch: int, method: str,
         # descheduler kept disruption inside its eviction budget and
         # never stranded a half-moved gang (--suite rebalance leg).
         detail["rebalance"] = reb
+    resh = _persisted_reshape()
+    if resh is not None:
+        # Elastic-reshaping provenance (r17, bench_check Rule 17):
+        # any artifact claiming gang or rebalance results must also
+        # prove the degrade-and-recover path never stranded a
+        # half-shaped gang and stayed inside the eviction budget
+        # (--suite reshape leg).
+        detail["reshape"] = resh
     scen = _persisted_scenario()
     if scen is not None:
         # Scenario-campaign provenance (r13, bench_check Rule 13):
@@ -1032,6 +1068,37 @@ def _run_suite_bench(name: str) -> None:
                        "oracle bandwidth gain")
         if bad:
             print("WARNING: rebalance bars unmet: " + "; ".join(bad),
+                  file=sys.stderr)
+            sys.exit(1)
+    if name == "reshape":
+        detail = res.metrics.get("detail", {})
+        resh = detail.get("reshape", {})
+        # Structural bars hold at every shape: zero half-shaped
+        # gangs, a silent reshape pass on a healthy cluster, and
+        # disruption inside the eviction budget.  The recovery
+        # fraction is a full-shape property (small shapes leave too
+        # little room between the half and full realizations), so
+        # only full runs are held to > 0.5.
+        bad = []
+        if resh.get("half_shaped_gangs", 1) != 0:
+            bad.append("half_shaped_gangs="
+                       f"{resh.get('half_shaped_gangs')}")
+        if resh.get("no_outage_reshapes", 1) != 0:
+            bad.append("reshape pass fired on a healthy cluster: "
+                       f"{resh.get('no_outage_reshapes')} reshapes")
+        if resh.get("no_outage_identical") is not True:
+            bad.append("idle reshape pass CHANGED placements")
+        if (resh.get("evictions_per_pod_hour", 1e9)
+                > resh.get("budget_per_pod_hour", 0.0)):
+            bad.append("disruption "
+                       f"{resh.get('evictions_per_pod_hour')} over "
+                       f"budget {resh.get('budget_per_pod_hour')}")
+        if not small and resh.get("recovered_frac", 0.0) <= 0.5:
+            bad.append("recovered "
+                       f"{resh.get('recovered_frac')} <= 0.5 of "
+                       "oracle bandwidth gain")
+        if bad:
+            print("WARNING: reshape bars unmet: " + "; ".join(bad),
                   file=sys.stderr)
             sys.exit(1)
     if name == "scenario":

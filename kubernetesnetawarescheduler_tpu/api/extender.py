@@ -96,7 +96,22 @@ def _pod_from_k8s(obj: Mapping[str, Any]) -> Pod:
             annotations.get("netaware/pod-group-min-member", 0)),
         gang_timeout_s=_parse_float(
             annotations.get("netaware/pod-group-timeout-s", 0.0)),
+        gang_shapes=_parse_shapes(
+            annotations.get("netaware/pod-group-shapes", "")),
     )
+
+
+def _parse_shapes(text: Any) -> tuple:
+    """``netaware/pod-group-shapes`` annotation -> the canonical
+    ``((count, priority), ...)`` family (core/gang.py grammar, e.g.
+    ``"8,4:0.5"``).  Malformed input degrades to ``()`` — a rigid
+    gang — matching the other numeric gang annotations: never an
+    exception on the watch path."""
+    from kubernetesnetawarescheduler_tpu.core.gang import (
+        parse_gang_shapes,
+    )
+
+    return parse_gang_shapes(str(text or ""))
 
 
 def _parse_int(text: Any) -> int:
@@ -547,6 +562,15 @@ class ExtenderHandlers:
                 getattr(self._loop, "gangs_bound", 0))
             snap["rolled_back_total"] = int(
                 getattr(self._loop, "gangs_rolled_back", 0))
+            # Elastic reshaping (r17): the committed realization per
+            # shaped gang ([chosen, declared]) and how many gangs
+            # bound at a degraded declared shape.  Absent pre-r17
+            # consumers ignore the extra keys.
+            enc = getattr(self._loop, "encoder", None)
+            if enc is not None and hasattr(enc, "gang_realizations"):
+                snap["realizations"] = enc.gang_realizations()
+            snap["shaped_degraded_total"] = int(
+                getattr(self._loop, "gangs_shaped_degraded", 0))
             return self._json(snap)
         if path == "/metrics":
             # Self-metrics in Prometheus exposition format (SURVEY.md

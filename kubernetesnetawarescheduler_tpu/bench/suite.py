@@ -2180,6 +2180,302 @@ def run_rebalance_config(out_dir: str | None = None,
     return SuiteResult("rebalance", doc, artifacts)
 
 
+def run_reshape_config(out_dir: str | None = None,
+                       num_nodes: int = 64,
+                       num_gangs: int = 10, gang_size: int = 8,
+                       filler_pods: int = 48, batch: int = 96,
+                       seed: int = 0, zones: int = 4,
+                       outage_zone: int = 0,
+                       drift_factor: float = 40.0,
+                       rounds: int = 12) -> SuiteResult:
+    """Elastic gang reshaping leg (ISSUE 19): a ZONAL OUTAGE strands
+    placed gangs behind catastrophically degraded links — how much of
+    the lost realized bandwidth does shape-aware degrade-and-recover
+    claw back, at what disruption cost, with ZERO half-shaped gangs?
+
+    Every gang declares the elastic family ``"S,S/2:0.5"`` (full shape
+    preferred, half shape at half desirability).  Four placements of
+    ONE workload (gangs whose members exchange ring traffic, plus
+    plain filler pods that keep the healthy zones under capacity
+    pressure), all measured on the same post-outage ground truth:
+
+    The outage itself is the kubelet-real combination: the zone's
+    nodes go NotReady (cordoned — running pods keep their bindings,
+    the feasibility mask drops the nodes from every future placement)
+    and every link touching them degrades (``lat * drift_factor``,
+    ``bw / drift_factor``).
+
+    - **no_reshape control** — drains against the clean network, then
+      the outage lands and nothing acts: the pre-r17 all-or-nothing
+      scheduler, gangs frozen behind the partition with members
+      stranded on dead nodes.
+    - **no-outage control** — reshaping fully enabled, network left
+      healthy, the rebalancer ticked repeatedly: placements must stay
+      identical to the control leg and the reshape count 0 (a healthy
+      full-shape gang is invisible to the reshape pass).
+    - **reshape treatment** — same outage; serve.py's link-event feed
+      marks the zone's nodes hot and the reshape pass evicts degraded
+      gangs as units through the reshape ledger; the shape-aware gang
+      path re-places each at the best feasible realization (full
+      where the surviving zones have room, half where they don't).
+    - **oracle** — a fresh shape-aware loop schedules the workload
+      with full knowledge of the degraded network.
+
+    Headline: ``recovered_frac = (treatment - control) / (oracle -
+    control)``, bar > 0.5, with ``half_shaped_gangs == 0`` and
+    ``evictions_per_pod_hour`` within budget (bench_check Rule 17).
+    """
+    from kubernetesnetawarescheduler_tpu.bench.envinfo import bench_env
+    from kubernetesnetawarescheduler_tpu.core.gang import (
+        parse_gang_shapes,
+    )
+    from kubernetesnetawarescheduler_tpu.core.rebalance import Rebalancer
+
+    num_pods = num_gangs * gang_size + filler_pods
+    queue = max(300, 2 * num_pods)
+    shapes = parse_gang_shapes(
+        f"{gang_size},{max(1, gang_size // 2)}:0.5")
+
+    def _mk(reshape: bool):
+        cfg = SchedulerConfig(
+            max_nodes=_round_up(num_nodes, 128), max_pods=batch,
+            max_peers=4, weights=BW_LAT, queue_capacity=queue,
+            # Static to the jitted assigners — set from construction,
+            # never flipped on a live loop.
+            enable_gang_reshaping=reshape,
+        )
+        cluster, lat, bw = build_fake_cluster(
+            ClusterSpec(num_nodes=num_nodes, seed=seed, zones=zones))
+        loop = SchedulerLoop(cluster, cfg, method="parallel")
+        loop.encoder.set_network(lat, bw)
+        feed_metrics(cluster, loop.encoder,
+                     np.random.default_rng(seed + 1))
+        return loop, cfg, cluster
+
+    def _attach(loop, cfg, reshape: bool):
+        # Move scan OFF in every leg: the single-pod/move path is
+        # r12's subject; this leg isolates the reshape contribution.
+        rb_cfg = dataclasses.replace(
+            cfg,
+            enable_rebalance=True,
+            rebalance_interval_s=1e-4,      # bench ticks explicitly
+            rebalance_max_moves_per_cycle=0,
+            rebalance_evictions_per_hour=256.0,
+            rebalance_move_timeout_s=120.0,
+            enable_gang_reshaping=reshape,
+            reshape_max_per_cycle=4,
+        )
+        rb = Rebalancer(rb_cfg, loop.encoder, loop.client)
+        loop.rebalance = rb
+        return rb, rb_cfg
+
+    def _workload(cfg) -> list[Pod]:
+        pods: list[Pod] = []
+        for g in range(num_gangs):
+            group = f"rg{g:03d}"
+            for m in range(gang_size):
+                peers = {f"{group}-w{(m + 1) % gang_size:02d}": 10.0}
+                pods.append(Pod(
+                    name=f"{group}-w{m:02d}",
+                    scheduler_name=cfg.scheduler_name,
+                    requests={"cpu": 4.0, "mem": 8.0, "net_bw": 1.0},
+                    peers=peers, pod_group=group,
+                    gang_min_member=gang_size, priority=5.0,
+                    # Self-anti-affinity: one worker per host (the
+                    # TPU-slice regime) — a ring that collapses onto
+                    # one node is all loopback and blind to any
+                    # outage.
+                    group=group, anti_groups=frozenset({group}),
+                    gang_shapes=shapes))
+        filler = generate_workload(
+            WorkloadSpec(num_pods=filler_pods, seed=seed + 5,
+                         services=6, peer_fraction=0.0,
+                         cpu_range=(1.0, 4.0), mem_range=(2.0, 8.0)),
+            scheduler_name=cfg.scheduler_name)
+        return pods + list(filler)
+
+    def _drain(loop, pods):
+        for start in range(0, len(pods), batch):
+            loop.client.add_pods(pods[start:start + batch])
+            loop.run_once()
+        loop.run_until_drained()
+        loop.flush_binds()
+
+    def _placements(loop) -> dict[str, str]:
+        out: dict[str, str] = {}
+        for b in loop.client.bindings:
+            out[b.pod_name] = b.node_name
+        return out
+
+    zone_nodes = [f"node-{i:04d}" for i in range(num_nodes)
+                  if i % zones == outage_zone % max(1, zones)]
+
+    def _cordon(cluster):
+        # Zone goes NotReady: the informer upserts the node with
+        # unschedulable set, which drops it from every feasibility
+        # mask while bound pods keep their usage (kubelet-real).
+        for node in cluster.list_nodes():
+            if node.name in zone_nodes:
+                cluster.add_node(
+                    dataclasses.replace(node, unschedulable=True))
+
+    _warm_like(num_nodes, seed, BW_LAT, batch=batch, queue=queue)
+
+    # ---- leg A: outage, no reshape (the pre-r17 scheduler) --------
+    loop_a, cfg_a, cl_a = _mk(reshape=False)
+    pods = _workload(cfg_a)
+    _drain(loop_a, pods)
+    placed_a = _placements(loop_a)
+    enc_a = loop_a.encoder
+    with enc_a._lock:
+        lat0 = np.array(enc_a._lat, dtype=np.float64)
+        bw0 = np.array(enc_a._bw, dtype=np.float64)
+    zone_idx = [enc_a.node_slot(n) for n in zone_nodes]
+    lat_d, bw_d = lat0.copy(), bw0.copy()
+    for i in zone_idx:
+        lat_d[i, :] *= drift_factor
+        lat_d[:, i] *= drift_factor
+        bw_d[i, :] /= drift_factor
+        bw_d[:, i] /= drift_factor
+    np.fill_diagonal(lat_d, 0.0)
+    loopback = float(bw0.max())
+
+    def _realized_bw(placements: dict[str, str], enc) -> float:
+        total = 0.0
+        for pod in pods:
+            if not pod.peers:
+                continue
+            ni = placements.get(pod.name)
+            ii = enc.node_slot(ni) if ni else None
+            if ii is None:
+                continue
+            for peer, w in pod.peers.items():
+                nj = placements.get(peer)
+                jj = enc.node_slot(nj) if nj else None
+                if jj is None:
+                    continue
+                total += w * (loopback if ii == jj
+                              else float(bw_d[ii, jj]))
+        return total
+
+    _cordon(cl_a)               # the control sees the outage too —
+    bw_a = _realized_bw(placed_a, enc_a)   # it just cannot act on it
+    loop_a.stop_bind_worker()
+
+    # ---- leg B: no-outage control (reshape pass must sleep) -------
+    loop_b, cfg_b, _ = _mk(reshape=True)
+    rb_b, _ = _attach(loop_b, cfg_b, reshape=True)
+    _drain(loop_b, _workload(cfg_b))
+    for _ in range(3):
+        rb_b._last_tick = 0.0
+        rb_b.tick(loop_b)
+        loop_b.run_until_drained()
+        loop_b.flush_binds()
+    placed_b = _placements(loop_b)
+    no_outage_reshapes = rb_b.reshapes_total
+    no_outage_identical = placed_a == placed_b
+    loop_b.stop_bind_worker()
+
+    # ---- leg C: outage + reshape ----------------------------------
+    loop_c, cfg_c, cl_c = _mk(reshape=True)
+    rb_c, rb_cfg_c = _attach(loop_c, cfg_c, reshape=True)
+    _drain(loop_c, _workload(cfg_c))
+    enc_c = loop_c.encoder
+    _cordon(cl_c)
+    enc_c.set_network(lat_d.astype(np.float64),
+                      bw_d.astype(np.float64))
+    scan_ms: list[float] = []
+    for _ in range(rounds):
+        for n in zone_nodes:
+            rb_c.note_link_event(n, "", "degraded", streak=1)
+        rb_c._last_tick = 0.0
+        t0 = time.perf_counter()
+        moved = rb_c.tick(loop_c)
+        scan_ms.append((time.perf_counter() - t0) * 1e3)
+        loop_c.run_until_drained()
+        loop_c.flush_binds()
+        if (moved == 0 and not rb_c._inflight
+                and not rb_c._inflight_reshapes):
+            break
+    rb_c._last_tick = 0.0
+    rb_c.tick(loop_c)           # settle the final wave
+    placed_c = _placements(loop_c)
+    bw_c = _realized_bw(placed_c, enc_c)
+    rb_summary = rb_c.summary()
+    resh = rb_summary.get("reshape", {})
+    evictions_per_pod_hour = rb_c.disruption_per_pod_hour(num_pods)
+    budget_per_pod_hour = (rb_cfg_c.rebalance_evictions_per_hour
+                           / max(1, num_pods))
+    loop_c.stop_bind_worker()
+
+    # ---- oracle: fresh shape-aware schedule under the outage ------
+    loop_o, cfg_o, cl_o = _mk(reshape=True)
+    _cordon(cl_o)
+    loop_o.encoder.set_network(lat_d.astype(np.float64),
+                               bw_d.astype(np.float64))
+    _drain(loop_o, _workload(cfg_o))
+    bw_o = _realized_bw(_placements(loop_o), loop_o.encoder)
+    loop_o.stop_bind_worker()
+
+    oracle_gain = bw_o - bw_a
+    recovered = ((bw_c - bw_a) / oracle_gain
+                 if oracle_gain > 0 else 1.0)
+
+    doc = {
+        "metric": "reshape_recovery",
+        "value": round(float(recovered), 6),
+        "unit": "fraction_of_oracle_bandwidth_gain_recovered",
+        "seed": seed,
+        "detail": {
+            "num_nodes": num_nodes,
+            "num_gangs": num_gangs,
+            "gang_size": gang_size,
+            "filler_pods": filler_pods,
+            "zones": zones,
+            "outage_zone": int(outage_zone),
+            "zone_nodes": len(zone_nodes),
+            "drift_factor": float(drift_factor),
+            "recovered_frac": float(recovered),
+            "no_reshape_bw": float(bw_a),
+            "reshape_bw": float(bw_c),
+            "oracle_bw": float(bw_o),
+            "oracle_gain": float(oracle_gain),
+            "reshape": {
+                "enabled": True,
+                "reshapes_total": int(resh.get("reshapes_total", 0)),
+                "reshapes_completed":
+                    int(resh.get("reshapes_completed", 0)),
+                "reshapes_reverted":
+                    int(resh.get("reshapes_reverted", 0)),
+                "half_shaped_gangs":
+                    int(resh.get("half_shaped_gangs", 0)),
+                "shrinks": int(resh.get("shrinks", 0)),
+                "regrows": int(resh.get("regrows", 0)),
+                "retiles": int(resh.get("retiles", 0)),
+                "skipped_gain": int(resh.get("skipped_gain", 0)),
+                "skipped_budget": int(resh.get("skipped_budget", 0)),
+                "recovered_frac": float(recovered),
+                "evictions_per_pod_hour":
+                    float(evictions_per_pod_hour),
+                "budget_per_pod_hour": float(budget_per_pod_hour),
+                "no_outage_reshapes": int(no_outage_reshapes),
+                "no_outage_identical": bool(no_outage_identical),
+            },
+            "pods_evicted": int(rb_summary["pods_evicted_total"]),
+            "half_moved_gangs": int(rb_summary["half_moved_gangs"]),
+            "evictions_per_pod_hour": float(evictions_per_pod_hour),
+            "budget_per_pod_hour": float(budget_per_pod_hour),
+            "scan_ms_p50": (float(np.percentile(scan_ms, 50))
+                            if scan_ms else 0.0),
+            "scan_ms_max": (float(max(scan_ms)) if scan_ms else 0.0),
+            "bench_env": bench_env(),
+        },
+    }
+    artifacts: list[str] = []
+    write_artifact(out_dir, "reshape.json", doc, artifacts)
+    return SuiteResult("reshape", doc, artifacts)
+
+
 def run_scenario_config(out_dir: str | None = None,
                         num_nodes: int = 256,
                         duration_s: float = 2900.0,
@@ -3101,6 +3397,7 @@ CONFIGS: dict[str, Callable[..., SuiteResult]] = {
     "integrity": run_integrity_config,
     "quality": run_quality_config,
     "rebalance": run_rebalance_config,
+    "reshape": run_reshape_config,
     "scenario": run_scenario_config,
     "policy": run_policy_config,
     "fleet": run_fleet_config,
@@ -3125,6 +3422,8 @@ SMALL = {
     "quality": dict(num_nodes=64, num_pods=96, batch=32),
     "rebalance": dict(num_nodes=64, num_pods=96, batch=32,
                       drift_nodes=8, rounds=4),
+    "reshape": dict(num_nodes=32, num_gangs=4, gang_size=4,
+                    filler_pods=16, batch=32, rounds=6),
     "scenario": dict(num_nodes=64, duration_s=30.0, base_rate=30.0,
                      batch=32, gang_fraction=0.01,
                      oracle_sample=64),

@@ -531,6 +531,26 @@ class SchedulerConfig:
     # unbounded: the breaker + retry budget still gate every fanout.
     bind_max_inflight: int = 1
 
+    # ---- elastic gang reshaping (r17) ----
+    # Off by default: with reshaping disabled (or no gang declaring
+    # alternative shapes) placement is bit-identical to the rigid
+    # all-or-nothing path — same discipline as enable_rebalance.
+    # When enabled, gangs carrying a ``netaware/pod-group-shapes``
+    # annotation may commit a SMALLER declared realization when the
+    # full shape is infeasible or strictly worse, and the rebalancer
+    # may reshape a degraded gang (shrink / regrow / re-tile) through
+    # the crash-safe reshape ledger under the same sliding-hour
+    # eviction budget as ordinary moves.
+    enable_gang_reshaping: bool = False
+    # Minimum relative desirability gain (priority-weighted realized
+    # intra-gang score under the frozen snapshot) a reshape must clear
+    # before any member is evicted — the hysteresis bar that keeps a
+    # healthy gang in its current shape.
+    reshape_min_gain: float = 0.05
+    # Bound on gangs reshaped per rebalancer tick; each member evicted
+    # by a reshape is charged against rebalance_evictions_per_hour.
+    reshape_max_per_cycle: int = 2
+
     def __post_init__(self) -> None:
         if self.max_nodes <= 0 or self.max_pods <= 0 or self.max_peers <= 0:
             raise ValueError("shape limits must be positive")
@@ -660,6 +680,10 @@ class SchedulerConfig:
             raise ValueError("bind_coalesce_window must be >= 1")
         if self.bind_max_inflight < 1:
             raise ValueError("bind_max_inflight must be >= 1")
+        if self.reshape_min_gain < 0:
+            raise ValueError("reshape_min_gain must be >= 0")
+        if self.reshape_max_per_cycle < 0:
+            raise ValueError("reshape_max_per_cycle must be >= 0")
 
     def startup_warnings(
             self, policy_eval_trace: str | None = None) -> list[str]:

@@ -338,6 +338,21 @@ def save_checkpoint(path: str, encoder: Encoder,
                 key: [list(e) for e in entries]
                 for key, entries in
                 encoder._inflight_migrations.items()},
+            # Elastic reshapes inside their evict->re-pin window
+            # (r17): restore settles the gang to fully-the-old-shape
+            # (rolls back every affected member; resync re-places the
+            # gang as a unit) — never a hybrid realization.  Optional
+            # key, read via .get: no format bump needed, pre-r17
+            # checkpoints load unchanged.
+            "reshapes_inflight": {
+                key: [v[0], v[1], [list(e) for e in v[2]]]
+                for key, v in encoder._inflight_reshapes.items()},
+            # Committed realization per shaped gang ([chosen_count,
+            # declared_count]) — tools/state_audit.py cross-checks it
+            # against the committed member placements.  Optional key.
+            "gang_realizations": {
+                key: list(v)
+                for key, v in encoder._gang_realizations.items()},
             # Zone interner (topology-spread domains).
             "zones": dict(encoder._zone_index),
             # Numeric-label columns (v5): Gt/Lt key -> column of
@@ -599,6 +614,25 @@ def load_checkpoint(path: str,
     if settle_inflight:
         for key, entries in meta.get("migrations_inflight", {}).items():
             enc.rollback_gang_members(e[0] for e in entries)
+    # Committed realizations per shaped gang (r17, optional key).
+    enc._gang_realizations = {
+        key: [int(v[0]), int(v[1])]
+        for key, v in meta.get("gang_realizations", {}).items()
+        if isinstance(v, (list, tuple)) and len(v) >= 2}
+    # Elastic reshapes inside their evict->re-pin window (r17,
+    # optional key): the reshape's outcome is unknown, so settle the
+    # gang WHOLE — pop every affected member's commit (targets the
+    # reshape may have pinned, sources it may not have evicted yet)
+    # and drop the realization record; the informer resync re-places
+    # the gang as a unit at whichever shape is then feasible.  Either
+    # way the restored ledger holds fully-the-old-shape or
+    # fully-the-new-shape via resync — NEVER a hybrid (zero
+    # half-shaped gangs, the r17 chaos drill's invariant).
+    if settle_inflight:
+        for key, v in meta.get("reshapes_inflight", {}).items():
+            entries = v[2] if len(v) > 2 else []
+            enc.rollback_gang_members(e[0] for e in entries)
+            enc._gang_realizations.pop(key, None)
     # Multi-cycle provenance (r16, optional): the ledger already holds
     # only RETIRED waves (commit-at-retire), so there is nothing to
     # settle — but a checkpoint taken mid-window names its restore

@@ -399,6 +399,19 @@ class Encoder:
         # between evict and re-bind, persisted by checkpoints so a
         # crash mid-move restores fully-moved-or-fully-reverted.
         self._inflight_migrations: dict[str, list[list]] = {}
+        # Elastic-reshape ledger (r17): gangs mid-reshape, staged
+        # between the first member eviction and the last re-pin.
+        # gang key -> [old_count, new_count, member entries] where
+        # each member entry is [uid, namespace, name, from_node,
+        # to_node] (to_node "" = the member is DROPPED by the new
+        # shape).  Persisted by checkpoints so a crash mid-reshape
+        # restores fully-old-shape-or-fully-new-shape, never a hybrid.
+        self._inflight_reshapes: dict[str, list] = {}
+        # Committed realization per gang: gang key -> [chosen_count,
+        # declared_count].  Written when a shaped gang commits or a
+        # reshape completes; read by the checkpoint meta and audited
+        # by tools/state_audit.py against the committed ledger.
+        self._gang_realizations: dict[str, list[int]] = {}
 
         # Nominations (kube's nominatedNodeName analog): a preemptor
         # whose victims are terminating holds a capacity reservation on
@@ -843,6 +856,71 @@ class Encoder:
         with self._lock:
             return {k: [list(e) for e in v]
                     for k, v in self._inflight_migrations.items()}
+
+    def note_reshape_inflight(self, gang_key: str, old_count: int,
+                              new_count: int,
+                              entries: list[list]) -> None:
+        """Record a gang entering its reshape window (entries:
+        ``[uid, namespace, name, from_node, to_node]`` per affected
+        member; ``to_node == ""`` means the new shape DROPS the
+        member).  Written BEFORE the first eviction; a checkpoint
+        taken inside the window persists it so restore settles the
+        gang to fully-the-old-shape, never a hybrid.  A gang already
+        mid-reshape raises — one gang in two concurrent reshapes is
+        the exact corruption tools/state_audit.py treats as fatal."""
+        with self._lock:
+            if gang_key in self._inflight_reshapes:
+                raise ValueError(
+                    f"gang {gang_key} is already mid-reshape")
+            self._inflight_reshapes[gang_key] = [
+                int(old_count), int(new_count),
+                [list(e) for e in entries]]
+
+    def clear_reshape_inflight(self, gang_key: str,
+                               committed_count: int | None = None,
+                               declared_count: int | None = None) -> None:
+        """The reshape resolved (new shape fully pinned, or fully
+        reverted).  When it COMMITTED, record the new realization so
+        checkpoint meta and the state audit see the shape the ledger
+        now holds."""
+        with self._lock:
+            self._inflight_reshapes.pop(gang_key, None)
+            if committed_count is not None:
+                self._gang_realizations[gang_key] = [
+                    int(committed_count),
+                    int(declared_count
+                        if declared_count is not None
+                        else committed_count)]
+
+    def reshapes_inflight(self) -> dict[str, list]:
+        """Snapshot of the reshape ledger (deep copy; the checkpoint
+        writer and tools/state_audit.py read this)."""
+        with self._lock:
+            return {k: [v[0], v[1], [list(e) for e in v[2]]]
+                    for k, v in self._inflight_reshapes.items()}
+
+    def note_gang_realization(self, gang_key: str, chosen: int,
+                              declared: int) -> None:
+        """Record the physical realization a shaped gang committed at
+        (chosen members placed out of declared) — the checkpoint-meta
+        fact the reshape audit cross-checks against committed member
+        placements."""
+        if not gang_key:
+            return
+        with self._lock:
+            self._gang_realizations[gang_key] = [int(chosen),
+                                                 int(declared)]
+
+    def drop_gang_realization(self, gang_key: str) -> None:
+        """The gang left the ledger (rolled back / fully released)."""
+        with self._lock:
+            self._gang_realizations.pop(gang_key, None)
+
+    def gang_realizations(self) -> dict[str, list[int]]:
+        """Snapshot of committed realizations (deep copy)."""
+        with self._lock:
+            return {k: list(v)
+                    for k, v in self._gang_realizations.items()}
 
     def gang_members(self, gang_key: str) -> list[tuple[str, "CommitRecord"]]:
         """Committed ledger entries belonging to one gang (by the

@@ -331,6 +331,220 @@ def mean_intra_gang_bw(bw: np.ndarray,
     return float(sub[off].mean())
 
 
+# ---------------------------------------------------------------------------
+# Elastic realizations (r17): a gang may declare a FAMILY of acceptable
+# physical shapes instead of one rigid member count.
+# ---------------------------------------------------------------------------
+
+
+def parse_gang_shapes(raw: str) -> tuple:
+    """Parse a ``netaware/pod-group-shapes`` annotation into the
+    canonical ``((member_count, priority), ...)`` family.
+
+    Grammar: comma-separated ``count[:priority]`` terms, e.g.
+    ``"8,4:0.5,2:0.2"`` — place all 8 members if feasible, else 4 at
+    half desirability, else 2.  Priority defaults to 1.0 and must land
+    in (0, 1]; counts must be positive integers.  Malformed input
+    degrades to ``()`` (the rigid pre-r17 gang), matching how the
+    extender treats malformed numeric gang annotations — never an
+    exception on the watch path."""
+    if not raw or not isinstance(raw, str):
+        return ()
+    out: dict[int, float] = {}
+    try:
+        for term in raw.split(","):
+            term = term.strip()
+            if not term:
+                continue
+            if ":" in term:
+                cs, ps = term.split(":", 1)
+                count, prio = int(cs), float(ps)
+            else:
+                count, prio = int(term), 1.0
+            if count < 1 or not (0.0 < prio <= 1.0):
+                return ()
+            # Duplicate counts keep the HIGHEST declared priority.
+            out[count] = max(out.get(count, 0.0), prio)
+    except (ValueError, TypeError):
+        return ()
+    return tuple(sorted(out.items(), key=lambda kv: (-kv[0], kv[1])))
+
+
+def gang_shapes_of(members: Sequence[Pod]) -> tuple:
+    """The gang-level realization family: the union of every member's
+    declared shapes (highest priority wins per count), clipped to the
+    arrived member count, with the FULL shape always present at
+    priority 1.0.  Returns ``((count, priority), ...)`` sorted by
+    count descending — ``()``-equivalent families (only the full
+    shape) return a 1-tuple the caller may treat as rigid."""
+    n = len(members)
+    fam: dict[int, float] = {n: 1.0}
+    for pod in members:
+        for count, prio in getattr(pod, "gang_shapes", ()) or ():
+            count = int(count)
+            if 1 <= count <= n and count != n:
+                fam[count] = max(fam.get(count, 0.0), float(prio))
+    return tuple(sorted(fam.items(), key=lambda kv: (-kv[0], kv[1])))
+
+
+_REAL_JIT_CACHE: dict = {}
+
+
+def realization_scores(state, nodes_stack: np.ndarray,
+                       valid_stack: np.ndarray,
+                       cfg: SchedulerConfig) -> np.ndarray:
+    """Score S candidate realizations in ONE padded/vmapped dispatch.
+
+    ``nodes_stack`` is ``i32[S, M]`` member node indices (padded with
+    -1), ``valid_stack`` ``bool[S, M]`` marking live members.  Returns
+    ``f64[S]`` — each row's :func:`intra_gang_pair_score` (identical
+    math: pairwise C over valid members, loopback pin for co-placed
+    pairs, self-pairs excluded), so per-shape and cross-shape
+    comparisons share one scale.  The kernel is jitted once per padded
+    ``(S, M)`` shape; S and M are padded to powers of two to bound
+    retraces across gangs of different widths."""
+    import jax
+    import jax.numpy as jnp
+
+    from kubernetesnetawarescheduler_tpu.core.score import _EPS
+
+    s, m = nodes_stack.shape
+    sp = 1 << max(0, (s - 1).bit_length())
+    mp = 1 << max(1, (m - 1).bit_length())
+    nodes = np.full((sp, mp), -1, np.int32)
+    valid = np.zeros((sp, mp), bool)
+    nodes[:s, :m] = nodes_stack
+    valid[:s, :m] = valid_stack & (nodes_stack >= 0)
+
+    key = (sp, mp)
+    fn = _REAL_JIT_CACHE.get(key)
+    if fn is None:
+        def impl(bw, lat, node_valid, nodes, valid, w_bw, w_lat):
+            pair_valid = node_valid[:, None] & node_valid[None, :]
+            bw_max = jnp.maximum(
+                jnp.max(jnp.where(pair_valid, bw, 0.0)), _EPS)
+            lat_max = jnp.maximum(
+                jnp.max(jnp.where(pair_valid, lat, 0.0)), _EPS)
+            eye = jnp.eye(nodes.shape[1], dtype=bool)
+
+            def one(nd, vd):
+                idx = jnp.clip(nd, 0, bw.shape[0] - 1)
+                sub_bw = bw[idx][:, idx]
+                sub_lat = lat[idx][:, idx]
+                c = (w_bw * sub_bw / bw_max
+                     - w_lat * sub_lat / lat_max)
+                same = idx[:, None] == idx[None, :]
+                c = jnp.where(same, w_bw, c)
+                ok = vd[:, None] & vd[None, :] & ~eye
+                return jnp.sum(jnp.where(ok, c, 0.0))
+
+            return jax.vmap(one)(nodes, valid)
+
+        fn = jax.jit(impl)
+        _REAL_JIT_CACHE[key] = fn
+    scores = np.asarray(_block(fn(
+        state.bw, state.lat, state.node_valid,
+        jnp.asarray(nodes), jnp.asarray(valid),
+        jnp.float32(cfg.weights.peer_bw),
+        jnp.float32(cfg.weights.peer_lat))), np.float64)
+    return scores[:s]
+
+
+def realization_key(target: int, placed: int, priority: float,
+                    score: float) -> tuple:
+    """The realized-desirability ordering every shape decision uses:
+    feasibility first (all ``target`` members placed), then
+    priority-weighted placed count, then the pairwise net score.
+    Strict ``>`` between keys is the "strictly improves" bar the
+    reshape property test pins."""
+    return (1 if placed == target else 0,
+            float(priority) * placed, float(score))
+
+
+def place_gang_shaped(state, batch, cfg: SchedulerConfig, static,
+                      assign_fn, num_members: int, shapes: Sequence):
+    """Shape-aware joint placement: run the two-pass C-matrix
+    placement once per declared realization (each with the member rows
+    beyond that shape's count masked infeasible through the assigner's
+    ``{"raw", "ok"}`` static seam — same compiled executable every
+    time, only mask values change), then score ALL candidate
+    realizations in one padded/vmapped :func:`realization_scores`
+    dispatch and return the winner under :func:`realization_key`.
+
+    A realization of count ``k`` places the FIRST ``k`` members of the
+    batch (members arrive name-sorted from the loop, so the prefix is
+    deterministic).  Returns ``(assignment, chosen_count, info)``:
+    the host assignment array for the whole batch, how many members
+    the winning realization targets (0 = nothing feasible at any
+    declared shape), and a debug dict for explain/flight records.
+    With a single declared shape equal to the full member count this
+    reduces EXACTLY to :func:`place_gang` (the bit-identical pre-r17
+    path)."""
+    import jax.numpy as jnp
+
+    from kubernetesnetawarescheduler_tpu.core import assign as assign_lib
+
+    shapes = [(int(c), float(p)) for c, p in shapes
+              if 1 <= int(c) <= num_members]
+    if not shapes:
+        shapes = [(num_members, 1.0)]
+    if len(shapes) == 1 and shapes[0][0] == num_members:
+        a = place_gang(state, batch, cfg, static, assign_fn,
+                       num_members)
+        placed = int(np.sum(a[:num_members] >= 0))
+        return a, (num_members if placed == num_members else 0), {
+            "shapes_scored": 1, "chosen": num_members,
+            "priority": shapes[0][1], "rigid": True}
+
+    raw, ok = assign_lib._static_parts(state, batch, cfg, static)
+    raw = jnp.asarray(raw)
+    ok = jnp.asarray(ok)
+    width = int(ok.shape[0])
+
+    # candidates: (shape_idx, count, priority, assignment)
+    candidates: list[tuple[int, int, float, np.ndarray]] = []
+    for si, (count, prio) in enumerate(shapes):
+        row_mask = np.zeros((width,), bool)
+        row_mask[:count] = True
+        okm = ok & jnp.asarray(row_mask)[:, None]
+        st0 = {"raw": raw, "ok": okm}
+        a0 = np.asarray(_block(assign_fn(state, batch, cfg, st0)))
+        candidates.append((si, count, prio, a0))
+        placed0 = a0[:count]
+        if cfg.gang_weight > 0 and np.any(placed0 >= 0):
+            bias = gang_bias(state, placed0[placed0 >= 0], cfg)
+            st1 = {"raw": raw + bias[None, :].astype(raw.dtype),
+                   "ok": okm}
+            a1 = np.asarray(_block(assign_fn(state, batch, cfg, st1)))
+            candidates.append((si, count, prio, a1))
+
+    mmax = max(c for _, c, _, _ in candidates)
+    nodes_stack = np.full((len(candidates), mmax), -1, np.int32)
+    valid_stack = np.zeros((len(candidates), mmax), bool)
+    for ci, (_, count, _, a) in enumerate(candidates):
+        nodes_stack[ci, :count] = a[:count]
+        valid_stack[ci, :count] = True
+    scores = realization_scores(state, nodes_stack, valid_stack, cfg)
+
+    best = None
+    best_key = None
+    for ci, (si, count, prio, a) in enumerate(candidates):
+        placed = int(np.sum(a[:count] >= 0))
+        key = realization_key(count, placed, prio, float(scores[ci]))
+        # Strict >: ties keep the earlier candidate (declaration
+        # order, pass 1 before pass 2) — same tie shape place_gang
+        # uses between its two passes.
+        if best_key is None or key > best_key:
+            best, best_key = (ci, si, count, prio, a, placed), key
+    ci, si, count, prio, a, placed = best
+    chosen = count if placed == count else 0
+    info = {"shapes_scored": len(shapes),
+            "candidates": len(candidates), "chosen": chosen,
+            "priority": prio, "score": float(scores[ci]),
+            "rigid": False}
+    return a, chosen, info
+
+
 def place_gang(state, batch, cfg: SchedulerConfig, static, assign_fn,
                num_members: int):
     """Joint two-pass placement of one gang's member batch.

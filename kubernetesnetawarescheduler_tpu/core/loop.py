@@ -28,7 +28,9 @@ from kubernetesnetawarescheduler_tpu.core.encode import Encoder
 from kubernetesnetawarescheduler_tpu.core.gang import (
     GangRegistry,
     gang_key_of,
+    gang_shapes_of,
     place_gang,
+    place_gang_shaped,
 )
 from kubernetesnetawarescheduler_tpu.k8s.client import ClusterClient
 from kubernetesnetawarescheduler_tpu.k8s.informer import Informer, PodQueue
@@ -479,6 +481,12 @@ class SchedulerLoop:
                       if cfg.enable_gang_scheduling else None)
         self.gangs_bound = 0
         self.gangs_rolled_back = 0
+        # Elastic reshaping (r17): gangs committed at a DEGRADED
+        # declared realization (fewer members than arrived) by the
+        # shape-aware placement path, plus the per-span delta baseline
+        # for the rebalancer's reshape counters.
+        self.gangs_shaped_degraded = 0
+        self._reshape_last = (0, 0)
 
         # Conflict-round window as a LogHistogram (rounds are small
         # ints; doubling buckets from 1 keep them exact): drop-in for
@@ -744,6 +752,7 @@ class SchedulerLoop:
         # per-span deltas (the descheduler runs on the maintain path,
         # so a span carries whatever moved since the previous span).
         rb_moves = rb_reverts = 0
+        gang_reshapes = reshape_reverts = None
         if self.rebalance is not None:
             mt = int(self.rebalance.moves_total)
             rt = int(self.rebalance.moves_reverted)
@@ -751,6 +760,20 @@ class SchedulerLoop:
             self._rebalance_last = (mt, rt)
             rb_moves = max(mt - last_mt, 0)
             rb_reverts = max(rt - last_rt, 0)
+            # r17 reshape accounting: carried only when the feature is
+            # live (None off-path, so pre-r17 trace consumers and old
+            # dumps stay byte-identical — same only-when-present
+            # contract trace_check enforces).
+            if self.cfg.enable_gang_reshaping or getattr(
+                    self.rebalance.cfg, "enable_gang_reshaping",
+                    False):
+                rs = int(getattr(self.rebalance, "reshapes_total", 0))
+                rr = int(getattr(self.rebalance,
+                                 "reshapes_reverted", 0))
+                last_rs, last_rr = self._reshape_last
+                self._reshape_last = (rs, rr)
+                gang_reshapes = max(rs - last_rs, 0)
+                reshape_reverts = max(rr - last_rr, 0)
         # Policy accounting: same cumulative->per-span-delta shape
         # (shadow ranking runs on the maintain path).
         pol_disagree = pol_version = 0
@@ -788,6 +811,8 @@ class SchedulerLoop:
                                 if self.quality is not None else 0),
             rebalance_moves=rb_moves,
             rebalance_reverts=rb_reverts,
+            gang_reshapes=gang_reshapes,
+            reshape_reverts=reshape_reverts,
             scenario_phase=self.scenario_phase,
             trace_offset=int(self.trace_offset),
             policy_shadow_disagreements=pol_disagree,
@@ -1670,13 +1695,57 @@ class SchedulerLoop:
                 static = None
                 assign_fn = {"greedy": assign_greedy,
                              "parallel": assign_parallel}[self.method]
+            # Elastic realizations (r17): when the gang declares
+            # alternative shapes AND the feature is on, score every
+            # declared realization and commit the winner; otherwise
+            # the pre-r17 rigid path runs bit-identically.
+            shapes = (gang_shapes_of(members)
+                      if self.cfg.enable_gang_reshaping else ())
+            shaped = len(shapes) > 1
             with self._profile_step(sb.cycle_id):
-                assignment = place_gang(state, batch, self.cfg, static,
-                                        assign_fn, len(members))
+                if shaped:
+                    assignment, chosen, shape_info = place_gang_shaped(
+                        state, batch, self.cfg, static, assign_fn,
+                        len(members), shapes)
+                else:
+                    assignment = place_gang(state, batch, self.cfg,
+                                            static, assign_fn,
+                                            len(members))
+                    chosen, shape_info = len(members), None
             self._note_dispatch()
+        commit_members = members
+        surplus: list[Pod] = []
+        if shaped and 0 < chosen < len(members):
+            # A degraded realization commits the chosen PREFIX
+            # all-or-nothing; the surplus members park (loudly) and
+            # re-gate on the next wakeup/resync — the rebalancer's
+            # regrow path restores the full shape when capacity
+            # returns.
+            commit_members = members[:chosen]
+            surplus = members[chosen:]
         with sb.phase("bind"), self.timer.phase("bind"):
-            bound = self._commit_gang(key, members, assignment,
+            bound = self._commit_gang(key, commit_members, assignment,
                                       node_table)
+        if shaped and bound:
+            self.encoder.note_gang_realization(key, len(commit_members),
+                                               len(members))
+        if surplus:
+            comp_events = []
+            if bound:
+                self.gangs_shaped_degraded += 1
+                why = (f"gang {key} realized degraded shape "
+                       f"{chosen}/{len(members)} "
+                       f"(declared family: "
+                       f"{','.join(str(c) for c, _ in shapes)}); "
+                       "member parked awaiting regrow")
+            else:
+                why = (f"gang {key}: no feasible placement at any "
+                       "declared shape")
+            for pod in surplus:
+                comp_events.append(failed_event(pod, comp, why))
+            self.client.create_events(comp_events)
+            self.unschedulable += len(surplus)
+            self._park_gang(surplus)
         # Explain records note the joint C-matrix pass: the per-node
         # decomposition is the INDEPENDENT score surface; the gang's
         # co-placement bias may have moved the winner off the
@@ -1686,7 +1755,10 @@ class SchedulerLoop:
             sb.cycle_id, "gang",
             extra={"gang": {"key": key, "members": len(members),
                             "joint_placement": True,
-                            "bound": bool(bound)}})
+                            "bound": bool(bound),
+                            **({"realization": chosen,
+                                "shape_info": shape_info}
+                               if shaped else {})}})
         self._span_commit(sb, members, static_version=static_version)
         return bound
 
@@ -1795,11 +1867,33 @@ class SchedulerLoop:
         """Expire incomplete gangs whose gate deadline passed: emit a
         FailedScheduling event per stranded member and return them to
         the queue (they re-gate with a fresh deadline on the next
-        pop — kube co-scheduling's retry shape)."""
+        pop — kube co-scheduling's retry shape).
+
+        Elastic gangs (r17) degrade instead: when reshaping is on and
+        the arrived members cover some DECLARED smaller shape, the
+        gate expiring means the missing members are not coming (a
+        zonal outage deleted them, a controller is slow) — the gang
+        schedules at the best viable realization now and the
+        rebalancer's regrow path restores the full shape when the
+        stragglers re-deliver."""
         if self.gangs is None:
             return
         comp = self.cfg.scheduler_name
         for key, members in self.gangs.flush_timeouts():
+            declared = {int(c) for pod in members
+                        for c, _ in (getattr(pod, "gang_shapes", ())
+                                     or ())}
+            if (self.cfg.enable_gang_reshaping and declared
+                    and min(declared) <= len(members)):
+                self.client.create_events([
+                    failed_event(
+                        pod, comp,
+                        f"gang {key} timed out waiting for members "
+                        f"({len(members)} arrived); degrading to the "
+                        "declared elastic family")
+                    for pod in members])
+                self._schedule_gang(key, members)
+                continue
             self.client.create_events([
                 failed_event(
                     pod, comp,

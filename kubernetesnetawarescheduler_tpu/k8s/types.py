@@ -156,6 +156,15 @@ class Pod:
     pod_group: str = ""
     gang_min_member: int = 0
     gang_timeout_s: float = 0.0
+    # Elastic gang reshaping (r17): the family of acceptable physical
+    # realizations for the pod's gang, as ``((member_count, priority),
+    # ...)`` sorted by declared preference.  Empty = the gang is rigid
+    # (all-or-nothing at ``gang_min_member``, the pre-r17 behavior).
+    # A realization places exactly ``member_count`` of the gang's
+    # members; ``priority`` in (0, 1] weights how desirable that shape
+    # is relative to the full one (the placer commits the feasible
+    # realization maximizing priority-weighted realized desirability).
+    gang_shapes: tuple = ()
 
 
 @dataclasses.dataclass(frozen=True, slots=True)

@@ -17,6 +17,12 @@ in non-decreasing virtual time:
   burst duration (the k8s chaos proxy models control-plane faults;
   these model data-plane drift);
 - ``node_down`` / ``node_up`` — node churn;
+- ``zone_down`` / ``zone_up`` — a CORRELATED mass failure (v2): every
+  node in one zone goes down at once and comes back together — the
+  degrade-and-recover trigger elastic gang reshaping exists for;
+- ``node_upgrade`` — one node drained for a rolling upgrade (v2);
+  the replay treats it as a node_down whose ``node_up`` is scheduled
+  a hold later, batch after batch marching across the fleet;
 - ``state_fault`` — one scheduler-state fault class for the
   state_chaos injector (core/state_chaos.py).
 
@@ -49,10 +55,14 @@ from kubernetesnetawarescheduler_tpu.core.state_chaos import (
 from kubernetesnetawarescheduler_tpu.k8s.types import Pod
 
 TRACE_FORMAT = "scenario-trace/v1"
-TRACE_VERSION = 1
+# v2 (r17): zone_down/zone_up + node_upgrade event kinds, elastic
+# gang-shape declarations on gang pods.  Readers accept 1..TRACE_VERSION
+# — a v1 trace replays unchanged (none of the new kinds appear in it).
+TRACE_VERSION = 2
 
 EVENT_KINDS = ("pod", "delete", "link_degrade", "link_repair",
-               "node_down", "node_up", "state_fault")
+               "node_down", "node_up", "zone_down", "zone_up",
+               "node_upgrade", "state_fault")
 
 POD_CLASSES = ("serving", "batch", "gang", "longrun")
 
@@ -102,6 +112,27 @@ class ScenarioSpec:
     # Node churn.
     node_churn_rate_per_s: float = 0.0
     node_down_duration_s: float = 20.0
+
+    # Zonal outage (v2): at ``zone_outage_at_s`` every node of
+    # ``zone_outage_zone`` goes down at once (one zone_down event),
+    # returning together after the duration.  Negative = never.
+    zone_outage_at_s: float = -1.0
+    zone_outage_zone: int = 0
+    zone_outage_duration_s: float = 45.0
+
+    # Rolling node upgrade (v2): starting at ``rolling_upgrade_at_s``,
+    # nodes drain in batches of ``rolling_upgrade_batch``, each held
+    # down ``rolling_upgrade_hold_s`` before the next batch starts.
+    # Negative = never.
+    rolling_upgrade_at_s: float = -1.0
+    rolling_upgrade_batch: int = 4
+    rolling_upgrade_hold_s: float = 10.0
+
+    # Fraction of gangs declaring an elastic shape family (v2):
+    # "size,size//2:0.5" — full shape preferred, half shape at 0.5
+    # priority (core/gang.parse_gang_shapes grammar).  0.0 = every
+    # gang rigid, exactly the v1 stream.
+    gang_shapes_fraction: float = 0.0
 
     # Scheduler-state faults (core/state_chaos.py classes).
     state_fault_rate_per_s: float = 0.0
@@ -192,6 +223,12 @@ def read_trace(path: str) -> tuple[dict[str, Any],
         fh.close()
         raise ValueError(
             f"not a {TRACE_FORMAT} trace (header {header!r})")
+    ver = header.get("version")
+    if not isinstance(ver, int) or not 1 <= ver <= TRACE_VERSION:
+        fh.close()
+        raise ValueError(
+            f"trace version {ver!r} outside the supported range "
+            f"1..{TRACE_VERSION}")
 
     def _events() -> Iterator[dict[str, Any]]:
         try:
@@ -207,6 +244,10 @@ def read_trace(path: str) -> tuple[dict[str, Any],
 def pod_from_event(ev: dict[str, Any],
                    scheduler_name: str = "netAwareScheduler") -> Pod:
     """Materialize one ``pod`` event as a schedulable Pod."""
+    from kubernetesnetawarescheduler_tpu.core.gang import (
+        parse_gang_shapes,
+    )
+
     p = ev["pod"]
     return Pod(
         name=p["name"],
@@ -218,6 +259,7 @@ def pod_from_event(ev: dict[str, Any],
         pod_group=p.get("pod_group", ""),
         gang_min_member=int(p.get("gang_min_member", 0)),
         priority=float(p.get("priority", 0.0)),
+        gang_shapes=parse_gang_shapes(p.get("gang_shapes", "")),
     )
 
 
@@ -263,7 +305,9 @@ def generate_trace(spec: ScenarioSpec, path: str,
         seq += 1
 
     stats = {"pods": 0, "events": 0, "gangs": 0, "deletes": 0,
-             "link_bursts": 0, "node_churn": 0, "state_faults": 0}
+             "link_bursts": 0, "node_churn": 0, "state_faults": 0,
+             "zone_outages": 0, "node_upgrades": 0,
+             "shaped_gangs": 0}
     # Recent alive pods per service, for peer edges (bounded; peers
     # may outlive their partners — the join skips unresolved peers).
     recent: dict[int, list[str]] = {}
@@ -325,10 +369,47 @@ def generate_trace(spec: ScenarioSpec, path: str,
                 fh.write(line + "\n")
                 stats["events"] += 1
 
+        zone_outage_fired = False
+        upgrade_fired = False
         t = 0.0
         while t < spec.duration_s:
             _drain_heap(t)
             tv = _round_t(t)
+            # --- scheduled mass events (v2, deterministic) ---------
+            if (spec.zone_outage_at_s >= 0.0 and not zone_outage_fired
+                    and t >= spec.zone_outage_at_s):
+                zone_outage_fired = True
+                z = spec.zone_outage_zone % max(1, spec.cluster.zones)
+                znodes = sorted(
+                    nm for (zz, _r), nms in racks_of.items()
+                    if zz == z for nm in nms)
+                up_t = _round_t(t + spec.zone_outage_duration_s)
+                _emit({"t": tv, "kind": "zone_down", "zone": z,
+                       "nodes": znodes})
+                _push(up_t, {"t": up_t, "kind": "zone_up", "zone": z,
+                             "nodes": znodes})
+                for nm in znodes:
+                    down_until[nm] = up_t
+                stats["zone_outages"] += 1
+            if (spec.rolling_upgrade_at_s >= 0.0 and not upgrade_fired
+                    and t >= spec.rolling_upgrade_at_s):
+                upgrade_fired = True
+                bsz = max(1, int(spec.rolling_upgrade_batch))
+                hold = max(spec.tick_s, spec.rolling_upgrade_hold_s)
+                for b, start in enumerate(range(0, n, bsz)):
+                    bt = _round_t(t + b * hold)
+                    up_t = _round_t(t + (b + 1) * hold)
+                    for i in range(start, min(start + bsz, n)):
+                        name = f"node-{i:04d}"
+                        obj = {"t": bt, "kind": "node_upgrade",
+                               "node": name}
+                        if bt <= tv:
+                            _emit(obj)
+                        else:
+                            _push(bt, obj)
+                        _push(up_t, {"t": up_t, "kind": "node_up",
+                                     "node": name})
+                        stats["node_upgrades"] += 1
             # --- fault/churn processes (Poisson per tick) ----------
             if spec.link_burst_rate_per_s > 0.0:
                 for _ in range(int(rng.poisson(
@@ -388,22 +469,35 @@ def generate_trace(spec: ScenarioSpec, path: str,
                         int(rng.integers(len(spec.gang_sizes)))])
                     group = f"gang-{gang_seq:06d}"
                     gang_seq += 1
+                    # Elastic shape family (v2): declared on every
+                    # member, identical string.  The 0-fraction guard
+                    # short-circuits the rng draw, so v1-equivalent
+                    # specs keep a byte-identical event stream.
+                    shapes = ""
+                    if (spec.gang_shapes_fraction > 0.0
+                            and size >= 2
+                            and rng.random()
+                            < spec.gang_shapes_fraction):
+                        shapes = f"{size},{max(1, size // 2)}:0.5"
+                        stats["shaped_gangs"] += 1
                     life = _round_t(t + _lifetime(mean))
                     names = []
                     for m in range(size):
                         name = f"{group}-w{m:03d}"
                         names.append(name)
+                        pod = {
+                            "name": name,
+                            "cpu": spec.gang_cpu,
+                            "mem": spec.gang_mem,
+                            "net_bw": spec.gang_netbw,
+                            "pod_group": group,
+                            "gang_min_member": size,
+                            "priority": 5.0,
+                        }
+                        if shapes:
+                            pod["gang_shapes"] = shapes
                         _emit({"t": tv, "kind": "pod",
-                               "pod_class": cls,
-                               "pod": {
-                                   "name": name,
-                                   "cpu": spec.gang_cpu,
-                                   "mem": spec.gang_mem,
-                                   "net_bw": spec.gang_netbw,
-                                   "pod_group": group,
-                                   "gang_min_member": size,
-                                   "priority": 5.0,
-                               }})
+                               "pod_class": cls, "pod": pod})
                         pod_seq += 1
                     for name in names:
                         _push(life, {"t": life, "kind": "delete",

@@ -110,6 +110,9 @@ class ReplayResult:
     link_repairs_applied: int = 0
     node_downs: int = 0
     node_ups: int = 0
+    zone_downs: int = 0
+    zone_ups: int = 0
+    node_upgrades: int = 0
     state_faults: dict[str, int] = dataclasses.field(
         default_factory=dict)
     cycle_ms: LogHistogram = dataclasses.field(
@@ -148,7 +151,8 @@ def _rss_bytes() -> int:
 
 def _build_loop(header: dict[str, Any], batch: int, method: str,
                 chaos: bool, queue_capacity: int,
-                score_weights: ScoreWeights | None = None
+                score_weights: ScoreWeights | None = None,
+                reshape: bool = False
                 ) -> tuple[SchedulerLoop, SchedulerConfig, FakeCluster,
                            list[Node], np.ndarray, np.ndarray]:
     """The serving stack for a trace header: cluster (optionally
@@ -173,6 +177,10 @@ def _build_loop(header: dict[str, Any], batch: int, method: str,
         weights=(REPLAY_WEIGHTS if score_weights is None
                  else score_weights),
         queue_capacity=queue_capacity,
+        # Built into the loop's cfg from construction: cfg is static
+        # to the jitted assigners, so flipping it on a live loop
+        # would recompile mid-replay.
+        enable_gang_reshaping=reshape,
     )
     loop = SchedulerLoop(cluster, cfg, method=method)
     loop.encoder.set_network(lat, bw)
@@ -188,6 +196,7 @@ def replay_trace(path: str, *,
                  drift: bool = True,
                  state_faults: bool = True,
                  rebalance: bool = True,
+                 reshape: bool = False,
                  quality: bool = True,
                  time_compression: float = 0.0,
                  compact: bool = True,
@@ -203,8 +212,11 @@ def replay_trace(path: str, *,
     Knobs mirror the subsystems they gate: ``chaos`` (control-plane
     proxy), ``drift`` (link bursts applied to the encoder's network),
     ``state_faults`` (state_chaos injection), ``rebalance`` (budgeted
-    descheduler at maintain cadence), ``quality`` (outcome observer +
-    harvest).  All off = the bit-identity degenerate mode.
+    descheduler at maintain cadence), ``reshape`` (elastic gang
+    reshaping — requires ``rebalance``; shape-aware placement plus
+    degrade-and-recover reshapes through the reshape ledger),
+    ``quality`` (outcome observer + harvest).  All off = the
+    bit-identity degenerate mode.
 
     ``collect_placements`` retains the full pod->node map (small
     traces / property tests only — it defeats the bounded-memory
@@ -221,7 +233,8 @@ def replay_trace(path: str, *,
     t_wall0 = time.perf_counter()
 
     loop, cfg, client, nodes, lat0, bw0 = _build_loop(
-        header, batch, method, chaos, queue_capacity, score_weights)
+        header, batch, method, chaos, queue_capacity, score_weights,
+        reshape=reshape and rebalance)
     inner = client.inner if hasattr(client, "inner") else client
     node_by_name = {nd.name: nd for nd in nodes}
     node_idx = {nd.name: i for i, nd in enumerate(nodes)}
@@ -244,6 +257,7 @@ def replay_trace(path: str, *,
             rebalance_max_moves_per_cycle=32,
             rebalance_evictions_per_hour=512.0,
             rebalance_move_timeout_s=300.0,
+            enable_gang_reshaping=bool(reshape),
         )
         rb = Rebalancer(rb_cfg, loop.encoder, loop.client)
         loop.rebalance = rb
@@ -481,12 +495,32 @@ def replay_trace(path: str, *,
                             degraded_now.discard(name)
                 _apply_network()
                 res.link_repairs_applied += 1
-        elif kind == "node_down":
+        elif kind in ("node_down", "node_upgrade"):
             nd = node_by_name.get(ev["node"])
             if nd is not None and ev["node"] in {
                     x.name for x in inner.list_nodes()}:
                 inner.delete_node(ev["node"])
-                res.node_downs += 1
+                if kind == "node_upgrade":
+                    res.node_upgrades += 1
+                else:
+                    res.node_downs += 1
+        elif kind in ("zone_down", "zone_up"):
+            alive = {x.name for x in inner.list_nodes()}
+            for name in ev.get("nodes", ()):
+                nd = node_by_name.get(name)
+                if nd is None:
+                    continue
+                if kind == "zone_down" and name in alive:
+                    inner.delete_node(name)
+                elif kind == "zone_up" and name not in alive:
+                    inner.add_node(nd)
+                    loop.encoder.update_metrics(
+                        nd.name, sample_metrics(metrics_rng),
+                        age_s=0.0)
+            if kind == "zone_down":
+                res.zone_downs += 1
+            else:
+                res.zone_ups += 1
         elif kind == "node_up":
             nd = node_by_name.get(ev["node"])
             if nd is not None and ev["node"] not in {

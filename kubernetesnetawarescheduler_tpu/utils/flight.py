@@ -129,6 +129,14 @@ class CycleSpan:
     # lint clean.
     scan_window_k: int | None = None
     retire_lag_cycles: int | None = None
+    # Elastic gang reshaping (ISSUE 19): gangs reshaped / reshape
+    # reverts since the previous committed span (per-span delta,
+    # rebalance_moves pattern — the reshape path runs at maintain
+    # cadence).  None on off-path spans (reshaping disabled, or no
+    # rebalancer attached) — pre-r17 spans and crash dumps deserialize
+    # unchanged and trace_check validates these only-when-present.
+    gang_reshapes: int | None = None
+    reshape_reverts: int | None = None
 
     def to_dict(self) -> dict[str, Any]:
         return {
@@ -163,6 +171,8 @@ class CycleSpan:
             "cluster_id": self.cluster_id,
             "scan_window_k": self.scan_window_k,
             "retire_lag_cycles": self.retire_lag_cycles,
+            "gang_reshapes": self.gang_reshapes,
+            "reshape_reverts": self.reshape_reverts,
         }
 
 

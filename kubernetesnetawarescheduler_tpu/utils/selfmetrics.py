@@ -661,6 +661,30 @@ def render_metrics(loop) -> str:
               float(rs["last_scan_candidates"]),
               "Improvement candidates surviving hysteresis at the "
               "last scan")
+        # Elastic gang reshaping (r17): one labeled counter family by
+        # outcome — a NEW family, no existing name renamed.  Emitted
+        # only when the rebalancer carries the reshape block (pre-r17
+        # scrape configs see an unchanged exposition otherwise).
+        resh = rs.get("reshape")
+        if isinstance(resh, dict) and resh.get("enabled"):
+            _register("netaware_gang_reshape_total")
+            lines.append("# HELP netaware_gang_reshape_total "
+                         "Elastic gang reshapes by outcome "
+                         "(committed = new realization bound; "
+                         "reverted = settled back / degraded; "
+                         "half_shaped MUST stay 0)")
+            lines.append("# TYPE netaware_gang_reshape_total counter")
+            for outcome, val in (
+                    ("committed", resh["reshapes_completed"]),
+                    ("reverted", resh["reshapes_reverted"]),
+                    ("half_shaped", resh["half_shaped_gangs"])):
+                lines.append(
+                    f'netaware_gang_reshape_total{{outcome='
+                    f'"{outcome}"}} {_fmt(float(val))}')
+            gauge("netaware_gang_reshapes_inflight",
+                  float(resh["reshapes_inflight"]),
+                  "Reshapes currently staged in the reshape ledger "
+                  "(crash-safe window)")
 
     # Learned scoring policy (r15, policy/): training volume, shadow
     # disagreement (the promotion runbook's first read — a promotion

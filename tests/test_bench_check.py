@@ -955,3 +955,81 @@ def test_multicycle_shape_validated_when_present():
     quiet["detail"]["north_star"]["p99_met"] = False
     fails = bench_check.check_doc("BENCH_r16.json", quiet)
     assert any("identity_ab" in f for f in fails), fails
+
+
+def _reshape_block(**overrides):
+    """A healthy r17 reshape block (bench.py _persisted_reshape
+    shape, fed from the --suite reshape leg's summary)."""
+    block = {
+        "enabled": True,
+        "half_shaped_gangs": 0,
+        "evictions_per_pod_hour": 0.5,
+        "budget_per_pod_hour": 8.0,
+        "recovered_frac": 0.83,
+        "reshapes_total": 4,
+        "no_outage_reshapes": 0,
+        "source": "suite_reshape",
+    }
+    block.update(overrides)
+    return block
+
+
+def _r17_doc(**detail_overrides):
+    detail = {"trace_provenance": _trace_prov(),
+              "winner_fusion": _winner_fusion(),
+              "rounds_max": 4,
+              "integrity": _integrity(),
+              "quality": _quality(),
+              "rebalance": _rebalance(),
+              "scenario": _scenario(),
+              "policy": _policy(),
+              "fleet": _fleet(),
+              "multicycle": _multicycle(),
+              "bind_split": _bind_split(),
+              "reshape": _reshape_block()}
+    detail.update(detail_overrides)
+    return _headline(detail=detail)
+
+
+def test_reshape_block_required_from_round17():
+    # r17+ doc claiming gang/rebalance results without the block:
+    # fails (the elastic degrade-and-recover evidence is missing).
+    doc = _r16_doc()
+    fails = bench_check.check_doc("BENCH_r17.json", doc)
+    assert any("reshape block" in f for f in fails), fails
+    # Same doc with the block: clean.
+    assert bench_check.check_doc("BENCH_r17.json", _r17_doc()) == []
+    # Committed r16 history predates the subsystem: exempt.
+    assert bench_check.check_doc("BENCH_r16.json", doc) == []
+    # An r17+ doc with no gang/rebalance claim may omit the block
+    # (not claiming the p99 bar either, so rules 8-16 stay quiet).
+    quiet = _headline()
+    quiet["detail"]["score_p99_ms"] = 87.44
+    quiet["detail"]["north_star"]["p99_met"] = False
+    assert bench_check.check_doc("BENCH_r17.json", quiet) == []
+
+
+def test_reshape_shape_validated_when_present():
+    # A leg that ran with reshaping off is no evidence at all.
+    fails = bench_check.check_doc("BENCH_r17.json", _r17_doc(
+        reshape=_reshape_block(enabled=False)))
+    assert any("enabled is false" in f for f in fails), fails
+    # A half-shaped gang breaks fully-old-or-fully-new — fatal
+    # wherever the block appears, including pre-r17 filenames.
+    fails = bench_check.check_doc("BENCH_r16.json", _r16_doc(
+        reshape=_reshape_block(half_shaped_gangs=1)))
+    assert any("half_shaped_gangs=1" in f for f in fails), fails
+    # Recovery bought with churn over the eviction budget.
+    fails = bench_check.check_doc("BENCH_r17.json", _r17_doc(
+        reshape=_reshape_block(evictions_per_pod_hour=9.0)))
+    assert any("unbudgeted churn" in f for f in fails), fails
+    # Missing accounting keys.
+    bad = _reshape_block()
+    del bad["budget_per_pod_hour"]
+    fails = bench_check.check_doc("BENCH_r17.json", _r17_doc(
+        reshape=bad))
+    assert any("reshape missing" in f for f in fails), fails
+    # Not an object at all.
+    fails = bench_check.check_doc("BENCH_r17.json", _r17_doc(
+        reshape=["not", "a", "dict"]))
+    assert any("reshape is not an object" in f for f in fails), fails

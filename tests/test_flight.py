@@ -477,3 +477,50 @@ def test_loop_multicycle_spans_carry_window_shape():
     lags = sorted({s.retire_lag_cycles for s in mc})
     assert lags[0] == 0 and lags[-1] <= 3
     assert trace_check.check_trace(loop.flight.to_chrome_trace()) == []
+
+
+def test_pre_r17_spans_default_reshape_none():
+    """Spans constructed without the r17 reshape fields (solo loops,
+    old crash dumps) default both to None and serialize them honestly
+    — the only-when-present contract trace_check enforces."""
+    span = CycleSpan(
+        cycle_id=1, path="serial", t_wall=0.0, t_mono=0.0,
+        dur_s=0.001, n_pods=2, pod_uids=("a", "b"), queue_depth=0,
+        phases=())
+    assert span.gang_reshapes is None
+    assert span.reshape_reverts is None
+    d = span.to_dict()
+    assert d["gang_reshapes"] is None
+    assert d["reshape_reverts"] is None
+
+
+def test_cycle_spans_carry_reshape_deltas_when_live():
+    """With reshaping enabled and a rebalancer attached, spans carry
+    integer per-span reshape deltas (0 on quiet cycles, never None);
+    a loop without the feature carries None.  Both lint clean."""
+    from kubernetesnetawarescheduler_tpu.core.rebalance import (
+        Rebalancer,
+    )
+    import dataclasses as _dc
+
+    cfg = _cfg(enable_gang_reshaping=True)
+    cluster, loop = _make_loop(cfg, seed=5)
+    rb_cfg = _dc.replace(cfg, enable_rebalance=True,
+                         rebalance_interval_s=1e-4,
+                         rebalance_max_moves_per_cycle=0)
+    loop.rebalance = Rebalancer(rb_cfg, loop.encoder, loop.client)
+    _drain(cluster, loop, num_pods=6, seed=5)
+    spans = [s for s in loop.flight.spans() if s.n_pods > 0]
+    assert spans
+    assert all(s.gang_reshapes == 0 and s.reshape_reverts == 0
+               for s in spans)
+    trace = loop.flight.to_chrome_trace()
+    assert trace_check.check_trace(trace) == []
+
+    solo_cluster, solo = _make_loop(_cfg(), seed=6)
+    _drain(solo_cluster, solo, num_pods=4, seed=6)
+    solo_spans = [s for s in solo.flight.spans() if s.n_pods > 0]
+    assert solo_spans
+    assert all(s.gang_reshapes is None and s.reshape_reverts is None
+               for s in solo_spans)
+    assert trace_check.check_trace(solo.flight.to_chrome_trace()) == []

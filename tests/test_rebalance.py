@@ -605,7 +605,7 @@ def test_summary_key_set_is_stable():
         "skipped_budget", "skipped_disruption", "triggers_link",
         "triggers_regret", "triggers_drain", "last_scan_pods",
         "last_scan_candidates", "last_scan_moves",
-        "evictions_window", "budget_per_hour"}
+        "evictions_window", "budget_per_hour", "reshape"}
     assert s["enabled"] is True
     loop.stop_bind_worker()
 

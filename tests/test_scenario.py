@@ -379,3 +379,96 @@ def test_pod_waves_contract():
     # batch-full split inside bucket 0, boundary splits after.
     assert names == [["a0", "a1", "a2", "a3"], ["a4"],
                      ["b0", "b1", "b2"], ["c0"]]
+
+
+# ---------------------------------------------------------------------------
+# v2 mass events + elastic shape declarations (r17).
+# ---------------------------------------------------------------------------
+
+def test_zone_outage_events_paired_and_deterministic(tmp_path):
+    """One zone_down takes every node of the zone at once; the paired
+    zone_up returns exactly the same set after the configured hold.
+    Two generations are byte-identical (the events are scheduled, not
+    sampled)."""
+    spec = _small_spec(zone_outage_at_s=5.0, zone_outage_zone=1,
+                       zone_outage_duration_s=8.0)
+    p1, p2 = str(tmp_path / "a.jsonl"), str(tmp_path / "b.jsonl")
+    s1 = generate_trace(spec, p1)
+    generate_trace(spec, p2)
+    assert open(p1, "rb").read() == open(p2, "rb").read()
+    assert s1["zone_outages"] == 1
+    _, events = read_trace(p1)
+    downs = ups = None
+    for ev in events:
+        if ev["kind"] == "zone_down":
+            assert downs is None     # exactly one
+            downs = ev
+        elif ev["kind"] == "zone_up":
+            ups = ev
+    assert downs is not None and ups is not None
+    assert downs["zone"] == 1 and ups["zone"] == 1
+    assert downs["nodes"] == ups["nodes"]
+    assert len(downs["nodes"]) > 0
+    assert ups["t"] == pytest.approx(downs["t"] + 8.0)
+    # Every named node really is in the zone (i % zones).
+    zones = spec.cluster.zones
+    for nm in downs["nodes"]:
+        assert int(nm.split("-")[1]) % zones == 1
+
+
+def test_rolling_upgrade_drains_fleet_in_batches(tmp_path):
+    """node_upgrade covers every node exactly once, in batches that
+    share a timestamp, each paired with a later node_up."""
+    spec = _small_spec(duration_s=40.0, rolling_upgrade_at_s=2.0,
+                       rolling_upgrade_batch=8,
+                       rolling_upgrade_hold_s=3.0)
+    path = str(tmp_path / "t.jsonl")
+    stats = generate_trace(spec, path)
+    n = spec.cluster.num_nodes
+    assert stats["node_upgrades"] == n
+    _, events = read_trace(path)
+    upgraded: dict[str, float] = {}
+    up_after: dict[str, float] = {}
+    for ev in events:
+        if ev["kind"] == "node_upgrade":
+            assert ev["node"] not in upgraded
+            upgraded[ev["node"]] = ev["t"]
+        elif ev["kind"] == "node_up" and ev["node"] in upgraded:
+            up_after[ev["node"]] = ev["t"]
+    assert len(upgraded) == n
+    # Batches of 8 share a start time -> n/8 distinct timestamps.
+    assert len(set(upgraded.values())) == n // 8
+    for nm, t_up in upgraded.items():
+        assert up_after[nm] > t_up
+
+
+def test_gang_shapes_fraction_zero_is_v1_stream(tmp_path):
+    """gang_shapes_fraction=0 emits no shape annotations at all (the
+    v1 stream, bit-identical rigid gangs); 1.0 annotates every gang
+    pod with a family pod_from_event parses."""
+    rigid = _small_spec(gang_fraction=0.3)
+    path_r = str(tmp_path / "rigid.jsonl")
+    generate_trace(rigid, path_r)
+    _, events = read_trace(path_r)
+    assert all("gang_shapes" not in ev["pod"] for ev in events
+               if ev["kind"] == "pod")
+
+    elastic = _small_spec(gang_fraction=0.3,
+                          gang_shapes_fraction=1.0)
+    path_e = str(tmp_path / "elastic.jsonl")
+    stats = generate_trace(elastic, path_e)
+    assert stats["gangs"] > 0
+    _, events = read_trace(path_e)
+    shaped = 0
+    for ev in events:
+        if ev["kind"] != "pod":
+            continue
+        pod = pod_from_event(ev, "netAwareScheduler")
+        if ev["pod"].get("gang_shapes"):
+            shaped += 1
+            assert len(pod.gang_shapes) == 2
+            counts = [c for c, _p in pod.gang_shapes]
+            assert counts[0] == pod.gang_min_member
+        else:
+            assert pod.gang_shapes == ()
+    assert shaped > 0

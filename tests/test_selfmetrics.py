@@ -307,3 +307,35 @@ def test_retire_lag_family_absent_when_multicycle_idle():
     body = render_metrics(loop)
     assert "netaware_multicycle_retire_lag" not in body
     assert "# TYPE netaware_bind_inflight gauge" in body
+
+
+def test_gang_reshape_family_exposed_only_when_enabled():
+    """r17: the outcome-labeled reshape counter family renders only
+    when the rebalancer carries a live reshape block (pre-r17 scrape
+    configs see an unchanged exposition otherwise)."""
+    import dataclasses
+
+    from kubernetesnetawarescheduler_tpu.core.rebalance import (
+        Rebalancer,
+    )
+
+    loop = _run_loop(num_pods=8, seed=21)
+    rb_cfg = dataclasses.replace(
+        CFG, enable_rebalance=True, enable_gang_reshaping=True,
+        rebalance_interval_s=1e-4, rebalance_max_moves_per_cycle=0)
+    loop.rebalance = Rebalancer(rb_cfg, loop.encoder, loop.client)
+    body = render_metrics(loop)
+    parsed = parse_prometheus_text(body)
+    fam = parsed["netaware_gang_reshape_total"]
+    outcomes = {dict(labels).get("outcome") for labels in fam}
+    assert outcomes == {"committed", "reverted", "half_shaped"}
+    assert all(v == 0.0 for v in fam.values())
+    assert "netaware_gang_reshapes_inflight" in parsed
+
+    # Reshaping off: the family is absent entirely.
+    plain = _run_loop(num_pods=8, seed=22)
+    plain.rebalance = Rebalancer(
+        dataclasses.replace(CFG, enable_rebalance=True,
+                            rebalance_interval_s=1e-4),
+        plain.encoder, plain.client)
+    assert "netaware_gang_reshape_total" not in render_metrics(plain)

@@ -222,6 +222,93 @@ def test_main_entrypoint_exit_codes(tmp_path, capsys):
     assert state_audit.main([path]) == 1
 
 
+def test_reshape_ledger_clean_mid_reshape(tmp_path):
+    """r17: a checkpoint written inside the reshape window carries the
+    staged reshape and the (transitional) realization; both audit
+    clean — settlement can roll this back to fully-the-old-shape."""
+    enc = _encoder()
+    p0 = Pod(name="g0-w0", requests={"cpu": 1.0}, pod_group="g0")
+    p1 = Pod(name="g0-w1", requests={"cpu": 1.0}, pod_group="g0")
+    enc.commit(p0, "n0")
+    enc.commit(p1, "n1")
+    enc.note_gang_realization("default/g0", 2, 4)
+    enc.note_reshape_inflight(
+        "default/g0", 2, 4,
+        [[p0.uid, "default", "g0-w0", "n0", ""],
+         [p1.uid, "default", "g0-w1", "n1", ""]])
+    path = _checkpoint(tmp_path, enc)
+    report = state_audit.run_audit(path)
+    assert report["ok"]
+    assert report["reshapes"]["reshapes_inflight"] == 1
+    assert report["reshapes"]["members_staged"] == 2
+    assert report["reshapes"]["realizations"] == 1
+
+
+def test_reshape_realization_must_match_committed_members(tmp_path):
+    """A settled gang whose recorded realization claims more members
+    than the usage ledger holds is the half-shaped state restore must
+    never reconstruct — fatal."""
+    enc = _encoder()
+    p0 = Pod(name="g1-w0", requests={"cpu": 1.0}, pod_group="g1",
+             gang_min_member=4)
+    enc.commit(p0, "n0")
+    enc.note_gang_realization("default/g1", 3, 4)  # ledger holds 1
+    path = _checkpoint(tmp_path, enc)
+    report = state_audit.run_audit(path)
+    assert not report["ok"]
+    assert any("usage ledger holds 1" in e
+               for e in report["reshapes"]["errors"])
+
+
+def test_member_staged_in_two_reshapes_is_fatal(tmp_path):
+    """One member uid staged under two gang keys can settle to two
+    different shapes — exactly the hybrid the ledger exists to
+    forbid."""
+    enc = _encoder()
+    p0 = Pod(name="g2-w0", requests={"cpu": 1.0}, pod_group="g2")
+    enc.commit(p0, "n0")
+    enc.note_reshape_inflight(
+        "default/g2", 2, 1, [[p0.uid, "default", "g2-w0", "n0", ""]])
+    enc.note_reshape_inflight(
+        "default/g3", 2, 1, [[p0.uid, "default", "g2-w0", "n0", ""]])
+    path = _checkpoint(tmp_path, enc)
+    report = state_audit.run_audit(path)
+    assert not report["ok"]
+    assert any("two concurrent reshapes" in e
+               for e in report["reshapes"]["errors"])
+
+
+def test_member_shared_with_migration_ledger_is_fatal(tmp_path):
+    """A pod staged in a reshape AND a single-pod migration settles
+    through two ledgers — it can land anywhere."""
+    enc = _encoder()
+    p0 = Pod(name="g4-w0", requests={"cpu": 1.0}, pod_group="g4")
+    enc.commit(p0, "n1")
+    enc.note_migration_inflight(
+        "mv9-x", [[p0.uid, "default", "g4-w0", "n0", "n1"]])
+    enc.note_reshape_inflight(
+        "default/g4", 2, 1, [[p0.uid, "default", "g4-w0", "n1", ""]])
+    path = _checkpoint(tmp_path, enc)
+    report = state_audit.run_audit(path)
+    assert not report["ok"]
+    assert any("also staged in a migration" in e
+               for e in report["reshapes"]["errors"])
+
+
+def test_reshape_malformed_entries_flagged(tmp_path):
+    enc = _encoder()
+    enc.note_reshape_inflight(
+        "default/g5", 2, 1, [["u-1", "default", "g5-w0"]])
+    enc.note_gang_realization("default/g6", 5, 4)  # chosen > declared
+    path = _checkpoint(tmp_path, enc)
+    report = state_audit.run_audit(path)
+    assert not report["ok"]
+    errors = report["reshapes"]["errors"]
+    assert any("malformed entry" in e for e in errors)
+    assert any("more members than the gang declares" in e
+               for e in errors)
+
+
 def test_update_manifest_restamps_legitimate_edit(tmp_path):
     """The tooling path for in-place edits: after update_manifest the
     audit passes again (this is what tests that hand-edit meta.json

@@ -878,6 +878,68 @@ def check_doc(path: str, doc: dict) -> list[str]:
                         f"{name}: bind_split.inflight_peak {peak} "
                         f"exceeds max_inflight {cap} — the bound did "
                         "not hold")
+
+    # Rule 17 — elastic-reshaping provenance (round 17+): an artifact
+    # claiming gang or rebalance results must prove the elastic
+    # degrade-and-recover path left no gang stranded between shapes —
+    # a ``reshape`` block from the ``bench.py --suite reshape`` leg
+    # with ZERO half-shaped gangs (the reshape ledger's one invariant:
+    # a gang neither fully-old-shape nor fully-new-shape is an
+    # atomicity hole whatever the filename says) and disruption
+    # (evictions/pod/hour) inside the configured budget.  Round-gated
+    # by filename like Rules 8-16; the block's shape — and the
+    # half-shaped/budget invariants — are fatal wherever the block
+    # appears.
+    if not grandfathered:
+        rnd = _round_of(name)
+        resh = detail.get("reshape")
+        claims_gang = any(
+            isinstance(detail.get(k), dict)
+            for k in ("rebalance", "gang", "scenario"))
+        if resh is None:
+            if claims_gang and rnd is not None and rnd >= 17:
+                fails.append(
+                    f"{name}: gang/rebalance results claimed without "
+                    "a reshape block (round 17+ requires the --suite "
+                    "reshape leg's zero-half-shaped + "
+                    "disruption-budget evidence behind any gang "
+                    "claim)")
+        elif not isinstance(resh, dict):
+            fails.append(f"{name}: reshape is not an object")
+        else:
+            required = {"enabled", "half_shaped_gangs",
+                        "evictions_per_pod_hour",
+                        "budget_per_pod_hour"}
+            missing = required - set(resh)
+            if missing:
+                fails.append(f"{name}: reshape missing "
+                             f"{sorted(missing)}")
+            else:
+                try:
+                    half = int(resh["half_shaped_gangs"])
+                    disr = float(resh["evictions_per_pod_hour"])
+                    budget = float(resh["budget_per_pod_hour"])
+                except (TypeError, ValueError):
+                    fails.append(f"{name}: reshape not numeric")
+                else:
+                    if not resh.get("enabled"):
+                        fails.append(
+                            f"{name}: reshape.enabled is false — the "
+                            "leg ran with reshaping off, which is no "
+                            "evidence at all")
+                    if half != 0:
+                        fails.append(
+                            f"{name}: reshape.half_shaped_gangs="
+                            f"{half} — a gang was left between "
+                            "shapes; the reshape ledger's "
+                            "fully-old-or-fully-new contract is "
+                            "broken")
+                    if disr > budget:
+                        fails.append(
+                            f"{name}: reshape disruption {disr} over "
+                            f"the budget {budget} evictions/pod/hour "
+                            "— recovery was bought with unbudgeted "
+                            "churn")
     return fails
 
 

@@ -39,6 +39,12 @@ scheduler.  Four independent checks:
   ``--decisions``, each member's ``from_node`` must match the pod's
   last logged decision (the placement it was evicted FROM) or its
   ``to_node`` (the move already re-decided).
+* **reshape ledger** (r17) — every ``reshapes_inflight`` entry is
+  well-formed, no gang member is staged in two concurrent reshapes
+  (or shared with a staged migration), and for every settled gang the
+  recorded realization in ``gang_realizations`` matches the committed
+  member count — a realization the usage ledger contradicts is a
+  half-shaped gang no restore must reconstruct.
 
 Exit 0 when every requested check passes, 1 otherwise; ``--json``
 emits the full report for machines.  Exercised by tier-1 via
@@ -239,6 +245,109 @@ def audit_migrations(path: str,
     }
 
 
+def audit_reshapes(path: str) -> dict:
+    """Reshape-ledger invariants (r17): a checkpoint written mid-reshape
+    carries the staged reshape in ``meta["reshapes_inflight"]`` and the
+    committed realization of every shaped gang in
+    ``meta["gang_realizations"]``.  Restore settles a staged reshape to
+    fully-the-old-shape, so the ledger must describe a state that
+    settlement can actually produce:
+
+    * every staged entry is well-formed (``[old_count, new_count,
+      [[uid, ns, name, from, to], ...]]``) with sane counts;
+    * no member uid is staged in two reshapes, nor shared with a
+      staged migration — one pod settling through two ledgers can
+      land anywhere (a gang in two concurrent reshapes is exactly
+      this, and it is fatal);
+    * for every gang NOT mid-reshape, the recorded realization's
+      chosen count equals the number of committed members carrying
+      that gang key — a realization claiming 8 members while the
+      ledger holds 4 is the half-shaped state restore must never
+      reconstruct."""
+    from kubernetesnetawarescheduler_tpu.core.checkpoint import (
+        resolve_checkpoint_dir,
+    )
+
+    base = resolve_checkpoint_dir(path)
+    with open(os.path.join(base, "meta.json"), encoding="utf-8") as fh:
+        meta = json.load(fh)
+    inflight = meta.get("reshapes_inflight", {})
+    realizations = meta.get("gang_realizations", {})
+    migrations = meta.get("migrations_inflight", {})
+    # committed rec: [..., labels, gang_key] — gang_key rides at the
+    # tail since r8; records without it simply don't join any gang.
+    members_by_gang: dict[str, int] = {}
+    for rec in meta.get("committed", {}).values():
+        gk = rec[13] if len(rec) > 13 else ""
+        if gk:
+            members_by_gang[gk] = members_by_gang.get(gk, 0) + 1
+    errors: list[str] = []
+    seen_uids: dict[str, str] = {}
+    mig_uids = {entry[0]
+                for entries in migrations.values()
+                for entry in entries
+                if isinstance(entry, (list, tuple)) and entry}
+    staged_members = 0
+    for key, staged in sorted(inflight.items()):
+        if (not isinstance(staged, (list, tuple)) or len(staged) != 3
+                or not isinstance(staged[2], (list, tuple))):
+            errors.append(
+                f"{key}: malformed reshape {staged!r} (want "
+                "[old_count, new_count, [entries...]])")
+            continue
+        old_count, new_count, entries = staged
+        if (not isinstance(old_count, int) or old_count < 0
+                or not isinstance(new_count, int) or new_count < 0):
+            errors.append(f"{key}: counts {old_count!r}->{new_count!r} "
+                          "are not non-negative integers")
+        for entry in entries:
+            staged_members += 1
+            if not isinstance(entry, (list, tuple)) or len(entry) != 5:
+                errors.append(f"{key}: malformed entry {entry!r} "
+                              "(want [uid, ns, name, from, to])")
+                continue
+            uid = entry[0]
+            if uid in seen_uids:
+                errors.append(
+                    f"{key}: member {uid} also staged in reshape "
+                    f"{seen_uids[uid]} — one gang in two concurrent "
+                    "reshapes can never settle to a single shape")
+            seen_uids[uid] = key
+            if uid in mig_uids:
+                errors.append(
+                    f"{key}: member {uid} is also staged in a "
+                    "migration — two ledgers settling one pod can "
+                    "land it anywhere")
+    for key, val in sorted(realizations.items()):
+        if (not isinstance(val, (list, tuple)) or len(val) < 2
+                or not all(isinstance(x, int) and x >= 0
+                           for x in val[:2])):
+            errors.append(f"{key}: malformed realization {val!r} "
+                          "(want [chosen_count, declared_count])")
+            continue
+        chosen, declared = int(val[0]), int(val[1])
+        if chosen > declared:
+            errors.append(f"{key}: realization {chosen}/{declared} "
+                          "claims more members than the gang declares")
+        if key in inflight:
+            # Mid-reshape the realization is transitional by design;
+            # settlement rewrites or drops it.
+            continue
+        have = members_by_gang.get(key, 0)
+        if have != chosen:
+            errors.append(
+                f"{key}: realization says {chosen} members committed "
+                f"but the usage ledger holds {have} — a half-shaped "
+                "gang a restore must never reconstruct")
+    return {
+        "ok": not errors,
+        "reshapes_inflight": len(inflight),
+        "members_staged": staged_members,
+        "realizations": len(realizations),
+        "errors": errors,
+    }
+
+
 def audit_policy(path: str) -> dict:
     """Learned-policy checkpoint invariants (r14): ``policy.npz`` is
     optional (absent pre-r14 or with ``enable_learned_score`` off —
@@ -349,6 +458,7 @@ def run_audit(path: str, decisions: str | None = None) -> dict:
         report["staging"] = audit_staging(path)
         report["roundtrip"] = audit_roundtrip(path)
         report["migrations"] = audit_migrations(path, decisions)
+        report["reshapes"] = audit_reshapes(path)
         report["policy"] = audit_policy(path)
         if decisions is not None:
             report["decisions"] = audit_decisions(path, decisions)
@@ -375,7 +485,7 @@ def main(argv: list[str] | None = None) -> int:
         print(json.dumps(report, indent=2))
     else:
         for key in ("manifest", "staging", "roundtrip", "migrations",
-                    "policy", "decisions"):
+                    "reshapes", "policy", "decisions"):
             section = report.get(key)
             if section is None:
                 continue

@@ -39,6 +39,10 @@ Checks:
     (``scan_window_k``/``retire_lag_cycles``) must be non-negative
     integers; null means per-cycle dispatch and pre-r16 dumps carry
     neither, so old traces lint clean
+  * elastic gang reshaping — cycle spans carrying the r17 args
+    (``gang_reshapes``/``reshape_reverts``) must be non-negative
+    integers; null means reshaping was off-path and pre-r17 dumps
+    carry neither, so old traces lint clean
 
 A cycle's phase set is NOT prescribed: the r9 fused single-dispatch
 step collapses score+assign+commit into one ``score_assign`` phase
@@ -123,7 +127,8 @@ def check_trace(doc: Any) -> list[str]:
             for k in ("rounds", "donated", "donation_skipped",
                       "outcome_ring_depth", "rebalance_moves",
                       "rebalance_reverts", "trace_offset",
-                      "scan_window_k", "retire_lag_cycles"):
+                      "scan_window_k", "retire_lag_cycles",
+                      "gang_reshapes", "reshape_reverts"):
                 v = args.get(k)
                 if v is not None and (not isinstance(v, int)
                                       or v < 0):
